@@ -1,0 +1,101 @@
+#!/usr/bin/env python
+"""Flagship benchmark: distributed KMeans fit throughput on the local device(s).
+
+Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
+
+Protocol follows the reference harness (reference python/benchmark/benchmark/base.py:
+232-285: timed fit with quality score). The metric is Lloyd-iteration row throughput —
+rows * iterations / wall-clock — on a dataset sized to the available memory, which is
+the quantity the north-star target tracks (BASELINE.json: rows/sec/chip).
+
+`vs_baseline`: the reference publishes no machine-readable numbers (BASELINE.md), so
+the ratio is computed against a locally-recorded baseline in BENCH_BASELINE.json when
+present (first run writes it), else 1.0.
+"""
+
+import functools
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from spark_rapids_ml_tpu.ops.kmeans import lloyd_fit
+    from spark_rapids_ml_tpu.parallel.mesh import get_mesh, shard_array
+
+    platform = jax.devices()[0].platform
+    on_tpu = platform == "tpu"
+
+    # size to platform: ~2 GiB of f32 on TPU, small on CPU
+    if on_tpu:
+        n_rows, n_cols, k, iters = 4_000_000, 128, 20, 10
+    else:
+        n_rows, n_cols, k, iters = 100_000, 64, 8, 10
+
+    # synthesize blobs ON DEVICE: host→device transfer is the enemy (and the metric
+    # tracks compute, not ingest — the reference times cuML fit after cudf ingest too)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = get_mesh()
+    rowsh = NamedSharding(mesh, P("data", None))
+
+    @functools.partial(jax.jit, out_shardings=(rowsh, None))
+    def make_data(key):
+        k1, k2, k3 = jax.random.split(key, 3)
+        centers_true = jax.random.normal(k1, (k, n_cols), jnp.float32) * 5.0
+        assign = jax.random.randint(k2, (n_rows,), 0, k)
+        X = centers_true[assign] + jax.random.normal(k3, (n_rows, n_cols), jnp.float32)
+        init = centers_true + 0.5 * jax.random.normal(k1, (k, n_cols), jnp.float32)
+        return X, init
+
+    Xd, init = make_data(jax.random.PRNGKey(0))
+    Xd.block_until_ready()
+    w = shard_array(np.ones((n_rows,), dtype=np.float32), mesh)
+
+    # compile warmup (excluded from timing)
+    centers, inertia, n_iter = lloyd_fit(Xd, w, init, 0.0, iters)
+    centers.block_until_ready()
+
+    t0 = time.perf_counter()
+    centers, inertia, n_iter = lloyd_fit(Xd, w, init, 0.0, iters)
+    centers.block_until_ready()
+    fit_time = time.perf_counter() - t0
+
+    rows_per_sec = n_rows * int(n_iter) / fit_time
+    n_chips = jax.device_count()
+    value = rows_per_sec / n_chips
+
+    baseline_path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_BASELINE.json")
+    vs_baseline = 1.0
+    try:
+        if os.path.exists(baseline_path):
+            with open(baseline_path) as f:
+                base = json.load(f)
+            if base.get("platform") == platform and base.get("value", 0) > 0:
+                vs_baseline = value / base["value"]
+        else:
+            with open(baseline_path, "w") as f:
+                json.dump({"platform": platform, "value": value, "unit": "rows*iters/sec/chip"}, f)
+    except OSError:
+        pass
+
+    print(
+        json.dumps(
+            {
+                "metric": "kmeans_lloyd_rows_per_sec_per_chip",
+                "value": round(value, 1),
+                "unit": "rows*iters/sec/chip",
+                "vs_baseline": round(vs_baseline, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

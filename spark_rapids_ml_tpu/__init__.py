@@ -1,0 +1,13 @@
+#
+# spark_rapids_ml_tpu — a TPU-native distributed ML library with the capabilities of
+# NVIDIA/spark-rapids-ml: pyspark.ml-style estimators whose fit/transform run as SPMD
+# JAX/XLA programs over a TPU device mesh (psum/all_gather over ICI replacing
+# NCCL/UCX). See SURVEY.md at the repo root for the structural map of the reference
+# this build follows.
+#
+
+__version__ = "0.1.0"
+
+# Top-level modules mirror the reference's public layout
+# (reference python/src/spark_rapids_ml/__init__.py): feature, clustering,
+# classification, regression, knn, umap, tuning, pipeline, metrics.

@@ -1,0 +1,10 @@
+# Public API module mirroring the reference's `spark_rapids_ml.clustering`
+# (reference python/src/spark_rapids_ml/clustering.py: KMeans + DBSCAN).
+from .models.clustering import KMeans, KMeansModel
+
+try:  # DBSCAN arrives with models/dbscan.py
+    from .models.dbscan import DBSCAN, DBSCANModel  # noqa: F401
+
+    __all__ = ["KMeans", "KMeansModel", "DBSCAN", "DBSCANModel"]
+except ImportError:  # pragma: no cover
+    __all__ = ["KMeans", "KMeansModel"]

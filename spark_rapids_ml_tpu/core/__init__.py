@@ -1,0 +1,11 @@
+from .params import Param, Params, TypeConverters
+from .backend_params import _TpuClass, _TpuParams
+from .estimator import (
+    FitInputs,
+    _TpuCaller,
+    _TpuEstimator,
+    _TpuEstimatorSupervised,
+    _TpuModel,
+    _TpuModelWithColumns,
+    _TpuModelWithPredictionCol,
+)

@@ -1,0 +1,306 @@
+#
+# Param-mapping layer between the pyspark.ml-style API params and the TPU backend's
+# kernel params (L4 of the layer map, SURVEY.md §1).
+#
+# Structural equivalent of the reference's _CumlClass/_CumlParams
+# (reference python/src/spark_rapids_ml/params.py:162-487): each estimator declares
+#   * _param_mapping():        Spark param name  -> backend kernel param name (or None when
+#                              unsupported / '' when silently ignored)
+#   * _param_value_mapping():  per-backend-param value translation functions
+#   * _get_tpu_params_default(): defaults of the backend kernel params
+#   * _fallback_class():       the CPU twin used for fallback — sklearn here, where the
+#                              reference uses the pyspark.ml class (params.py:248-257);
+#                              pyspark itself is optional in this environment.
+# and `_set_params(**kwargs)` keeps the Spark-side Params and the backend dict in sync
+# exactly like reference params.py:430-487.
+#
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Mapping, Optional, Union
+
+from .params import Param, Params, TypeConverters
+from ..utils import get_logger
+
+P = "unsupported"
+
+
+class HasEnableSparseDataOptim(Params):
+    """Mirror of reference params.py:45-67: tri-state sparse-input optimization flag."""
+
+    enable_sparse_data_optim: Param[bool] = Param(
+        "undefined",
+        "enable_sparse_data_optim",
+        "if True, convert input to CSR before fit; if False, densify; if unset, "
+        "infer from the input data.",
+        TypeConverters.toBoolean,
+    )
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._setDefault(enable_sparse_data_optim=None)
+
+
+class HasFeaturesCols(Params):
+    """Mirror of reference params.py:69-89: multi-column numeric feature input."""
+
+    featuresCols: Param[List[str]] = Param(
+        "undefined",
+        "featuresCols",
+        "features column names for multi-column input.",
+        TypeConverters.toListString,
+    )
+
+    def getFeaturesCols(self) -> List[str]:
+        return self.getOrDefault(self.featuresCols)
+
+    def setFeaturesCols(self, value: List[str]) -> "HasFeaturesCols":
+        return self._set(featuresCols=value)  # type: ignore[return-value]
+
+
+class HasIDCol(Params):
+    """Mirror of reference params.py:91-142: row-id column for algorithms that must
+    join results back to input rows (kNN, DBSCAN)."""
+
+    idCol: Param[str] = Param(
+        "undefined",
+        "idCol",
+        "id column name; used to identify rows in results that are returned "
+        "out of input order.",
+        TypeConverters.toString,
+    )
+
+    def getIdCol(self) -> str:
+        return self.getOrDefault(self.idCol)
+
+    def setIdCol(self, value: str) -> "HasIDCol":
+        return self._set(idCol=value)  # type: ignore[return-value]
+
+    def _ensureIdCol(self, df: Any) -> Any:
+        """Add a monotonically-increasing id column if idCol is not set
+        (reference params.py:110-129)."""
+        from .dataset import ensure_id_col
+
+        id_col_name = self.getOrDefault(self.idCol) if self.isDefined(self.idCol) else None
+        if id_col_name is None:
+            id_col_name = "unique_id_" + self.uid
+            self._set(idCol=id_col_name)
+            return ensure_id_col(df, id_col_name)
+        return ensure_id_col(df, id_col_name)
+
+
+class HasVerboseParam(Params):
+    """Mirror of reference params.py:144-159: verbosity plumbed to backend logging."""
+
+    verbose: Param[Union[int, bool]] = Param(
+        "undefined",
+        "verbose",
+        "logging verbosity for the backend compute kernels.",
+        TypeConverters.identity,
+    )
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._setDefault(verbose=False)
+
+
+class DictTypeConverters(TypeConverters):
+    """Mirror of reference params.py:710-719: dict-typed Params."""
+
+    @staticmethod
+    def _toDict(value: Any) -> Dict[str, Any]:
+        if isinstance(value, dict):
+            return value
+        raise TypeError("Could not convert %s to dict" % value)
+
+
+class _TpuClass:
+    """Declares the Spark-param ⇄ backend-param correspondence for one estimator.
+
+    Structural equivalent of _CumlClass (reference params.py:162-257)."""
+
+    @classmethod
+    def _param_mapping(cls) -> Mapping[str, Optional[str]]:
+        """Mapping of pyspark.ml param name -> backend kernel param name.
+
+        None  => unsupported: raise (or CPU-fallback) if user sets a non-default value.
+        ''    => accepted but ignored by the backend (Spark-API-only param).
+        """
+        return {}
+
+    @classmethod
+    def _param_value_mapping(cls) -> Dict[str, Callable[[Any], Union[None, Any]]]:
+        """Mapping of backend param name -> function translating Spark value to backend
+        value; return None from the function to indicate an invalid value."""
+        return {}
+
+    @classmethod
+    def _get_tpu_params_default(cls) -> Dict[str, Any]:
+        """Default values of the backend kernel params for this algorithm."""
+        return {}
+
+    @classmethod
+    def _fallback_class(cls) -> Optional[type]:
+        """The CPU estimator class used for fallback (sklearn; the reference uses the
+        pyspark twin, params.py:248-257). None => no fallback available."""
+        return None
+
+
+class _TpuParams(HasVerboseParam):
+    """Keeps a dict of backend params in sync with the pyspark.ml-style Params.
+
+    Structural equivalent of _CumlParams (reference params.py:260-707). Holds:
+      * _tpu_params: the kernel param dict handed to ops/ fit functions
+      * num_workers: number of mesh data-parallel workers (devices); reference semantics
+        at params.py:337-371 (there: 1 worker == 1 GPU; here: 1 worker == 1 TPU device
+        in the jax mesh, inferred from the runtime when unset)
+      * float32_inputs: cast inputs to float32 (reference params.py:286-299); float32 is
+        additionally the TPU-preferred dtype (MXU native).
+    """
+
+    _tpu_params: Dict[str, Any]
+    _num_workers: Optional[int] = None
+    _float32_inputs: bool = True
+    _fallback_enabled: bool = True
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._tpu_params = {}
+
+    @property
+    def tpu_params(self) -> Dict[str, Any]:
+        """Backend kernel params for this estimator (reference `cuml_params`,
+        params.py:330-335)."""
+        return self._tpu_params
+
+    @property
+    def num_workers(self) -> int:
+        """Number of TPU devices (data-parallel workers) used by fit
+        (reference params.py:337-371)."""
+        if self._num_workers is not None:
+            return self._num_workers
+        return self._infer_num_workers()
+
+    @num_workers.setter
+    def num_workers(self, value: int) -> None:
+        self._num_workers = value
+
+    def _infer_num_workers(self) -> int:
+        """Infer the worker count from the active runtime/mesh
+        (reference params.py:556-588 infers from the Spark cluster)."""
+        from ..parallel.mesh import default_num_workers
+
+        return default_num_workers()
+
+    @property
+    def float32_inputs(self) -> bool:
+        return self._float32_inputs
+
+    def initialize_tpu_params(self) -> None:
+        """Set the backend param dict to defaults, then sync Spark-side defaults in
+        (reference _CumlParams._initialize... via _set_params)."""
+        assert isinstance(self, _TpuClass)
+        self._tpu_params = dict(self._get_tpu_params_default())
+        # push spark param defaults into tpu_params
+        for spark_name, backend_name in self._param_mapping().items():
+            if not backend_name:
+                continue
+            if self.hasParam(spark_name) and self.hasDefault(spark_name):
+                self._set_tpu_value(backend_name, self.getOrDefault(spark_name))
+
+    def _set_params(self, **kwargs: Any) -> "_TpuParams":
+        """Set params from either Spark names or backend names, keeping both sides in
+        sync (reference params.py:430-487)."""
+        assert isinstance(self, _TpuClass)
+        mapping = self._param_mapping()
+        for k, v in kwargs.items():
+            if k == "num_workers":
+                self._num_workers = int(v)
+                continue
+            if k == "float32_inputs":
+                self._float32_inputs = bool(v)
+                continue
+            if self.hasParam(k):
+                # spark-side name
+                self._set(**{k: v})
+                backend_name = mapping.get(k, "")
+                if backend_name is None:
+                    self._handle_unsupported(k, v)
+                elif backend_name:
+                    self._set_tpu_value(backend_name, v)
+            elif k in self._tpu_params or k in self._get_tpu_params_default():
+                # backend-side name; also sync any spark alias
+                self._set_tpu_value(k, v, translate=False)
+                for spark_name, backend_name in mapping.items():
+                    if backend_name == k and self.hasParam(spark_name):
+                        self._set(**{spark_name: v})
+            else:
+                raise ValueError(f"Unsupported param '{k}'.")
+        return self
+
+    def _handle_unsupported(self, name: str, value: Any) -> None:
+        """User set a Spark param the backend does not support. If the set value equals
+        the default it is harmless; otherwise flag for fallback at fit time
+        (reference core.py:1283-1297 / params.py:690-707)."""
+        param = self.getParam(name)
+        if param in self._defaultParamMap and self._defaultParamMap[param] == value:
+            return
+        logger = get_logger(self.__class__)
+        logger.warning(
+            "Param '%s' is not supported by the TPU backend; fit() will fall back to the "
+            "CPU implementation if fallback is enabled.",
+            name,
+        )
+        self._fallback_requested_params = getattr(self, "_fallback_requested_params", set())
+        self._fallback_requested_params.add(name)
+
+    def _use_cpu_fallback(self) -> bool:
+        """Whether fit should fall back to the CPU twin (reference params.py:690-707)."""
+        return bool(getattr(self, "_fallback_requested_params", set())) and self._fallback_enabled
+
+    def _set_tpu_value(self, backend_name: str, value: Any, translate: bool = True) -> None:
+        assert isinstance(self, _TpuClass)
+        if translate:
+            value_mapping = self._param_value_mapping()
+            if backend_name in value_mapping:
+                mapped = value_mapping[backend_name](value)
+                if mapped is None:
+                    raise ValueError(
+                        f"Value {value!r} is not supported for backend param '{backend_name}'."
+                    )
+                value = mapped
+        self._tpu_params[backend_name] = value
+
+    def _copyValues(self, to: Params, extra: Optional[Dict[Param, Any]] = None) -> Params:
+        to = super()._copyValues(to, extra)
+        if isinstance(to, _TpuParams):
+            to._tpu_params = dict(self._tpu_params)
+            to._num_workers = self._num_workers
+            to._float32_inputs = self._float32_inputs
+            # re-sync any params that came through `extra` (CrossValidator param maps)
+            if extra and isinstance(to, _TpuClass):
+                mapping = to._param_mapping()
+                for param, value in extra.items():
+                    backend_name = mapping.get(param.name, "")
+                    if backend_name:
+                        to._set_tpu_value(backend_name, value)
+        return to
+
+    def _get_input_columns(self) -> tuple:
+        """Resolve the (single_col, multi_cols) input spec from whichever of
+        inputCol/inputCols/featuresCol/featuresCols is set
+        (reference params.py:489-530)."""
+        input_col: Optional[str] = None
+        input_cols: Optional[List[str]] = None
+
+        if self.hasParam("inputCols") and self.isDefined("inputCols"):
+            input_cols = self.getOrDefault("inputCols")
+        elif self.hasParam("inputCol") and self.isDefined("inputCol"):
+            input_col = self.getOrDefault("inputCol")
+        elif self.hasParam("featuresCols") and self.isDefined("featuresCols"):
+            input_cols = self.getOrDefault("featuresCols")
+        elif self.hasParam("featuresCol") and self.isDefined("featuresCol"):
+            input_col = self.getOrDefault("featuresCol")
+        else:
+            raise ValueError("Please set inputCol(s) or featuresCol(s)")
+        return input_col, input_cols

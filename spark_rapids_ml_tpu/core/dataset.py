@@ -1,0 +1,200 @@
+#
+# Input dataset abstraction (L3 of the layer map, SURVEY.md §1).
+#
+# The reference's data plane is a Spark DataFrame: `_pre_process_data` selects columns,
+# casts to float32, unwraps VectorUDT / CSR (reference core.py:463-562,183-265), and
+# `mapInPandas` streams Arrow batches into the worker python process (core.py:1005-1011).
+#
+# The TPU framework is Spark-optional: the same estimators accept
+#   * pandas.DataFrame  — feature column of per-row lists/arrays, or multiple scalar
+#                         columns (the reference's three feature layouts,
+#                         tests/utils.py:81-147)
+#   * numpy.ndarray     — a (n, d) design matrix used directly as features
+#   * scipy.sparse csr  — sparse design matrix (reference sparse path core.py:220-265)
+#   * pyspark DataFrame — when pyspark is installed (adapter converts via toPandas /
+#                         mapInPandas in the plugin layer)
+# and transform() returns the same flavor it was given with output columns appended.
+#
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple, Union
+
+import numpy as np
+
+try:  # scipy is available in this image; keep soft anyway
+    import scipy.sparse as sp
+
+    _SCIPY = True
+except ImportError:  # pragma: no cover
+    _SCIPY = False
+
+
+def _is_spark_df(dataset: Any) -> bool:
+    mod = type(dataset).__module__
+    return mod.startswith("pyspark.sql")
+
+
+def _is_pandas_df(dataset: Any) -> bool:
+    import pandas as pd
+
+    return isinstance(dataset, pd.DataFrame)
+
+
+def _is_sparse(x: Any) -> bool:
+    return _SCIPY and sp.issparse(x)
+
+
+@dataclass
+class FeatureData:
+    """Extracted, host-side training data: the product of `_pre_process_data`."""
+
+    features: Union[np.ndarray, "sp.csr_matrix"]  # (n, d)
+    label: Optional[np.ndarray] = None  # (n,)
+    weight: Optional[np.ndarray] = None  # (n,)
+    row_id: Optional[np.ndarray] = None  # (n,) int64
+    input_kind: str = "numpy"  # numpy | pandas | spark | sparse
+    feature_layout: str = "array"  # array | multi_cols | vector | sparse
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+    @property
+    def n_rows(self) -> int:
+        return self.features.shape[0]
+
+    @property
+    def n_cols(self) -> int:
+        return self.features.shape[1]
+
+    @property
+    def is_sparse(self) -> bool:
+        return _is_sparse(self.features)
+
+
+def _stack_feature_column(col: Any) -> np.ndarray:
+    """A pandas column whose cells are lists/arrays -> (n, d) float array
+    (reference's ArrayType/VectorUDT unwrap, core.py:496-527)."""
+    first = col.iloc[0]
+    if np.isscalar(first):
+        return col.to_numpy().reshape(-1, 1)
+    return np.stack([np.asarray(v) for v in col.to_numpy()])
+
+
+def extract_feature_data(
+    dataset: Any,
+    input_col: Optional[str] = None,
+    input_cols: Optional[List[str]] = None,
+    label_col: Optional[str] = None,
+    weight_col: Optional[str] = None,
+    id_col: Optional[str] = None,
+    float32: bool = True,
+) -> FeatureData:
+    """Structural equivalent of _CumlCaller._pre_process_data (reference core.py:463-562):
+    column selection + dtype casting + layout normalization, producing host arrays ready
+    to shard onto the mesh."""
+    dtype = np.float32 if float32 else np.float64
+
+    if _is_spark_df(dataset):
+        pdf = dataset.toPandas()
+        fd = extract_feature_data(
+            pdf, input_col, input_cols, label_col, weight_col, id_col, float32
+        )
+        fd.input_kind = "spark"
+        return fd
+
+    if _is_sparse(dataset):
+        X = dataset.tocsr().astype(dtype)
+        return FeatureData(features=X, input_kind="sparse", feature_layout="sparse")
+
+    if isinstance(dataset, np.ndarray):
+        X = np.atleast_2d(np.asarray(dataset, dtype=dtype))
+        return FeatureData(features=X, input_kind="numpy", feature_layout="array")
+
+    if isinstance(dataset, (list, tuple)) and dataset and isinstance(dataset[0], np.ndarray):
+        # pre-partitioned arrays (one per worker shard)
+        X = np.concatenate([np.asarray(a, dtype=dtype) for a in dataset], axis=0)
+        return FeatureData(features=np.atleast_2d(X), input_kind="numpy", feature_layout="array")
+
+    if _is_pandas_df(dataset):
+        if len(dataset) == 0:
+            raise RuntimeError(
+                "Fit/transform input is empty (the reference raises on empty partitions "
+                "too, core.py:959-962)."
+            )
+        label = weight = row_id = None
+        if input_cols:
+            X = dataset[list(input_cols)].to_numpy(dtype=dtype)
+            layout = "multi_cols"
+        elif input_col:
+            cell = dataset[input_col].iloc[0]
+            if _is_sparse(cell):
+                X = sp.vstack(list(dataset[input_col].to_numpy())).tocsr().astype(dtype)
+                layout = "sparse"
+            else:
+                X = _stack_feature_column(dataset[input_col]).astype(dtype)
+                layout = "array"
+        else:
+            raise ValueError("input_col or input_cols must be provided for DataFrame input")
+        for col_name, kind in ((label_col, "label"), (weight_col, "weight"), (id_col, "id")):
+            if col_name is not None and col_name not in dataset.columns:
+                raise ValueError(
+                    f"{kind} column '{col_name}' not found in dataset columns "
+                    f"{list(dataset.columns)}"
+                )
+        if label_col is not None:
+            label = dataset[label_col].to_numpy(dtype=dtype)
+        if weight_col is not None:
+            weight = dataset[weight_col].to_numpy(dtype=dtype)
+        if id_col is not None:
+            row_id = dataset[id_col].to_numpy(dtype=np.int64)
+        return FeatureData(
+            features=X,
+            label=label,
+            weight=weight,
+            row_id=row_id,
+            input_kind="pandas",
+            feature_layout=layout,
+        )
+
+    raise TypeError(f"Unsupported dataset type: {type(dataset)}")
+
+
+def ensure_id_col(dataset: Any, id_col_name: str) -> Any:
+    """Add a monotonically-increasing id column when absent
+    (reference params.py:110-129 `_ensureIdCol`)."""
+    if _is_pandas_df(dataset):
+        if id_col_name not in dataset.columns:
+            dataset = dataset.copy()
+            dataset[id_col_name] = np.arange(len(dataset), dtype=np.int64)
+        return dataset
+    return dataset
+
+
+def append_output_columns(
+    dataset: Any,
+    outputs: Dict[str, np.ndarray],
+    input_col_to_drop: Optional[str] = None,
+) -> Any:
+    """Append transform() outputs to the input, preserving its flavor
+    (the reference appends Spark columns via withColumn, core.py:1846-1899)."""
+    import pandas as pd
+
+    def _colify(v: np.ndarray) -> Any:
+        if v.ndim == 1:
+            return v
+        return list(v)  # one array cell per row, like a Spark array column
+
+    if _is_spark_df(dataset):
+        # keep the Spark flavor: compute on pandas, hand the result back to the session
+        # (the plugin layer will stream this per-partition via mapInPandas instead)
+        pdf = append_output_columns(dataset.toPandas(), outputs, input_col_to_drop)
+        return dataset.sparkSession.createDataFrame(pdf)
+
+    if _is_pandas_df(dataset):
+        out = dataset.copy()
+        for name, v in outputs.items():
+            out[name] = _colify(v)
+        return out
+
+    # numpy / sparse input: outputs as a DataFrame (no original columns to carry)
+    return pd.DataFrame({name: _colify(v) for name, v in outputs.items()})

@@ -1,0 +1,394 @@
+#
+# Estimator/Model framework (L5 of the layer map, SURVEY.md §1) — the structural
+# equivalent of _CumlCaller/_CumlEstimator/_CumlModel
+# (reference python/src/spark_rapids_ml/core.py:435-1967).
+#
+# Orchestration differences from the reference, by design (TPU-first):
+#   * The reference fans out one barrier task per GPU and runs an opaque cuML MG kernel
+#     per rank with NCCL inside (core.py:1005-1011). Here fit is ONE SPMD program: host
+#     arrays are padded + sharded onto a jax Mesh (parallel/partition.py) and a single
+#     jit-compiled fit function runs across all devices, XLA inserting the collectives.
+#   * `_get_tpu_fit_func` returns a host-callable that consumes FitInputs (sharded
+#     device arrays + PartitionDescriptor + param dict) and returns a dict of model
+#     attributes — the analog of the model "rows" the reference collects
+#     (core.py:996-1003, 1244-1267).
+#   * CPU fallback targets sklearn twins instead of pyspark.ml twins
+#     (reference core.py:1283-1297), since pyspark is optional here.
+#
+
+from __future__ import annotations
+
+import threading
+from abc import abstractmethod
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from ..parallel.mesh import get_mesh, replicate_array, shard_array
+from ..parallel.partition import PartitionDescriptor, pad_rows
+from ..utils import get_logger
+from .backend_params import _TpuClass, _TpuParams
+from .dataset import FeatureData, append_output_columns, extract_feature_data  # noqa: F401
+from .params import Param, ParamMap, Params
+from .persistence import ParamsReader, ParamsWriter, load_metadata, save_instance
+
+
+@dataclass
+class FitInputs:
+    """Everything a fit kernel sees; the analog of the (inputs, params) pair handed to
+    `_get_cuml_fit_func` closures (reference core.py:604-635)."""
+
+    features: Any  # jax.Array (padded_m, n), rows sharded over the data axis
+    row_weight: Any  # jax.Array (padded_m,), 1.0 real / 0.0 padding, times sample weight
+    label: Optional[Any] = None  # jax.Array (padded_m,)
+    desc: Optional[PartitionDescriptor] = None
+    mesh: Any = None
+    params: Dict[str, Any] = field(default_factory=dict)
+    dtype: Any = np.float32
+    # host-side originals for algorithms that need them (trees, sparse paths)
+    host_features: Optional[np.ndarray] = None
+    host_label: Optional[np.ndarray] = None
+    host_row_weight: Optional[np.ndarray] = None
+    row_id: Optional[np.ndarray] = None
+
+
+# type of the value returned by _get_tpu_fit_func
+FitFunc = Callable[[FitInputs], Dict[str, Any]]
+
+
+class _TpuCaller(_TpuClass, _TpuParams):
+    """Shared data-prep + fan-out machinery (reference _CumlCaller, core.py:435-1065)."""
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.logger = get_logger(self.__class__)
+
+    # ---- subclass hooks (contract mirrors reference core.py:450-635) ----
+
+    @abstractmethod
+    def _out_schema(self) -> List[str]:
+        """Names of the model attributes produced by fit (the reference's model-row
+        schema, core.py:450)."""
+
+    @abstractmethod
+    def _get_tpu_fit_func(
+        self, extra_params: Optional[List[Dict[str, Any]]] = None
+    ) -> FitFunc:
+        """Return the fit kernel closure (reference core.py:604-635)."""
+
+    def _fit_array_order(self) -> str:
+        """Row-major by default (reference core.py:1015)."""
+        return "C"
+
+    def _use_label(self) -> bool:
+        return False
+
+    def _use_sample_weight(self) -> bool:
+        return self.hasParam("weightCol") and self.isDefined("weightCol")
+
+    def _repartition_needed(self) -> bool:
+        return True
+
+    # ---- data prep + execution ----
+
+    def _pre_process_data(self, dataset: Any) -> FeatureData:
+        input_col, input_cols = self._get_input_columns()
+        label_col = (
+            self.getOrDefault("labelCol")
+            if self._use_label() and self.hasParam("labelCol")
+            else None
+        )
+        weight_col = (
+            self.getOrDefault("weightCol")
+            if self._use_sample_weight()
+            else None
+        )
+        id_col = (
+            self.getOrDefault("idCol")
+            if self.hasParam("idCol") and self.isDefined("idCol")
+            else None
+        )
+        return extract_feature_data(
+            dataset,
+            input_col=input_col,
+            input_cols=input_cols,
+            label_col=label_col,
+            weight_col=weight_col,
+            id_col=id_col,
+            float32=self._float32_inputs,
+        )
+
+    def _build_fit_inputs(self, fd: FeatureData) -> FitInputs:
+        num_workers = self.num_workers
+        mesh = get_mesh(num_workers)
+
+        X = np.asarray(fd.features.todense()) if fd.is_sparse else fd.features
+        X = np.asarray(X, order=self._fit_array_order())  # type: ignore[arg-type]
+        Xp, pad_weight, (label_p, sw_p) = pad_rows(X, num_workers, fd.label, fd.weight)
+        row_weight = pad_weight if sw_p is None else pad_weight * sw_p
+
+        # real-row counts per rank under the actual contiguous equal-shard layout:
+        # rank r owns padded rows [r*s, (r+1)*s); rows >= n_rows are padding
+        shard = Xp.shape[0] // num_workers
+        rank_rows = [
+            max(0, min(fd.n_rows - r * shard, shard)) for r in range(num_workers)
+        ]
+        desc = PartitionDescriptor.build(
+            rank_rows,
+            fd.n_cols,
+            nnz=-1,
+            padded_m=Xp.shape[0],
+        )
+
+        return FitInputs(
+            features=shard_array(Xp, mesh),
+            row_weight=shard_array(row_weight, mesh),
+            label=shard_array(label_p, mesh) if label_p is not None else None,
+            desc=desc,
+            mesh=mesh,
+            params=dict(self._tpu_params),
+            dtype=np.float32 if self._float32_inputs else np.float64,
+            host_features=X,
+            host_label=fd.label,
+            host_row_weight=fd.weight,
+            row_id=fd.row_id,
+        )
+
+    def _call_tpu_fit_func(
+        self, dataset: Any, extra_params: Optional[List[Dict[str, Any]]] = None
+    ) -> List[Dict[str, Any]]:
+        """Run the fit kernel over the mesh and return model-attribute dicts, one per
+        fitted model (reference _call_cuml_fit_func, core.py:742-1011)."""
+        fd = self._pre_process_data(dataset)
+        if fd.n_rows == 0:
+            raise RuntimeError(
+                "Fit input is empty. An empty partition would hang the reference's "
+                "barrier stage (core.py:959-962); here it is a direct error."
+            )
+        inputs = self._build_fit_inputs(fd)
+        fit_func = self._get_tpu_fit_func(extra_params)
+        result = fit_func(inputs)
+        if isinstance(result, list):
+            return result
+        return [result]
+
+
+class _TpuEstimator(_TpuCaller):
+    """Abstract estimator (reference _CumlEstimator, core.py:1067-1354)."""
+
+    @abstractmethod
+    def _create_pyspark_model(self, attrs: Dict[str, Any]) -> "_TpuModel":
+        """Build the model object from fit attributes (reference core.py:1084)."""
+
+    def _enable_fit_multiple_in_single_pass(self) -> bool:
+        """Whether fitMultiple can run every param map in one data pass
+        (reference core.py:1172)."""
+        return False
+
+    def fit(self, dataset: Any, params: Optional[Union[ParamMap, List[ParamMap]]] = None) -> Any:
+        if params is None:
+            return self._fit(dataset)
+        if isinstance(params, (list, tuple)):
+            models: List[Optional[_TpuModel]] = [None] * len(params)
+            for index, model in self.fitMultiple(dataset, list(params)):
+                models[index] = model
+            return models
+        if isinstance(params, dict):
+            return self.copy(params)._fit(dataset)
+        raise TypeError(f"params must be a param map or list of maps, got {type(params)}")
+
+    def fitMultiple(
+        self, dataset: Any, paramMaps: List[ParamMap]
+    ) -> Iterator[Tuple[int, "_TpuModel"]]:
+        """Fit for each param map; in single-pass mode all models come from one sweep
+        over the (already device-resident) data (reference core.py:1177-1228)."""
+        if self._enable_fit_multiple_in_single_pass():
+            estimator = self.copy()
+            extra = []
+            for m in paramMaps:
+                est = estimator.copy(m)
+                extra.append(dict(est._tpu_params))
+            models = estimator._fit_internal(dataset, extra)
+            return _FitMultipleIterator(lambda i: models[i], len(paramMaps))
+        else:
+            def fit_single(index: int) -> "_TpuModel":
+                return self.copy(paramMaps[index])._fit(dataset)
+
+            return _FitMultipleIterator(fit_single, len(paramMaps))
+
+    def _fit_internal(
+        self, dataset: Any, extra_params: Optional[List[Dict[str, Any]]]
+    ) -> List["_TpuModel"]:
+        attr_rows = self._call_tpu_fit_func(dataset, extra_params)
+        models = []
+        for attrs in attr_rows:
+            model = self._create_pyspark_model(attrs)
+            model._num_workers = self._num_workers
+            model._float32_inputs = self._float32_inputs
+            self._copyValues(model)
+            models.append(model)
+        return models
+
+    def _fit(self, dataset: Any) -> "_TpuModel":
+        if self._use_cpu_fallback():
+            return self._fallback_fit(dataset)
+        return self._fit_internal(dataset, None)[0]
+
+    def _fallback_fit(self, dataset: Any) -> "_TpuModel":
+        """CPU fallback via the sklearn twin (the reference falls back to pyspark.ml,
+        core.py:1283-1297). Subclasses implement `_fit_fallback_model` to run the twin
+        and translate its fitted attributes into this framework's model."""
+        twin = self._fallback_class()
+        reasons = getattr(self, "_fallback_requested_params", set())
+        if twin is None:
+            raise NotImplementedError(
+                f"{self.__class__.__name__} has unsupported params {reasons} "
+                f"and no CPU fallback class."
+            )
+        self.logger.warning(
+            "Falling back to CPU %s.%s for unsupported params %s "
+            "(reference falls back to pyspark.ml, core.py:1283-1297).",
+            twin.__module__,
+            twin.__name__,
+            reasons,
+        )
+        fd = self._pre_process_data(dataset)
+        attrs = self._fit_fallback_model(twin, fd)
+        model = self._create_pyspark_model(attrs)
+        model._num_workers = self._num_workers
+        model._float32_inputs = self._float32_inputs
+        self._copyValues(model)
+        return model
+
+    def _fit_fallback_model(self, twin: type, fd: FeatureData) -> Dict[str, Any]:
+        """Fit the CPU twin on host data and return this estimator's model-attribute
+        dict. Subclasses with a _fallback_class must override."""
+        raise NotImplementedError(
+            f"{self.__class__.__name__} does not implement the CPU fallback translation."
+        )
+
+    # ---- persistence (reference core.py:268-307) ----
+
+    def write(self) -> ParamsWriter:
+        return ParamsWriter(self)
+
+    def save(self, path: str) -> None:
+        self.write().save(path)
+
+    @classmethod
+    def read(cls) -> ParamsReader:
+        return ParamsReader(cls)
+
+    @classmethod
+    def load(cls, path: str) -> Any:
+        return cls.read().load(path)
+
+
+class _FitMultipleIterator:
+    """Thread-safe iterator over (index, model) (reference core.py:1022-1064)."""
+
+    def __init__(self, fitSingleModel: Callable[[int], "_TpuModel"], numModels: int):
+        self.fitSingleModel = fitSingleModel
+        self.numModels = numModels
+        self.counter = 0
+        self.lock = threading.Lock()
+
+    def __iter__(self) -> "_FitMultipleIterator":
+        return self
+
+    def __next__(self) -> Tuple[int, "_TpuModel"]:
+        with self.lock:
+            index = self.counter
+            if index >= self.numModels:
+                raise StopIteration("No models remaining.")
+            self.counter += 1
+        return index, self.fitSingleModel(index)
+
+    next = __next__
+
+
+class _TpuModel(_TpuClass, _TpuParams):
+    """Abstract fitted model (reference _CumlModel, core.py:1356-1754).
+
+    Holds the fit-produced attribute dict; transform() extracts features, runs the
+    jitted predict kernel batch-wise, and appends output columns preserving the input
+    dataset flavor."""
+
+    def __init__(self, **model_attributes: Any) -> None:
+        super().__init__()
+        self._model_attributes: Dict[str, Any] = model_attributes
+        self.logger = get_logger(self.__class__)
+
+    def get_model_attributes(self) -> Dict[str, Any]:
+        return self._model_attributes
+
+    @classmethod
+    def _from_row(cls, attrs: Dict[str, Any]) -> "_TpuModel":
+        """Rebuild from an attribute dict (reference core.py:1389-1396)."""
+        return cls(**attrs)
+
+    # ---- transform hooks ----
+
+    @abstractmethod
+    def _transform_arrays(self, X: np.ndarray) -> Dict[str, np.ndarray]:
+        """Map a feature block to named output arrays (the reference's
+        _get_cuml_transform_func closure pair, core.py:1398-1428)."""
+
+    def _input_col_for_transform(self) -> Tuple[Optional[str], Optional[List[str]]]:
+        return self._get_input_columns()
+
+    def transform(self, dataset: Any, params: Optional[ParamMap] = None) -> Any:
+        if params:
+            return self.copy(params).transform(dataset)
+        input_col, input_cols = self._input_col_for_transform()
+        fd = extract_feature_data(
+            dataset,
+            input_col=input_col,
+            input_cols=input_cols,
+            float32=self._float32_inputs,
+        )
+        X = np.asarray(fd.features.todense()) if fd.is_sparse else fd.features
+        outputs = self._transform_arrays(X)
+        return append_output_columns(dataset, outputs)
+
+    def _supportsTransformEvaluate(self) -> bool:
+        """Whether transform+evaluate can run in one pass for CrossValidator
+        (reference core.py:1306)."""
+        return False
+
+    # ---- persistence (reference core.py:310-355) ----
+
+    def write(self) -> ParamsWriter:
+        return ParamsWriter(self)
+
+    def save(self, path: str) -> None:
+        self.write().save(path)
+
+    @classmethod
+    def read(cls) -> ParamsReader:
+        return ParamsReader(cls)
+
+    @classmethod
+    def load(cls, path: str) -> Any:
+        return cls.read().load(path)
+
+
+class _TpuEstimatorSupervised(_TpuEstimator):
+    """Supervised estimator: extracts the label column too
+    (reference _CumlEstimatorSupervised, core.py:1314-1354)."""
+
+    def _use_label(self) -> bool:
+        return True
+
+
+class _TpuModelWithColumns(_TpuModel):
+    """Model whose transform appends columns (reference _CumlModelWithColumns,
+    core.py:1756-1955) — the behavior is already the _TpuModel default."""
+
+
+class _TpuModelWithPredictionCol(_TpuModelWithColumns):
+    """Model with a predictionCol output (reference core.py:1957-1967)."""
+
+    def _out_schema(self) -> List[str]:
+        return [self.getOrDefault("predictionCol")]

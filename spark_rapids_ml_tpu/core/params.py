@@ -1,0 +1,606 @@
+#
+# pyspark.ml-compatible Param system, implemented standalone.
+#
+# The reference library (NVIDIA/spark-rapids-ml) inherits its Param machinery from
+# pyspark.ml.param (Param, Params, TypeConverters) and mixes in the shared param traits
+# (HasInputCol, HasFeaturesCol, ...). This framework must present the identical user-facing
+# surface — `PCA(k=3)`, `est.setK(3)`, `est.getOrDefault(est.k)`, `est.copy(extra)`,
+# `est.explainParams()` — without requiring pyspark to be installed. When pyspark IS
+# installed the plugin layer can interpose over pyspark.ml directly; here we provide a
+# faithful re-implementation of the subset the estimator framework needs.
+#
+# Behavioral parity notes (vs pyspark 3.5 pyspark/ml/param/__init__.py):
+#   * Params are discovered as class attributes of type Param, copied per-instance so
+#     `param.parent == instance.uid`.
+#   * `_set` applies the type converter and raises on conversion failure.
+#   * `copy(extra)` produces a deep param-map copy like pyspark's.
+#   * `extractParamMap` merges defaults then user-set values.
+#
+
+from __future__ import annotations
+
+import copy as _copy
+import threading
+import uuid
+from typing import Any, Callable, Dict, Generic, List, Optional, TypeVar, Union
+
+__all__ = [
+    "Param",
+    "Params",
+    "TypeConverters",
+    "ParamMap",
+]
+
+T = TypeVar("T")
+
+
+class Param(Generic[T]):
+    """A param with self-contained documentation, mirroring pyspark.ml.param.Param."""
+
+    def __init__(
+        self,
+        parent: Union["Params", str],
+        name: str,
+        doc: str,
+        typeConverter: Optional[Callable[[Any], T]] = None,
+    ):
+        self.parent = parent.uid if isinstance(parent, Params) else parent
+        self.name = str(name)
+        self.doc = str(doc)
+        self.typeConverter = TypeConverters.identity if typeConverter is None else typeConverter
+
+    def _copy_new_parent(self, parent: "Params") -> "Param":
+        """Copy the current param to a new parent, must be a dummy param."""
+        if self.parent == "undefined":
+            param = _copy.copy(self)
+            param.parent = parent.uid
+            return param
+        raise ValueError("Cannot copy from non-dummy parent %s." % self.parent)
+
+    def __str__(self) -> str:
+        return str(self.parent) + "__" + self.name
+
+    def __repr__(self) -> str:
+        return "Param(parent=%r, name=%r, doc=%r)" % (self.parent, self.name, self.doc)
+
+    def __hash__(self) -> int:
+        return hash(str(self))
+
+    def __eq__(self, other: Any) -> bool:
+        if isinstance(other, Param):
+            return self.parent == other.parent and self.name == other.name
+        return False
+
+
+ParamMap = Dict[Param, Any]
+
+
+class TypeConverters:
+    """Factory methods for common type conversion functions for `Param.typeConverter`.
+
+    Mirrors pyspark.ml.param.TypeConverters semantics.
+    """
+
+    @staticmethod
+    def identity(value: Any) -> Any:
+        return value
+
+    @staticmethod
+    def _is_numeric(value: Any) -> bool:
+        return isinstance(value, (int, float)) and not isinstance(value, bool)
+
+    @staticmethod
+    def _can_convert_to_list(value: Any) -> bool:
+        import numpy as np
+
+        return isinstance(value, (list, tuple, range, np.ndarray))
+
+    @staticmethod
+    def toList(value: Any) -> List:
+        if TypeConverters._can_convert_to_list(value):
+            return list(value)
+        raise TypeError("Could not convert %s to list" % value)
+
+    @staticmethod
+    def toListFloat(value: Any) -> List[float]:
+        value = TypeConverters.toList(value)
+        if all(map(TypeConverters._is_numeric, value)):
+            return [float(v) for v in value]
+        raise TypeError("Could not convert %s to list of floats" % value)
+
+    @staticmethod
+    def toListInt(value: Any) -> List[int]:
+        value = TypeConverters.toList(value)
+        if all(map(TypeConverters._is_numeric, value)):
+            return [int(v) for v in value]
+        raise TypeError("Could not convert %s to list of ints" % value)
+
+    @staticmethod
+    def toListString(value: Any) -> List[str]:
+        value = TypeConverters.toList(value)
+        return [TypeConverters.toString(v) for v in value]
+
+    @staticmethod
+    def toVector(value: Any) -> List[float]:
+        # no pyspark VectorUDT here; a plain float list is the TPU-side vector type
+        return TypeConverters.toListFloat(value)
+
+    @staticmethod
+    def toFloat(value: Any) -> float:
+        if TypeConverters._is_numeric(value):
+            return float(value)
+        raise TypeError("Could not convert %s to float" % value)
+
+    @staticmethod
+    def toInt(value: Any) -> int:
+        if TypeConverters._is_numeric(value):
+            if float(value) != int(value):
+                raise TypeError("Could not convert %s to int without loss" % value)
+            return int(value)
+        raise TypeError("Could not convert %s to int" % value)
+
+    @staticmethod
+    def toString(value: Any) -> str:
+        if isinstance(value, str):
+            return value
+        raise TypeError("Could not convert %s to string type" % type(value))
+
+    @staticmethod
+    def toBoolean(value: Any) -> bool:
+        if isinstance(value, bool):
+            return value
+        raise TypeError("Boolean Param requires value of type bool. Found %s." % type(value))
+
+
+class Params:
+    """Components that take parameters. Mirrors pyspark.ml.param.Params."""
+
+    _lock = threading.RLock()
+
+    def __init__(self) -> None:
+        self._paramMap: ParamMap = {}
+        self._defaultParamMap: ParamMap = {}
+        self._params: Optional[List[Param]] = None
+        self.uid = self._randomUID()
+        self._copy_params()
+
+    @classmethod
+    def _randomUID(cls) -> str:
+        return str(cls.__name__ + "_" + uuid.uuid4().hex[:12])
+
+    def _copy_params(self) -> None:
+        """Copy class-level Param attributes to instance-level with parent=self.uid."""
+        cls = type(self)
+        src_params = [
+            getattr(cls, name)
+            for name in dir(cls)
+            if isinstance(getattr(cls, name, None), Param)
+        ]
+        for param in src_params:
+            inst_param = _copy.copy(param)
+            inst_param.parent = self.uid
+            setattr(self, param.name, inst_param)
+
+    @property
+    def params(self) -> List[Param]:
+        """Returns all params ordered by name."""
+        if self._params is None:
+            self._params = list(
+                filter(
+                    lambda attr: isinstance(attr, Param),
+                    [getattr(self, x) for x in dir(self) if x != "params" and not x.startswith("_")],
+                )
+            )
+            self._params.sort(key=lambda p: p.name)
+        return self._params
+
+    def explainParam(self, param: Union[str, Param]) -> str:
+        param = self._resolveParam(param)
+        values = []
+        if self.isDefined(param):
+            if param in self._defaultParamMap:
+                values.append("default: %s" % str(self._defaultParamMap[param]))
+            if param in self._paramMap:
+                values.append("current: %s" % str(self._paramMap[param]))
+        else:
+            values.append("undefined")
+        valueStr = "(" + ", ".join(values) + ")"
+        return "%s: %s %s" % (param.name, param.doc, valueStr)
+
+    def explainParams(self) -> str:
+        return "\n".join([self.explainParam(param) for param in self.params])
+
+    def getParam(self, paramName: str) -> Param:
+        param = getattr(self, paramName, None)
+        if isinstance(param, Param):
+            return param
+        raise ValueError("Cannot find param with name %s." % paramName)
+
+    def isSet(self, param: Union[str, Param]) -> bool:
+        param = self._resolveParam(param)
+        return param in self._paramMap
+
+    def hasDefault(self, param: Union[str, Param]) -> bool:
+        param = self._resolveParam(param)
+        return param in self._defaultParamMap
+
+    def isDefined(self, param: Union[str, Param]) -> bool:
+        return self.isSet(param) or self.hasDefault(param)
+
+    def hasParam(self, paramName: str) -> bool:
+        if isinstance(paramName, str):
+            p = getattr(self, paramName, None)
+            return isinstance(p, Param)
+        raise TypeError("hasParam(): paramName must be a string")
+
+    def getOrDefault(self, param: Union[str, Param]) -> Any:
+        param = self._resolveParam(param)
+        if param in self._paramMap:
+            return self._paramMap[param]
+        if param in self._defaultParamMap:
+            return self._defaultParamMap[param]
+        raise KeyError("Failed to find a default value for %s" % param.name)
+
+    def extractParamMap(self, extra: Optional[ParamMap] = None) -> ParamMap:
+        if extra is None:
+            extra = dict()
+        paramMap = self._defaultParamMap.copy()
+        paramMap.update(self._paramMap)
+        paramMap.update(extra)
+        return paramMap
+
+    def copy(self, extra: Optional[ParamMap] = None) -> "Params":
+        if extra is None:
+            extra = dict()
+        that = _copy.copy(self)
+        that._paramMap = {}
+        that._defaultParamMap = {}
+        that._copy_params()
+        return self._copyValues(that, extra)
+
+    def set(self, param: Param, value: Any) -> None:
+        self._shouldOwn(param)
+        try:
+            value = param.typeConverter(value)
+        except ValueError as e:
+            raise ValueError('Invalid param value given for param "%s". %s' % (param.name, e))
+        self._paramMap[param] = value
+
+    def clear(self, param: Param) -> None:
+        if self.isSet(param):
+            del self._paramMap[param]
+
+    def _shouldOwn(self, param: Param) -> None:
+        if not (self.uid == param.parent and self.hasParam(param.name)):
+            raise ValueError("Param %r does not belong to %r." % (param, self))
+
+    def _resolveParam(self, param: Union[str, Param]) -> Param:
+        if isinstance(param, Param):
+            self._shouldOwn(param)
+            return param
+        elif isinstance(param, str):
+            return self.getParam(param)
+        else:
+            raise TypeError("Cannot resolve %r as a param." % param)
+
+    def _set(self, **kwargs: Any) -> "Params":
+        """Sets user-supplied params."""
+        for param, value in kwargs.items():
+            p = self.getParam(param)
+            if value is not None:
+                try:
+                    value = p.typeConverter(value)
+                except TypeError as e:
+                    raise TypeError('Invalid param value given for param "%s". %s' % (p.name, e))
+            self._paramMap[p] = value
+        return self
+
+    def _clear(self, param: Param) -> None:
+        self.clear(param)
+
+    def _setDefault(self, **kwargs: Any) -> "Params":
+        """Sets default params."""
+        for param, value in kwargs.items():
+            p = self.getParam(param)
+            if value is not None and not callable(value):
+                try:
+                    value = p.typeConverter(value)
+                except TypeError as e:
+                    raise TypeError(
+                        'Invalid default param value given for param "%s". %s' % (p.name, e)
+                    )
+            self._defaultParamMap[p] = value
+        return self
+
+    def _copyValues(self, to: "Params", extra: Optional[ParamMap] = None) -> "Params":
+        paramMap = self._paramMap.copy()
+        if isinstance(extra, dict):
+            for param, value in extra.items():
+                if isinstance(param, Param):
+                    paramMap[param] = value
+                else:
+                    raise TypeError(
+                        "Expecting a valid instance of Param, but received: {}".format(param)
+                    )
+        elif extra is not None:
+            raise TypeError(
+                "Expecting a dict, but received an object of type {}.".format(type(extra))
+            )
+        for param in self._defaultParamMap:
+            if to.hasParam(param.name):
+                to._defaultParamMap[to.getParam(param.name)] = self._defaultParamMap[param]
+        for param in paramMap:
+            if to.hasParam(param.name):
+                to._paramMap[to.getParam(param.name)] = paramMap[param]
+        return to
+
+    def _resetUid(self, newUid: Any) -> "Params":
+        newUid = str(newUid)
+        self.uid = newUid
+        newDefaultParamMap = dict()
+        newParamMap = dict()
+        for param in self.params:
+            newParam = _copy.copy(param)
+            newParam.parent = newUid
+            if param in self._defaultParamMap:
+                newDefaultParamMap[newParam] = self._defaultParamMap[param]
+            if param in self._paramMap:
+                newParamMap[newParam] = self._paramMap[param]
+            param.parent = newUid
+        self._defaultParamMap = newDefaultParamMap
+        self._paramMap = newParamMap
+        return self
+
+
+# ---------------------------------------------------------------------------
+# Shared param mixins — the subset of pyspark.ml.param.shared the reference uses,
+# plus reference-specific mixins (HasFeaturesCols, HasIDCol, ... from
+# reference python/src/spark_rapids_ml/params.py:45-160).
+# ---------------------------------------------------------------------------
+
+
+class HasMaxIter(Params):
+    maxIter: Param[int] = Param(
+        "undefined", "maxIter", "max number of iterations (>= 0).", TypeConverters.toInt
+    )
+
+    def getMaxIter(self) -> int:
+        return self.getOrDefault(self.maxIter)
+
+
+class HasRegParam(Params):
+    regParam: Param[float] = Param(
+        "undefined", "regParam", "regularization parameter (>= 0).", TypeConverters.toFloat
+    )
+
+    def getRegParam(self) -> float:
+        return self.getOrDefault(self.regParam)
+
+
+class HasElasticNetParam(Params):
+    elasticNetParam: Param[float] = Param(
+        "undefined",
+        "elasticNetParam",
+        "the ElasticNet mixing parameter, in range [0, 1]. For alpha = 0, "
+        "the penalty is an L2 penalty. For alpha = 1, it is an L1 penalty.",
+        TypeConverters.toFloat,
+    )
+
+    def getElasticNetParam(self) -> float:
+        return self.getOrDefault(self.elasticNetParam)
+
+
+class HasFeaturesCol(Params):
+    featuresCol: Param[str] = Param(
+        "undefined", "featuresCol", "features column name.", TypeConverters.toString
+    )
+
+    def getFeaturesCol(self) -> str:
+        return self.getOrDefault(self.featuresCol)
+
+
+class HasLabelCol(Params):
+    labelCol: Param[str] = Param(
+        "undefined", "labelCol", "label column name.", TypeConverters.toString
+    )
+
+    def getLabelCol(self) -> str:
+        return self.getOrDefault(self.labelCol)
+
+
+class HasPredictionCol(Params):
+    predictionCol: Param[str] = Param(
+        "undefined", "predictionCol", "prediction column name.", TypeConverters.toString
+    )
+
+    def getPredictionCol(self) -> str:
+        return self.getOrDefault(self.predictionCol)
+
+
+class HasProbabilityCol(Params):
+    probabilityCol: Param[str] = Param(
+        "undefined",
+        "probabilityCol",
+        "Column name for predicted class conditional probabilities.",
+        TypeConverters.toString,
+    )
+
+    def getProbabilityCol(self) -> str:
+        return self.getOrDefault(self.probabilityCol)
+
+
+class HasRawPredictionCol(Params):
+    rawPredictionCol: Param[str] = Param(
+        "undefined",
+        "rawPredictionCol",
+        "raw prediction (a.k.a. confidence) column name.",
+        TypeConverters.toString,
+    )
+
+    def getRawPredictionCol(self) -> str:
+        return self.getOrDefault(self.rawPredictionCol)
+
+
+class HasInputCol(Params):
+    inputCol: Param[str] = Param(
+        "undefined", "inputCol", "input column name.", TypeConverters.toString
+    )
+
+    def getInputCol(self) -> str:
+        return self.getOrDefault(self.inputCol)
+
+
+class HasInputCols(Params):
+    inputCols: Param[List[str]] = Param(
+        "undefined", "inputCols", "input column names.", TypeConverters.toListString
+    )
+
+    def getInputCols(self) -> List[str]:
+        return self.getOrDefault(self.inputCols)
+
+
+class HasOutputCol(Params):
+    outputCol: Param[str] = Param(
+        "undefined", "outputCol", "output column name.", TypeConverters.toString
+    )
+
+    def getOutputCol(self) -> str:
+        return self.getOrDefault(self.outputCol)
+
+
+class HasOutputCols(Params):
+    outputCols: Param[List[str]] = Param(
+        "undefined", "outputCols", "output column names.", TypeConverters.toListString
+    )
+
+    def getOutputCols(self) -> List[str]:
+        return self.getOrDefault(self.outputCols)
+
+
+class HasSeed(Params):
+    seed: Param[int] = Param("undefined", "seed", "random seed.", TypeConverters.toInt)
+
+    def getSeed(self) -> int:
+        return self.getOrDefault(self.seed)
+
+
+class HasTol(Params):
+    tol: Param[float] = Param(
+        "undefined",
+        "tol",
+        "the convergence tolerance for iterative algorithms (>= 0).",
+        TypeConverters.toFloat,
+    )
+
+    def getTol(self) -> float:
+        return self.getOrDefault(self.tol)
+
+
+class HasStandardization(Params):
+    standardization: Param[bool] = Param(
+        "undefined",
+        "standardization",
+        "whether to standardize the training features before fitting the model.",
+        TypeConverters.toBoolean,
+    )
+
+    def getStandardization(self) -> bool:
+        return self.getOrDefault(self.standardization)
+
+
+class HasFitIntercept(Params):
+    fitIntercept: Param[bool] = Param(
+        "undefined",
+        "fitIntercept",
+        "whether to fit an intercept term.",
+        TypeConverters.toBoolean,
+    )
+
+    def getFitIntercept(self) -> bool:
+        return self.getOrDefault(self.fitIntercept)
+
+
+class HasSolver(Params):
+    solver: Param[str] = Param(
+        "undefined",
+        "solver",
+        "the solver algorithm for optimization.",
+        TypeConverters.toString,
+    )
+
+    def getSolver(self) -> str:
+        return self.getOrDefault(self.solver)
+
+
+class HasWeightCol(Params):
+    weightCol: Param[str] = Param(
+        "undefined",
+        "weightCol",
+        "weight column name. If this is not set or empty, we treat all instance "
+        "weights as 1.0.",
+        TypeConverters.toString,
+    )
+
+    def getWeightCol(self) -> str:
+        return self.getOrDefault(self.weightCol)
+
+
+class HasCheckpointInterval(Params):
+    checkpointInterval: Param[int] = Param(
+        "undefined",
+        "checkpointInterval",
+        "set checkpoint interval (>= 1) or disable checkpoint (-1).",
+        TypeConverters.toInt,
+    )
+
+    def getCheckpointInterval(self) -> int:
+        return self.getOrDefault(self.checkpointInterval)
+
+
+class HasAggregationDepth(Params):
+    aggregationDepth: Param[int] = Param(
+        "undefined",
+        "aggregationDepth",
+        "suggested depth for treeAggregate (>= 2).",
+        TypeConverters.toInt,
+    )
+
+    def getAggregationDepth(self) -> int:
+        return self.getOrDefault(self.aggregationDepth)
+
+
+class HasThresholds(Params):
+    thresholds: Param[List[float]] = Param(
+        "undefined",
+        "thresholds",
+        "Thresholds in multi-class classification to adjust the probability of "
+        "predicting each class.",
+        TypeConverters.toListFloat,
+    )
+
+    def getThresholds(self) -> List[float]:
+        return self.getOrDefault(self.thresholds)
+
+
+class HasParallelism(Params):
+    parallelism: Param[int] = Param(
+        "undefined",
+        "parallelism",
+        "the number of threads to use when running parallel algorithms (>= 1).",
+        TypeConverters.toInt,
+    )
+
+    def getParallelism(self) -> int:
+        return self.getOrDefault(self.parallelism)
+
+
+class HasCollectSubModels(Params):
+    collectSubModels: Param[bool] = Param(
+        "undefined",
+        "collectSubModels",
+        "Param for whether to collect a list of sub-models trained during tuning.",
+        TypeConverters.toBoolean,
+    )
+
+    def getCollectSubModels(self) -> bool:
+        return self.getOrDefault(self.collectSubModels)

@@ -1,0 +1,140 @@
+#
+# Estimator/model persistence (reference core.py:268-355).
+#
+# The reference saves Spark DefaultParamsWriter metadata plus a JSON attribute row;
+# models are rebuilt from the attribute dict (core.py:1389-1396). The TPU format is a
+# directory:
+#   metadata.json      — class name, uid, user-set + default params, backend params
+#   arrays.npz         — every ndarray-valued model attribute
+#   attributes.json    — every non-array model attribute
+# which keeps the "model == attribute dict" contract while storing arrays natively.
+#
+
+from __future__ import annotations
+
+import importlib
+import json
+import os
+import shutil
+import time
+from typing import Any, Dict, Optional, Type
+
+import numpy as np
+
+VERSION = "0.1.0"
+
+
+def _json_default(o: Any) -> Any:
+    if isinstance(o, (np.integer,)):
+        return int(o)
+    if isinstance(o, (np.floating,)):
+        return float(o)
+    if isinstance(o, np.ndarray):
+        return o.tolist()
+    raise TypeError(f"not JSON serializable: {type(o)}")
+
+
+def save_instance(instance: Any, path: str, overwrite: bool = False) -> None:
+    if os.path.exists(path):
+        if not overwrite:
+            raise IOError(f"Path {path} already exists; use write().overwrite().save(path).")
+        shutil.rmtree(path)  # stale attribute/array files must not survive an overwrite
+    os.makedirs(path, exist_ok=True)
+
+    cls = type(instance)
+    metadata: Dict[str, Any] = {
+        "class": f"{cls.__module__}.{cls.__qualname__}",
+        "timestamp": int(time.time() * 1000),
+        "version": VERSION,
+        "uid": instance.uid,
+        "paramMap": {p.name: v for p, v in instance._paramMap.items()},
+        "defaultParamMap": {p.name: v for p, v in instance._defaultParamMap.items()},
+        "tpuParams": getattr(instance, "_tpu_params", {}),
+        "numWorkers": getattr(instance, "_num_workers", None),
+        "float32Inputs": getattr(instance, "_float32_inputs", True),
+    }
+    with open(os.path.join(path, "metadata.json"), "w") as f:
+        json.dump(metadata, f, default=_json_default)
+
+    attrs: Optional[Dict[str, Any]] = getattr(instance, "_model_attributes", None)
+    if attrs is not None:
+        arrays = {k: np.asarray(v) for k, v in attrs.items() if isinstance(v, np.ndarray)}
+        scalars = {k: v for k, v in attrs.items() if not isinstance(v, np.ndarray)}
+        if arrays:
+            np.savez(os.path.join(path, "arrays.npz"), **arrays)
+        with open(os.path.join(path, "attributes.json"), "w") as f:
+            json.dump(scalars, f, default=_json_default)
+
+
+def load_metadata(path: str) -> Dict[str, Any]:
+    with open(os.path.join(path, "metadata.json")) as f:
+        return json.load(f)
+
+
+def _resolve_class(qualname: str) -> Type:
+    module_name, _, cls_name = qualname.rpartition(".")
+    module = importlib.import_module(module_name)
+    obj: Any = module
+    for part in cls_name.split("."):
+        obj = getattr(obj, part)
+    return obj
+
+
+def load_instance(path: str, expected_cls: Optional[Type] = None) -> Any:
+    metadata = load_metadata(path)
+    cls = _resolve_class(metadata["class"])
+    if expected_cls is not None and not issubclass(cls, expected_cls):
+        raise TypeError(
+            f"Path {path} holds a {metadata['class']}, which is not a {expected_cls.__name__}"
+        )
+
+    attrs: Dict[str, Any] = {}
+    attr_file = os.path.join(path, "attributes.json")
+    if os.path.exists(attr_file):
+        with open(attr_file) as f:
+            attrs.update(json.load(f))
+        npz_file = os.path.join(path, "arrays.npz")
+        if os.path.exists(npz_file):
+            with np.load(npz_file) as data:
+                attrs.update({k: data[k] for k in data.files})
+        instance = cls._from_row(attrs)
+    else:
+        instance = cls()
+
+    instance._resetUid(metadata["uid"])
+    for name, value in metadata.get("defaultParamMap", {}).items():
+        if instance.hasParam(name):
+            instance._setDefault(**{name: value})
+    for name, value in metadata.get("paramMap", {}).items():
+        if instance.hasParam(name):
+            instance._set(**{name: value})
+    if hasattr(instance, "_tpu_params"):
+        instance._tpu_params = dict(metadata.get("tpuParams", {}))
+        instance._num_workers = metadata.get("numWorkers")
+        instance._float32_inputs = metadata.get("float32Inputs", True)
+    return instance
+
+
+class ParamsWriter:
+    """`instance.write().overwrite().save(path)` chain, mirroring pyspark's MLWriter."""
+
+    def __init__(self, instance: Any):
+        self._instance = instance
+        self._overwrite = False
+
+    def overwrite(self) -> "ParamsWriter":
+        self._overwrite = True
+        return self
+
+    def save(self, path: str) -> None:
+        save_instance(self._instance, path, overwrite=self._overwrite)
+
+
+class ParamsReader:
+    """`Cls.read().load(path)` chain, mirroring pyspark's MLReader."""
+
+    def __init__(self, cls: Type):
+        self._cls = cls
+
+    def load(self, path: str) -> Any:
+        return load_instance(path, self._cls)

@@ -1,0 +1,3 @@
+# Algorithm estimator/model classes (L6 API layer). Top-level compatibility modules
+# (spark_rapids_ml_tpu.feature, .clustering, ...) re-export from here so imports mirror
+# the reference's `spark_rapids_ml.feature.PCA` layout.

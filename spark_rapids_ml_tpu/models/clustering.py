@@ -1,0 +1,248 @@
+#
+# KMeans estimator/model (L6 API) — pyspark.ml.clustering.KMeans-compatible surface,
+# fit as one SPMD Lloyd program over the TPU mesh.
+#
+# Structural equivalent of reference python/src/spark_rapids_ml/clustering.py:84-604:
+#   * param mapping incl. tol=0 -> tiny epsilon (reference clustering.py:84-141)
+#   * n_init forced to 1 for Spark parity (reference clustering.py:317-319)
+#   * fit returns cluster centers + inertia + n_iter attributes
+#     (reference clustering.py:376-456)
+# (DBSCAN, the other member of the reference module, lives in models/dbscan.py.)
+#
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..core.backend_params import HasFeaturesCols, _TpuClass
+from ..core.estimator import FitInputs, _TpuEstimator, _TpuModelWithPredictionCol
+from ..core.params import (
+    HasFeaturesCol,
+    HasMaxIter,
+    HasPredictionCol,
+    HasSeed,
+    HasTol,
+    HasWeightCol,
+    Param,
+    TypeConverters,
+)
+from ..ops.kmeans import kmeans_fit, kmeans_predict
+
+
+class _KMeansClass(_TpuClass):
+    @classmethod
+    def _param_mapping(cls):
+        # reference clustering.py:84-141
+        return {
+            "k": "n_clusters",
+            "maxIter": "max_iter",
+            "tol": "tol",
+            "initMode": "init",
+            "initSteps": "init_steps",
+            "seed": "random_state",
+            "distanceMeasure": None,  # euclidean only; cosine falls back
+            "featuresCol": "",
+            "predictionCol": "",
+            "weightCol": "",
+            "solver": None,
+            "maxBlockSizeInMB": None,
+        }
+
+    @classmethod
+    def _param_value_mapping(cls):
+        # tol=0 would spin max_iter rounds; remap to a tiny epsilon like the reference
+        return {
+            "tol": lambda x: 1.0e-16 if x == 0 else float(x),
+            "init": lambda x: (
+                x if x in ("k-means||", "scalable-k-means++", "random") else None
+            ),
+        }
+
+    @classmethod
+    def _get_tpu_params_default(cls) -> Dict[str, Any]:
+        return {
+            "n_clusters": 8,
+            "max_iter": 300,
+            "tol": 1e-4,
+            "init": "k-means||",
+            "init_steps": 2,
+            "random_state": 1,
+            "n_init": 1,  # Spark parity (reference clustering.py:317-319)
+        }
+
+    @classmethod
+    def _fallback_class(cls):
+        from sklearn.cluster import KMeans as SkKMeans
+
+        return SkKMeans
+
+
+class _KMeansParams(
+    HasFeaturesCol, HasFeaturesCols, HasPredictionCol, HasMaxIter, HasTol, HasSeed, HasWeightCol
+):
+    k: Param[int] = Param(
+        "undefined", "k", "The number of clusters to create. Must be > 1.", TypeConverters.toInt
+    )
+    initMode: Param[str] = Param(
+        "undefined",
+        "initMode",
+        "The initialization algorithm. Supported options: 'k-means||' and 'random'.",
+        TypeConverters.toString,
+    )
+    initSteps: Param[int] = Param(
+        "undefined",
+        "initSteps",
+        "The number of steps for k-means|| initialization mode. Must be > 0.",
+        TypeConverters.toInt,
+    )
+    distanceMeasure: Param[str] = Param(
+        "undefined",
+        "distanceMeasure",
+        "the distance measure. Supported options: 'euclidean' and 'cosine'.",
+        TypeConverters.toString,
+    )
+    solver: Param[str] = Param(
+        "undefined",
+        "solver",
+        "The solver algorithm for optimization. Supported options: 'auto', 'row', 'block'.",
+        TypeConverters.toString,
+    )
+    maxBlockSizeInMB: Param[float] = Param(
+        "undefined",
+        "maxBlockSizeInMB",
+        "Maximum memory in MB for stacking input data into blocks.",
+        TypeConverters.toFloat,
+    )
+
+    def getK(self) -> int:
+        return self.getOrDefault("k")
+
+    def setFeaturesCol(self, value: str):
+        return self._set(featuresCol=value)
+
+    def setPredictionCol(self, value: str):
+        return self._set(predictionCol=value)
+
+
+class KMeans(_KMeansClass, _TpuEstimator, _KMeansParams):
+    """KMeans on the TPU mesh: one jitted Lloyd loop, centroid psum over ICI.
+
+    Drop-in for pyspark.ml.clustering.KMeans / reference
+    spark_rapids_ml.clustering.KMeans (reference clustering.py:226-456).
+
+    Example
+    -------
+    >>> from spark_rapids_ml_tpu.clustering import KMeans
+    >>> model = KMeans(k=4, featuresCol="features").fit(df)
+    >>> model.transform(df)   # adds 'prediction' column
+    """
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__()
+        self._setDefault(
+            featuresCol="features",
+            predictionCol="prediction",
+            k=2,
+            maxIter=20,
+            tol=1e-4,
+            initMode="k-means||",
+            initSteps=2,
+            seed=1,
+            distanceMeasure="euclidean",
+            solver="auto",
+            maxBlockSizeInMB=0.0,
+        )
+        self.initialize_tpu_params()
+        self._set_params(**kwargs)
+
+    def setK(self, value: int) -> "KMeans":
+        return self._set_params(k=value)  # type: ignore[return-value]
+
+    def setMaxIter(self, value: int) -> "KMeans":
+        return self._set_params(maxIter=value)  # type: ignore[return-value]
+
+    def _out_schema(self) -> List[str]:
+        return ["cluster_centers", "inertia", "n_iter"]
+
+    def _get_tpu_fit_func(self, extra_params: Optional[List[Dict[str, Any]]] = None):
+        def _fit(inputs: FitInputs) -> Dict[str, Any]:
+            p = inputs.params
+            if int(p["n_clusters"]) > inputs.desc.m:
+                raise ValueError(
+                    f"k={p['n_clusters']} exceeds the number of rows {inputs.desc.m}; "
+                    "initialization would select padding rows as centers."
+                )
+            return kmeans_fit(
+                inputs.features,
+                inputs.row_weight,
+                k=int(p["n_clusters"]),
+                max_iter=int(p["max_iter"]),
+                tol=float(p["tol"]),
+                init=str(p["init"]),
+                init_steps=int(p["init_steps"]),
+                seed=int(p["random_state"]) if p["random_state"] is not None else 1,
+            )
+
+        return _fit
+
+    def _create_pyspark_model(self, attrs: Dict[str, Any]) -> "KMeansModel":
+        return KMeansModel(**attrs)
+
+    def _fit_fallback_model(self, twin: type, fd) -> Dict[str, Any]:
+        if self.getOrDefault("distanceMeasure") != "euclidean":
+            raise ValueError(
+                "distanceMeasure='cosine' is supported neither by the TPU backend nor "
+                "by the sklearn CPU fallback; use the pyspark.ml KMeans for cosine."
+            )
+        X = np.asarray(fd.features.todense()) if fd.is_sparse else fd.features
+        init = self.getOrDefault("initMode")
+        sk = twin(
+            n_clusters=self.getOrDefault("k"),
+            max_iter=self.getOrDefault("maxIter"),
+            tol=self.getOrDefault("tol"),
+            init="k-means++" if init != "random" else "random",
+            n_init=1,
+            random_state=self.getOrDefault("seed") & 0x7FFFFFFF,
+        ).fit(X, sample_weight=fd.weight)
+        return {
+            "cluster_centers": sk.cluster_centers_.astype(np.float32),
+            "inertia": float(sk.inertia_),
+            "n_iter": int(sk.n_iter_),
+        }
+
+
+class KMeansModel(_KMeansClass, _TpuModelWithPredictionCol, _KMeansParams):
+    """Fitted KMeans model (reference clustering.py:459-604)."""
+
+    def __init__(
+        self, cluster_centers: np.ndarray, inertia: float, n_iter: int
+    ) -> None:
+        super().__init__(
+            cluster_centers=np.asarray(cluster_centers),
+            inertia=float(inertia),
+            n_iter=int(n_iter),
+        )
+        self._setDefault(featuresCol="features", predictionCol="prediction")
+
+    def clusterCenters(self) -> List[np.ndarray]:
+        """Spark MLlib KMeansModel surface."""
+        return list(self._model_attributes["cluster_centers"])
+
+    @property
+    def cluster_centers_(self) -> np.ndarray:
+        return self._model_attributes["cluster_centers"]
+
+    @property
+    def inertia_(self) -> float:
+        return self._model_attributes["inertia"]
+
+    def predict(self, value: np.ndarray) -> int:
+        """Single-vector prediction (Spark API)."""
+        X = np.asarray(value, dtype=np.float32).reshape(1, -1)
+        return int(np.asarray(kmeans_predict(X, self.cluster_centers_))[0])
+
+    def _transform_arrays(self, X: np.ndarray) -> Dict[str, np.ndarray]:
+        pred = np.asarray(kmeans_predict(X, self.cluster_centers_))
+        return {self.getOrDefault("predictionCol"): pred.astype(np.int32)}

@@ -1,0 +1,180 @@
+#
+# PCA estimator/model (L6 API) — pyspark.ml.feature.PCA-compatible surface with the
+# fit/transform executing on the TPU mesh.
+#
+# Structural equivalent of reference python/src/spark_rapids_ml/feature.py:
+#   * param mapping {k -> n_components} (reference feature.py:61-65)
+#   * fit produces mean/components/explained_variance/singular_values attributes
+#     (reference feature.py:260-285)
+#   * transform projects raw rows for Spark parity (reference feature.py:438-451)
+#
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..core.backend_params import HasFeaturesCols, _TpuClass
+from ..core.estimator import FitInputs, _TpuEstimator, _TpuModelWithColumns
+from ..core.params import (
+    HasInputCol,
+    HasInputCols,
+    HasOutputCol,
+    Param,
+    Params,
+    TypeConverters,
+)
+from ..ops.pca import pca_fit, pca_transform
+
+
+class _PCAClass(_TpuClass):
+    @classmethod
+    def _param_mapping(cls):
+        return {"k": "n_components", "inputCol": "", "inputCols": "", "outputCol": ""}
+
+    @classmethod
+    def _get_tpu_params_default(cls) -> Dict[str, Any]:
+        return {"n_components": None, "whiten": False}
+
+    @classmethod
+    def _fallback_class(cls):
+        from sklearn.decomposition import PCA as SkPCA
+
+        return SkPCA
+
+
+class _PCAParams(HasInputCol, HasInputCols, HasOutputCol):
+    k: Param[int] = Param(
+        "undefined",
+        "k",
+        "the number of principal components (> 0).",
+        TypeConverters.toInt,
+    )
+
+    def getK(self) -> int:
+        return self.getOrDefault("k")
+
+    def setInputCol(self, value: str) -> "_PCAParams":
+        return self._set(inputCol=value)  # type: ignore[return-value]
+
+    def setInputCols(self, value: List[str]) -> "_PCAParams":
+        return self._set(inputCols=value)  # type: ignore[return-value]
+
+    def setOutputCol(self, value: str) -> "_PCAParams":
+        return self._set(outputCol=value)  # type: ignore[return-value]
+
+
+class PCA(_PCAClass, _TpuEstimator, _PCAParams):
+    """PCA estimator running as one SPMD program over the TPU mesh.
+
+    Drop-in for pyspark.ml.feature.PCA / reference spark_rapids_ml.feature.PCA
+    (reference feature.py:117-253).
+
+    Example
+    -------
+    >>> import pandas as pd, numpy as np
+    >>> from spark_rapids_ml_tpu.feature import PCA
+    >>> df = pd.DataFrame({"features": list(np.random.rand(100, 8).astype(np.float32))})
+    >>> model = PCA(k=2, inputCol="features").fit(df)
+    >>> out = model.transform(df)   # adds 'pca_features' column
+    """
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__()
+        self._setDefault(outputCol="pca_features")
+        self.initialize_tpu_params()
+        self._set_params(**kwargs)
+
+    def setK(self, value: int) -> "PCA":
+        return self._set_params(k=value)  # type: ignore[return-value]
+
+    def _out_schema(self) -> List[str]:
+        return [
+            "mean",
+            "components",
+            "explained_variance",
+            "explained_variance_ratio",
+            "singular_values",
+        ]
+
+    def _get_tpu_fit_func(self, extra_params: Optional[List[Dict[str, Any]]] = None):
+        k = self.getOrDefault("k")
+
+        def _fit(inputs: FitInputs) -> Dict[str, Any]:
+            if k > inputs.desc.n:
+                raise ValueError(
+                    f"k={k} exceeds the number of features {inputs.desc.n}"
+                )
+            return pca_fit(inputs.features, inputs.row_weight, k)
+
+        return _fit
+
+    def _create_pyspark_model(self, attrs: Dict[str, Any]) -> "PCAModel":
+        return PCAModel(**attrs)
+
+    def _fit_fallback_model(self, twin: type, fd) -> Dict[str, Any]:
+        X = np.asarray(fd.features.todense()) if fd.is_sparse else fd.features
+        sk = twin(n_components=self.getOrDefault("k")).fit(np.asarray(X, dtype=np.float64))
+        return {
+            "mean": sk.mean_.astype(np.float32),
+            "components": sk.components_.astype(np.float32),
+            "explained_variance": sk.explained_variance_,
+            "explained_variance_ratio": sk.explained_variance_ratio_,
+            "singular_values": sk.singular_values_,
+        }
+
+
+class PCAModel(_PCAClass, _TpuModelWithColumns, _PCAParams):
+    """Fitted PCA model (reference feature.py:288-459)."""
+
+    def __init__(
+        self,
+        mean: np.ndarray,
+        components: np.ndarray,
+        explained_variance: np.ndarray,
+        explained_variance_ratio: np.ndarray,
+        singular_values: np.ndarray,
+    ) -> None:
+        super().__init__(
+            mean=np.asarray(mean),
+            components=np.asarray(components),
+            explained_variance=np.asarray(explained_variance),
+            explained_variance_ratio=np.asarray(explained_variance_ratio),
+            singular_values=np.asarray(singular_values),
+        )
+        self._setDefault(outputCol="pca_features")
+
+    # --- Spark MLlib PCAModel surface ---
+
+    @property
+    def pc(self) -> np.ndarray:
+        """Principal components as a (d, k) matrix, Spark's PCAModel.pc layout."""
+        return self._model_attributes["components"].T
+
+    @property
+    def explainedVariance(self) -> np.ndarray:
+        """Proportion of variance explained per component (Spark semantics)."""
+        return self._model_attributes["explained_variance_ratio"]
+
+    # --- cuML-style surface (reference exposes these too) ---
+
+    @property
+    def mean(self) -> np.ndarray:
+        return self._model_attributes["mean"]
+
+    @property
+    def components_(self) -> np.ndarray:
+        return self._model_attributes["components"]
+
+    @property
+    def explained_variance_(self) -> np.ndarray:
+        return self._model_attributes["explained_variance"]
+
+    @property
+    def singular_values_(self) -> np.ndarray:
+        return self._model_attributes["singular_values"]
+
+    def _transform_arrays(self, X: np.ndarray) -> Dict[str, np.ndarray]:
+        out = np.asarray(pca_transform(X, self._model_attributes["components"]))
+        return {self.getOrDefault("outputCol"): out}

@@ -1,0 +1,2 @@
+# JAX/XLA compute kernels (L1 of the layer map) — the TPU-native replacement for the
+# reference's cuML/cuVS/treelite native backends (SURVEY.md §2.5).

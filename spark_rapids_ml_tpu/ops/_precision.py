@@ -1,0 +1,23 @@
+#
+# Matmul precision policy.
+#
+# On TPU the MXU's DEFAULT precision computes f32 dots via bfloat16 passes — fast, but
+# off by ~2^-8, which breaks parity with the reference's fp32/fp64 cuML results (and
+# this build's XLA CPU backend shows the same behavior). Statistics that feed model
+# attributes (covariance, Gram, gradients, projections) therefore pin
+# Precision.HIGHEST (6-pass bf16 ≙ full f32 on MXU). Ops where throughput matters more
+# than the last bits (distance matrices in kNN/KMeans assignment) may choose lower
+# precision explicitly.
+#
+
+import jax
+
+PARITY = jax.lax.Precision.HIGHEST
+FAST = jax.lax.Precision.DEFAULT
+
+
+def pdot(a, b):
+    """Parity-precision matmul."""
+    import jax.numpy as jnp
+
+    return jnp.matmul(a, b, precision=PARITY)

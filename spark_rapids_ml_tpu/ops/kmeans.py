@@ -1,0 +1,194 @@
+#
+# KMeans fit/predict kernels — the TPU-native replacement for
+# cuml.cluster.kmeans_mg.KMeansMG (reference clustering.py:376-456; the centroid
+# allreduce happens inside cuML over NCCL).
+#
+# TPU formulation: Lloyd iterations as one jitted lax.while_loop over row-sharded data.
+# Per iteration:
+#   * assignment: pairwise squared distances via the ‖x‖² - 2x·c + ‖c‖² expansion —
+#     an (n,k) matmul on the MXU,
+#   * update: one-hot(assign)ᵀ @ X — another MXU matmul whose contraction over the
+#     sharded row axis makes XLA emit the psum over ICI (exactly where cuML put its
+#     NCCL allreduce).
+# Empty clusters keep their previous center (cuML/Spark behavior for stability).
+#
+# Initialization: "random" picks k real rows; "k-means||" (Spark's default initMode)
+# runs `initSteps` rounds of distance-weighted oversampling. The reference delegates to
+# cuML's scalable-k-means++; the TPU version keeps shapes static by sampling a fixed
+# 2k candidates per round via the Gumbel-top-k trick on log(d²) (sampling without
+# replacement ∝ d², same distribution as k-means|| oversampling with l=2k), then runs
+# weighted k-means++ on the small candidate set host-side — the same
+# cluster-then-reduce structure as scalable k-means++.
+#
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ._precision import PARITY, pdot
+
+
+@functools.partial(jax.jit, static_argnames=())
+def _sq_dists(X: jax.Array, centers: jax.Array) -> jax.Array:
+    """(n, k) squared euclidean distances; the MXU hot loop."""
+    x2 = jnp.sum(X * X, axis=1, keepdims=True)
+    c2 = jnp.sum(centers * centers, axis=1)
+    d2 = x2 - 2.0 * pdot(X, centers.T) + c2
+    return jnp.maximum(d2, 0.0)
+
+
+@functools.partial(jax.jit, static_argnames=("max_iter",))
+def lloyd_fit(
+    X: jax.Array,
+    w: jax.Array,
+    init_centers: jax.Array,
+    tol: float,
+    max_iter: int,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Lloyd iterations until max center movement² <= tol² or max_iter.
+
+    Returns (centers, inertia, n_iter). Convergence on per-center movement matches
+    Spark's KMeans semantics (the reference remaps tol=0 to a tiny epsilon,
+    clustering.py:84-141 — callers do that remap)."""
+    k = init_centers.shape[0]
+
+    def cond(state):
+        _, _, it, shift2 = state
+        return jnp.logical_and(it < max_iter, shift2 > tol * tol)
+
+    def body(state):
+        centers, _, it, _ = state
+        d2 = _sq_dists(X, centers)
+        assign = jnp.argmin(d2, axis=1)
+        min_d2 = jnp.min(d2, axis=1)
+        onehot = jax.nn.one_hot(assign, k, dtype=X.dtype) * w[:, None]
+        counts = jnp.sum(onehot, axis=0)
+        sums = pdot(onehot.T, X)
+        new_centers = jnp.where(
+            counts[:, None] > 0, sums / jnp.maximum(counts, 1.0)[:, None], centers
+        )
+        inertia = jnp.sum(w * min_d2)
+        shift2 = jnp.max(jnp.sum((new_centers - centers) ** 2, axis=1))
+        return new_centers, inertia, it + 1, shift2
+
+    init_state = (init_centers, jnp.array(0.0, X.dtype), 0, jnp.array(jnp.inf, X.dtype))
+    centers, inertia, n_iter, _ = jax.lax.while_loop(cond, body, init_state)
+    # inertia reported against the final centers
+    d2 = _sq_dists(X, centers)
+    inertia = jnp.sum(w * jnp.min(d2, axis=1))
+    return centers, inertia, n_iter
+
+
+@jax.jit
+def kmeans_predict(X: jax.Array, centers: jax.Array) -> jax.Array:
+    return jnp.argmin(_sq_dists(X, centers), axis=1)
+
+
+@jax.jit
+def kmeans_inertia(X: jax.Array, w: jax.Array, centers: jax.Array) -> jax.Array:
+    return jnp.sum(w * jnp.min(_sq_dists(X, centers), axis=1))
+
+
+def _random_real_rows(
+    X: jax.Array, w: jax.Array, n_pick: int, key: jax.Array
+) -> jax.Array:
+    """Pick n_pick distinct real (w>0) rows via Gumbel-top-k on the mask."""
+    g = jax.random.gumbel(key, (X.shape[0],), dtype=X.dtype)
+    score = jnp.where(w > 0, g, -jnp.inf)
+    _, idx = jax.lax.top_k(score, n_pick)
+    return X[idx]
+
+
+@functools.partial(jax.jit, static_argnames=("n_pick",))
+def _sample_by_d2(
+    X: jax.Array, w: jax.Array, centers: jax.Array, n_pick: int, key: jax.Array
+) -> jax.Array:
+    """Sample n_pick rows without replacement with probability ∝ d²(x, centers):
+    Gumbel-top-k over log d² (k-means|| oversampling with static shapes)."""
+    d2 = jnp.min(_sq_dists(X, centers), axis=1)
+    logits = jnp.where(w > 0, jnp.log(d2 + 1e-30), -jnp.inf)
+    g = jax.random.gumbel(key, logits.shape, dtype=X.dtype)
+    _, idx = jax.lax.top_k(logits + g, n_pick)
+    return X[idx]
+
+
+def _weighted_kmeans_pp(
+    candidates: np.ndarray, weights: np.ndarray, k: int, rng: np.random.Generator
+) -> np.ndarray:
+    """Host-side weighted k-means++ over the small candidate set (the final reduce of
+    scalable k-means++)."""
+    n = candidates.shape[0]
+    centers = np.empty((k, candidates.shape[1]), dtype=candidates.dtype)
+    p = weights / weights.sum()
+    centers[0] = candidates[rng.choice(n, p=p)]
+    d2 = np.sum((candidates - centers[0]) ** 2, axis=1)
+    for i in range(1, k):
+        probs = weights * d2
+        s = probs.sum()
+        if s <= 0:
+            centers[i] = candidates[rng.integers(n)]
+        else:
+            centers[i] = candidates[rng.choice(n, p=probs / s)]
+        d2 = np.minimum(d2, np.sum((candidates - centers[i]) ** 2, axis=1))
+    return centers
+
+
+def kmeans_init(
+    X: jax.Array,
+    w: jax.Array,
+    k: int,
+    init: str,
+    init_steps: int,
+    seed: int,
+) -> np.ndarray:
+    """Compute initial centers (host-side result).
+
+    init == "random": k distinct real rows.
+    init == "k-means||" (or "scalable-k-means++"): Gumbel-top-k oversampling rounds,
+    then weighted k-means++ on the ~(1 + steps·2k) candidates."""
+    key = jax.random.PRNGKey(seed & 0x7FFFFFFF)
+    if init == "random":
+        return np.asarray(_random_real_rows(X, w, k, key))
+
+    rng = np.random.default_rng(seed & 0x7FFFFFFF)
+    n_real = int(jnp.sum(w > 0))
+    l = max(2, min(2 * k, n_real))  # never oversample past the real rows (padding)
+    key, sub = jax.random.split(key)
+    cand = [np.asarray(_random_real_rows(X, w, 1, sub))]
+    for _ in range(max(init_steps, 1)):
+        key, sub = jax.random.split(key)
+        current = jnp.asarray(np.concatenate(cand, axis=0))
+        cand.append(np.asarray(_sample_by_d2(X, w, current, l, sub)))
+    candidates = np.concatenate(cand, axis=0)
+    # weight candidates by how many points they attract (one cheap pass)
+    assign = np.asarray(kmeans_predict(X, jnp.asarray(candidates)))
+    wh = np.asarray(w)
+    weights = np.bincount(assign, weights=wh, minlength=candidates.shape[0]).astype(
+        candidates.dtype
+    )
+    weights = np.maximum(weights, 1e-12)
+    return _weighted_kmeans_pp(candidates, weights, k, rng)
+
+
+def kmeans_fit(
+    X: jax.Array,
+    w: jax.Array,
+    k: int,
+    max_iter: int,
+    tol: float,
+    init: str,
+    init_steps: int,
+    seed: int,
+) -> Dict[str, object]:
+    init_centers = jnp.asarray(kmeans_init(X, w, k, init, init_steps, seed))
+    centers, inertia, n_iter = lloyd_fit(X, w, init_centers, float(tol), int(max_iter))
+    return {
+        "cluster_centers": np.asarray(centers),
+        "inertia": float(inertia),
+        "n_iter": int(n_iter),
+    }

@@ -1,0 +1,81 @@
+#
+# Shared distributed linear-algebra kernels (L1).
+#
+# These replace the reference's cuML sufficient-statistics machinery: weighted moments
+# and Gram/covariance accumulation with the allreduce that cuML MG runs over NCCL
+# (e.g. PCAMG covariance, reference feature.py:228-253; distributed standardization via
+# allGather-sum, reference utils.py:876-982). Here the inputs are row-sharded jax arrays
+# and XLA inserts the psum over the mesh when the contraction crosses the sharded axis —
+# the matmuls land on the MXU, the reduction rides ICI.
+#
+# All kernels are weight-aware: `w` is the {0,1} padding mask times any sample weight
+# (parallel/partition.py), so padded rows contribute nothing.
+#
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ._precision import pdot
+
+
+@jax.jit
+def weighted_mean(X: jax.Array, w: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Returns (mean, wsum). One pass; psum over the data axis is implicit."""
+    wsum = jnp.sum(w)
+    mean = pdot(w, X) / wsum
+    return mean, wsum
+
+
+@jax.jit
+def weighted_moments(X: jax.Array, w: jax.Array) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Returns (mean, var, wsum) with the unbiased (wsum-1) variance denominator,
+    matching Spark's Summarizer semantics used by the reference's standardization
+    (utils.py:876-982)."""
+    wsum = jnp.sum(w)
+    mean = pdot(w, X) / wsum
+    sq = pdot(w, X * X)
+    var = (sq - wsum * mean * mean) / (wsum - 1.0)
+    return mean, jnp.maximum(var, 0.0), wsum
+
+
+@jax.jit
+def weighted_covariance(X: jax.Array, w: jax.Array) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Centered covariance C = Σ w_i (x_i-μ)(x_i-μ)ᵀ / (Σw - 1) via sufficient
+    statistics (single data pass: S2 = Xᵀ diag(w) X, then mean correction)."""
+    wsum = jnp.sum(w)
+    mean = pdot(w, X) / wsum
+    S2 = pdot((X * w[:, None]).T, X)
+    cov = (S2 - wsum * jnp.outer(mean, mean)) / (wsum - 1.0)
+    return cov, mean, wsum
+
+
+@jax.jit
+def gram_and_xty(
+    X: jax.Array, y: jax.Array, w: jax.Array
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Normal-equation sufficient statistics: (XᵀWX, XᵀWy, Σw) in one sharded pass —
+    the TPU form of the reference's LinearRegressionMG/RidgeMG allreduce."""
+    Xw = X * w[:, None]
+    return pdot(Xw.T, X), pdot(Xw.T, y), jnp.sum(w)
+
+
+def standardize_columns(
+    X: jax.Array, w: jax.Array, with_mean: bool = True
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Return (X_standardized, mean, scale): the reference's distributed
+    standardization workaround (classification.py:1018-1028, utils.py:876-982) as a
+    sharded kernel. Columns with zero variance get scale 1 to avoid division blowup.
+    Padded rows are standardized too (they are masked at use sites via w)."""
+    mean, var, _ = weighted_moments(X, w)
+    scale = jnp.sqrt(var)
+    scale = jnp.where(scale <= 0.0, 1.0, scale)
+    if with_mean:
+        Xs = (X - mean) / scale
+    else:
+        Xs = X / scale
+    return Xs, mean, scale

@@ -1,0 +1,70 @@
+#
+# PCA fit/transform kernels — the TPU-native replacement for cuml.decomposition.pca_mg
+# (reference feature.py:228-269 calls PCAMG.fit with partition descriptors; the
+# covariance allreduce happens inside cuML over NCCL).
+#
+# TPU formulation: one sharded pass builds the dxd covariance from sufficient
+# statistics (ops/linalg.py, psum over ICI implicit in the sharded contraction), then a
+# replicated symmetric eigendecomposition extracts the top-k components. For d up to a
+# few thousand the eigh is tiny next to the covariance matmul, which is the MXU-bound
+# hot loop.
+#
+# Parity notes:
+#   * component signs canonicalized so each component's max-|.| element is positive —
+#     the reference's signFlip (deprecated/native/src/rapidsml_jni.cu:35) / sklearn
+#     svd_flip convention.
+#   * transform does NOT center: Spark's PCA projects raw rows, and the reference adds
+#     the projected mean back onto cuML's centered output to match
+#     (reference feature.py:438-451). We project raw rows directly.
+#
+
+from __future__ import annotations
+
+import functools
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .linalg import weighted_covariance
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def _pca_from_cov(cov: jax.Array, k: int):
+    eigvals, eigvecs = jnp.linalg.eigh(cov)  # ascending
+    # top-k, descending
+    vals = eigvals[::-1][:k]
+    vecs = eigvecs[:, ::-1][:, :k].T  # (k, d)
+    # sign canonicalization: max-|.| element of each component positive
+    idx = jnp.argmax(jnp.abs(vecs), axis=1)
+    signs = jnp.sign(vecs[jnp.arange(k), idx])
+    signs = jnp.where(signs == 0, 1.0, signs)
+    vecs = vecs * signs[:, None]
+    total_var = jnp.trace(cov)
+    return vals, vecs, total_var
+
+
+def pca_fit(X: jax.Array, w: jax.Array, k: int) -> Dict[str, np.ndarray]:
+    """Distributed PCA fit. X: (padded_m, d) rows sharded over the mesh; w: padding/
+    sample weights. Returns host-side model attributes (the analog of the model row the
+    reference collects, feature.py:260-285)."""
+    cov, mean, wsum = weighted_covariance(X, w)
+    vals, vecs, total_var = _pca_from_cov(cov, k)
+    n = float(wsum)
+    vals_h = np.asarray(vals, dtype=np.float64)
+    return {
+        "mean": np.asarray(mean),
+        "components": np.asarray(vecs),
+        "explained_variance": vals_h,
+        "explained_variance_ratio": vals_h / float(total_var),
+        "singular_values": np.sqrt(np.maximum(vals_h, 0.0) * (n - 1.0)),
+    }
+
+
+@jax.jit
+def pca_transform(X: jax.Array, components: jax.Array) -> jax.Array:
+    """Spark-parity projection of raw (uncentered) rows: X @ Vᵀ."""
+    from ._precision import pdot
+
+    return pdot(X, components.T)

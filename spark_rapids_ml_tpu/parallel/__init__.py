@@ -1,0 +1,11 @@
+from .mesh import (
+    DATA_AXIS,
+    FEATURE_AXIS,
+    default_num_workers,
+    get_mesh,
+    replicate_array,
+    row_sharding,
+    shard_array,
+)
+from .partition import PartitionDescriptor, even_partition_sizes, pad_rows
+from .bootstrap import init_process_group

@@ -1,0 +1,75 @@
+#
+# Multi-host process-group bootstrap — the TPU analog of the reference's NCCL-uid
+# handshake (reference common/cuml_context.py:75-110: rank 0 creates the uid, the Spark
+# barrier allGather distributes it, every rank calls nccl.init).
+#
+# On TPU pods, jax.distributed.initialize plays that role once per host process: the
+# coordinator address takes the place of the NCCL uid, and any hardware-agnostic
+# control plane (Spark barrier allGather, a file system rendezvous, GCE metadata) can
+# carry it. After initialization, jax.devices() spans the pod and Mesh/pjit handle all
+# collective wiring — there is no per-algorithm communicator to inject.
+#
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Optional
+
+import jax
+
+from ..utils import get_logger
+
+_initialized = False
+
+
+def init_process_group(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    allgather_fn: Optional[Callable[[str], list]] = None,
+) -> None:
+    """Initialize the multi-host JAX runtime.
+
+    `allgather_fn` is the pluggable control plane: given this rank's string payload it
+    must return every rank's payload in rank order — a Spark BarrierTaskContext.allGather
+    fits directly (the reference's bootstrap control plane, cuml_context.py:80-110).
+    Rank 0 advertises its address; all ranks then initialize against it.
+
+    No-op on single-process runs (local mode / tests), mirroring how the reference skips
+    UCX when nranks == 1 would make it pointless.
+    """
+    global _initialized
+    if _initialized:
+        return
+    logger = get_logger("bootstrap")
+
+    if coordinator_address is None and allgather_fn is not None:
+        import socket
+
+        my_payload = ""
+        if process_id == 0:
+            host = socket.gethostbyname(socket.gethostname())
+            port = int(os.environ.get("SPARK_RAPIDS_ML_TPU_COORD_PORT", "8476"))
+            my_payload = f"{host}:{port}"
+        payloads = allgather_fn(my_payload)
+        coordinator_address = next(p for p in payloads if p)
+        if num_processes is None:
+            num_processes = len(payloads)  # the barrier width IS the process count
+
+    if coordinator_address is None or num_processes in (None, 1):
+        logger.debug("single-process run; skipping jax.distributed.initialize")
+        _initialized = True
+        return
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    logger.info(
+        "jax.distributed initialized: process %s/%s via %s",
+        process_id,
+        num_processes,
+        coordinator_address,
+    )
+    _initialized = True

@@ -1,0 +1,70 @@
+#
+# Device-mesh runtime (L2 of the layer map) — the structural replacement for the
+# reference's CumlContext NCCL/UCX bootstrap
+# (reference python/src/spark_rapids_ml/common/cuml_context.py:36-201).
+#
+# Where the reference builds an explicit communicator (rank-0 generates an NCCL uid,
+# Spark barrier allGather distributes it, nccl.init + inject_comms_on_handle wire it into
+# cuML's RAFT handle), the TPU runtime has NO communicator object: the "clique" is a
+# jax.sharding.Mesh, and the collectives are inserted by XLA when a jitted program runs
+# over sharded arrays (psum / all_gather / ppermute over ICI/DCN). Multi-host process
+# groups bootstrap once per process via jax.distributed.initialize (see bootstrap.py) —
+# the drop-in analog of the NCCL-uid handshake.
+#
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+DATA_AXIS = "data"
+FEATURE_AXIS = "feature"
+
+_default_mesh: Optional[Mesh] = None
+
+
+def default_num_workers() -> int:
+    """One worker == one addressable TPU device (the reference's 1 worker == 1 GPU,
+    params.py:337-371)."""
+    return jax.local_device_count()
+
+
+def get_mesh(num_workers: Optional[int] = None, feature_axis: int = 1) -> Mesh:
+    """Build (or fetch) a mesh of `num_workers` data-parallel devices.
+
+    feature_axis > 1 carves the device pool into a 2-D (data, feature) mesh used for
+    feature-sharded covariance / wide-model layouts."""
+    global _default_mesh
+    devices = jax.devices()
+    n = num_workers if num_workers is not None else len(devices)
+    n = min(n, len(devices))
+    if feature_axis == 1 and _default_mesh is not None and _default_mesh.devices.size == n:
+        return _default_mesh
+    if n % feature_axis != 0:
+        raise ValueError(f"num_workers={n} not divisible by feature_axis={feature_axis}")
+    dev_array = np.array(devices[:n]).reshape(n // feature_axis, feature_axis)
+    mesh = Mesh(dev_array, (DATA_AXIS, FEATURE_AXIS))
+    if feature_axis == 1:
+        _default_mesh = mesh
+    return mesh
+
+
+def row_sharding(mesh: Mesh, ndim: int) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec(*([DATA_AXIS] + [None] * (ndim - 1))))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def shard_array(x: np.ndarray, mesh: Mesh) -> jax.Array:
+    """Place a host array on the mesh with rows sharded across the data axis."""
+    return jax.device_put(x, row_sharding(mesh, x.ndim))
+
+
+def replicate_array(x: np.ndarray, mesh: Mesh) -> jax.Array:
+    return jax.device_put(x, replicated(mesh))

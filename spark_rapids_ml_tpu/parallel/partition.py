@@ -1,0 +1,95 @@
+#
+# Partition bookkeeping + padding (part of L2, SURVEY.md §1).
+#
+# Structural equivalent of the reference's PartitionDescriptor
+# (reference python/src/spark_rapids_ml/utils.py:300-355): there, each barrier task
+# allGathers (rank, n_rows, nnz) strings so every cuML MG kernel knows the global data
+# layout. Here the global layout is a property of the sharded jax.Array, but two
+# TPU-specific concerns remain and live in this module:
+#   * ragged partitions: XLA requires equal shard sizes, so rows are padded to a
+#     multiple of the worker count and a {0,1} weight vector marks real rows — every op
+#     in ops/ is weight-aware (this is SURVEY.md §7 "hard parts: dynamic shapes").
+#   * the descriptor itself (sizes per rank, total rows, cols, nnz) still travels to the
+#     fit functions, matching the reference's `parts_rank_size` contract
+#     (e.g. feature.py:228-253).
+#
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+@dataclass
+class PartitionDescriptor:
+    """Global data-layout facts shared with every fit kernel
+    (reference utils.py:300-355)."""
+
+    parts_rank_size: List[Tuple[int, int]]  # [(rank, n_real_rows_on_rank)]
+    m: int  # total real rows
+    n: int  # cols
+    rank: int = 0
+    nnz: int = -1  # total nonzeros for sparse inputs
+    padded_m: int = -1  # rows after padding to the mesh
+
+    @classmethod
+    def build(
+        cls,
+        partition_rows: Sequence[int],
+        total_cols: int,
+        rank: int = 0,
+        nnz: int = -1,
+        padded_m: int = -1,
+    ) -> "PartitionDescriptor":
+        parts = [(r, int(sz)) for r, sz in enumerate(partition_rows)]
+        return cls(
+            parts_rank_size=parts,
+            m=int(sum(partition_rows)),
+            n=int(total_cols),
+            rank=rank,
+            nnz=nnz,
+            padded_m=padded_m,
+        )
+
+
+def pad_rows(
+    X: np.ndarray,
+    num_workers: int,
+    *extra_row_aligned: Optional[np.ndarray],
+    row_multiple: int = 8,
+) -> Tuple[np.ndarray, np.ndarray, List[Optional[np.ndarray]]]:
+    """Pad rows so every mesh worker gets an equal, tile-friendly shard.
+
+    Returns (X_padded, weight, padded_extras) where weight is 1.0 for real rows and 0.0
+    for padding. `row_multiple` keeps per-shard rows a multiple of the float32 sublane
+    tile (8) so XLA lays shards out MXU-friendly. Extra arrays (labels, sample weights,
+    row ids) are padded with zeros to the same length.
+    """
+    n = X.shape[0]
+    chunk = num_workers * row_multiple
+    padded = ((n + chunk - 1) // chunk) * chunk
+    pad = padded - n
+    weight = np.ones((padded,), dtype=X.dtype if X.dtype in (np.float32, np.float64) else np.float32)
+    if pad:
+        weight[n:] = 0.0
+        X = np.concatenate([X, np.zeros((pad,) + X.shape[1:], dtype=X.dtype)], axis=0)
+    extras_out: List[Optional[np.ndarray]] = []
+    for e in extra_row_aligned:
+        if e is None:
+            extras_out.append(None)
+        elif pad:
+            extras_out.append(
+                np.concatenate([e, np.zeros((pad,) + e.shape[1:], dtype=e.dtype)], axis=0)
+            )
+        else:
+            extras_out.append(e)
+    return X, weight, extras_out
+
+
+def even_partition_sizes(n_rows: int, num_workers: int) -> List[int]:
+    """Row counts per worker for an evenly-split dataset (repartition(num_workers),
+    reference core.py:771-772)."""
+    base, rem = divmod(n_rows, num_workers)
+    return [base + (1 if i < rem else 0) for i in range(num_workers)]

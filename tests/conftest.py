@@ -1,0 +1,43 @@
+#
+# Test harness: a virtual 8-device CPU mesh is the cluster simulator, the TPU analog of
+# the reference's `local[N]` multi-GPU Spark session (reference tests/conftest.py:45-86).
+# Collectives (psum/all_gather) run genuinely across the 8 XLA host devices — multi-chip
+# is simulated by forcing the host platform device count, never by mocking.
+#
+import os
+
+# tests always run on the virtual CPU mesh, even when the ambient env points jax at a
+# real accelerator platform
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="session")
+def n_devices() -> int:
+    import jax
+
+    return jax.local_device_count()
+
+
+def pytest_addoption(parser):
+    parser.addoption(
+        "--runslow", action="store_true", default=False, help="run slow tests"
+    )
+
+
+def pytest_configure(config):
+    config.addinivalue_line("markers", "slow: mark test as slow to run")
+
+
+def pytest_collection_modifyitems(config, items):
+    if config.getoption("--runslow"):
+        return
+    skip_slow = pytest.mark.skip(reason="need --runslow option to run")
+    for item in items:
+        if "slow" in item.keywords:
+            item.add_marker(skip_slow)

@@ -1,0 +1,222 @@
+"""Framework contract tests via a dummy estimator — the reference's pattern of testing
+the harness with a fake algorithm, not a fake backend
+(reference tests/test_common_estimator.py:119-245 SparkRapidsMLDummy)."""
+
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_ml_tpu.core import (
+    FitInputs,
+    _TpuClass,
+    _TpuEstimator,
+    _TpuModelWithColumns,
+)
+from spark_rapids_ml_tpu.core.backend_params import HasFeaturesCols
+from spark_rapids_ml_tpu.core.params import (
+    HasInputCol,
+    HasMaxIter,
+    Param,
+    TypeConverters,
+)
+
+
+class TpuDummy(
+    _TpuEstimator, HasInputCol, HasFeaturesCols, HasMaxIter
+):
+    """Dummy estimator whose fit kernel asserts the FitInputs contract on-device."""
+
+    alpha = Param("undefined", "alpha", "dummy param", TypeConverters.toFloat)
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__()
+        self._setDefault(maxIter=7, alpha=1.0)
+        self.initialize_tpu_params()
+        self._set_params(**kwargs)
+        self.fit_checks: Dict[str, Any] = {}
+
+    @classmethod
+    def _param_mapping(cls):
+        return {"maxIter": "max_iter", "alpha": "alpha_backend", "inputCol": "", "featuresCols": ""}
+
+    @classmethod
+    def _get_tpu_params_default(cls):
+        return {"max_iter": 7, "alpha_backend": 1.0}
+
+    def _out_schema(self) -> List[str]:
+        return ["model_mean", "n_seen"]
+
+    def _get_tpu_fit_func(self, extra_params: Optional[List[Dict[str, Any]]] = None):
+        expected = dict(self._expected)
+
+        def _fit(inputs: FitInputs) -> Dict[str, Any]:
+            # param delivery (reference asserts init params inside the executor,
+            # test_common_estimator.py:190-227)
+            assert inputs.params["max_iter"] == expected["max_iter"]
+            assert inputs.params["alpha_backend"] == expected["alpha_backend"]
+            # descriptor contract
+            desc = inputs.desc
+            assert desc.m == expected["m"]
+            assert desc.n == expected["n"]
+            assert len(desc.parts_rank_size) == expected["num_workers"]
+            assert sum(sz for _, sz in desc.parts_rank_size) == desc.m
+            # sharding contract: rows sharded over the data axis of the mesh
+            assert inputs.features.shape == (desc.padded_m, desc.n)
+            shard_sizes = {s.data.shape[0] for s in inputs.features.addressable_shards}
+            assert len(shard_sizes) == 1  # equal shards after padding
+            # collective liveness: weighted count via sharded reduction must equal m
+            # (the test_ucx.py analog: a real reduction across all devices,
+            # reference tests/test_ucx.py:58-106)
+            n_seen = float(jnp.sum(inputs.row_weight))
+            assert n_seen == float(desc.m)
+            mean = np.asarray(
+                (inputs.row_weight @ inputs.features) / jnp.sum(inputs.row_weight)
+            )
+            return {"model_mean": mean, "n_seen": n_seen}
+
+        return _fit
+
+    def _create_pyspark_model(self, attrs: Dict[str, Any]) -> "TpuDummyModel":
+        return TpuDummyModel(**attrs)
+
+
+class TpuDummyModel(_TpuModelWithColumns, HasInputCol, HasFeaturesCols, HasMaxIter):
+    alpha = Param("undefined", "alpha", "dummy param", TypeConverters.toFloat)
+
+    def __init__(self, model_mean: np.ndarray, n_seen: float) -> None:
+        super().__init__(model_mean=np.asarray(model_mean), n_seen=n_seen)
+
+    @classmethod
+    def _param_mapping(cls):
+        return TpuDummy._param_mapping()
+
+    def _out_schema(self):
+        return ["centered"]
+
+    def _get_tpu_fit_func(self, extra_params=None):
+        raise NotImplementedError
+
+    def _transform_arrays(self, X: np.ndarray) -> Dict[str, np.ndarray]:
+        return {"centered": X - self._model_attributes["model_mean"]}
+
+
+def _make_df(n=37, d=5, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    return pd.DataFrame({"features": list(X)}), X
+
+
+def test_dummy_fit_contract(n_devices):
+    df, X = _make_df()
+    est = TpuDummy(inputCol="features", maxIter=3, alpha=2.5)
+    est.num_workers = n_devices
+    est._expected = {
+        "max_iter": 3,
+        "alpha_backend": 2.5,
+        "m": len(df),
+        "n": X.shape[1],
+        "num_workers": n_devices,
+    }
+    model = est.fit(df)
+    np.testing.assert_allclose(
+        model.get_model_attributes()["model_mean"], X.mean(axis=0), rtol=1e-5
+    )
+    # params copied onto the model (reference core.py:1267-1279)
+    assert model.getOrDefault("maxIter") == 3
+    assert model.tpu_params["alpha_backend"] == 2.5
+
+
+def test_dummy_backend_param_names():
+    # set via backend name; spark alias syncs (reference params.py:430-487)
+    est = TpuDummy(inputCol="features", max_iter=11)
+    assert est.getOrDefault("maxIter") == 11
+    assert est.tpu_params["max_iter"] == 11
+
+
+def test_dummy_transform_roundtrip(n_devices):
+    df, X = _make_df(n=23)
+    est = TpuDummy(inputCol="features")
+    est.num_workers = n_devices
+    est._expected = {
+        "max_iter": 7,
+        "alpha_backend": 1.0,
+        "m": 23,
+        "n": 5,
+        "num_workers": n_devices,
+    }
+    model = est.fit(df)
+    out = model.transform(df)
+    assert "centered" in out.columns
+    got = np.stack(out["centered"].to_numpy())
+    np.testing.assert_allclose(got, X - X.mean(axis=0), rtol=1e-4, atol=1e-5)
+
+
+def test_dummy_numpy_input(n_devices):
+    _, X = _make_df(n=16)
+    est = TpuDummy(inputCol="features")
+    est.num_workers = n_devices
+    est._expected = {
+        "max_iter": 7,
+        "alpha_backend": 1.0,
+        "m": 16,
+        "n": 5,
+        "num_workers": n_devices,
+    }
+    model = est.fit(X)  # numpy design matrix bypasses column selection
+    np.testing.assert_allclose(
+        model.get_model_attributes()["model_mean"], X.mean(axis=0), rtol=1e-5
+    )
+
+
+def test_dummy_persistence(tmp_path, n_devices):
+    df, X = _make_df(n=19)
+    est = TpuDummy(inputCol="features", alpha=3.5)
+    est.num_workers = n_devices
+    est._expected = {
+        "max_iter": 7,
+        "alpha_backend": 3.5,
+        "m": 19,
+        "n": 5,
+        "num_workers": n_devices,
+    }
+    model = est.fit(df)
+    path = str(tmp_path / "dummy_model")
+    model.save(path)
+    loaded = TpuDummyModel.load(path)
+    np.testing.assert_allclose(
+        loaded.get_model_attributes()["model_mean"],
+        model.get_model_attributes()["model_mean"],
+    )
+    assert loaded.getOrDefault("alpha") == 3.5
+    assert loaded.uid == model.uid
+
+
+def test_empty_input_raises():
+    est = TpuDummy(inputCol="features")
+    df = pd.DataFrame({"features": []})
+    with pytest.raises((RuntimeError, IndexError)):
+        est.fit(df)
+
+
+def test_fit_multiple():
+    df, X = _make_df(n=12)
+    est = TpuDummy(inputCol="features")
+    est.num_workers = jax.local_device_count()
+    est._expected = {
+        "max_iter": 7,
+        "alpha_backend": 1.0,
+        "m": 12,
+        "n": 5,
+        "num_workers": est.num_workers,
+    }
+    maps = [{est.alpha: 1.0}, {est.alpha: 1.0}]
+    models = est.fit(df, maps)
+    assert len(models) == 2
+    for m in models:
+        np.testing.assert_allclose(
+            m.get_model_attributes()["model_mean"], X.mean(axis=0), rtol=1e-5
+        )

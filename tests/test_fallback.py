@@ -1,0 +1,77 @@
+"""CPU-fallback behavior (reference core.py:1283-1297 / params.py:690-707: estimators
+with unsupported params fall back wholesale to the CPU twin — sklearn here)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_ml_tpu.clustering import KMeans, KMeansModel
+from spark_rapids_ml_tpu.feature import PCA
+
+
+def _df(n=80, d=5, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    return pd.DataFrame({"features": list(X)}), X
+
+
+def test_unsupported_param_flags_fallback():
+    est = KMeans(k=2, solver="fancy")
+    assert est._use_cpu_fallback()
+    est2 = KMeans(k=2)
+    assert not est2._use_cpu_fallback()
+
+
+def test_kmeans_fallback_fit_produces_model(n_devices):
+    df, X = _df()
+    est = KMeans(k=3, seed=1, solver="unsupported_thing")
+    model = est.fit(df)
+    assert isinstance(model, KMeansModel)
+    assert model.cluster_centers_.shape == (3, 5)
+    out = model.transform(df)
+    assert set(out["prediction"].unique()) <= {0, 1, 2}
+
+
+def test_kmeans_cosine_fallback_raises_informative():
+    df, _ = _df()
+    with pytest.raises(ValueError, match="cosine"):
+        KMeans(k=2, distanceMeasure="cosine").fit(df)
+
+
+def test_fallback_disabled_raises():
+    df, _ = _df()
+    est = KMeans(k=2, solver="x")
+    est._fallback_enabled = False
+    assert not est._use_cpu_fallback()
+
+
+def test_kmeans_k_exceeds_rows():
+    df, _ = _df(n=3)
+    with pytest.raises(ValueError, match="exceeds the number of rows"):
+        KMeans(k=5, initMode="random").fit(df)
+
+
+def test_missing_weight_col_raises():
+    df, _ = _df()
+    with pytest.raises(ValueError, match="weight column 'wieght' not found"):
+        KMeans(k=2, weightCol="wieght").fit(df)
+
+
+def test_load_wrong_class_raises(tmp_path, n_devices):
+    df, _ = _df()
+    model = PCA(k=2, inputCol="features").fit(df)
+    path = str(tmp_path / "m")
+    model.save(path)
+    with pytest.raises(TypeError, match="not a KMeansModel"):
+        KMeansModel.load(path)
+
+
+def test_overwrite_save_clears_stale_files(tmp_path, n_devices):
+    df, _ = _df()
+    model = PCA(k=2, inputCol="features").fit(df)
+    path = str(tmp_path / "p")
+    model.save(path)
+    est = PCA(k=4, inputCol="features")
+    est.write().overwrite().save(path)
+    loaded = PCA.load(path)  # must not resurrect the old model's attributes
+    assert loaded.getK() == 4

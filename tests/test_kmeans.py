@@ -1,0 +1,96 @@
+"""KMeans parity tests vs sklearn (the reference compares GPU vs Spark ML CPU,
+tests/test_kmeans.py)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+from sklearn.cluster import KMeans as SkKMeans
+from sklearn.datasets import make_blobs
+
+from spark_rapids_ml_tpu.clustering import KMeans, KMeansModel
+
+
+def _blobs(n=500, d=8, k=5, seed=0, std=0.5):
+    X, y = make_blobs(
+        n_samples=n, n_features=d, centers=k, cluster_std=std, random_state=seed
+    )
+    return X.astype(np.float32), y
+
+
+def _match_centers(got: np.ndarray, expected: np.ndarray) -> float:
+    """Max distance between matched center pairs (greedy match)."""
+    from scipy.optimize import linear_sum_assignment
+    from scipy.spatial.distance import cdist
+
+    cost = cdist(got, expected)
+    r, c = linear_sum_assignment(cost)
+    return float(cost[r, c].max())
+
+
+@pytest.mark.parametrize("init", ["k-means||", "random"])
+def test_kmeans_recovers_blobs(init, n_devices):
+    X, _ = _blobs()
+    df = pd.DataFrame({"features": list(X)})
+    est = KMeans(k=5, initMode=init, maxIter=50, seed=7, tol=1e-6)
+    est.num_workers = n_devices
+    model = est.fit(df)
+
+    sk = SkKMeans(n_clusters=5, n_init=10, random_state=0).fit(X)
+    # well-separated blobs: both should find essentially the true centers
+    assert _match_centers(model.cluster_centers_, sk.cluster_centers_) < 0.15
+    # inertia within 2% of sklearn's
+    assert model.inertia_ <= sk.inertia_ * 1.02
+
+
+def test_kmeans_transform_and_predict(n_devices):
+    X, y = _blobs(n=300, d=4, k=3, seed=2)
+    df = pd.DataFrame({"features": list(X)})
+    model = KMeans(k=3, seed=5, maxIter=40).fit(df)
+    out = model.transform(df)
+    assert "prediction" in out.columns
+    pred = out["prediction"].to_numpy()
+    # cluster labels must be consistent: same-blob points share a label
+    from sklearn.metrics import adjusted_rand_score
+
+    assert adjusted_rand_score(y, pred) > 0.95
+    # single-vector predict agrees with transform
+    assert model.predict(X[0]) == pred[0]
+
+
+def test_kmeans_weighted_fit(n_devices):
+    """Sample weights shift centers (weightCol support)."""
+    X = np.array([[0.0], [0.0], [10.0]], dtype=np.float32)
+    w = np.array([1.0, 1.0, 100.0], dtype=np.float32)
+    df = pd.DataFrame({"features": list(X), "w": w})
+    model = KMeans(k=1, weightCol="w", maxIter=10, initMode="random", seed=1).fit(df)
+    center = model.cluster_centers_[0, 0]
+    expected = (0 * 2 + 10 * 100) / 102
+    assert abs(center - expected) < 1e-3
+
+
+def test_kmeans_tol_zero_remap():
+    est = KMeans(k=2, tol=0.0)
+    assert est.tpu_params["tol"] == 1.0e-16
+
+
+def test_kmeans_persistence(tmp_path, n_devices):
+    X, _ = _blobs(n=100, d=3, k=2, seed=4)
+    df = pd.DataFrame({"features": list(X)})
+    model = KMeans(k=2, seed=3).fit(df)
+    path = str(tmp_path / "kmeans_model")
+    model.save(path)
+    loaded = KMeansModel.load(path)
+    np.testing.assert_allclose(loaded.cluster_centers_, model.cluster_centers_)
+    pred_a = model.transform(df)["prediction"].to_numpy()
+    pred_b = loaded.transform(df)["prediction"].to_numpy()
+    np.testing.assert_array_equal(pred_a, pred_b)
+
+
+def test_kmeans_uneven_rows(n_devices):
+    """Padding must not create phantom points at the origin."""
+    X, _ = _blobs(n=97, d=5, k=3, seed=6)
+    X += 100.0  # far from origin: a phantom zero-row would grab a center
+    df = pd.DataFrame({"features": list(X)})
+    model = KMeans(k=3, seed=0, maxIter=30).fit(df)
+    # all centers near the data, none at the origin
+    assert np.all(np.linalg.norm(model.cluster_centers_, axis=1) > 50)

@@ -1,0 +1,70 @@
+"""Param-system parity tests (patterned on the reference's param plumbing coverage in
+tests/test_common_estimator.py:412-)."""
+
+import pytest
+
+from spark_rapids_ml_tpu.core.params import (
+    HasInputCol,
+    HasMaxIter,
+    Param,
+    Params,
+    TypeConverters,
+)
+
+
+class _Thing(HasMaxIter, HasInputCol):
+    k = Param("undefined", "k", "doc for k", TypeConverters.toInt)
+
+    def __init__(self, **kwargs):
+        super().__init__()
+        self._setDefault(maxIter=10, k=2)
+        self._set(**kwargs)
+
+
+def test_defaults_and_set():
+    t = _Thing()
+    assert t.getOrDefault(t.maxIter) == 10
+    assert t.getOrDefault("k") == 2
+    assert not t.isSet(t.k)
+    t._set(k=5)
+    assert t.isSet(t.k)
+    assert t.getOrDefault(t.k) == 5
+
+
+def test_type_conversion():
+    t = _Thing(k=3.0)
+    assert t.getOrDefault(t.k) == 3 and isinstance(t.getOrDefault(t.k), int)
+    with pytest.raises(TypeError):
+        _Thing(k="three")
+
+
+def test_param_ownership_and_uid():
+    a, b = _Thing(), _Thing()
+    assert a.uid != b.uid
+    assert a.k.parent == a.uid
+    with pytest.raises(ValueError):
+        a.getOrDefault(b.k) if a._shouldOwn(b.k) is None else None
+
+
+def test_copy_with_extra():
+    a = _Thing(k=7)
+    b = a.copy({a.maxIter: 99})
+    assert b.getOrDefault(b.k) == 7
+    assert b.getOrDefault(b.maxIter) == 99
+    # original untouched
+    assert a.getOrDefault(a.maxIter) == 10
+    # copied params re-parented
+    assert b.k.parent == b.uid
+
+
+def test_explain_params():
+    t = _Thing(k=4)
+    text = t.explainParams()
+    assert "doc for k" in text and "current: 4" in text
+
+
+def test_extract_param_map():
+    t = _Thing(k=4)
+    pm = t.extractParamMap()
+    assert pm[t.k] == 4
+    assert pm[t.maxIter] == 10

@@ -1,0 +1,111 @@
+"""PCA parity tests vs sklearn (the reference compares GPU vs Spark ML CPU results,
+tests/test_pca.py; sklearn is the CPU oracle here)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+from sklearn.decomposition import PCA as SkPCA
+
+from spark_rapids_ml_tpu.feature import PCA, PCAModel
+
+
+def _data(n=200, d=16, seed=0):
+    rng = np.random.default_rng(seed)
+    # anisotropic data so components are well separated
+    scales = np.linspace(1, 5, d)
+    X = (rng.normal(size=(n, d)) * scales).astype(np.float32)
+    return X
+
+
+@pytest.mark.parametrize("k", [1, 3, 8])
+@pytest.mark.parametrize("layout", ["array", "multi_cols", "numpy"])
+def test_pca_matches_sklearn(k, layout, n_devices):
+    X = _data()
+    sk = SkPCA(n_components=k).fit(X.astype(np.float64))
+
+    if layout == "array":
+        df = pd.DataFrame({"features": list(X)})
+        est = PCA(k=k, inputCol="features")
+    elif layout == "multi_cols":
+        cols = [f"c{i}" for i in range(X.shape[1])]
+        df = pd.DataFrame(X, columns=cols)
+        est = PCA(k=k, inputCols=cols)
+    else:
+        df = X
+        est = PCA(k=k, inputCol="features")
+
+    est.num_workers = n_devices
+    model = est.fit(df)
+
+    np.testing.assert_allclose(model.mean, X.mean(axis=0), atol=1e-4)
+    np.testing.assert_allclose(
+        np.abs(model.components_), np.abs(sk.components_), atol=2e-3
+    )
+    np.testing.assert_allclose(
+        model.explained_variance_, sk.explained_variance_, rtol=2e-3
+    )
+    np.testing.assert_allclose(
+        model.explainedVariance, sk.explained_variance_ratio_, rtol=2e-3
+    )
+    np.testing.assert_allclose(
+        model.singular_values_, sk.singular_values_, rtol=2e-3
+    )
+
+
+def test_pca_sign_convention(n_devices):
+    """Max-|.| element of each component positive (signFlip parity)."""
+    X = _data(seed=3)
+    model = PCA(k=4, inputCol="features").fit(pd.DataFrame({"features": list(X)}))
+    comps = model.components_
+    for row in comps:
+        assert row[np.argmax(np.abs(row))] > 0
+
+
+def test_pca_transform_spark_parity(n_devices):
+    """transform projects RAW rows (no centering) — Spark semantics the reference
+    restores via mean add-back (reference feature.py:438-451)."""
+    X = _data(n=50, d=8)
+    df = pd.DataFrame({"features": list(X)})
+    model = PCA(k=3, inputCol="features").fit(df)
+    out = model.transform(df)
+    got = np.stack(out["pca_features"].to_numpy())
+    expected = X @ model.pc
+    np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-4)
+
+
+def test_pca_model_persistence(tmp_path, n_devices):
+    X = _data(n=60, d=6)
+    df = pd.DataFrame({"features": list(X)})
+    model = PCA(k=2, inputCol="features", outputCol="proj").fit(df)
+    path = str(tmp_path / "pca_model")
+    model.write().overwrite().save(path)
+    loaded = PCAModel.load(path)
+    np.testing.assert_allclose(loaded.components_, model.components_)
+    assert loaded.getOrDefault("outputCol") == "proj"
+    out = loaded.transform(df)
+    assert "proj" in out.columns
+
+
+def test_pca_estimator_persistence(tmp_path):
+    est = PCA(k=5, inputCol="features")
+    path = str(tmp_path / "pca_est")
+    est.save(path)
+    loaded = PCA.load(path)
+    assert loaded.getK() == 5
+    assert loaded.tpu_params["n_components"] == 5
+
+
+def test_pca_k_too_large():
+    X = _data(n=30, d=4)
+    with pytest.raises(ValueError, match="exceeds"):
+        PCA(k=10, inputCol="features").fit(pd.DataFrame({"features": list(X)}))
+
+
+def test_pca_uneven_rows(n_devices):
+    """Row counts not divisible by the mesh: padding/masking must not skew results."""
+    X = _data(n=101, d=7, seed=5)
+    sk = SkPCA(n_components=2).fit(X.astype(np.float64))
+    model = PCA(k=2, inputCol="features").fit(pd.DataFrame({"features": list(X)}))
+    np.testing.assert_allclose(
+        model.explained_variance_, sk.explained_variance_, rtol=2e-3
+    )
