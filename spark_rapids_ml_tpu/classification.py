@@ -1,0 +1,19 @@
+# Public API module mirroring the reference's `spark_rapids_ml.classification`
+# (reference python/src/spark_rapids_ml/classification.py: LogisticRegression +
+# RandomForestClassifier).
+from .models.classification import LogisticRegression, LogisticRegressionModel
+
+try:  # RandomForestClassifier arrives with models/tree.py
+    from .models.tree import (  # noqa: F401
+        RandomForestClassificationModel,
+        RandomForestClassifier,
+    )
+
+    __all__ = [
+        "LogisticRegression",
+        "LogisticRegressionModel",
+        "RandomForestClassifier",
+        "RandomForestClassificationModel",
+    ]
+except ImportError:  # pragma: no cover
+    __all__ = ["LogisticRegression", "LogisticRegressionModel"]

@@ -244,6 +244,8 @@ class _TpuParams(HasVerboseParam):
         (reference core.py:1283-1297 / params.py:690-707)."""
         param = self.getParam(name)
         if param in self._defaultParamMap and self._defaultParamMap[param] == value:
+            # set back to the harmless default: clear any earlier fallback request
+            getattr(self, "_fallback_requested_params", set()).discard(name)
             return
         logger = get_logger(self.__class__)
         logger.warning(
@@ -265,10 +267,22 @@ class _TpuParams(HasVerboseParam):
             if backend_name in value_mapping:
                 mapped = value_mapping[backend_name](value)
                 if mapped is None:
-                    raise ValueError(
-                        f"Value {value!r} is not supported for backend param '{backend_name}'."
+                    # value the TPU backend can't honor: flag for CPU fallback at fit
+                    # time (reference params.py:654-688 + core.py:1283-1297)
+                    get_logger(self.__class__).warning(
+                        "Value %r is not supported for backend param '%s'; fit() will "
+                        "fall back to the CPU implementation if fallback is enabled.",
+                        value,
+                        backend_name,
                     )
+                    self._fallback_requested_params = getattr(
+                        self, "_fallback_requested_params", set()
+                    )
+                    self._fallback_requested_params.add(backend_name)
+                    return
                 value = mapped
+        # a successfully-mapped value clears any earlier fallback request for this param
+        getattr(self, "_fallback_requested_params", set()).discard(backend_name)
         self._tpu_params[backend_name] = value
 
     def _copyValues(self, to: Params, extra: Optional[Dict[Param, Any]] = None) -> Params:
@@ -277,6 +291,9 @@ class _TpuParams(HasVerboseParam):
             to._tpu_params = dict(self._tpu_params)
             to._num_workers = self._num_workers
             to._float32_inputs = self._float32_inputs
+            to._fallback_requested_params = set(
+                getattr(self, "_fallback_requested_params", set())
+            )
             # re-sync any params that came through `extra` (CrossValidator param maps)
             if extra and isinstance(to, _TpuClass):
                 mapping = to._param_mapping()
@@ -284,6 +301,8 @@ class _TpuParams(HasVerboseParam):
                     backend_name = mapping.get(param.name, "")
                     if backend_name:
                         to._set_tpu_value(backend_name, value)
+                    elif backend_name is None:
+                        to._handle_unsupported(param.name, value)
         return to
 
     def _get_input_columns(self) -> tuple:

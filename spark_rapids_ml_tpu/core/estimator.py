@@ -203,13 +203,12 @@ class _TpuEstimator(_TpuCaller):
     ) -> Iterator[Tuple[int, "_TpuModel"]]:
         """Fit for each param map; in single-pass mode all models come from one sweep
         over the (already device-resident) data (reference core.py:1177-1228)."""
-        if self._enable_fit_multiple_in_single_pass():
-            estimator = self.copy()
-            extra = []
-            for m in paramMaps:
-                est = estimator.copy(m)
-                extra.append(dict(est._tpu_params))
-            models = estimator._fit_internal(dataset, extra)
+        per_map_estimators = [self.copy(m) for m in paramMaps]
+        if self._enable_fit_multiple_in_single_pass() and not any(
+            est._use_cpu_fallback() for est in per_map_estimators
+        ):
+            extra = [dict(est._tpu_params) for est in per_map_estimators]
+            models = self.copy()._fit_internal(dataset, extra)
             return _FitMultipleIterator(lambda i: models[i], len(paramMaps))
         else:
             def fit_single(index: int) -> "_TpuModel":

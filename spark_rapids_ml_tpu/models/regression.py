@@ -1,0 +1,286 @@
+#
+# LinearRegression estimator/model (L6 API) — pyspark.ml.regression.LinearRegression-
+# compatible surface; OLS/Ridge/ElasticNet fit as one SPMD stats pass + replicated
+# solver on the TPU mesh.
+#
+# Structural equivalent of reference python/src/spark_rapids_ml/regression.py:181-863:
+#   * param mapping incl. regParam->alpha, standardization->normalize
+#     (reference regression.py:183-215)
+#   * solver dispatch by regularization (reference regression.py:548-606): here
+#     closed-form L2 vs FISTA elastic net (ops/linear.py)
+#   * single-pass fitMultiple reusing the data pass (reference regression.py:657-674)
+#   * 1-feature inputs are supported (the reference guards/raises for dim==1 because
+#     of a cuML limitation, regression.py:499-505 — no such limit here)
+# (RandomForestRegressor, the other member of the reference module, lives in
+# models/tree.py.)
+#
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..core.backend_params import HasFeaturesCols, _TpuClass
+from ..core.estimator import (
+    FitInputs,
+    _TpuEstimatorSupervised,
+    _TpuModelWithPredictionCol,
+)
+from ..core.params import (
+    HasElasticNetParam,
+    HasFeaturesCol,
+    HasFitIntercept,
+    HasLabelCol,
+    HasMaxIter,
+    HasPredictionCol,
+    HasRegParam,
+    HasSolver,
+    HasStandardization,
+    HasTol,
+    HasWeightCol,
+    Param,
+    TypeConverters,
+)
+from ..ops.linear import linreg_fit, linreg_predict
+
+
+class _LinearRegressionClass(_TpuClass):
+    @classmethod
+    def _param_mapping(cls):
+        # reference regression.py:183-215
+        return {
+            "regParam": "alpha",
+            "elasticNetParam": "l1_ratio",
+            "fitIntercept": "fit_intercept",
+            "standardization": "normalize",
+            "maxIter": "max_iter",
+            "tol": "tol",
+            "loss": "loss",
+            "solver": "solver",
+            "epsilon": None,  # huber knob: unsupported
+            "aggregationDepth": "",
+            "maxBlockSizeInMB": "",
+            "featuresCol": "",
+            "labelCol": "",
+            "predictionCol": "",
+            "weightCol": "",
+        }
+
+    @classmethod
+    def _param_value_mapping(cls):
+        return {
+            "loss": lambda x: {"squaredError": "squared_loss", "squared_loss": "squared_loss"}.get(x),
+            "solver": lambda x: {"auto": "eig", "normal": "eig", "eig": "eig", "l-bfgs": "eig"}.get(x),
+        }
+
+    @classmethod
+    def _get_tpu_params_default(cls) -> Dict[str, Any]:
+        return {
+            "alpha": 0.0,
+            "l1_ratio": 0.0,
+            "fit_intercept": True,
+            "normalize": True,
+            "max_iter": 100,
+            "tol": 1e-6,
+            "loss": "squared_loss",
+            "solver": "eig",
+        }
+
+    @classmethod
+    def _fallback_class(cls):
+        from sklearn.linear_model import LinearRegression as SkLR
+
+        return SkLR
+
+
+class _LinearRegressionParams(
+    HasFeaturesCol,
+    HasFeaturesCols,
+    HasLabelCol,
+    HasPredictionCol,
+    HasMaxIter,
+    HasTol,
+    HasRegParam,
+    HasElasticNetParam,
+    HasFitIntercept,
+    HasStandardization,
+    HasSolver,
+    HasWeightCol,
+):
+    loss: Param[str] = Param(
+        "undefined",
+        "loss",
+        "The loss function to be optimized. Supported options: squaredError, huber.",
+        TypeConverters.toString,
+    )
+    epsilon: Param[float] = Param(
+        "undefined",
+        "epsilon",
+        "The shape parameter to control the amount of robustness (huber only).",
+        TypeConverters.toFloat,
+    )
+    maxBlockSizeInMB: Param[float] = Param(
+        "undefined",
+        "maxBlockSizeInMB",
+        "Maximum memory in MB for stacking input data into blocks.",
+        TypeConverters.toFloat,
+    )
+    aggregationDepth: Param[int] = Param(
+        "undefined",
+        "aggregationDepth",
+        "suggested depth for treeAggregate (>= 2).",
+        TypeConverters.toInt,
+    )
+
+    def setFeaturesCol(self, value: str):
+        return self._set(featuresCol=value)
+
+    def setLabelCol(self, value: str):
+        return self._set(labelCol=value)
+
+    def setPredictionCol(self, value: str):
+        return self._set(predictionCol=value)
+
+
+class LinearRegression(_LinearRegressionClass, _TpuEstimatorSupervised, _LinearRegressionParams):
+    """LinearRegression (OLS/Ridge/Lasso/ElasticNet) on the TPU mesh.
+
+    One sharded pass accumulates (XᵀWX, XᵀWy) with the psum over ICI; the d×d solve is
+    replicated. Drop-in for pyspark.ml.regression.LinearRegression / reference
+    spark_rapids_ml.regression.LinearRegression (reference regression.py:312-660).
+    """
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__()
+        self._setDefault(
+            featuresCol="features",
+            labelCol="label",
+            predictionCol="prediction",
+            regParam=0.0,
+            elasticNetParam=0.0,
+            fitIntercept=True,
+            standardization=True,
+            maxIter=100,
+            tol=1e-6,
+            loss="squaredError",
+            epsilon=1.35,
+            solver="auto",
+            aggregationDepth=2,
+            maxBlockSizeInMB=0.0,
+        )
+        self.initialize_tpu_params()
+        self._set_params(**kwargs)
+
+    def setRegParam(self, value: float) -> "LinearRegression":
+        return self._set_params(regParam=value)  # type: ignore[return-value]
+
+    def setElasticNetParam(self, value: float) -> "LinearRegression":
+        return self._set_params(elasticNetParam=value)  # type: ignore[return-value]
+
+    def _out_schema(self) -> List[str]:
+        return ["coefficients", "intercept", "n_iter"]
+
+    def _enable_fit_multiple_in_single_pass(self) -> bool:
+        # the sufficient-statistics pass is shared across all param maps
+        return True
+
+    def _get_tpu_fit_func(self, extra_params: Optional[List[Dict[str, Any]]] = None):
+        p = dict(self._tpu_params)
+
+        def _fit(inputs: FitInputs):
+            results = linreg_fit(
+                inputs.features,
+                inputs.label,
+                inputs.row_weight,
+                reg=float(p["alpha"]),
+                l1_ratio=float(p["l1_ratio"]),
+                fit_intercept=bool(p["fit_intercept"]),
+                standardize=bool(p["normalize"]),
+                max_iter=int(p["max_iter"]),
+                tol=float(p["tol"]),
+                extra_param_sets=extra_params,
+            )
+            return results if extra_params is not None else results[0]
+
+        return _fit
+
+    def _create_pyspark_model(self, attrs: Dict[str, Any]) -> "LinearRegressionModel":
+        return LinearRegressionModel(**attrs)
+
+    def _fit_fallback_model(self, twin: type, fd) -> Dict[str, Any]:
+        X = np.asarray(fd.features.todense()) if fd.is_sparse else fd.features
+        X64 = np.asarray(X, dtype=np.float64)
+        fit_intercept = self.getOrDefault("fitIntercept")
+        if self.getOrDefault("loss") == "huber":
+            from sklearn.linear_model import HuberRegressor
+
+            sk = HuberRegressor(
+                epsilon=max(self.getOrDefault("epsilon"), 1.0),
+                alpha=self.getOrDefault("regParam"),
+                fit_intercept=fit_intercept,
+            ).fit(X64, fd.label, sample_weight=fd.weight)
+        else:
+            reg = self.getOrDefault("regParam")
+            l1r = self.getOrDefault("elasticNetParam")
+            n = fd.n_rows
+            if reg == 0.0:
+                sk = twin(fit_intercept=fit_intercept)
+            elif l1r == 0.0:
+                from sklearn.linear_model import Ridge
+
+                sk = Ridge(alpha=reg * n, fit_intercept=fit_intercept)
+            else:
+                from sklearn.linear_model import ElasticNet
+
+                sk = ElasticNet(
+                    alpha=reg, l1_ratio=l1r, fit_intercept=fit_intercept,
+                    max_iter=max(self.getOrDefault("maxIter"), 1000),
+                )
+            sk = sk.fit(X64, fd.label, sample_weight=fd.weight)
+        return {
+            "coefficients": sk.coef_.astype(np.float32),
+            "intercept": float(sk.intercept_),
+            "n_iter": int(getattr(sk, "n_iter_", 1) or 1),
+        }
+
+
+class LinearRegressionModel(
+    _LinearRegressionClass, _TpuModelWithPredictionCol, _LinearRegressionParams
+):
+    """Fitted linear regression model (reference regression.py:700-863)."""
+
+    def __init__(self, coefficients: np.ndarray, intercept: float, n_iter: int) -> None:
+        super().__init__(
+            coefficients=np.asarray(coefficients),
+            intercept=float(intercept),
+            n_iter=int(n_iter),
+        )
+        self._setDefault(featuresCol="features", labelCol="label", predictionCol="prediction")
+
+    @property
+    def coefficients(self) -> np.ndarray:
+        return self._model_attributes["coefficients"]
+
+    @property
+    def intercept(self) -> float:
+        return self._model_attributes["intercept"]
+
+    @property
+    def numFeatures(self) -> int:
+        return int(self._model_attributes["coefficients"].shape[0])
+
+    def predict(self, value: np.ndarray) -> float:
+        X = np.asarray(value, dtype=np.float32).reshape(1, -1)
+        return float(np.asarray(linreg_predict(X, self.coefficients, self.intercept))[0])
+
+    def _combine(self, models: List["LinearRegressionModel"]) -> "LinearRegressionModel":
+        """Stack models fitted by fitMultiple for CV transform-evaluate
+        (reference regression.py:828-846)."""
+        first = models[0]
+        first._combined_models = models
+        return first
+
+    def _transform_arrays(self, X: np.ndarray) -> Dict[str, np.ndarray]:
+        pred = np.asarray(linreg_predict(X, self.coefficients, np.float32(self.intercept)))
+        return {self.getOrDefault("predictionCol"): pred}

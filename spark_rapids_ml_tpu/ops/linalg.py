@@ -64,6 +64,19 @@ def gram_and_xty(
     return pdot(Xw.T, X), pdot(Xw.T, y), jnp.sum(w)
 
 
+def power_iteration_lmax(G: jax.Array, n_steps: int = 16) -> jax.Array:
+    """Largest eigenvalue of a symmetric PSD matrix via power iteration — used for
+    FISTA Lipschitz constants in ops/linear.py and ops/logistic.py."""
+
+    def body(i, v):
+        v = pdot(G, v)
+        return v / (jnp.linalg.norm(v) + 1e-30)
+
+    d = G.shape[0]
+    v = jax.lax.fori_loop(0, n_steps, body, jnp.ones((d,), G.dtype) / jnp.sqrt(d))
+    return jnp.dot(v, pdot(G, v))
+
+
 def standardize_columns(
     X: jax.Array, w: jax.Array, with_mean: bool = True
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:

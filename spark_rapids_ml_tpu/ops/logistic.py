@@ -1,0 +1,244 @@
+#
+# Logistic regression fit kernels — the TPU-native replacement for
+# cuml.linear_model.logistic_regression_mg.LogisticRegressionMG (reference
+# classification.py:989-1052: a C++ quasi-Newton (L-BFGS/OWL-QN) solver with the
+# gradient allreduce over NCCL, configured with linesearch_max_iter=20,
+# lbfgs_memory=10, penalty_normalized=False).
+#
+# TPU formulation: the loss/gradient over row-sharded data is ONE jitted function —
+# jax.value_and_grad of the weighted cross-entropy; the contraction over the sharded
+# row axis makes XLA emit the psum (where cuML put its NCCL allreduce). The optimizer
+# loop is a lax.while_loop around optax.lbfgs (memory 10, zoom linesearch ≤20 steps —
+# the reference's cuML settings).
+#
+# L1/elastic-net uses FISTA proximal gradient instead of OWL-QN: same distributed
+# gradient, soft-threshold prox on coefficients (not intercept), Lipschitz constant
+# from a one-pass Gram + power iteration. OWL-QN's orthant projections are branchy;
+# FISTA is pure matrix arithmetic — the TPU-friendly way to the same objective.
+#
+# Objective (Spark parity): (1/Σw)·Σᵢ wᵢ·CE(yᵢ, xᵢ) + λ(α‖β‖₁ + (1-α)/2·‖β‖²),
+# penalty on σ-scaled coefficients when standardization=True (implemented by
+# optimizing β_s with effective coefficients β_s/σ — no scaled data copy; XLA fuses
+# the divide into the logits matmul).
+#
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ._precision import pdot
+from .linalg import power_iteration_lmax, weighted_moments
+
+LINESEARCH_MAX_STEPS = 20  # reference classification.py:1046-1052
+LBFGS_MEMORY = 10
+
+
+def _binomial_loss_fn(X, y, w, scale, reg_l2, fit_intercept):
+    """Returns f(params) for params = [coef_s (d,), intercept]. y in {0,1}."""
+    wsum = jnp.sum(w)
+
+    def loss(params):
+        coef_s, b = params[:-1], params[-1]
+        z = pdot(X, coef_s / scale) + jnp.where(fit_intercept, b, 0.0)
+        # stable log-loss: softplus(z) - y*z
+        ce = jnp.sum(w * (jax.nn.softplus(z) - y * z)) / wsum
+        return ce + 0.5 * reg_l2 * jnp.sum(coef_s * coef_s)
+
+    return loss
+
+
+def _multinomial_loss_fn(X, y_onehot, w, scale, reg_l2, fit_intercept):
+    """params = (k, d+1): rows [coef_s_k..., intercept_k]."""
+    wsum = jnp.sum(w)
+
+    def loss(params):
+        coef_s, b = params[:, :-1], params[:, -1]
+        z = pdot(X, (coef_s / scale).T) + jnp.where(fit_intercept, b, 0.0)
+        logz = jax.nn.log_softmax(z, axis=1)
+        ce = -jnp.sum(w * jnp.sum(y_onehot * logz, axis=1)) / wsum
+        return ce + 0.5 * reg_l2 * jnp.sum(coef_s * coef_s)
+
+    return loss
+
+
+def _run_lbfgs(loss, params0, max_iter: int, tol: float):
+    """jitted L-BFGS loop (optax) with objective-decrease + gradient stopping, the
+    stopping style of the reference's QN solver."""
+    opt = optax.lbfgs(
+        memory_size=LBFGS_MEMORY,
+        linesearch=optax.scale_by_zoom_linesearch(max_linesearch_steps=LINESEARCH_MAX_STEPS),
+    )
+    value_and_grad = optax.value_and_grad_from_state(loss)
+
+    def cond(state):
+        _, opt_state, it, delta, gnorm = state
+        return jnp.logical_and(
+            it < max_iter, jnp.logical_and(delta > tol, gnorm > tol)
+        )
+
+    def body(state):
+        params, opt_state, it, _, _ = state
+        value, grad = value_and_grad(params, state=opt_state)
+        updates, opt_state = opt.update(
+            grad, opt_state, params, value=value, grad=grad, value_fn=loss
+        )
+        new_params = optax.apply_updates(params, updates)
+        new_value = optax.tree_utils.tree_get(opt_state, "value")
+        delta = jnp.abs(value - new_value) / jnp.maximum(jnp.abs(new_value), 1.0)
+        gnorm = optax.tree_utils.tree_l2_norm(grad)
+        return new_params, opt_state, it + 1, delta, gnorm
+
+    state0 = (
+        params0,
+        opt.init(params0),
+        0,
+        jnp.array(jnp.inf, params0.dtype),
+        jnp.array(jnp.inf, params0.dtype),
+    )
+    params, _, n_iter, _, _ = jax.lax.while_loop(cond, body, state0)
+    return params, n_iter
+
+
+@functools.partial(jax.jit, static_argnames=("fit_intercept", "max_iter", "multinomial"))
+def _qn_fit(
+    X, y_enc, w, scale, reg_l2, fit_intercept: bool, max_iter: int, tol, multinomial: bool
+):
+    if multinomial:
+        loss = _multinomial_loss_fn(X, y_enc, w, scale, reg_l2, fit_intercept)
+        params0 = jnp.zeros((y_enc.shape[1], X.shape[1] + 1), X.dtype)
+    else:
+        loss = _binomial_loss_fn(X, y_enc, w, scale, reg_l2, fit_intercept)
+        params0 = jnp.zeros((X.shape[1] + 1,), X.dtype)
+    params, n_iter = _run_lbfgs(loss, params0, max_iter, tol)
+    return params, n_iter, loss(params)
+
+
+@functools.partial(jax.jit, static_argnames=("fit_intercept", "max_iter", "multinomial"))
+def _fista_fit(
+    X, y_enc, w, scale, reg_l1, reg_l2, lipschitz, fit_intercept: bool, max_iter: int,
+    tol, multinomial: bool,
+):
+    """Proximal-gradient elastic-net fit; prox applies only to coefficient entries."""
+    if multinomial:
+        smooth = _multinomial_loss_fn(X, y_enc, w, scale, reg_l2, fit_intercept)
+        params0 = jnp.zeros((y_enc.shape[1], X.shape[1] + 1), X.dtype)
+        coef_mask = jnp.concatenate(
+            [jnp.ones((y_enc.shape[1], X.shape[1])), jnp.zeros((y_enc.shape[1], 1))], axis=1
+        ).astype(X.dtype)
+    else:
+        smooth = _binomial_loss_fn(X, y_enc, w, scale, reg_l2, fit_intercept)
+        params0 = jnp.zeros((X.shape[1] + 1,), X.dtype)
+        coef_mask = jnp.concatenate(
+            [jnp.ones((X.shape[1],)), jnp.zeros((1,))]
+        ).astype(X.dtype)
+
+    grad_fn = jax.grad(smooth)
+    step = 1.0 / lipschitz
+
+    def prox(p):
+        soft = jnp.sign(p) * jnp.maximum(jnp.abs(p) - step * reg_l1, 0.0)
+        return jnp.where(coef_mask > 0, soft, p)
+
+    def cond(state):
+        _, _, _, it, delta = state
+        return jnp.logical_and(it < max_iter, delta > tol)
+
+    def body(state):
+        pk, zk, tk, it, _ = state
+        p_next = prox(zk - step * grad_fn(zk))
+        t_next = 0.5 * (1.0 + jnp.sqrt(1.0 + 4.0 * tk * tk))
+        z_next = p_next + ((tk - 1.0) / t_next) * (p_next - pk)
+        delta = jnp.max(jnp.abs(p_next - pk)) / (jnp.max(jnp.abs(p_next)) + 1e-12)
+        return p_next, z_next, t_next, it + 1, delta
+
+    state0 = (params0, params0, jnp.array(1.0, X.dtype), 0, jnp.array(jnp.inf, X.dtype))
+    params, _, _, n_iter, _ = jax.lax.while_loop(cond, body, state0)
+    return params, n_iter, smooth(params) + reg_l1 * jnp.sum(jnp.abs(params * coef_mask))
+
+
+@jax.jit
+def _gram_lmax(X, w, scale):
+    """λ_max of (X/σ)ᵀW(X/σ)/Σw via one sharded Gram pass + power iteration."""
+    wsum = jnp.sum(w)
+    Xs = X / scale
+    G = pdot((Xs * w[:, None]).T, Xs) / wsum
+    return power_iteration_lmax(G)
+
+
+def logreg_fit(
+    X: jax.Array,
+    y: jax.Array,
+    w: jax.Array,
+    n_classes: int,
+    reg: float,
+    l1_ratio: float,
+    fit_intercept: bool,
+    standardize: bool,
+    max_iter: int,
+    tol: float,
+    multinomial: bool,
+) -> Dict[str, Any]:
+    """Full fit; returns Spark-layout model attributes:
+    coefficients (k_rows, d) and intercepts (k_rows,) with k_rows = 1 for binomial."""
+    d = X.shape[1]
+    if standardize:
+        _, var, _ = weighted_moments(X, w)
+        scale = jnp.sqrt(var)
+        scale = jnp.where(scale <= 0.0, 1.0, scale)
+    else:
+        scale = jnp.ones((d,), X.dtype)
+
+    reg_l1 = reg * l1_ratio
+    reg_l2 = reg * (1.0 - l1_ratio)
+
+    if multinomial:
+        y_enc = jax.nn.one_hot(y.astype(jnp.int32), n_classes, dtype=X.dtype) * (
+            (w > 0)[:, None]
+        )
+    else:
+        y_enc = y
+
+    if reg_l1 > 0.0:
+        lmax = _gram_lmax(X, w, scale)
+        lipschitz = (0.5 if multinomial else 0.25) * lmax + reg_l2 + 1e-12
+        params, n_iter, obj = _fista_fit(
+            X, y_enc, w, scale, reg_l1, reg_l2, lipschitz, bool(fit_intercept),
+            int(max_iter), float(tol), bool(multinomial),
+        )
+    else:
+        params, n_iter, obj = _qn_fit(
+            X, y_enc, w, scale, reg_l2, bool(fit_intercept), int(max_iter),
+            float(tol), bool(multinomial),
+        )
+
+    params = np.asarray(params, dtype=np.float64)
+    scale_h = np.asarray(scale, dtype=np.float64)
+    if multinomial:
+        coef = params[:, :-1] / scale_h
+        intercept = params[:, -1]
+        # Spark centers multinomial intercepts (reference classification.py:1135-1147)
+        if fit_intercept:
+            intercept = intercept - intercept.mean()
+    else:
+        coef = (params[:-1] / scale_h).reshape(1, -1)
+        intercept = params[-1:]
+    return {
+        "coefficients": coef.astype(np.float32),
+        "intercepts": intercept.astype(np.float32),
+        "n_iter": int(n_iter),
+        "objective": float(obj),
+    }
+
+
+@functools.partial(jax.jit, static_argnames=("multinomial",))
+def logreg_decision(X, coef, intercept, multinomial: bool):
+    """Raw margins: (n,) for binomial single-vector, (n,k) for multinomial."""
+    if multinomial:
+        return pdot(X, coef.T) + intercept
+    return pdot(X, coef[0]) + intercept[0]

@@ -1,0 +1,16 @@
+# Public API module mirroring the reference's `spark_rapids_ml.regression`
+# (reference python/src/spark_rapids_ml/regression.py: LinearRegression +
+# RandomForestRegressor).
+from .models.regression import LinearRegression, LinearRegressionModel
+
+try:  # RandomForestRegressor arrives with models/tree.py
+    from .models.tree import RandomForestRegressor, RandomForestRegressionModel  # noqa: F401
+
+    __all__ = [
+        "LinearRegression",
+        "LinearRegressionModel",
+        "RandomForestRegressor",
+        "RandomForestRegressionModel",
+    ]
+except ImportError:  # pragma: no cover
+    __all__ = ["LinearRegression", "LinearRegressionModel"]

@@ -1,0 +1,194 @@
+"""LogisticRegression parity tests vs sklearn (the reference's largest suite,
+tests/test_logistic_regression.py, validates against the Spark objective; objective
+mapping: Spark 1/n·Σ CE + λ((1-α)/2‖β‖² + α‖β‖₁)  <=>  sklearn C = 1/(n·λ))."""
+
+import numpy as np
+import pandas as pd
+import pytest
+from sklearn.datasets import make_classification
+from sklearn.linear_model import LogisticRegression as SkLogReg
+
+from spark_rapids_ml_tpu.classification import (
+    LogisticRegression,
+    LogisticRegressionModel,
+)
+
+
+def _data(n=400, d=10, k=2, seed=0, sep=1.5):
+    X, y = make_classification(
+        n_samples=n,
+        n_features=d,
+        n_informative=max(2, d // 2),
+        n_redundant=0,
+        n_classes=k,
+        class_sep=sep,
+        random_state=seed,
+    )
+    return X.astype(np.float32), y.astype(np.float64)
+
+
+def _objective(X, y, coef, intercept, reg, l1_ratio=0.0):
+    """Spark-convention LR objective (the reference validates with the same formula,
+    metrics/utils.py:14-78)."""
+    if coef.ndim == 1 or coef.shape[0] == 1:
+        c = coef.reshape(-1)
+        z = X @ c + intercept
+        ce = np.mean(np.logaddexp(0, z) - y * z)
+        b = c
+    else:
+        z = X @ coef.T + intercept
+        zs = z - z.max(axis=1, keepdims=True)
+        logp = zs - np.log(np.exp(zs).sum(axis=1, keepdims=True))
+        ce = -np.mean(logp[np.arange(len(y)), y.astype(int)])
+        b = coef.reshape(-1)
+    return ce + reg * ((1 - l1_ratio) / 2 * np.sum(b**2) + l1_ratio * np.sum(np.abs(b)))
+
+
+def test_binomial_no_reg_matches_sklearn(n_devices):
+    X, y = _data()
+    df = pd.DataFrame({"features": list(X), "label": y})
+    model = LogisticRegression(standardization=False, maxIter=200, tol=1e-8).fit(df)
+    sk = SkLogReg(C=1e8, max_iter=2000, tol=1e-10).fit(X.astype(np.float64), y)
+    ours = _objective(X.astype(np.float64), y, model.coefficients, model.intercept, 0.0)
+    theirs = _objective(X.astype(np.float64), y, sk.coef_[0], sk.intercept_[0], 0.0)
+    assert ours <= theirs * 1.005 + 1e-6
+    np.testing.assert_allclose(model.coefficients, sk.coef_[0], rtol=0.05, atol=0.03)
+
+
+def test_binomial_l2_matches_sklearn(n_devices):
+    X, y = _data(seed=1)
+    n, lam = len(y), 0.1
+    df = pd.DataFrame({"features": list(X), "label": y})
+    model = LogisticRegression(
+        regParam=lam, standardization=False, maxIter=200, tol=1e-9
+    ).fit(df)
+    sk = SkLogReg(C=1.0 / (n * lam), max_iter=5000, tol=1e-12).fit(
+        X.astype(np.float64), y
+    )
+    np.testing.assert_allclose(model.coefficients, sk.coef_[0], rtol=2e-3, atol=2e-4)
+    np.testing.assert_allclose(model.intercept, sk.intercept_[0], rtol=2e-3, atol=2e-3)
+
+
+def test_multinomial_l2_objective_parity(n_devices):
+    X, y = _data(n=600, d=8, k=4, seed=2)
+    n, lam = len(y), 0.05
+    df = pd.DataFrame({"features": list(X), "label": y})
+    model = LogisticRegression(
+        regParam=lam, standardization=False, maxIter=300, tol=1e-9, family="multinomial"
+    ).fit(df)
+    assert model.coefficientMatrix.shape == (4, 8)
+    assert model.numClasses == 4
+    sk = SkLogReg(C=1.0 / (n * lam), max_iter=5000, tol=1e-12).fit(
+        X.astype(np.float64), y
+    )
+    ours = _objective(
+        X.astype(np.float64), y, model.coefficientMatrix, model.interceptVector, lam
+    )
+    theirs = _objective(X.astype(np.float64), y, sk.coef_, sk.intercept_, lam)
+    assert ours <= theirs * 1.005 + 1e-6
+    # prediction agreement
+    pred = model.transform(df)["prediction"].to_numpy()
+    assert (pred == sk.predict(X.astype(np.float64))).mean() > 0.98
+
+
+def test_l1_fista_matches_sklearn(n_devices):
+    X, y = _data(n=500, d=12, seed=3)
+    n, lam = len(y), 0.02
+    df = pd.DataFrame({"features": list(X), "label": y})
+    model = LogisticRegression(
+        regParam=lam, elasticNetParam=1.0, standardization=False,
+        maxIter=3000, tol=1e-9,
+    ).fit(df)
+    sk = SkLogReg(
+        C=1.0 / (n * lam), l1_ratio=1.0, solver="liblinear", max_iter=5000, tol=1e-10
+    ).fit(X.astype(np.float64), y)
+    ours = _objective(
+        X.astype(np.float64), y, model.coefficients, model.intercept, lam, 1.0
+    )
+    theirs = _objective(X.astype(np.float64), y, sk.coef_[0], sk.intercept_[0], lam, 1.0)
+    assert ours <= theirs * 1.01 + 1e-6
+    # L1 produces sparsity
+    assert np.sum(np.abs(model.coefficients) < 1e-5) >= np.sum(np.abs(sk.coef_[0]) < 1e-5) - 2
+
+
+def test_standardization_changes_solution(n_devices):
+    X, y = _data(n=300, d=6, seed=4)
+    X = X * np.linspace(0.1, 10, 6).astype(np.float32)
+    df = pd.DataFrame({"features": list(X), "label": y})
+    m_std = LogisticRegression(regParam=0.5, standardization=True, maxIter=100).fit(df)
+    m_raw = LogisticRegression(regParam=0.5, standardization=False, maxIter=100).fit(df)
+    assert not np.allclose(m_std.coefficients, m_raw.coefficients, rtol=1e-2)
+
+
+def test_transform_output_columns(n_devices):
+    X, y = _data(n=200, d=5, seed=5)
+    df = pd.DataFrame({"features": list(X), "label": y})
+    model = LogisticRegression(maxIter=50).fit(df)
+    out = model.transform(df)
+    for col in ("prediction", "probability", "rawPrediction"):
+        assert col in out.columns
+    prob = np.stack(out["probability"].to_numpy())
+    assert prob.shape == (200, 2)
+    np.testing.assert_allclose(prob.sum(axis=1), 1.0, atol=1e-5)
+    raw = np.stack(out["rawPrediction"].to_numpy())
+    np.testing.assert_allclose(raw[:, 0], -raw[:, 1], atol=1e-5)
+    acc = (out["prediction"].to_numpy() == y).mean()
+    assert acc > 0.85
+
+
+def test_single_label_inf_intercept(n_devices):
+    """All-one-class input: ±inf intercept, zero coefficients
+    (reference classification.py:1106-1121)."""
+    X = np.random.default_rng(0).normal(size=(50, 4)).astype(np.float32)
+    df = pd.DataFrame({"features": list(X), "label": np.ones(50)})
+    model = LogisticRegression().fit(df)
+    assert model.intercept == np.inf
+    assert np.all(model.coefficients == 0)
+    out = model.transform(df)
+    assert (out["prediction"].to_numpy() == 1.0).all()
+
+
+def test_missing_label_raises(n_devices):
+    X = np.random.default_rng(0).normal(size=(60, 4)).astype(np.float32)
+    y = np.array([0.0, 2.0] * 30)  # label 1 missing
+    df = pd.DataFrame({"features": list(X), "label": y})
+    with pytest.raises(RuntimeError, match="missing"):
+        LogisticRegression(family="multinomial").fit(df)
+
+
+def test_weighted_fit(n_devices):
+    X, y = _data(n=300, d=6, seed=6)
+    rng = np.random.default_rng(1)
+    w = rng.uniform(0.2, 2.0, size=len(y)).astype(np.float32)
+    df = pd.DataFrame({"features": list(X), "label": y, "w": w})
+    model = LogisticRegression(
+        weightCol="w", regParam=0.05, standardization=False, maxIter=200, tol=1e-9
+    ).fit(df)
+    sk = SkLogReg(C=1.0 / (w.sum() * 0.05), max_iter=5000, tol=1e-12).fit(
+        X.astype(np.float64), y, sample_weight=w
+    )
+    np.testing.assert_allclose(model.coefficients, sk.coef_[0], rtol=5e-3, atol=5e-4)
+
+
+def test_fit_multiple_single_pass(n_devices):
+    X, y = _data(n=250, d=6, seed=7)
+    df = pd.DataFrame({"features": list(X), "label": y})
+    est = LogisticRegression(standardization=False, maxIter=100)
+    maps = [{est.regParam: 0.01}, {est.regParam: 1.0}]
+    models = est.fit(df, maps)
+    assert len(models) == 2
+    assert np.linalg.norm(models[0].coefficients) > np.linalg.norm(models[1].coefficients)
+
+
+def test_logreg_persistence(tmp_path, n_devices):
+    X, y = _data(n=150, d=5, seed=8)
+    df = pd.DataFrame({"features": list(X), "label": y})
+    model = LogisticRegression(regParam=0.1, maxIter=50).fit(df)
+    path = str(tmp_path / "lrm")
+    model.save(path)
+    loaded = LogisticRegressionModel.load(path)
+    np.testing.assert_allclose(loaded.coefficients, model.coefficients)
+    assert loaded.numClasses == 2
+    a = model.transform(df)["prediction"].to_numpy()
+    b = loaded.transform(df)["prediction"].to_numpy()
+    np.testing.assert_array_equal(a, b)
