@@ -354,7 +354,15 @@ class _TpuModel(_TpuClass, _TpuParams):
     def _supportsTransformEvaluate(self) -> bool:
         """Whether transform+evaluate can run in one pass for CrossValidator
         (reference core.py:1306)."""
-        return False
+        return True
+
+    def _transformEvaluate(self, dataset: Any, evaluator: Any) -> float:
+        """Transform-then-evaluate hook used by CrossValidator. The default is the
+        plain two-step host path; subclasses may fuse prediction + partial-metric
+        computation into one device pass (the reference's
+        _transform_evaluate_internal, core.py:1572-1693) and signal support via
+        _supportsTransformEvaluate."""
+        return evaluator.evaluate(self.transform(dataset))
 
     # ---- persistence (reference core.py:310-355) ----
 

@@ -1,0 +1,210 @@
+#
+# pyspark.ml.evaluation-compatible evaluators, implemented standalone (pyspark is
+# optional in this environment). The reference consumes pyspark's evaluators directly
+# in its CrossValidator (reference tuning.py:92-157) and re-implements their math in
+# metrics/ for the one-pass transform-evaluate path; here the evaluators themselves
+# sit on the metrics/ reduction classes, so evaluator math and one-pass math cannot
+# diverge.
+#
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import numpy as np
+
+from .core.params import (
+    HasLabelCol,
+    HasPredictionCol,
+    HasProbabilityCol,
+    HasRawPredictionCol,
+    HasWeightCol,
+    Param,
+    Params,
+    TypeConverters,
+)
+from .metrics.MulticlassMetrics import (
+    SUPPORTED_MULTI_CLASS_METRIC_NAMES,
+    MulticlassMetrics,
+)
+from .metrics.RegressionMetrics import RegressionMetrics
+
+
+def _col(dataset: Any, name: str) -> np.ndarray:
+    arr = dataset[name].to_numpy()
+    if arr.dtype == object:
+        return np.stack(arr)
+    return arr
+
+
+class Evaluator(Params):
+    """Base evaluator (pyspark.ml.evaluation.Evaluator surface)."""
+
+    def evaluate(self, dataset: Any, params: Optional[dict] = None) -> float:
+        if params:
+            return self.copy(params).evaluate(dataset)
+        return self._evaluate(dataset)
+
+    def _evaluate(self, dataset: Any) -> float:
+        raise NotImplementedError
+
+    def isLargerBetter(self) -> bool:
+        return True
+
+
+class RegressionEvaluator(Evaluator, HasLabelCol, HasPredictionCol, HasWeightCol):
+    """Metrics: rmse (default), mse, r2, mae, var."""
+
+    metricName: Param[str] = Param(
+        "undefined",
+        "metricName",
+        "metric name in evaluation (mse|rmse|r2|mae|var)",
+        TypeConverters.toString,
+    )
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__()
+        self._setDefault(
+            metricName="rmse", labelCol="label", predictionCol="prediction"
+        )
+        self._set(**kwargs)
+
+    def getMetricName(self) -> str:
+        return self.getOrDefault("metricName")
+
+    def setMetricName(self, value: str) -> "RegressionEvaluator":
+        return self._set(metricName=value)  # type: ignore[return-value]
+
+    def isLargerBetter(self) -> bool:
+        return self.getMetricName() in ("r2", "var")
+
+    def _evaluate(self, dataset: Any) -> float:
+        w = (
+            _col(dataset, self.getOrDefault("weightCol"))
+            if self.isDefined("weightCol")
+            else None
+        )
+        metrics = RegressionMetrics.from_predictions(
+            _col(dataset, self.getOrDefault("labelCol")),
+            _col(dataset, self.getOrDefault("predictionCol")),
+            w,
+        )
+        return metrics.evaluate(self.getMetricName())
+
+
+class MulticlassClassificationEvaluator(
+    Evaluator, HasLabelCol, HasPredictionCol, HasProbabilityCol, HasWeightCol
+):
+    """Metrics: f1 (default), accuracy, weighted*, *ByLabel, logLoss, hammingLoss."""
+
+    metricName: Param[str] = Param(
+        "undefined",
+        "metricName",
+        "metric name in evaluation " + "|".join(SUPPORTED_MULTI_CLASS_METRIC_NAMES),
+        TypeConverters.toString,
+    )
+    metricLabel: Param[float] = Param(
+        "undefined",
+        "metricLabel",
+        "The class whose metric will be computed in *ByLabel metrics.",
+        TypeConverters.toFloat,
+    )
+    beta: Param[float] = Param(
+        "undefined",
+        "beta",
+        "beta value in weightedFMeasure|fMeasureByLabel.",
+        TypeConverters.toFloat,
+    )
+    eps: Param[float] = Param(
+        "undefined", "eps", "log-loss clamp epsilon.", TypeConverters.toFloat
+    )
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__()
+        self._setDefault(
+            metricName="f1",
+            metricLabel=0.0,
+            beta=1.0,
+            eps=1e-15,
+            labelCol="label",
+            predictionCol="prediction",
+            probabilityCol="probability",
+        )
+        self._set(**kwargs)
+
+    def getMetricName(self) -> str:
+        return self.getOrDefault("metricName")
+
+    def setMetricName(self, value: str) -> "MulticlassClassificationEvaluator":
+        return self._set(metricName=value)  # type: ignore[return-value]
+
+    def isLargerBetter(self) -> bool:
+        return self.getMetricName() not in ("logLoss", "hammingLoss")
+
+    def _evaluate(self, dataset: Any) -> float:
+        name = self.getMetricName()
+        probs = None
+        if name == "logLoss":
+            probs = _col(dataset, self.getOrDefault("probabilityCol"))
+        w = (
+            _col(dataset, self.getOrDefault("weightCol"))
+            if self.isDefined("weightCol")
+            else None
+        )
+        metrics = MulticlassMetrics.from_predictions(
+            _col(dataset, self.getOrDefault("labelCol")),
+            _col(dataset, self.getOrDefault("predictionCol")),
+            w,
+            probs,
+            eps=self.getOrDefault("eps"),
+        )
+        return metrics.evaluate(
+            name, self.getOrDefault("metricLabel"), self.getOrDefault("beta")
+        )
+
+
+class BinaryClassificationEvaluator(
+    Evaluator, HasLabelCol, HasRawPredictionCol, HasWeightCol
+):
+    """Metrics: areaUnderROC (default), areaUnderPR — trapezoid integration over the
+    score-sorted sweep, Spark BinaryClassificationMetrics semantics."""
+
+    metricName: Param[str] = Param(
+        "undefined",
+        "metricName",
+        "metric name in evaluation (areaUnderROC|areaUnderPR)",
+        TypeConverters.toString,
+    )
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__()
+        self._setDefault(
+            metricName="areaUnderROC", labelCol="label", rawPredictionCol="rawPrediction"
+        )
+        self._set(**kwargs)
+
+    def getMetricName(self) -> str:
+        return self.getOrDefault("metricName")
+
+    def _evaluate(self, dataset: Any) -> float:
+        raw = _col(dataset, self.getOrDefault("rawPredictionCol"))
+        score = raw[:, 1] if raw.ndim == 2 else raw
+        y = _col(dataset, self.getOrDefault("labelCol")).astype(np.float64)
+        w = (
+            _col(dataset, self.getOrDefault("weightCol")).astype(np.float64)
+            if self.isDefined("weightCol")
+            else np.ones_like(y)
+        )
+        order = np.argsort(-score, kind="stable")
+        y, w = y[order], w[order]
+        tps = np.cumsum(w * y)
+        fps = np.cumsum(w * (1.0 - y))
+        tps = np.concatenate([[0.0], tps])
+        fps = np.concatenate([[0.0], fps])
+        P, N = tps[-1], fps[-1]
+        if self.getMetricName() == "areaUnderROC":
+            return float(np.trapezoid(tps / P, fps / N))
+        # areaUnderPR
+        recall = tps / P
+        precision = np.where(tps + fps > 0, tps / np.maximum(tps + fps, 1e-30), 1.0)
+        return float(np.trapezoid(precision, recall))

@@ -1,5 +1,5 @@
 # Public API module mirroring the reference's `spark_rapids_ml.feature`
 # (reference python/src/spark_rapids_ml/feature.py).
-from .models.feature import PCA, PCAModel
+from .models.feature import PCA, PCAModel, VectorAssembler
 
-__all__ = ["PCA", "PCAModel"]
+__all__ = ["PCA", "PCAModel", "VectorAssembler"]

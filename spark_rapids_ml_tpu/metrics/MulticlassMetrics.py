@@ -1,0 +1,183 @@
+#
+# Driver-side reduction of per-partition (label, prediction) confusion counts and
+# log-loss sums into Spark-compatible multiclass metrics
+# (reference python/src/spark_rapids_ml/metrics/MulticlassMetrics.py: the executor
+# side counts per partition at classification.py:117-159; the merge happens on the
+# driver). The metric formulas follow Spark MLlib's MulticlassMetrics semantics.
+#
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+SUPPORTED_MULTI_CLASS_METRIC_NAMES = [
+    "f1",
+    "accuracy",
+    "weightedPrecision",
+    "weightedRecall",
+    "weightedTruePositiveRate",
+    "weightedFalsePositiveRate",
+    "weightedFMeasure",
+    "truePositiveRateByLabel",
+    "falsePositiveRateByLabel",
+    "precisionByLabel",
+    "recallByLabel",
+    "fMeasureByLabel",
+    "logLoss",
+    "hammingLoss",
+]
+
+
+class MulticlassMetrics:
+    """Accumulates weighted confusion counts; `merge` combines partition partials."""
+
+    def __init__(
+        self,
+        tp_by_class: Optional[Dict[float, float]] = None,
+        fp_by_class: Optional[Dict[float, float]] = None,
+        label_count_by_class: Optional[Dict[float, float]] = None,
+        label_count: float = 0.0,
+        log_loss: float = 0.0,
+    ) -> None:
+        self._tp = dict(tp_by_class or {})
+        self._fp = dict(fp_by_class or {})
+        self._label_count_by_class = dict(label_count_by_class or {})
+        self._label_count = label_count
+        self._log_loss = log_loss
+
+    # ---- partial computation (executor side in the reference) ----
+
+    @classmethod
+    def from_predictions(
+        cls,
+        labels: np.ndarray,
+        predictions: np.ndarray,
+        weights: Optional[np.ndarray] = None,
+        probabilities: Optional[np.ndarray] = None,
+        eps: float = 1e-15,
+    ) -> "MulticlassMetrics":
+        labels = np.asarray(labels, dtype=np.float64)
+        predictions = np.asarray(predictions, dtype=np.float64)
+        w = (
+            np.ones_like(labels)
+            if weights is None
+            else np.asarray(weights, dtype=np.float64)
+        )
+        tp: Dict[float, float] = {}
+        fp: Dict[float, float] = {}
+        lc: Dict[float, float] = {}
+        for cls_val in np.unique(np.concatenate([labels, predictions])):
+            sel_l = labels == cls_val
+            sel_p = predictions == cls_val
+            lc[float(cls_val)] = float(w[sel_l].sum())
+            tp[float(cls_val)] = float(w[sel_l & sel_p].sum())
+            fp[float(cls_val)] = float(w[~sel_l & sel_p].sum())
+        log_loss = 0.0
+        if probabilities is not None:
+            p = np.clip(
+                probabilities[np.arange(len(labels)), labels.astype(int)], eps, 1 - eps
+            )
+            log_loss = float(-(w * np.log(p)).sum())
+        return cls(tp, fp, lc, float(w.sum()), log_loss)
+
+    def merge(self, other: "MulticlassMetrics") -> "MulticlassMetrics":
+        def _madd(a: Dict[float, float], b: Dict[float, float]) -> Dict[float, float]:
+            out = dict(a)
+            for k, v in b.items():
+                out[k] = out.get(k, 0.0) + v
+            return out
+
+        return MulticlassMetrics(
+            _madd(self._tp, other._tp),
+            _madd(self._fp, other._fp),
+            _madd(self._label_count_by_class, other._label_count_by_class),
+            self._label_count + other._label_count,
+            self._log_loss + other._log_loss,
+        )
+
+    # ---- Spark MulticlassMetrics formulas ----
+
+    def _precision(self, label: float) -> float:
+        tp = self._tp.get(label, 0.0)
+        fp = self._fp.get(label, 0.0)
+        return 0.0 if (tp + fp) == 0 else tp / (tp + fp)
+
+    def _recall(self, label: float) -> float:
+        tp = self._tp.get(label, 0.0)
+        n = self._label_count_by_class.get(label, 0.0)
+        return 0.0 if n == 0 else tp / n
+
+    def _f_measure(self, label: float, beta: float = 1.0) -> float:
+        p, r = self._precision(label), self._recall(label)
+        b2 = beta * beta
+        return 0.0 if (p + r) == 0 else (1 + b2) * p * r / (b2 * p + r)
+
+    def _false_positive_rate(self, label: float) -> float:
+        fp = self._fp.get(label, 0.0)
+        neg = self._label_count - self._label_count_by_class.get(label, 0.0)
+        return 0.0 if neg == 0 else fp / neg
+
+    def weighted_precision(self) -> float:
+        return sum(
+            self._precision(c) * n / self._label_count
+            for c, n in self._label_count_by_class.items()
+        )
+
+    def weighted_recall(self) -> float:
+        return sum(
+            self._recall(c) * n / self._label_count
+            for c, n in self._label_count_by_class.items()
+        )
+
+    def weighted_f_measure(self, beta: float = 1.0) -> float:
+        return sum(
+            self._f_measure(c, beta) * n / self._label_count
+            for c, n in self._label_count_by_class.items()
+        )
+
+    def weighted_false_positive_rate(self) -> float:
+        return sum(
+            self._false_positive_rate(c) * n / self._label_count
+            for c, n in self._label_count_by_class.items()
+        )
+
+    def accuracy(self) -> float:
+        return sum(self._tp.values()) / self._label_count
+
+    def log_loss(self) -> float:
+        return self._log_loss / self._label_count
+
+    def hamming_loss(self) -> float:
+        return 1.0 - self.accuracy()
+
+    def evaluate(self, metric_name: str, metric_label: float = 0.0, beta: float = 1.0) -> float:
+        """Dispatch by Spark metric name (reference MulticlassMetrics.py:149-180)."""
+        if metric_name == "f1":
+            return self.weighted_f_measure()
+        if metric_name == "accuracy":
+            return self.accuracy()
+        if metric_name == "weightedPrecision":
+            return self.weighted_precision()
+        if metric_name in ("weightedRecall", "weightedTruePositiveRate"):
+            return self.weighted_recall()
+        if metric_name == "weightedFalsePositiveRate":
+            return self.weighted_false_positive_rate()
+        if metric_name == "weightedFMeasure":
+            return self.weighted_f_measure(beta)
+        if metric_name == "truePositiveRateByLabel":
+            return self._recall(metric_label)
+        if metric_name == "falsePositiveRateByLabel":
+            return self._false_positive_rate(metric_label)
+        if metric_name == "precisionByLabel":
+            return self._precision(metric_label)
+        if metric_name == "recallByLabel":
+            return self._recall(metric_label)
+        if metric_name == "fMeasureByLabel":
+            return self._f_measure(metric_label, beta)
+        if metric_name == "logLoss":
+            return self.log_loss()
+        if metric_name == "hammingLoss":
+            return self.hamming_loss()
+        raise ValueError(f"Unsupported metric name: {metric_name}")

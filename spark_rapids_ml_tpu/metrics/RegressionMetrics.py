@@ -1,0 +1,104 @@
+#
+# Mergeable moment statistics for regression metrics — the structural equivalent of
+# Spark's SummarizerBuffer merge that the reference re-implements
+# (reference python/src/spark_rapids_ml/metrics/RegressionMetrics.py:63-98; executor
+# partials at regression.py:149-178). Produces rmse/mse/r2/mae/var with Spark
+# RegressionEvaluator semantics.
+#
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+class RegressionMetrics:
+    """Holds weighted moments of (residual, label): enough to reconstruct
+    rmse/mse/r2/mae/var after any number of merges."""
+
+    def __init__(
+        self,
+        weight_sum: float = 0.0,
+        residual_l1: float = 0.0,
+        residual_l2: float = 0.0,
+        label_sum: float = 0.0,
+        label_sq_sum: float = 0.0,
+    ) -> None:
+        self._w = weight_sum
+        self._res_l1 = residual_l1
+        self._res_l2 = residual_l2
+        self._label_sum = label_sum
+        self._label_sq = label_sq_sum
+
+    @classmethod
+    def from_predictions(
+        cls,
+        labels: np.ndarray,
+        predictions: np.ndarray,
+        weights: Optional[np.ndarray] = None,
+    ) -> "RegressionMetrics":
+        labels = np.asarray(labels, dtype=np.float64)
+        predictions = np.asarray(predictions, dtype=np.float64)
+        w = (
+            np.ones_like(labels)
+            if weights is None
+            else np.asarray(weights, dtype=np.float64)
+        )
+        res = labels - predictions
+        return cls(
+            float(w.sum()),
+            float((w * np.abs(res)).sum()),
+            float((w * res * res).sum()),
+            float((w * labels).sum()),
+            float((w * labels * labels).sum()),
+        )
+
+    def merge(self, other: "RegressionMetrics") -> "RegressionMetrics":
+        return RegressionMetrics(
+            self._w + other._w,
+            self._res_l1 + other._res_l1,
+            self._res_l2 + other._res_l2,
+            self._label_sum + other._label_sum,
+            self._label_sq + other._label_sq,
+        )
+
+    @property
+    def mean_squared_error(self) -> float:
+        return self._res_l2 / self._w
+
+    @property
+    def mean_absolute_error(self) -> float:
+        return self._res_l1 / self._w
+
+    @property
+    def root_mean_squared_error(self) -> float:
+        return float(np.sqrt(self.mean_squared_error))
+
+    @property
+    def _ss_tot(self) -> float:
+        mean = self._label_sum / self._w
+        return self._label_sq - self._w * mean * mean
+
+    @property
+    def r2(self) -> float:
+        return 1.0 - self._res_l2 / self._ss_tot
+
+    @property
+    def explained_variance(self) -> float:
+        # Spark's "var" metric: variance of labels explained, here the residual-based
+        # population variance convention Spark uses in RegressionMetrics
+        return self._ss_tot / self._w - self._res_l2 / self._w
+
+    def evaluate(self, metric_name: str) -> float:
+        if metric_name == "rmse":
+            return self.root_mean_squared_error
+        if metric_name == "mse":
+            return self.mean_squared_error
+        if metric_name == "mae":
+            return self.mean_absolute_error
+        if metric_name == "r2":
+            return self.r2
+        if metric_name == "var":
+            return self.explained_variance
+        raise ValueError(f"Unsupported metric name: {metric_name}")

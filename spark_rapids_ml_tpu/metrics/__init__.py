@@ -1,0 +1,26 @@
+#
+# Evaluation-metric subsystem (reference python/src/spark_rapids_ml/metrics/):
+# distributed partial aggregation of confusion counts / moment statistics merged on the
+# driver (reference classification.py:117-159, regression.py:149-178, metrics/*).
+#
+# On TPU the partials are computed as sharded jnp reductions (psum implicit) or plain
+# numpy for host-resident outputs; the merge algebra is identical.
+#
+
+from dataclasses import dataclass
+from typing import Optional
+
+from .MulticlassMetrics import MulticlassMetrics
+from .RegressionMetrics import RegressionMetrics
+
+
+@dataclass
+class EvalMetricInfo:
+    """Tags a transform-with-evaluation pass with what the evaluator needs
+    (reference metrics/__init__.py:22-41)."""
+
+    eval_metric: str = ""  # "accuracy_like" | "log_loss" | "regression"
+    eval_metric_name: Optional[str] = None
+
+
+__all__ = ["EvalMetricInfo", "MulticlassMetrics", "RegressionMetrics"]

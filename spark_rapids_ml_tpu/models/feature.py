@@ -178,3 +178,43 @@ class PCAModel(_PCAClass, _TpuModelWithColumns, _PCAParams):
     def _transform_arrays(self, X: np.ndarray) -> Dict[str, np.ndarray]:
         out = np.asarray(pca_transform(X, self._model_attributes["components"]))
         return {self.getOrDefault("outputCol"): out}
+
+
+class VectorAssembler(HasInputCols, HasOutputCol):
+    """Combines scalar columns into one array-valued feature column —
+    pyspark.ml.feature.VectorAssembler surface, provided so Pipelines written against
+    pyspark port over. TPU pipelines usually skip it: Pipeline bypasses a
+    VectorAssembler feeding a TPU estimator (reference pipeline.py:85-119)."""
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__()
+        self._setDefault(outputCol="features")
+        self._set(**kwargs)
+
+    def setInputCols(self, value: List[str]) -> "VectorAssembler":
+        return self._set(inputCols=value)  # type: ignore[return-value]
+
+    def setOutputCol(self, value: str) -> "VectorAssembler":
+        return self._set(outputCol=value)  # type: ignore[return-value]
+
+    def transform(self, dataset: Any, params: Optional[dict] = None) -> Any:
+        import pandas as pd
+
+        if params:
+            return self.copy(params).transform(dataset)
+        if not isinstance(dataset, pd.DataFrame):
+            raise TypeError("VectorAssembler requires a pandas DataFrame input")
+        cols = self.getOrDefault("inputCols")
+        out = dataset.copy()
+        # pyspark assembles DoubleType vectors and flattens vector-valued inputs;
+        # match both (estimators downcast per their float32_inputs setting)
+        blocks = []
+        for c in cols:
+            col = dataset[c]
+            if col.dtype == object:
+                blocks.append(np.stack([np.asarray(v, dtype=np.float64) for v in col]))
+            else:
+                blocks.append(col.to_numpy(dtype=np.float64).reshape(-1, 1))
+        stacked = np.hstack(blocks)
+        out[self.getOrDefault("outputCol")] = list(stacked)
+        return out

@@ -91,7 +91,7 @@ def _run_lbfgs(loss, params0, max_iter: int, tol: float):
         new_params = optax.apply_updates(params, updates)
         new_value = optax.tree_utils.tree_get(opt_state, "value")
         delta = jnp.abs(value - new_value) / jnp.maximum(jnp.abs(new_value), 1.0)
-        gnorm = optax.tree_utils.tree_l2_norm(grad)
+        gnorm = optax.tree_utils.tree_norm(grad)
         return new_params, opt_state, it + 1, delta, gnorm
 
     state0 = (

@@ -1,0 +1,110 @@
+#
+# Pipeline — pyspark.ml.Pipeline-compatible surface with the reference's acceleration
+# trick (reference python/src/spark_rapids_ml/pipeline.py:85-159): a
+# VectorAssembler -> TPU-estimator pair is bypassed, feeding the scalar columns
+# directly to the estimator via featuresCols and replacing the assembler with a
+# NoOpTransformer — the vector column is never materialized.
+#
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+from .core.backend_params import _TpuParams
+from .core.params import Param, ParamMap, Params
+from .utils import get_logger
+
+
+class NoOpTransformer(Params):
+    """Stage that passes data through unchanged (reference pipeline.py:37-49)."""
+
+    def transform(self, dataset: Any, params: Optional[ParamMap] = None) -> Any:
+        return dataset
+
+
+class Transformer(Params):
+    """Marker base for pure transformers (pyspark.ml.Transformer surface)."""
+
+    def transform(self, dataset: Any, params: Optional[ParamMap] = None) -> Any:
+        raise NotImplementedError
+
+
+def _isTpuEstimator(stage: Any) -> bool:
+    """reference pipeline.py:146-159 `_isGPUEstimator`."""
+    return isinstance(stage, _TpuParams) and hasattr(stage, "_get_tpu_fit_func")
+
+
+def _isVectorAssembler(stage: Any) -> bool:
+    return type(stage).__name__ == "VectorAssembler" and stage.hasParam("inputCols")
+
+
+class Pipeline(Params):
+    """Sequential stages; estimators are fit then their models transform
+    (pyspark.ml.Pipeline semantics + the assembler bypass)."""
+
+    def __init__(self, stages: Optional[List[Any]] = None) -> None:
+        super().__init__()
+        self._stages = stages or []
+        self.logger = get_logger(self.__class__)
+
+    def getStages(self) -> List[Any]:
+        return self._stages
+
+    def setStages(self, value: List[Any]) -> "Pipeline":
+        self._stages = value
+        return self
+
+    def fit(self, dataset: Any) -> "PipelineModel":
+        return self._fit(dataset)
+
+    def _fit(self, dataset: Any) -> "PipelineModel":
+        stages = list(self._stages)
+
+        # assembler bypass (reference pipeline.py:85-119): VectorAssembler feeding a
+        # TPU estimator's featuresCol becomes featuresCols on the estimator directly
+        for i in range(len(stages) - 1):
+            a, b = stages[i], stages[i + 1]
+            if (
+                _isVectorAssembler(a)
+                and _isTpuEstimator(b)
+                and a.isDefined("outputCol")
+                and b.hasParam("featuresCol")
+                and b.getOrDefault("featuresCol") == a.getOrDefault("outputCol")
+                and b.hasParam("featuresCols")
+            ):
+                self.logger.info(
+                    "Bypassing VectorAssembler '%s' -> feeding %d scalar columns "
+                    "directly to %s",
+                    a.uid,
+                    len(a.getOrDefault("inputCols")),
+                    type(b).__name__,
+                )
+                b._set(featuresCols=a.getOrDefault("inputCols"))
+                b._clear(b.getParam("featuresCol"))
+                stages[i] = NoOpTransformer()
+
+        fitted: List[Any] = []
+        for stage in stages:
+            if hasattr(stage, "_get_tpu_fit_func") or (
+                hasattr(stage, "fit") and not hasattr(stage, "transform")
+            ):
+                model = stage.fit(dataset)
+                fitted.append(model)
+                dataset = model.transform(dataset)
+            elif hasattr(stage, "transform"):
+                fitted.append(stage)
+                dataset = stage.transform(dataset)
+            else:
+                raise TypeError(f"Pipeline stage {stage} is neither fit-able nor transform-able")
+        return PipelineModel(fitted)
+
+
+class PipelineModel(Params):
+    def __init__(self, stages: List[Any]) -> None:
+        super().__init__()
+        self.stages = stages
+
+    def transform(self, dataset: Any, params: Optional[ParamMap] = None) -> Any:
+        for stage in self.stages:
+            dataset = stage.transform(dataset)
+        return dataset

@@ -1,0 +1,221 @@
+#
+# Hyperparameter tuning — pyspark.ml.tuning-compatible ParamGridBuilder /
+# CrossValidator / CrossValidatorModel with the reference's GPU acceleration strategy
+# (reference python/src/spark_rapids_ml/tuning.py:92-157):
+#   * all param maps of a fold fit in ONE data pass via fitMultiple
+#     (P6 "multi-model-in-one-pass", SURVEY.md §2.7)
+#   * transform+evaluate runs per fitted model on the held-out fold
+# The k-fold split, metric averaging and best-model refit semantics match pyspark.
+#
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .core.params import (
+    HasCollectSubModels,
+    HasParallelism,
+    HasSeed,
+    Param,
+    ParamMap,
+    Params,
+    TypeConverters,
+)
+from .utils import get_logger
+
+
+class ParamGridBuilder:
+    """Builder for a param grid used in grid search (pyspark.ml.tuning surface)."""
+
+    def __init__(self) -> None:
+        self._param_grid: Dict[Param, List[Any]] = {}
+
+    def addGrid(self, param: Param, values: List[Any]) -> "ParamGridBuilder":
+        if isinstance(param, Param):
+            self._param_grid[param] = list(values)
+            return self
+        raise TypeError("param must be an instance of Param")
+
+    def baseOn(self, *args: Tuple[Param, Any], **kwargs: Any) -> "ParamGridBuilder":
+        if isinstance(args[0], dict) if args else False:
+            args = tuple(args[0].items())
+        for param, value in args:
+            self.addGrid(param, [value])
+        return self
+
+    def build(self) -> List[ParamMap]:
+        keys = list(self._param_grid.keys())
+        grid_values = [self._param_grid[k] for k in keys]
+        return [
+            dict(zip(keys, combo)) for combo in itertools.product(*grid_values)
+        ]
+
+
+class _CrossValidatorParams(HasSeed, HasParallelism, HasCollectSubModels):
+    numFolds: Param[int] = Param(
+        "undefined",
+        "numFolds",
+        "number of folds for cross validation (>= 2).",
+        TypeConverters.toInt,
+    )
+    foldCol: Param[str] = Param(
+        "undefined",
+        "foldCol",
+        "Param for the column name of user specified fold number.",
+        TypeConverters.toString,
+    )
+
+    def getNumFolds(self) -> int:
+        return self.getOrDefault("numFolds")
+
+
+class CrossValidator(_CrossValidatorParams):
+    """K-fold cross validation accelerated the reference's way: one fitMultiple pass
+    per fold (reference tuning.py:92-157)."""
+
+    def __init__(
+        self,
+        estimator: Any = None,
+        estimatorParamMaps: Optional[List[ParamMap]] = None,
+        evaluator: Any = None,
+        numFolds: int = 3,
+        seed: Optional[int] = None,
+        parallelism: int = 1,
+        collectSubModels: bool = False,
+        foldCol: str = "",
+    ) -> None:
+        super().__init__()
+        self._setDefault(numFolds=3, foldCol="", parallelism=1, collectSubModels=False, seed=42)
+        self._set(
+            numFolds=numFolds,
+            foldCol=foldCol,
+            parallelism=parallelism,
+            collectSubModels=collectSubModels,
+        )
+        if seed is not None:
+            self._set(seed=seed)
+        self._estimator = estimator
+        self._estimatorParamMaps = estimatorParamMaps or []
+        self._evaluator = evaluator
+        self.logger = get_logger(self.__class__)
+
+    # pyspark getters/setters
+
+    def getEstimator(self) -> Any:
+        return self._estimator
+
+    def setEstimator(self, value: Any) -> "CrossValidator":
+        self._estimator = value
+        return self
+
+    def getEstimatorParamMaps(self) -> List[ParamMap]:
+        return self._estimatorParamMaps
+
+    def setEstimatorParamMaps(self, value: List[ParamMap]) -> "CrossValidator":
+        self._estimatorParamMaps = value
+        return self
+
+    def getEvaluator(self) -> Any:
+        return self._evaluator
+
+    def setEvaluator(self, value: Any) -> "CrossValidator":
+        self._evaluator = value
+        return self
+
+    def _kFold(self, dataset: Any) -> List[Tuple[Any, Any]]:
+        """Random (or foldCol-driven) k-fold split of a pandas dataset."""
+        n_folds = self.getNumFolds()
+        fold_col = self.getOrDefault("foldCol")
+        n = len(dataset)
+        if fold_col:
+            fold_ids = dataset[fold_col].to_numpy().astype(int) % n_folds
+        else:
+            rng = np.random.default_rng(self.getOrDefault("seed"))
+            fold_ids = rng.integers(0, n_folds, size=n)
+        pairs = []
+        for f in range(n_folds):
+            test_mask = fold_ids == f
+            pairs.append(
+                (
+                    dataset.iloc[~test_mask].reset_index(drop=True),
+                    dataset.iloc[test_mask].reset_index(drop=True),
+                )
+            )
+        return pairs
+
+    def fit(self, dataset: Any) -> "CrossValidatorModel":
+        return self._fit(dataset)
+
+    def _fit(self, dataset: Any) -> "CrossValidatorModel":
+        est = self._estimator
+        maps = self._estimatorParamMaps
+        evaluator = self._evaluator
+        if est is None or evaluator is None or not maps:
+            raise ValueError(
+                "CrossValidator requires an estimator, a non-empty "
+                "estimatorParamMaps, and an evaluator."
+            )
+        n_models = len(maps)
+        metrics = np.zeros((n_models,), dtype=np.float64)
+        sub_models: Optional[List[List[Any]]] = (
+            [] if self.getOrDefault("collectSubModels") else None
+        )
+
+        for train, test in self._kFold(dataset):
+            fold_models: List[Any] = [None] * n_models
+            # ONE pass per fold when the estimator supports it (fitMultiple)
+            for index, model in est.fitMultiple(train, maps):
+                fold_models[index] = model
+            for i, model in enumerate(fold_models):
+                if getattr(model, "_supportsTransformEvaluate", lambda: False)():
+                    metrics[i] += model._transformEvaluate(test, evaluator)
+                else:
+                    metrics[i] += evaluator.evaluate(model.transform(test))
+            if sub_models is not None:
+                sub_models.append(fold_models)
+
+        metrics /= self.getNumFolds()
+        best_index = (
+            int(np.argmax(metrics))
+            if evaluator.isLargerBetter()
+            else int(np.argmin(metrics))
+        )
+        self.logger.info(
+            "CrossValidator metrics=%s best_index=%d", metrics.tolist(), best_index
+        )
+        best_model = est.fit(dataset, maps[best_index])
+        cv_model = CrossValidatorModel(
+            best_model, metrics.tolist(), sub_models=sub_models
+        )
+        cv_model._resetUid(self.uid)
+        self._copyValues(cv_model)
+        return cv_model
+
+    def copy(self, extra: Optional[ParamMap] = None) -> "CrossValidator":
+        that = super().copy(extra)
+        that._estimator = self._estimator.copy()
+        that._estimatorParamMaps = list(self._estimatorParamMaps)
+        that._evaluator = self._evaluator.copy()
+        return that  # type: ignore[return-value]
+
+
+class CrossValidatorModel(_CrossValidatorParams):
+    """Holds the best model + averaged metrics (pyspark surface)."""
+
+    def __init__(
+        self,
+        bestModel: Any,
+        avgMetrics: Optional[List[float]] = None,
+        sub_models: Optional[List[List[Any]]] = None,
+    ) -> None:
+        super().__init__()
+        self._setDefault(numFolds=3, foldCol="", parallelism=1, collectSubModels=False, seed=42)
+        self.bestModel = bestModel
+        self.avgMetrics = avgMetrics or []
+        self.subModels = sub_models
+
+    def transform(self, dataset: Any) -> Any:
+        return self.bestModel.transform(dataset)

@@ -1,0 +1,228 @@
+"""Metrics / evaluators / CrossValidator / Pipeline tests (reference coverage:
+metrics vs sklearn formulas, CV best-model selection, pipeline assembler bypass)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+from sklearn.datasets import make_classification, make_regression
+from sklearn.metrics import (
+    accuracy_score,
+    f1_score,
+    log_loss,
+    mean_absolute_error,
+    mean_squared_error,
+    precision_score,
+    r2_score,
+    recall_score,
+    roc_auc_score,
+)
+
+from spark_rapids_ml_tpu.classification import LogisticRegression
+from spark_rapids_ml_tpu.evaluation import (
+    BinaryClassificationEvaluator,
+    MulticlassClassificationEvaluator,
+    RegressionEvaluator,
+)
+from spark_rapids_ml_tpu.feature import VectorAssembler
+from spark_rapids_ml_tpu.metrics import MulticlassMetrics, RegressionMetrics
+from spark_rapids_ml_tpu.pipeline import NoOpTransformer, Pipeline
+from spark_rapids_ml_tpu.regression import LinearRegression
+from spark_rapids_ml_tpu.tuning import (
+    CrossValidator,
+    CrossValidatorModel,
+    ParamGridBuilder,
+)
+
+
+def _cls_preds(n=300, k=3, seed=0):
+    rng = np.random.default_rng(seed)
+    y = rng.integers(0, k, size=n).astype(float)
+    pred = y.copy()
+    flip = rng.random(n) < 0.25
+    pred[flip] = rng.integers(0, k, size=flip.sum()).astype(float)
+    prob = rng.dirichlet(np.ones(k), size=n)
+    prob[np.arange(n), pred.astype(int)] += 1.0
+    prob /= prob.sum(axis=1, keepdims=True)
+    return y, pred, prob
+
+
+class TestMulticlassMetrics:
+    def test_against_sklearn(self):
+        y, pred, prob = _cls_preds()
+        m = MulticlassMetrics.from_predictions(y, pred, probabilities=prob)
+        assert m.evaluate("accuracy") == pytest.approx(accuracy_score(y, pred))
+        assert m.evaluate("f1") == pytest.approx(f1_score(y, pred, average="weighted"))
+        assert m.evaluate("weightedPrecision") == pytest.approx(
+            precision_score(y, pred, average="weighted")
+        )
+        assert m.evaluate("weightedRecall") == pytest.approx(
+            recall_score(y, pred, average="weighted")
+        )
+        assert m.evaluate("precisionByLabel", metric_label=1.0) == pytest.approx(
+            precision_score(y, pred, labels=[1.0], average="macro", zero_division=0)
+        )
+        assert m.evaluate("logLoss") == pytest.approx(
+            log_loss(y, prob, labels=[0.0, 1.0, 2.0]), rel=1e-6
+        )
+        assert m.evaluate("hammingLoss") == pytest.approx(1 - accuracy_score(y, pred))
+
+    def test_merge_partials(self):
+        """Per-partition partials merged == whole-dataset computation (the reference's
+        executor/driver split, classification.py:117-159 + 232-282)."""
+        y, pred, prob = _cls_preds(n=200, seed=1)
+        whole = MulticlassMetrics.from_predictions(y, pred, probabilities=prob)
+        parts = [
+            MulticlassMetrics.from_predictions(
+                y[s], pred[s], probabilities=prob[s]
+            )
+            for s in (slice(0, 67), slice(67, 151), slice(151, 200))
+        ]
+        merged = parts[0].merge(parts[1]).merge(parts[2])
+        for name in ("accuracy", "f1", "weightedPrecision", "logLoss"):
+            assert merged.evaluate(name) == pytest.approx(whole.evaluate(name))
+
+
+class TestRegressionMetrics:
+    def test_against_sklearn(self):
+        rng = np.random.default_rng(0)
+        y = rng.normal(size=250)
+        pred = y + rng.normal(scale=0.3, size=250)
+        m = RegressionMetrics.from_predictions(y, pred)
+        assert m.evaluate("mse") == pytest.approx(mean_squared_error(y, pred))
+        assert m.evaluate("rmse") == pytest.approx(np.sqrt(mean_squared_error(y, pred)))
+        assert m.evaluate("mae") == pytest.approx(mean_absolute_error(y, pred))
+        assert m.evaluate("r2") == pytest.approx(r2_score(y, pred))
+
+    def test_merge(self):
+        rng = np.random.default_rng(1)
+        y = rng.normal(size=100)
+        pred = y + rng.normal(scale=0.5, size=100)
+        whole = RegressionMetrics.from_predictions(y, pred)
+        merged = RegressionMetrics.from_predictions(y[:37], pred[:37]).merge(
+            RegressionMetrics.from_predictions(y[37:], pred[37:])
+        )
+        assert merged.evaluate("rmse") == pytest.approx(whole.evaluate("rmse"))
+        assert merged.evaluate("r2") == pytest.approx(whole.evaluate("r2"))
+
+
+class TestEvaluators:
+    def test_binary_auc(self, n_devices):
+        X, y = make_classification(n_samples=300, n_features=8, random_state=0)
+        df = pd.DataFrame(
+            {"features": list(X.astype(np.float32)), "label": y.astype(float)}
+        )
+        model = LogisticRegression(maxIter=50).fit(df)
+        out = model.transform(df)
+        ev = BinaryClassificationEvaluator()
+        raw = np.stack(out["rawPrediction"].to_numpy())
+        sk_auc = roc_auc_score(y, raw[:, 1])
+        assert ev.evaluate(out) == pytest.approx(sk_auc, rel=1e-6)
+
+    def test_regression_evaluator_larger_better(self):
+        assert not RegressionEvaluator(metricName="rmse").isLargerBetter()
+        assert RegressionEvaluator(metricName="r2").isLargerBetter()
+        assert not MulticlassClassificationEvaluator(metricName="logLoss").isLargerBetter()
+
+
+class TestCrossValidator:
+    def test_cv_picks_best_reg(self, n_devices):
+        """CV must prefer low regularization on clean, well-determined data."""
+        X, y, _ = make_regression(
+            n_samples=400, n_features=6, noise=2.0, coef=True, random_state=0
+        )
+        df = pd.DataFrame(
+            {"features": list(X.astype(np.float32)), "label": y.astype(np.float32)}
+        )
+        est = LinearRegression(standardization=False)
+        grid = (
+            ParamGridBuilder()
+            .addGrid(est.regParam, [0.0, 100.0])
+            .build()
+        )
+        cv = CrossValidator(
+            estimator=est,
+            estimatorParamMaps=grid,
+            evaluator=RegressionEvaluator(metricName="rmse"),
+            numFolds=3,
+            seed=5,
+        )
+        cv_model = cv.fit(df)
+        assert isinstance(cv_model, CrossValidatorModel)
+        assert len(cv_model.avgMetrics) == 2
+        assert cv_model.avgMetrics[0] < cv_model.avgMetrics[1]  # low reg wins on rmse
+        assert cv_model.bestModel.getOrDefault("regParam") == 0.0
+        out = cv_model.transform(df)
+        assert "prediction" in out.columns
+
+    def test_cv_classification_f1(self, n_devices):
+        X, y = make_classification(n_samples=300, n_features=8, random_state=1)
+        df = pd.DataFrame(
+            {"features": list(X.astype(np.float32)), "label": y.astype(float)}
+        )
+        est = LogisticRegression(maxIter=60)
+        grid = ParamGridBuilder().addGrid(est.regParam, [0.001, 10.0]).build()
+        cv = CrossValidator(
+            estimator=est,
+            estimatorParamMaps=grid,
+            evaluator=MulticlassClassificationEvaluator(metricName="f1"),
+            numFolds=3,
+            seed=2,
+        )
+        model = cv.fit(df)
+        assert model.bestModel.getOrDefault("regParam") == 0.001
+
+    def test_param_grid_builder(self):
+        est = LinearRegression()
+        grid = (
+            ParamGridBuilder()
+            .addGrid(est.regParam, [0.0, 0.1])
+            .addGrid(est.elasticNetParam, [0.0, 0.5, 1.0])
+            .build()
+        )
+        assert len(grid) == 6
+
+    def test_fold_col(self, n_devices):
+        X, y, _ = make_regression(n_samples=90, n_features=4, noise=1.0, coef=True, random_state=2)
+        df = pd.DataFrame(
+            {
+                "features": list(X.astype(np.float32)),
+                "label": y.astype(np.float32),
+                "fold": np.arange(90) % 3,
+            }
+        )
+        est = LinearRegression(standardization=False)
+        cv = CrossValidator(
+            estimator=est,
+            estimatorParamMaps=[{est.regParam: 0.0}],
+            evaluator=RegressionEvaluator(),
+            numFolds=3,
+            foldCol="fold",
+        )
+        assert len(cv.fit(df).avgMetrics) == 1
+
+
+class TestPipeline:
+    def test_assembler_bypass(self, n_devices):
+        """VectorAssembler -> TPU estimator is replaced by NoOp + featuresCols
+        (reference pipeline.py:85-119)."""
+        X, y, _ = make_regression(n_samples=120, n_features=4, noise=1.0, coef=True, random_state=3)
+        cols = [f"c{i}" for i in range(4)]
+        df = pd.DataFrame(X.astype(np.float32), columns=cols)
+        df["label"] = y.astype(np.float32)
+        assembler = VectorAssembler(inputCols=cols, outputCol="features")
+        lr = LinearRegression(standardization=False)
+        pipe_model = Pipeline(stages=[assembler, lr]).fit(df)
+        assert isinstance(pipe_model.stages[0], NoOpTransformer)
+        assert pipe_model.stages[1].getFeaturesCols() == cols
+        out = pipe_model.transform(df)
+        assert "prediction" in out.columns
+        ss_res = np.sum((df["label"] - out["prediction"]) ** 2)
+        assert 1 - ss_res / np.sum((df["label"] - df["label"].mean()) ** 2) > 0.95
+
+    def test_plain_assembler_pipeline(self, n_devices):
+        """Without the bypass conditions the assembler actually assembles."""
+        X = np.random.default_rng(0).normal(size=(50, 3)).astype(np.float32)
+        df = pd.DataFrame(X, columns=["a", "b", "c"])
+        assembler = VectorAssembler(inputCols=["a", "b", "c"], outputCol="vec")
+        out = assembler.transform(df)
+        np.testing.assert_allclose(np.stack(out["vec"].to_numpy()), X)
