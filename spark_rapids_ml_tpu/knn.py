@@ -1,0 +1,15 @@
+# Public API module mirroring the reference's `spark_rapids_ml.knn`
+# (reference python/src/spark_rapids_ml/knn.py).
+from .models.knn import (
+    ApproximateNearestNeighbors,
+    ApproximateNearestNeighborsModel,
+    NearestNeighbors,
+    NearestNeighborsModel,
+)
+
+__all__ = [
+    "ApproximateNearestNeighbors",
+    "ApproximateNearestNeighborsModel",
+    "NearestNeighbors",
+    "NearestNeighborsModel",
+]

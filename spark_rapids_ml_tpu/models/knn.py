@@ -1,0 +1,355 @@
+#
+# Exact and approximate k-NN estimators (L6 API) — the reference's
+# spark_rapids_ml.knn surface (reference python/src/spark_rapids_ml/knn.py):
+#   * NearestNeighbors: fit() just captures the item set (reference knn.py:347-367 —
+#     no compute), kneighbors() runs the distributed all-to-all search (stack §3.4)
+#   * exactNearestNeighborsJoin: flattened (query, item, distance) join
+#     (reference knn.py:435-482)
+#   * ApproximateNearestNeighbors: IVF-Flat per-device index + probe search
+#     (reference knn.py:838-1723 wraps cuVS ivf_flat/ivf_pq/cagra)
+#   * neither is persistable, matching the reference (knn.py:384-408)
+#
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+import pandas as pd
+
+from ..core.backend_params import HasIDCol, _TpuClass
+from ..core.dataset import extract_feature_data
+from ..core.estimator import FitInputs, _TpuEstimator, _TpuModel
+from ..core.params import Param, Params, TypeConverters
+from ..core.backend_params import DictTypeConverters, HasFeaturesCols
+from ..core.params import HasInputCol, HasLabelCol
+from ..parallel.mesh import get_mesh, shard_array
+from ..parallel.partition import pad_rows
+from ..ops.knn import exact_knn_distributed, ivfflat_build, ivfflat_search
+from ..utils import get_logger
+
+
+class _NNParams(HasInputCol, HasFeaturesCols, HasIDCol):
+    k: Param[int] = Param(
+        "undefined", "k", "number of nearest neighbors to retrieve (> 0).",
+        TypeConverters.toInt,
+    )
+
+    def getK(self) -> int:
+        return self.getOrDefault("k")
+
+    def setK(self, value: int):
+        return self._set_params(k=value)
+
+    def setInputCol(self, value: str):
+        return self._set(inputCol=value)
+
+
+class _NearestNeighborsClass(_TpuClass):
+    @classmethod
+    def _param_mapping(cls):
+        return {"k": "n_neighbors", "inputCol": "", "featuresCols": "", "idCol": ""}
+
+    @classmethod
+    def _get_tpu_params_default(cls) -> Dict[str, Any]:
+        return {"n_neighbors": 5}
+
+
+class NearestNeighbors(_NearestNeighborsClass, _TpuEstimator, _NNParams):
+    """Exact k-NN: fit stores the item set; kneighbors runs the sharded all-to-all
+    search over the mesh (reference knn.py:76-835)."""
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__()
+        self._setDefault(k=5)
+        self.initialize_tpu_params()
+        self._set_params(**kwargs)
+
+    def _out_schema(self) -> List[str]:
+        return []
+
+    def _get_tpu_fit_func(self, extra_params=None):
+        raise NotImplementedError("NearestNeighbors.fit stores data; no kernel runs.")
+
+    def _create_pyspark_model(self, attrs: Dict[str, Any]) -> "NearestNeighborsModel":
+        return NearestNeighborsModel(**attrs)
+
+    def _fit(self, dataset: Any) -> "NearestNeighborsModel":
+        # no compute at fit time (reference knn.py:347-367)
+        dataset = self._ensureIdCol(dataset)
+        fd = self._pre_process_data(dataset)
+        model = NearestNeighborsModel(
+            item_features=np.asarray(fd.features),
+            item_ids=(
+                fd.row_id
+                if fd.row_id is not None
+                else np.arange(fd.n_rows, dtype=np.int64)
+            ),
+            item_df=dataset,
+        )
+        model._num_workers = self._num_workers
+        self._copyValues(model)
+        return model
+
+    def write(self):
+        raise NotImplementedError(
+            "NearestNeighbors is not persistable (reference knn.py:384-408)."
+        )
+
+
+class NearestNeighborsModel(_NearestNeighborsClass, _TpuModel, _NNParams):
+    def __init__(
+        self,
+        item_features: np.ndarray,
+        item_ids: np.ndarray,
+        item_df: Any = None,
+    ) -> None:
+        super().__init__(item_features=item_features, item_ids=item_ids)
+        self._item_df = item_df
+        self._setDefault(k=5)
+
+    def _transform_arrays(self, X: np.ndarray) -> Dict[str, np.ndarray]:
+        raise NotImplementedError("Use kneighbors() / exactNearestNeighborsJoin().")
+
+    def kneighbors(self, query_df: Any) -> Tuple[Any, Any, pd.DataFrame]:
+        """Returns (item_df, query_df, knn_df): knn_df has query_id + arrays of item
+        indices (ids) and euclidean distances (reference knn.py:574-660)."""
+        query_df = self._ensureIdCol(query_df)
+        input_col, input_cols = self._get_input_columns()
+        id_col = self.getIdCol()
+        fd = extract_feature_data(
+            query_df, input_col=input_col, input_cols=input_cols, id_col=id_col
+        )
+        Q = np.asarray(fd.features)
+        query_ids = (
+            fd.row_id if fd.row_id is not None else np.arange(len(Q), dtype=np.int64)
+        )
+
+        items = self._model_attributes["item_features"]
+        item_ids = self._model_attributes["item_ids"]
+        mesh = get_mesh(self.num_workers)
+        Xp, valid, _ = pad_rows(items, mesh.devices.size)
+        Xd = shard_array(Xp, mesh)
+        vd = shard_array(valid, mesh)
+        k = min(self.getK(), items.shape[0])
+        dists, gidx = exact_knn_distributed(mesh, Q, Xd, vd, k)
+        ids = item_ids[gidx]  # padded positions never win (inf distance)
+
+        knn_df = pd.DataFrame(
+            {
+                f"query_{id_col}": query_ids,
+                "indices": list(ids),
+                "distances": list(dists.astype(np.float32)),
+            }
+        )
+        return self._item_df, query_df, knn_df
+
+    def exactNearestNeighborsJoin(
+        self, query_df: Any, distCol: str = "distCol"
+    ) -> pd.DataFrame:
+        """Flattened (query_id, item_id, distance) join (reference knn.py:435-482)."""
+        _, query_df, knn_df = self.kneighbors(query_df)
+        id_col = self.getIdCol()
+        rows = []
+        for _, r in knn_df.iterrows():
+            for item_id, dist in zip(r["indices"], r["distances"]):
+                rows.append((r[f"query_{id_col}"], item_id, dist))
+        return pd.DataFrame(rows, columns=[f"query_{id_col}", f"item_{id_col}", distCol])
+
+    def write(self):
+        raise NotImplementedError(
+            "NearestNeighborsModel is not persistable (reference knn.py:484-508)."
+        )
+
+
+class _ApproxNNClass(_TpuClass):
+    @classmethod
+    def _param_mapping(cls):
+        return {
+            "k": "n_neighbors",
+            "algorithm": "algorithm",
+            "algoParams": "algo_params",
+            "metric": "metric",
+            "inputCol": "",
+            "featuresCols": "",
+            "idCol": "",
+        }
+
+    @classmethod
+    def _param_value_mapping(cls):
+        return {
+            "algorithm": lambda x: x if x in ("ivfflat", "ivf_flat", "brute_force") else None,
+            "metric": lambda x: x if x in ("euclidean", "sqeuclidean", "l2") else None,
+        }
+
+    @classmethod
+    def _get_tpu_params_default(cls) -> Dict[str, Any]:
+        return {
+            "n_neighbors": 5,
+            "algorithm": "ivfflat",
+            "algo_params": None,
+            "metric": "euclidean",
+        }
+
+
+class ApproximateNearestNeighbors(_ApproxNNClass, _TpuEstimator, _NNParams):
+    """ANN with an IVF-Flat index built by our distributed kmeans
+    (reference knn.py:838-1723; algorithm/algoParams names follow the reference's
+    cuVS translation table knn.py:1324-1404 — ivfflat params: nlist, nprobe)."""
+
+    algorithm: Param[str] = Param(
+        "undefined",
+        "algorithm",
+        "algorithm to use: 'ivfflat' or 'brute_force' (ivfpq/cagra: future rounds).",
+        TypeConverters.toString,
+    )
+    algoParams: Param[Dict[str, Any]] = Param(
+        "undefined",
+        "algoParams",
+        "algorithm parameters, e.g. {'nlist': 64, 'nprobe': 8}.",
+        DictTypeConverters._toDict,
+    )
+    metric: Param[str] = Param(
+        "undefined", "metric", "distance metric.", TypeConverters.toString
+    )
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__()
+        self._setDefault(k=5, algorithm="ivfflat", metric="euclidean", algoParams=None)
+        self.initialize_tpu_params()
+        self._set_params(**kwargs)
+
+    def _out_schema(self) -> List[str]:
+        return ["centers", "cells", "cell_ids", "cell_sizes"]
+
+    def _get_tpu_fit_func(self, extra_params: Optional[List[Dict[str, Any]]] = None):
+        algo_params = self.getOrDefault("algoParams") or {}
+        nlist = int(algo_params.get("nlist", 64))
+        seed = int(algo_params.get("seed", 42))
+
+        def _fit(inputs: FitInputs) -> Dict[str, Any]:
+            return ivfflat_build(
+                inputs.features, inputs.row_weight, nlist=min(nlist, inputs.desc.m),
+                max_iter=20, seed=seed,
+            )
+
+        return _fit
+
+    def _create_pyspark_model(self, attrs) -> "ApproximateNearestNeighborsModel":
+        return ApproximateNearestNeighborsModel(**attrs)
+
+    def _fit(self, dataset: Any) -> "ApproximateNearestNeighborsModel":
+        dataset = self._ensureIdCol(dataset)
+        fd = self._pre_process_data(dataset)
+        if self.getOrDefault("algorithm") == "brute_force":
+            model = ApproximateNearestNeighborsModel(
+                centers=np.zeros((0, fd.n_cols), np.float32),
+                cells=np.zeros((0, 0, fd.n_cols), np.float32),
+                cell_ids=np.zeros((0, 0), np.int64),
+                cell_sizes=np.zeros((0,), np.int32),
+            )
+            model._brute_items = np.asarray(fd.features)
+        else:
+            model = self._fit_internal(dataset, None)[0]
+        model._item_row_ids = (
+            fd.row_id if fd.row_id is not None else np.arange(fd.n_rows, dtype=np.int64)
+        )
+        model._item_df = dataset
+        self._copyValues(model)
+        return model
+
+    def write(self):
+        raise NotImplementedError("ApproximateNearestNeighbors is not persistable.")
+
+
+class ApproximateNearestNeighborsModel(_ApproxNNClass, _TpuModel, _NNParams):
+    algorithm = ApproximateNearestNeighbors.algorithm
+    algoParams = ApproximateNearestNeighbors.algoParams
+    metric = ApproximateNearestNeighbors.metric
+
+    def __init__(
+        self,
+        centers: np.ndarray,
+        cells: np.ndarray,
+        cell_ids: np.ndarray,
+        cell_sizes: np.ndarray,
+    ) -> None:
+        super().__init__(
+            centers=np.asarray(centers),
+            cells=np.asarray(cells),
+            cell_ids=np.asarray(cell_ids),
+            cell_sizes=np.asarray(cell_sizes),
+        )
+        self._setDefault(k=5, algorithm="ivfflat", metric="euclidean", algoParams=None)
+        self._brute_items: Optional[np.ndarray] = None
+        self._item_row_ids: Optional[np.ndarray] = None
+        self._item_df: Any = None
+        self.logger = get_logger(self.__class__)
+
+    def _transform_arrays(self, X: np.ndarray) -> Dict[str, np.ndarray]:
+        raise NotImplementedError("Use kneighbors() / approxSimilarityJoin().")
+
+    def kneighbors(self, query_df: Any) -> Tuple[Any, Any, pd.DataFrame]:
+        import jax.numpy as jnp
+
+        query_df = self._ensureIdCol(query_df)
+        input_col, input_cols = self._get_input_columns()
+        id_col = self.getIdCol()
+        fd = extract_feature_data(
+            query_df, input_col=input_col, input_cols=input_cols, id_col=id_col
+        )
+        Q = np.asarray(fd.features)
+        query_ids = (
+            fd.row_id if fd.row_id is not None else np.arange(len(Q), dtype=np.int64)
+        )
+        k = self.getK()
+
+        if self._brute_items is not None:
+            from ..ops.knn import exact_knn_single
+
+            items = self._brute_items
+            d2, idx = exact_knn_single(
+                jnp.asarray(Q), jnp.asarray(items),
+                jnp.ones((items.shape[0],), bool), min(k, items.shape[0]),
+            )
+            dists = np.sqrt(np.asarray(d2))
+            pos = np.asarray(idx)
+        else:
+            algo_params = self.getOrDefault("algoParams") or {}
+            nlist = self._model_attributes["centers"].shape[0]
+            nprobe = int(algo_params.get("nprobe", max(1, nlist // 8)))
+            dists_j, ids_j = ivfflat_search(
+                jnp.asarray(Q),
+                jnp.asarray(self._model_attributes["centers"]),
+                jnp.asarray(self._model_attributes["cells"]),
+                jnp.asarray(self._model_attributes["cell_ids"]),
+                k=k,
+                nprobe=min(nprobe, nlist),
+            )
+            dists = np.asarray(dists_j)
+            pos = np.asarray(ids_j)
+
+        ids = np.where(pos >= 0, self._item_row_ids[np.maximum(pos, 0)], -1)
+        knn_df = pd.DataFrame(
+            {
+                f"query_{id_col}": query_ids,
+                "indices": list(ids),
+                "distances": list(dists.astype(np.float32)),
+            }
+        )
+        return self._item_df, query_df, knn_df
+
+    def approxSimilarityJoin(
+        self, query_df: Any, distCol: str = "distCol"
+    ) -> pd.DataFrame:
+        _, query_df, knn_df = self.kneighbors(query_df)
+        id_col = self.getIdCol()
+        rows = []
+        for _, r in knn_df.iterrows():
+            for item_id, dist in zip(r["indices"], r["distances"]):
+                if item_id >= 0 and np.isfinite(dist):
+                    rows.append((r[f"query_{id_col}"], item_id, dist))
+        return pd.DataFrame(rows, columns=[f"query_{id_col}", f"item_{id_col}", distCol])
+
+    def write(self):
+        raise NotImplementedError("ApproximateNearestNeighborsModel is not persistable.")

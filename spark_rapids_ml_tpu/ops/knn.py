@@ -1,0 +1,173 @@
+#
+# k-nearest-neighbor kernels — the TPU-native replacement for
+# cuml.neighbors.nearest_neighbors_mg.NearestNeighborsMG (reference knn.py:683-774:
+# exact kNN with the query-block all-to-all over UCX endpoints and a distributed
+# top-k merge inside cuML) and for the cuVS ANN indexes (reference knn.py:1510-1690).
+#
+# TPU formulation (P4 all-to-all, SURVEY.md §2.7):
+#   * items live row-sharded across the mesh; each device scans ITS shard against the
+#     (replicated or gathered) query block — an (nq, n_shard) distance matmul on the
+#     MXU — and keeps a local top-k with GLOBAL item ids,
+#   * one all_gather of the per-device top-k candidates over ICI (k·n_devices per
+#     query — tiny next to the data) replaces cuML's UCX endpoint mesh,
+#   * a final replicated top-k merge gives the global neighbors.
+# Queries are processed in fixed-size blocks (lax.map) to bound the distance-matrix
+# footprint in HBM.
+#
+# IVF-Flat: our own kmeans partitions the items into nlist cells, padded to a common
+# cell size (static shapes); search probes the nprobe nearest cells with a masked
+# distance scan — the cuVS ivf_flat equivalent re-expressed as dense gathers+matmuls.
+#
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from ._precision import FAST, pdot
+from ..parallel.mesh import DATA_AXIS
+
+
+def _block_sq_dists(Q: jax.Array, X: jax.Array) -> jax.Array:
+    """(nq, n) squared euclidean distances (FAST precision: ranking tolerates bf16
+    passes; exact distances are recomputed at parity precision only for the winners)."""
+    q2 = jnp.sum(Q * Q, axis=1, keepdims=True)
+    x2 = jnp.sum(X * X, axis=1)
+    d2 = q2 - 2.0 * jnp.matmul(Q, X.T, precision=FAST) + x2
+    return jnp.maximum(d2, 0.0)
+
+
+@functools.partial(jax.jit, static_argnames=("k", "block"))
+def exact_knn_single(
+    Q: jax.Array, X: jax.Array, valid: jax.Array, k: int, block: int = 1024
+) -> Tuple[jax.Array, jax.Array]:
+    """Single-shard exact kNN: blocked scan, returns (distances², indices)."""
+    nq = Q.shape[0]
+    pad = (-nq) % block
+    Qp = jnp.pad(Q, ((0, pad), (0, 0)))
+
+    def scan_block(qb):
+        d2 = _block_sq_dists(qb, X)
+        d2 = jnp.where(valid[None, :], d2, jnp.inf)
+        neg, idx = jax.lax.top_k(-d2, k)
+        return -neg, idx
+
+    d2b, idxb = jax.lax.map(scan_block, Qp.reshape(-1, block, Q.shape[1]))
+    return d2b.reshape(-1, k)[:nq], idxb.reshape(-1, k)[:nq]
+
+
+def exact_knn_distributed(
+    mesh: Mesh,
+    Q: np.ndarray,
+    X_sharded: jax.Array,
+    valid_sharded: jax.Array,
+    k: int,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Distributed exact kNN over the mesh: local shard scans + all_gather top-k merge.
+
+    Returns host (distances, global indices); distances are EUCLIDEAN (sqrt'd),
+    matching the reference's returned distances (knn.py:783-802)."""
+    n_total = X_sharded.shape[0]
+    n_dev = mesh.devices.size
+    shard_rows = n_total // n_dev
+    k_eff = min(k, n_total)
+
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(), P(DATA_AXIS, None), P(DATA_AXIS)),
+        out_specs=P(),
+        check_vma=False,  # post-all_gather results are replicated; size-1 aux axes
+        # defeat the static replication checker
+    )
+    def _local_then_merge(q, x_local, valid_local):
+        rank = jax.lax.axis_index(DATA_AXIS)
+        d2, idx = exact_knn_single(q, x_local, valid_local, k_eff)
+        gidx = idx + rank * shard_rows
+        # all-to-all candidate exchange over ICI (the UCX replacement)
+        d2_all = jax.lax.all_gather(d2, DATA_AXIS, axis=1)  # (nq, n_dev, k)
+        gidx_all = jax.lax.all_gather(gidx, DATA_AXIS, axis=1)
+        d2_all = d2_all.reshape(d2.shape[0], -1)
+        gidx_all = gidx_all.reshape(d2.shape[0], -1)
+        neg, pos = jax.lax.top_k(-d2_all, k_eff)
+        return -neg, jnp.take_along_axis(gidx_all, pos, axis=1)
+
+    d2, gidx = _local_then_merge(jnp.asarray(Q), X_sharded, valid_sharded)
+    return np.sqrt(np.asarray(d2)), np.asarray(gidx)
+
+
+# ---------------------------------------------------------------------------
+# IVF-Flat
+# ---------------------------------------------------------------------------
+
+
+def ivfflat_build(
+    X: jax.Array, w: jax.Array, nlist: int, max_iter: int, seed: int
+) -> Dict[str, np.ndarray]:
+    """Partition items into nlist cells via our kmeans; lay cells out densely padded
+    to the max cell size (static shapes for the probe scan)."""
+    from .kmeans import kmeans_fit, kmeans_predict
+
+    fitted = kmeans_fit(
+        X, w, k=nlist, max_iter=max_iter, tol=1e-4, init="k-means||",
+        init_steps=2, seed=seed,
+    )
+    centers = fitted["cluster_centers"]
+    assign = np.asarray(kmeans_predict(X, jnp.asarray(centers)))
+    valid = np.asarray(w) > 0
+    n, d = X.shape
+    cell_sizes = np.bincount(assign[valid], minlength=nlist)
+    max_cell = max(int(cell_sizes.max()), 1)
+    cells = np.zeros((nlist, max_cell, d), dtype=np.float32)
+    cell_ids = np.full((nlist, max_cell), -1, dtype=np.int64)
+    Xh = np.asarray(X)
+    fill = np.zeros(nlist, dtype=np.int64)
+    for i in np.nonzero(valid)[0]:
+        c = assign[i]
+        cells[c, fill[c]] = Xh[i]
+        cell_ids[c, fill[c]] = i
+        fill[c] += 1
+    return {
+        "centers": centers,
+        "cells": cells,
+        "cell_ids": cell_ids,
+        "cell_sizes": cell_sizes.astype(np.int32),
+    }
+
+
+@functools.partial(jax.jit, static_argnames=("k", "nprobe"))
+def ivfflat_search(
+    Q: jax.Array,
+    centers: jax.Array,
+    cells: jax.Array,
+    cell_ids: jax.Array,
+    k: int,
+    nprobe: int,
+) -> Tuple[jax.Array, jax.Array]:
+    """Probe the nprobe nearest cells per query; masked scan + top-k.
+    Returns (euclidean distances, item ids), id -1 where fewer than k found."""
+    nlist, max_cell, d = cells.shape
+
+    cd2 = _block_sq_dists(Q, centers)  # (nq, nlist)
+    _, probe = jax.lax.top_k(-cd2, nprobe)  # (nq, nprobe)
+
+    probed_items = cells[probe]  # (nq, nprobe, max_cell, d)
+    probed_ids = cell_ids[probe]  # (nq, nprobe, max_cell)
+    nq = Q.shape[0]
+    flat_items = probed_items.reshape(nq, nprobe * max_cell, d)
+    flat_ids = probed_ids.reshape(nq, nprobe * max_cell)
+
+    d2 = jnp.sum((flat_items - Q[:, None, :]) ** 2, axis=-1)
+    d2 = jnp.where(flat_ids >= 0, d2, jnp.inf)
+    k_eff = min(k, nprobe * max_cell)
+    neg, pos = jax.lax.top_k(-d2, k_eff)
+    ids = jnp.take_along_axis(flat_ids, pos, axis=1)
+    dists = jnp.sqrt(jnp.maximum(-neg, 0.0))
+    dists = jnp.where(ids >= 0, dists, jnp.inf)
+    return dists, ids

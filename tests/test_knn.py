@@ -1,0 +1,118 @@
+"""Exact + approximate kNN tests vs sklearn (reference tests/test_nearest_neighbors.py
+and tests/test_approximate_nearest_neighbors.py)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+from sklearn.neighbors import NearestNeighbors as SkNN
+
+from spark_rapids_ml_tpu.knn import (
+    ApproximateNearestNeighbors,
+    NearestNeighbors,
+)
+
+
+def _data(n_items=500, n_queries=40, d=16, seed=0):
+    rng = np.random.default_rng(seed)
+    items = rng.normal(size=(n_items, d)).astype(np.float32)
+    queries = rng.normal(size=(n_queries, d)).astype(np.float32)
+    return items, queries
+
+
+def test_exact_knn_matches_sklearn(n_devices):
+    items, queries = _data()
+    item_df = pd.DataFrame({"features": list(items)})
+    query_df = pd.DataFrame({"features": list(queries)})
+    est = NearestNeighbors(k=7, inputCol="features")
+    est.num_workers = n_devices
+    model = est.fit(item_df)
+    _, _, knn_df = model.kneighbors(query_df)
+
+    sk = SkNN(n_neighbors=7).fit(items)
+    sk_dists, sk_idx = sk.kneighbors(queries)
+
+    got_idx = np.stack(knn_df["indices"].to_numpy())
+    got_d = np.stack(knn_df["distances"].to_numpy())
+    np.testing.assert_array_equal(got_idx, sk_idx)
+    np.testing.assert_allclose(got_d, sk_dists, rtol=1e-3, atol=1e-3)
+
+
+def test_exact_knn_with_id_col(n_devices):
+    items, queries = _data(n_items=100, n_queries=5, d=4, seed=1)
+    item_ids = np.arange(100, dtype=np.int64) * 10 + 3  # non-contiguous ids
+    item_df = pd.DataFrame({"features": list(items), "my_id": item_ids})
+    query_df = pd.DataFrame({"features": list(queries)})
+    model = NearestNeighbors(k=3, inputCol="features", idCol="my_id").fit(item_df)
+    _, _, knn_df = model.kneighbors(query_df)
+    got_ids = np.stack(knn_df["indices"].to_numpy())
+    sk = SkNN(n_neighbors=3).fit(items)
+    _, sk_idx = sk.kneighbors(queries)
+    np.testing.assert_array_equal(got_ids, item_ids[sk_idx])
+
+
+def test_exact_knn_join(n_devices):
+    items, queries = _data(n_items=50, n_queries=4, d=3, seed=2)
+    model = NearestNeighbors(k=2, inputCol="features").fit(
+        pd.DataFrame({"features": list(items)})
+    )
+    joined = model.exactNearestNeighborsJoin(
+        pd.DataFrame({"features": list(queries)}), distCol="dist"
+    )
+    assert len(joined) == 4 * 2
+    assert set(joined.columns) >= {"dist"}
+
+
+def test_knn_not_persistable():
+    est = NearestNeighbors(k=2, inputCol="features")
+    with pytest.raises(NotImplementedError):
+        est.write()
+
+
+def test_knn_k_larger_than_items(n_devices):
+    items, queries = _data(n_items=5, n_queries=3, d=4, seed=3)
+    model = NearestNeighbors(k=10, inputCol="features").fit(
+        pd.DataFrame({"features": list(items)})
+    )
+    _, _, knn_df = model.kneighbors(pd.DataFrame({"features": list(queries)}))
+    assert len(knn_df["indices"].iloc[0]) == 5  # clamped to item count
+
+
+@pytest.mark.parametrize("algorithm", ["ivfflat", "brute_force"])
+def test_ann_recall(algorithm, n_devices):
+    """IVF-Flat with generous nprobe must reach high recall vs exact."""
+    items, queries = _data(n_items=800, n_queries=50, d=8, seed=4)
+    est = ApproximateNearestNeighbors(
+        k=10,
+        inputCol="features",
+        algorithm=algorithm,
+        algoParams={"nlist": 16, "nprobe": 8},
+    )
+    est.num_workers = n_devices
+    model = est.fit(pd.DataFrame({"features": list(items)}))
+    _, _, knn_df = model.kneighbors(pd.DataFrame({"features": list(queries)}))
+
+    sk = SkNN(n_neighbors=10).fit(items)
+    _, sk_idx = sk.kneighbors(queries)
+    got = np.stack(knn_df["indices"].to_numpy())
+    recall = np.mean(
+        [len(set(g) & set(s)) / 10.0 for g, s in zip(got, sk_idx)]
+    )
+    if algorithm == "brute_force":
+        assert recall == 1.0
+    else:
+        assert recall > 0.9
+
+
+def test_ann_bad_algorithm_flags_fallback():
+    est = ApproximateNearestNeighbors(algorithm="cagra", inputCol="features")
+    assert est._use_cpu_fallback()  # cagra not yet TPU-implemented
+
+
+def test_ann_join_filters_invalid(n_devices):
+    items, queries = _data(n_items=30, n_queries=3, d=4, seed=5)
+    model = ApproximateNearestNeighbors(
+        k=4, inputCol="features", algoParams={"nlist": 4, "nprobe": 4}
+    ).fit(pd.DataFrame({"features": list(items)}))
+    joined = model.approxSimilarityJoin(pd.DataFrame({"features": list(queries)}))
+    assert (joined["distCol"] < np.inf).all()
+    assert (joined["item_" + model.getIdCol()] >= 0).all()
