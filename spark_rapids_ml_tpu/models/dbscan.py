@@ -1,0 +1,162 @@
+#
+# DBSCAN estimator/model (L6 API) — reference spark_rapids_ml.clustering.DBSCAN
+# (reference clustering.py:607-1186):
+#   * fit() does NO compute — it captures the dataset; the clustering runs at
+#     transform() time (reference clustering.py:904-918: "_fit returns empty model")
+#   * transform() broadcasts the (transform-time) dataset, computes labels, and joins
+#     them back by idCol (reference clustering.py:1103-1186)
+#   * int64 labels throughout (the reference escalates out_dtype for >2.1e9 points,
+#     clustering.py:1076-1078 — int64 is simply the default here)
+#
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..core.backend_params import HasFeaturesCols, HasIDCol, _TpuClass
+from ..core.dataset import extract_feature_data
+from ..core.estimator import _TpuEstimator, _TpuModel
+from ..core.params import (
+    HasFeaturesCol,
+    HasPredictionCol,
+    Param,
+    TypeConverters,
+)
+from ..parallel.mesh import get_mesh, shard_array
+from ..parallel.partition import pad_rows
+from ..ops.dbscan import dbscan_fit_predict
+
+
+class _DBSCANClass(_TpuClass):
+    @classmethod
+    def _param_mapping(cls):
+        return {
+            "eps": "eps",
+            "min_samples": "min_samples",
+            "metric": "metric",
+            "max_mbytes_per_batch": "max_mbytes_per_batch",
+            "featuresCol": "",
+            "featuresCols": "",
+            "predictionCol": "",
+            "idCol": "",
+        }
+
+    @classmethod
+    def _param_value_mapping(cls):
+        return {"metric": lambda x: x if x in ("euclidean",) else None}
+
+    @classmethod
+    def _get_tpu_params_default(cls) -> Dict[str, Any]:
+        return {
+            "eps": 0.5,
+            "min_samples": 5,
+            "metric": "euclidean",
+            "max_mbytes_per_batch": None,
+        }
+
+    @classmethod
+    def _fallback_class(cls):
+        from sklearn.cluster import DBSCAN as SkDBSCAN
+
+        return SkDBSCAN
+
+
+class _DBSCANParams(HasFeaturesCol, HasFeaturesCols, HasPredictionCol, HasIDCol):
+    eps: Param[float] = Param(
+        "undefined",
+        "eps",
+        "The maximum distance between two samples for one to be considered as in the "
+        "neighborhood of the other.",
+        TypeConverters.toFloat,
+    )
+    min_samples: Param[int] = Param(
+        "undefined",
+        "min_samples",
+        "The number of samples in a neighborhood for a point to be considered as a "
+        "core point (including the point itself).",
+        TypeConverters.toInt,
+    )
+    metric: Param[str] = Param(
+        "undefined", "metric", "Distance metric (euclidean).", TypeConverters.toString
+    )
+    max_mbytes_per_batch: Param[int] = Param(
+        "undefined",
+        "max_mbytes_per_batch",
+        "Batch size cap for the pairwise-distance computation.",
+        TypeConverters.toInt,
+    )
+
+    def setFeaturesCol(self, value: str):
+        return self._set(featuresCol=value)
+
+
+class DBSCAN(_DBSCANClass, _TpuEstimator, _DBSCANParams):
+    """Density-based clustering on the TPU mesh (reference clustering.py:607-918)."""
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__()
+        self._setDefault(
+            featuresCol="features",
+            predictionCol="prediction",
+            eps=0.5,
+            min_samples=5,
+            metric="euclidean",
+        )
+        self.initialize_tpu_params()
+        self._set_params(**kwargs)
+
+    def _out_schema(self) -> List[str]:
+        return []
+
+    def _get_tpu_fit_func(self, extra_params=None):
+        raise NotImplementedError("DBSCAN defers all compute to transform().")
+
+    def _create_pyspark_model(self, attrs) -> "DBSCANModel":
+        return DBSCANModel()
+
+    def _fit(self, dataset: Any) -> "DBSCANModel":
+        # no compute at fit (reference clustering.py:904-918)
+        if self._use_cpu_fallback():
+            model = DBSCANModel()
+            model._use_sklearn = True
+        else:
+            model = DBSCANModel()
+        model._num_workers = self._num_workers
+        self._copyValues(model)
+        return model
+
+
+class DBSCANModel(_DBSCANClass, _TpuModel, _DBSCANParams):
+    def __init__(self) -> None:
+        super().__init__()
+        self._setDefault(
+            featuresCol="features",
+            predictionCol="prediction",
+            eps=0.5,
+            min_samples=5,
+            metric="euclidean",
+        )
+        self._use_sklearn = False
+
+    def _transform_arrays(self, X: np.ndarray) -> Dict[str, np.ndarray]:
+        if self._use_sklearn:
+            sk = self._fallback_class()(
+                eps=self.getOrDefault("eps"),
+                min_samples=self.getOrDefault("min_samples"),
+                metric=self.getOrDefault("metric"),
+            )
+            labels = sk.fit_predict(X)
+            return {self.getOrDefault("predictionCol"): labels.astype(np.int64)}
+        mesh = get_mesh(self.num_workers)
+        Xp, valid, _ = pad_rows(X, mesh.devices.size)
+        Xd = shard_array(Xp, mesh)
+        vd = shard_array(valid > 0, mesh)
+        labels = dbscan_fit_predict(
+            Xd,
+            vd,
+            eps=self.getOrDefault("eps"),
+            min_samples=self.getOrDefault("min_samples"),
+        )
+        return {self.getOrDefault("predictionCol"): labels[: X.shape[0]]}

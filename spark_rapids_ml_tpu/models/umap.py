@@ -1,0 +1,199 @@
+#
+# UMAP estimator/model (L6 API) — reference spark_rapids_ml.umap
+# (reference python/src/spark_rapids_ml/umap.py):
+#   * fit samples the dataset by sample_fraction and runs a single-worker fit
+#     (reference umap.py:923-951 coalesces to 1 partition; here: one jitted program on
+#     the local device — P5 in SURVEY.md §2.7)
+#   * the model is embedding + raw data (reference umap.py:1069-1298), used map-side
+#     by transform (reference broadcasts them in chunks, umap.py:1404-1446)
+#   * cuML-style constructor params (reference umap.py:114-137)
+#
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..core.backend_params import HasFeaturesCols, _TpuClass
+from ..core.estimator import FitInputs, _TpuEstimator, _TpuModelWithColumns
+from ..core.params import (
+    HasFeaturesCol,
+    HasLabelCol,
+    HasOutputCol,
+    HasSeed,
+    Param,
+    TypeConverters,
+)
+from ..ops.umap_ops import umap_fit, umap_transform
+
+
+class _UMAPClass(_TpuClass):
+    @classmethod
+    def _param_mapping(cls):
+        return {
+            "n_neighbors": "n_neighbors",
+            "n_components": "n_components",
+            "n_epochs": "n_epochs",
+            "min_dist": "min_dist",
+            "spread": "spread",
+            "negative_sample_rate": "negative_sample_rate",
+            "learning_rate": "learning_rate",
+            "sample_fraction": "",
+            "seed": "random_state",
+            "featuresCol": "",
+            "featuresCols": "",
+            # supervised UMAP (reference supports labelCol) is not yet implemented on
+            # the TPU path: setting it must surface, not silently run unsupervised
+            "labelCol": None,
+            "outputCol": "",
+        }
+
+    @classmethod
+    def _get_tpu_params_default(cls) -> Dict[str, Any]:
+        # cuML defaults (reference umap.py:114-137)
+        return {
+            "n_neighbors": 15,
+            "n_components": 2,
+            "n_epochs": 200,
+            "min_dist": 0.1,
+            "spread": 1.0,
+            "negative_sample_rate": 5,
+            "learning_rate": 1.0,
+            "random_state": 42,
+        }
+
+    @classmethod
+    def _fallback_class(cls):
+        return None  # umap-learn is not in the image
+
+
+class _UMAPParams(HasFeaturesCol, HasFeaturesCols, HasLabelCol, HasOutputCol, HasSeed):
+    n_neighbors: Param[int] = Param(
+        "undefined", "n_neighbors", "size of local neighborhood.", TypeConverters.toInt
+    )
+    n_components: Param[int] = Param(
+        "undefined", "n_components", "embedding dimension.", TypeConverters.toInt
+    )
+    n_epochs: Param[int] = Param(
+        "undefined", "n_epochs", "number of SGD epochs.", TypeConverters.toInt
+    )
+    min_dist: Param[float] = Param(
+        "undefined", "min_dist", "minimum embedding distance between points.",
+        TypeConverters.toFloat,
+    )
+    spread: Param[float] = Param(
+        "undefined", "spread", "effective scale of embedded points.",
+        TypeConverters.toFloat,
+    )
+    negative_sample_rate: Param[int] = Param(
+        "undefined", "negative_sample_rate", "negative samples per positive edge.",
+        TypeConverters.toInt,
+    )
+    learning_rate: Param[float] = Param(
+        "undefined", "learning_rate", "initial embedding learning rate.",
+        TypeConverters.toFloat,
+    )
+    sample_fraction: Param[float] = Param(
+        "undefined",
+        "sample_fraction",
+        "fraction of the input dataset used for fit (reference umap.py:923-951).",
+        TypeConverters.toFloat,
+    )
+
+    def setFeaturesCol(self, value: str):
+        return self._set(featuresCol=value)
+
+    def setOutputCol(self, value: str):
+        return self._set(outputCol=value)
+
+
+class UMAP(_UMAPClass, _TpuEstimator, _UMAPParams):
+    """UMAP: single-device fit on (sampled) data, broadcastable model for transform
+    (reference umap.py:838-1304)."""
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__()
+        self._setDefault(
+            featuresCol="features",
+            outputCol="embedding",
+            n_neighbors=15,
+            n_components=2,
+            n_epochs=200,
+            min_dist=0.1,
+            spread=1.0,
+            negative_sample_rate=5,
+            learning_rate=1.0,
+            seed=42,
+            sample_fraction=1.0,
+        )
+        self.initialize_tpu_params()
+        self._set_params(**kwargs)
+
+    def _out_schema(self) -> List[str]:
+        return ["embedding", "raw_data", "a", "b", "n_neighbors"]
+
+    def _get_tpu_fit_func(self, extra_params: Optional[List[Dict[str, Any]]] = None):
+        p = dict(self._tpu_params)
+        frac = self.getOrDefault("sample_fraction")
+
+        def _fit(inputs: FitInputs) -> Dict[str, Any]:
+            X = inputs.host_features
+            seed = int(p["random_state"]) if p["random_state"] is not None else 42
+            if frac < 1.0:
+                rng = np.random.default_rng(seed)
+                keep = rng.random(X.shape[0]) < frac
+                X = X[keep]
+            return umap_fit(
+                X,
+                n_neighbors=int(p["n_neighbors"]),
+                n_components=int(p["n_components"]),
+                n_epochs=int(p["n_epochs"]),
+                min_dist=float(p["min_dist"]),
+                spread=float(p["spread"]),
+                negative_sample_rate=int(p["negative_sample_rate"]),
+                learning_rate=float(p["learning_rate"]),
+                seed=seed,
+                mesh=inputs.mesh,
+            )
+
+        return _fit
+
+    def _create_pyspark_model(self, attrs) -> "UMAPModel":
+        return UMAPModel(**attrs)
+
+
+class UMAPModel(_UMAPClass, _TpuModelWithColumns, _UMAPParams):
+    def __init__(
+        self,
+        embedding: np.ndarray,
+        raw_data: np.ndarray,
+        a: float,
+        b: float,
+        n_neighbors: int,
+    ) -> None:
+        super().__init__(
+            embedding=np.asarray(embedding),
+            raw_data=np.asarray(raw_data),
+            a=float(a),
+            b=float(b),
+            n_neighbors=int(n_neighbors),
+        )
+        self._setDefault(featuresCol="features", outputCol="embedding", n_neighbors=15)
+
+    @property
+    def embedding_(self) -> np.ndarray:
+        return self._model_attributes["embedding"]
+
+    @property
+    def rawData_(self) -> np.ndarray:
+        return self._model_attributes["raw_data"]
+
+    def _transform_arrays(self, X: np.ndarray) -> Dict[str, np.ndarray]:
+        out = umap_transform(
+            X,
+            self._model_attributes["raw_data"],
+            self._model_attributes["embedding"],
+            self._model_attributes["n_neighbors"],
+        )
+        return {self.getOrDefault("outputCol"): out}

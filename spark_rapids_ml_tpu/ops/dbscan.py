@@ -1,0 +1,126 @@
+#
+# DBSCAN kernels — the TPU-native replacement for cuml.cluster.dbscan_mg.DBSCANMG
+# (reference clustering.py:1018-1092: the whole dataset is broadcast to every worker
+# (P3), cuML MG partitions the adjacency computation internally, rank 0 emits labels).
+#
+# TPU formulation:
+#   * core-point detection: blocked pairwise-distance scan over row-sharded data
+#     (an (block, n) matmul per block on the MXU), counting eps-neighbors,
+#   * cluster formation = connected components of the core-core eps-graph, computed by
+#     iterative min-label propagation with pointer jumping (O(log n) rounds, each one
+#     blocked distance pass + a gather) — the XLA-friendly union-find,
+#   * border points take the label of their minimum-label core neighbor; noise = -1,
+#   * labels are finally compacted to 0..n_clusters-1 in first-appearance order
+#     (cuML/sklearn convention).
+#
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .knn import _block_sq_dists
+
+
+@functools.partial(jax.jit, static_argnames=("block",))
+def _core_mask(
+    X: jax.Array, valid: jax.Array, eps2: float, min_samples: int, block: int = 512
+) -> jax.Array:
+    """Bool mask of core points (eps-neighbor count incl. self >= min_samples)."""
+    n = X.shape[0]
+    pad = (-n) % block
+    Xp = jnp.pad(X, ((0, pad), (0, 0)))
+
+    def count_block(qb):
+        d2 = _block_sq_dists(qb, X)
+        return jnp.sum((d2 <= eps2) & valid[None, :], axis=1)
+
+    counts = jax.lax.map(count_block, Xp.reshape(-1, block, X.shape[1]))
+    return (counts.reshape(-1)[:n] >= min_samples) & valid
+
+
+@functools.partial(jax.jit, static_argnames=("block",))
+def _min_core_neighbor_labels(
+    X: jax.Array, labels: jax.Array, core: jax.Array, eps2: float, block: int = 512
+) -> jax.Array:
+    """For every row: min label among its CORE eps-neighbors (int32 max if none)."""
+    n = X.shape[0]
+    pad = (-n) % block
+    Xp = jnp.pad(X, ((0, pad), (0, 0)))
+    big = jnp.iinfo(jnp.int32).max
+
+    def min_label_block(qb):
+        d2 = _block_sq_dists(qb, X)
+        neigh = (d2 <= eps2) & core[None, :]
+        return jnp.min(jnp.where(neigh, labels[None, :], big), axis=1)
+
+    mins = jax.lax.map(min_label_block, Xp.reshape(-1, block, X.shape[1]))
+    return mins.reshape(-1)[:n]
+
+
+@jax.jit
+def _hook_and_jump(
+    labels: jax.Array, mins: jax.Array, core: jax.Array
+) -> jax.Array:
+    """Hook: core points take the min neighbor label; then two pointer-jumping steps
+    compress label chains (labels index rows)."""
+    new_labels = jnp.where(core, jnp.minimum(labels, mins), labels)
+    new_labels = new_labels[new_labels]
+    new_labels = new_labels[new_labels]
+    return new_labels
+
+
+def dbscan_fit_predict(
+    X: jax.Array,
+    valid: jax.Array,
+    eps: float,
+    min_samples: int,
+    max_rounds: int = 64,
+) -> np.ndarray:
+    """Full DBSCAN labeling; returns int labels (noise = -1) for all rows
+    (padding rows get -1)."""
+    n = X.shape[0]
+    eps2 = float(eps) * float(eps)
+    core = _core_mask(X, valid, eps2, int(min_samples))
+    labels = jnp.arange(n, dtype=jnp.int32)
+
+    prev = None
+    for r in range(max_rounds):
+        mins = _min_core_neighbor_labels(X, labels, core, eps2)
+        labels = _hook_and_jump(labels, mins, core)
+        # convergence check costs a device->host sync; amortize over 4 rounds
+        if r % 4 == 3:
+            cur = np.asarray(labels)
+            if prev is not None and np.array_equal(cur, prev):
+                break
+            prev = cur
+
+    labels_h = np.asarray(labels)
+    core_h = np.asarray(core)
+    valid_h = np.asarray(valid)
+
+    # border points: min-label core neighbor (one more pass)
+    border_min = np.asarray(
+        _min_core_neighbor_labels(X, jnp.asarray(labels_h), jnp.asarray(core_h), eps2)
+    )
+    out = np.full((n,), -1, dtype=np.int64)
+    out[core_h] = labels_h[core_h]
+    border = (~core_h) & valid_h & (border_min < np.iinfo(np.int32).max)
+    out[border] = border_min[border]
+
+    # compact labels to 0..k-1 in first-appearance order (sklearn/cuML convention),
+    # vectorized: order cluster representatives by their first row of appearance
+    clustered = out >= 0
+    if clustered.any():
+        uniq, first_idx = np.unique(out[clustered], return_index=True)
+        order = np.argsort(np.nonzero(clustered)[0][first_idx])
+        rank = np.empty(len(uniq), dtype=np.int64)
+        rank[order] = np.arange(len(uniq))
+        final = np.full((n,), -1, dtype=np.int64)
+        final[clustered] = rank[np.searchsorted(uniq, out[clustered])]
+        return final
+    return out

@@ -1,0 +1,5 @@
+# Public API module mirroring the reference's `spark_rapids_ml.umap`
+# (reference python/src/spark_rapids_ml/umap.py).
+from .models.umap import UMAP, UMAPModel
+
+__all__ = ["UMAP", "UMAPModel"]

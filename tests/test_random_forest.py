@@ -1,0 +1,168 @@
+"""RandomForest classifier/regressor tests vs sklearn
+(reference tests/test_random_forest.py)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+from sklearn.datasets import make_classification, make_regression
+from sklearn.ensemble import (
+    RandomForestClassifier as SkRFC,
+    RandomForestRegressor as SkRFR,
+)
+
+from spark_rapids_ml_tpu.classification import (
+    RandomForestClassificationModel,
+    RandomForestClassifier,
+)
+from spark_rapids_ml_tpu.regression import (
+    RandomForestRegressionModel,
+    RandomForestRegressor,
+)
+
+
+def _cls_data(n=600, d=10, k=3, seed=0):
+    X, y = make_classification(
+        n_samples=n, n_features=d, n_informative=d // 2, n_redundant=0,
+        n_classes=k, class_sep=2.0, random_state=seed,
+    )
+    return X.astype(np.float32), y.astype(np.float64)
+
+
+def test_rf_classifier_accuracy(n_devices):
+    X, y = _cls_data()
+    df = pd.DataFrame({"features": list(X), "label": y})
+    est = RandomForestClassifier(numTrees=20, maxDepth=6, seed=3)
+    est.num_workers = n_devices
+    model = est.fit(df)
+    out = model.transform(df)
+    acc = (out["prediction"].to_numpy() == y).mean()
+    sk_acc = (
+        SkRFC(n_estimators=20, max_depth=6, random_state=0).fit(X, y).score(X, y)
+    )
+    # within a few points of sklearn's train accuracy
+    assert acc > sk_acc - 0.05
+    assert model.numClasses == 3
+    prob = np.stack(out["probability"].to_numpy())
+    np.testing.assert_allclose(prob.sum(axis=1), 1.0, atol=1e-5)
+    raw = np.stack(out["rawPrediction"].to_numpy())
+    assert raw.shape == (len(y), 3)
+    assert model.predict(X[0]) == out["prediction"].iloc[0]
+
+
+def test_rf_regressor_r2(n_devices):
+    X, y, _ = make_regression(
+        n_samples=600, n_features=8, noise=5.0, coef=True, random_state=1
+    )
+    X, y = X.astype(np.float32), y.astype(np.float32)
+    df = pd.DataFrame({"features": list(X), "label": y})
+    model = RandomForestRegressor(numTrees=20, maxDepth=7, seed=5).fit(df)
+    pred = model.transform(df)["prediction"].to_numpy()
+    r2 = 1 - np.sum((y - pred) ** 2) / np.sum((y - y.mean()) ** 2)
+    sk = SkRFR(n_estimators=20, max_depth=7, random_state=0).fit(X, y)
+    sk_r2 = sk.score(X, y)
+    assert r2 > sk_r2 - 0.1
+    assert abs(model.predict(X[0]) - pred[0]) < 1e-5
+
+
+def test_rf_single_tree_deterministic_structure(n_devices):
+    """A depth-2 single tree must find the obvious splits on separable data."""
+    rng = np.random.default_rng(0)
+    X = rng.uniform(-1, 1, size=(400, 2)).astype(np.float32)
+    y = ((X[:, 0] > 0.1) & (X[:, 1] > -0.2)).astype(np.float64)
+    df = pd.DataFrame({"features": list(X), "label": y})
+    model = RandomForestClassifier(
+        numTrees=1, maxDepth=3, bootstrap=False, featureSubsetStrategy="all",
+        maxBins=64, seed=1,
+    ).fit(df)
+    acc = (model.transform(df)["prediction"].to_numpy() == y).mean()
+    assert acc > 0.97
+
+
+def test_rf_min_instances_per_node(n_devices):
+    X, y = _cls_data(n=200, d=4, k=2, seed=2)
+    df = pd.DataFrame({"features": list(X), "label": y})
+    deep = RandomForestClassifier(
+        numTrees=3, maxDepth=8, minInstancesPerNode=1, bootstrap=False, seed=7
+    ).fit(df)
+    shallow = RandomForestClassifier(
+        numTrees=3, maxDepth=8, minInstancesPerNode=80, bootstrap=False, seed=7
+    ).fit(df)
+    # strong min-instances constraint => many more leaves high in the tree
+    assert (
+        shallow.get_model_attributes()["is_leaf"][:, : 2**4].sum()
+        >= deep.get_model_attributes()["is_leaf"][:, : 2**4].sum()
+    )
+
+
+def test_rf_missing_label_raises(n_devices):
+    X, _ = _cls_data(n=60, d=4, k=2)
+    df = pd.DataFrame({"features": list(X), "label": [0.0, 2.0] * 30})
+    with pytest.raises(RuntimeError, match="missing"):
+        RandomForestClassifier(numTrees=2).fit(df)
+
+
+def test_rf_entropy_impurity(n_devices):
+    X, y = _cls_data(n=300, d=6, k=2, seed=4)
+    df = pd.DataFrame({"features": list(X), "label": y})
+    model = RandomForestClassifier(numTrees=5, impurity="entropy", seed=2).fit(df)
+    acc = (model.transform(df)["prediction"].to_numpy() == y).mean()
+    assert acc > 0.9
+
+
+def test_rf_regressor_unsupported_impurity(n_devices):
+    """Classifier impurity on a regressor flags CPU fallback; the sklearn twin then
+    fits a (squared-error) forest and the model still works."""
+    est = RandomForestRegressor(impurity="gini", numTrees=3, maxDepth=3)
+    assert est._use_cpu_fallback()
+    X, y, _ = make_regression(n_samples=80, n_features=4, noise=1.0, coef=True, random_state=0)
+    df = pd.DataFrame({"features": list(X.astype(np.float32)), "label": y.astype(np.float32)})
+    model = est.fit(df)
+    assert isinstance(model, RandomForestRegressionModel)
+    pred = model.transform(df)["prediction"].to_numpy()
+    assert np.isfinite(pred).all()
+
+
+def test_rf_persistence(tmp_path, n_devices):
+    X, y = _cls_data(n=150, d=5, k=2, seed=6)
+    df = pd.DataFrame({"features": list(X), "label": y})
+    model = RandomForestClassifier(numTrees=4, maxDepth=4, seed=8).fit(df)
+    path = str(tmp_path / "rf")
+    model.save(path)
+    loaded = RandomForestClassificationModel.load(path)
+    np.testing.assert_array_equal(
+        loaded.get_model_attributes()["feature"],
+        model.get_model_attributes()["feature"],
+    )
+    a = model.transform(df)["prediction"].to_numpy()
+    b = loaded.transform(df)["prediction"].to_numpy()
+    np.testing.assert_array_equal(a, b)
+
+
+def test_rf_json_dump(n_devices):
+    X, y = _cls_data(n=100, d=4, k=2, seed=7)
+    df = pd.DataFrame({"features": list(X), "label": y})
+    model = RandomForestClassifier(numTrees=2, maxDepth=3, seed=9).fit(df)
+    dump = model.toJSON()
+    assert len(dump) == 2
+    root = dump[0]["root"]
+    assert "split_feature" in root or "leaf_class_probs" in root
+
+    def depth(node):
+        if "left_child" not in node:
+            return 0
+        return 1 + max(depth(node["left_child"]), depth(node["right_child"]))
+
+    assert depth(root) <= 3
+
+
+def test_rf_feature_subset_strategies():
+    from spark_rapids_ml_tpu.ops.trees import resolve_feature_subset
+
+    assert resolve_feature_subset("auto", 16, True) == 4
+    assert resolve_feature_subset("auto", 16, False) == 5
+    assert resolve_feature_subset("all", 16, True) == 16
+    assert resolve_feature_subset("log2", 16, True) == 4
+    assert resolve_feature_subset("0.5", 16, True) == 8
+    assert resolve_feature_subset("3", 16, True) == 3
+    with pytest.raises(ValueError):
+        resolve_feature_subset("bogus", 16, True)

@@ -1,0 +1,113 @@
+"""UMAP + DBSCAN tests (reference tests/test_umap.py validates with sklearn
+trustworthiness; tests/test_dbscan.py compares against sklearn DBSCAN labels)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+from sklearn.cluster import DBSCAN as SkDBSCAN
+from sklearn.datasets import make_blobs, make_moons
+from sklearn.manifold import trustworthiness
+from sklearn.metrics import adjusted_rand_score
+
+from spark_rapids_ml_tpu.clustering import DBSCAN, DBSCANModel
+from spark_rapids_ml_tpu.umap import UMAP, UMAPModel
+
+
+class TestDBSCAN:
+    def test_blobs_match_sklearn(self, n_devices):
+        X, y = make_blobs(
+            n_samples=400, n_features=3, centers=4, cluster_std=0.4, random_state=0
+        )
+        X = X.astype(np.float32)
+        df = pd.DataFrame({"features": list(X)})
+        est = DBSCAN(eps=0.8, min_samples=5)
+        est.num_workers = n_devices
+        model = est.fit(df)
+        out = model.transform(df)
+        got = out["prediction"].to_numpy()
+        sk = SkDBSCAN(eps=0.8, min_samples=5).fit_predict(X)
+        # identical cluster structure (labels may permute)
+        assert adjusted_rand_score(sk, got) > 0.99
+        # same noise points
+        np.testing.assert_array_equal(got == -1, sk == -1)
+
+    def test_moons(self, n_devices):
+        X, y = make_moons(n_samples=300, noise=0.05, random_state=1)
+        X = X.astype(np.float32)
+        df = pd.DataFrame({"features": list(X)})
+        model = DBSCAN(eps=0.2, min_samples=4).fit(df)
+        got = model.transform(df)["prediction"].to_numpy()
+        sk = SkDBSCAN(eps=0.2, min_samples=4).fit_predict(X)
+        assert adjusted_rand_score(sk, got) > 0.99
+
+    def test_all_noise(self, n_devices):
+        rng = np.random.default_rng(0)
+        X = (rng.uniform(size=(50, 4)) * 100).astype(np.float32)
+        df = pd.DataFrame({"features": list(X)})
+        model = DBSCAN(eps=0.01, min_samples=3).fit(df)
+        got = model.transform(df)["prediction"].to_numpy()
+        assert (got == -1).all()
+
+    def test_fit_does_no_compute(self):
+        est = DBSCAN(eps=0.5, min_samples=5)
+        model = est.fit(pd.DataFrame({"features": [np.zeros(2, np.float32)] * 3}))
+        assert isinstance(model, DBSCANModel)
+
+    def test_unsupported_metric_fallback(self, n_devices):
+        X, _ = make_blobs(n_samples=60, centers=2, random_state=2)
+        df = pd.DataFrame({"features": list(X.astype(np.float32))})
+        est = DBSCAN(eps=0.5, min_samples=5, metric="cosine")
+        assert est._use_cpu_fallback()
+
+
+class TestUMAP:
+    def test_trustworthiness_blobs(self, n_devices):
+        """Embedding must preserve local structure (the reference's own quality
+        gate: trustworthiness, tests/test_umap.py)."""
+        X, y = make_blobs(
+            n_samples=400, n_features=10, centers=5, cluster_std=1.0, random_state=0
+        )
+        X = X.astype(np.float32)
+        df = pd.DataFrame({"features": list(X)})
+        est = UMAP(n_neighbors=15, n_epochs=150, seed=3)
+        model = est.fit(df)
+        emb = model.embedding_
+        assert emb.shape == (400, 2)
+        t = trustworthiness(X, emb, n_neighbors=15)
+        assert t > 0.85
+
+    def test_transform_near_train_points(self, n_devices):
+        X, _ = make_blobs(n_samples=200, n_features=6, centers=3, random_state=1)
+        X = X.astype(np.float32)
+        df = pd.DataFrame({"features": list(X)})
+        model = UMAP(n_neighbors=10, n_epochs=100, seed=5).fit(df)
+        out = model.transform(df)
+        assert "embedding" in out.columns
+        emb_t = np.stack(out["embedding"].to_numpy())
+        # transform of training points lands near their fitted embedding
+        dist = np.linalg.norm(emb_t - model.embedding_, axis=1)
+        spread = np.linalg.norm(
+            model.embedding_ - model.embedding_.mean(0), axis=1
+        ).mean()
+        assert np.median(dist) < spread
+
+    def test_sample_fraction(self, n_devices):
+        X, _ = make_blobs(n_samples=300, n_features=5, centers=3, random_state=2)
+        df = pd.DataFrame({"features": list(X.astype(np.float32))})
+        model = UMAP(n_epochs=50, sample_fraction=0.5, seed=7).fit(df)
+        # fit on ~half the rows
+        assert 100 < model.rawData_.shape[0] < 200
+        out = model.transform(df)  # transform still covers all rows
+        assert len(out) == 300
+
+    def test_umap_persistence(self, tmp_path, n_devices):
+        X, _ = make_blobs(n_samples=100, n_features=4, centers=2, random_state=3)
+        df = pd.DataFrame({"features": list(X.astype(np.float32))})
+        model = UMAP(n_epochs=50, seed=9).fit(df)
+        path = str(tmp_path / "umap")
+        model.save(path)
+        loaded = UMAPModel.load(path)
+        np.testing.assert_allclose(loaded.embedding_, model.embedding_)
+        a = np.stack(model.transform(df)["embedding"].to_numpy())
+        b = np.stack(loaded.transform(df)["embedding"].to_numpy())
+        np.testing.assert_allclose(a, b, atol=1e-5)
