@@ -1,0 +1,1 @@
+# Benchmark package — structural equivalent of reference python/benchmark/benchmark/.

@@ -1,0 +1,110 @@
+#
+# BenchmarkBase — structural equivalent of reference python/benchmark/benchmark/base.py:
+# CLI parsing (dataset shape/paths, num_runs, report_path, algorithm params), the
+# input loader, the timing loop, and the CSV report writer (reference base.py:43-285).
+#
+# Differences by design: the reference benchmarks GPU spark-rapids-ml against CPU
+# Spark ML inside a Spark session; this harness benchmarks the TPU estimators against
+# their sklearn CPU twins on locally-generated (or parquet-loaded) data — Spark is
+# optional in this environment. `fit_time`, `transform_time`, `total_time` and a
+# per-algorithm quality score are reported, matching the reference's measured
+# quantities (base.py:262-285).
+#
+
+from __future__ import annotations
+
+import argparse
+import csv
+import os
+import sys
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+import pandas as pd
+
+
+class BenchmarkBase:
+    """Subclasses implement run_tpu(df, args) / run_cpu(df, args) -> metrics dict."""
+
+    name = "base"
+
+    def add_arguments(self, parser: argparse.ArgumentParser) -> None:
+        pass
+
+    def parse_arguments(self, argv: List[str]) -> argparse.Namespace:
+        parser = argparse.ArgumentParser(prog=f"benchmark {self.name}")
+        parser.add_argument("--num_rows", type=int, default=5000)
+        parser.add_argument("--num_cols", type=int, default=3000)
+        parser.add_argument("--dtype", default="float32")
+        parser.add_argument("--train_path", default=None, help="parquet input; generated when absent")
+        parser.add_argument("--transform_path", default=None)
+        parser.add_argument("--num_runs", type=int, default=1)
+        parser.add_argument("--report_path", default="")
+        parser.add_argument("--no_cpu", action="store_true", help="skip the sklearn CPU run")
+        parser.add_argument("--num_workers", type=int, default=None)
+        parser.add_argument("--seed", type=int, default=0)
+        self.add_arguments(parser)
+        return parser.parse_args(argv)
+
+    # ---- data ----
+
+    def gen_dataframe(self, args: argparse.Namespace) -> pd.DataFrame:
+        from ..gen_data import BlobsDataGen
+
+        return BlobsDataGen(
+            num_rows=args.num_rows, num_cols=args.num_cols, seed=args.seed
+        ).gen_dataframe()
+
+    def load_dataframe(self, args: argparse.Namespace) -> pd.DataFrame:
+        if args.train_path:
+            df = pd.read_parquet(args.train_path)
+            feature_cols = [c for c in df.columns if c not in ("label", "unique_id")]
+            if len(feature_cols) >= 1 and np.isscalar(df[feature_cols[0]].iloc[0]):
+                df["features"] = list(df[feature_cols].to_numpy(dtype=np.float32))
+                df = df.drop(columns=feature_cols)
+            return df
+        return self.gen_dataframe(args)
+
+    # ---- per-benchmark hooks ----
+
+    def run_tpu(self, df: pd.DataFrame, args: argparse.Namespace) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def run_cpu(self, df: pd.DataFrame, args: argparse.Namespace) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    # ---- driver ----
+
+    def run(self, argv: List[str]) -> List[Dict[str, Any]]:
+        args = self.parse_arguments(argv)
+        df = self.load_dataframe(args)
+        rows: List[Dict[str, Any]] = []
+        for run_idx in range(args.num_runs):
+            for mode in ("tpu",) if args.no_cpu else ("tpu", "cpu"):
+                t0 = time.perf_counter()
+                metrics = (self.run_tpu if mode == "tpu" else self.run_cpu)(df, args)
+                total = time.perf_counter() - t0
+                row = {
+                    "benchmark": self.name,
+                    "mode": mode,
+                    "run": run_idx,
+                    "num_rows": len(df),
+                    "total_time": round(total, 4),
+                    **{k: (round(v, 6) if isinstance(v, float) else v) for k, v in metrics.items()},
+                }
+                print(row)
+                rows.append(row)
+        if args.report_path:
+            self.write_report(rows, args.report_path)
+        return rows
+
+    def write_report(self, rows: List[Dict[str, Any]], path: str) -> None:
+        """Append rows to a CSV report (reference base.py:262-285)."""
+        fieldnames = sorted({k for r in rows for k in r})
+        exists = os.path.exists(path)
+        with open(path, "a", newline="") as f:
+            writer = csv.DictWriter(f, fieldnames=fieldnames)
+            if not exists:
+                writer.writeheader()
+            writer.writerows(rows)

@@ -1,0 +1,55 @@
+# Approximate kNN benchmark with recall-vs-exact quality score
+# (reference bench_approximate_nearest_neighbors.py).
+
+from __future__ import annotations
+
+import numpy as np
+import pandas as pd
+
+from .base import BenchmarkBase
+from .utils import with_benchmark
+
+
+class BenchmarkApproximateNearestNeighbors(BenchmarkBase):
+    name = "approximate_nearest_neighbors"
+
+    def add_arguments(self, parser):
+        parser.add_argument("--k", type=int, default=10)
+        parser.add_argument("--num_queries", type=int, default=100)
+        parser.add_argument("--nlist", type=int, default=64)
+        parser.add_argument("--nprobe", type=int, default=8)
+
+    def run_tpu(self, df, args):
+        from sklearn.neighbors import NearestNeighbors as SkNN
+
+        from spark_rapids_ml_tpu.knn import ApproximateNearestNeighbors
+
+        X = np.stack(df["features"].to_numpy())
+        qdf = pd.DataFrame({"features": list(X[: args.num_queries])})
+        est = ApproximateNearestNeighbors(
+            k=args.k, inputCol="features",
+            algoParams={"nlist": args.nlist, "nprobe": args.nprobe},
+        )
+        if args.num_workers:
+            est.num_workers = args.num_workers
+        model, fit_time = with_benchmark("tpu build", lambda: est.fit(df))
+        (_, _, knn_df), search_time = with_benchmark(
+            "tpu search", lambda: model.kneighbors(qdf)
+        )
+        got = np.stack(knn_df["indices"].to_numpy())
+        _, exact = SkNN(n_neighbors=args.k).fit(X).kneighbors(X[: args.num_queries])
+        recall = float(
+            np.mean([len(set(g) & set(e)) / args.k for g, e in zip(got, exact)])
+        )
+        return {"fit_time": fit_time, "transform_time": search_time, "score": recall}
+
+    def run_cpu(self, df, args):
+        from sklearn.neighbors import NearestNeighbors as SkNN
+
+        X = np.stack(df["features"].to_numpy())
+        est = SkNN(n_neighbors=args.k, algorithm="ball_tree")
+        model, fit_time = with_benchmark("cpu build", lambda: est.fit(X))
+        _, search_time = with_benchmark(
+            "cpu search", lambda: model.kneighbors(X[: args.num_queries])
+        )
+        return {"fit_time": fit_time, "transform_time": search_time, "score": 1.0}

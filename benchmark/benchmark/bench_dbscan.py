@@ -1,0 +1,43 @@
+# DBSCAN benchmark (reference bench_dbscan.py).
+
+from __future__ import annotations
+
+import numpy as np
+
+from .base import BenchmarkBase
+from .utils import with_benchmark
+
+
+class BenchmarkDBSCAN(BenchmarkBase):
+    name = "dbscan"
+
+    def add_arguments(self, parser):
+        parser.add_argument("--eps", type=float, default=1.0)
+        parser.add_argument("--min_samples", type=int, default=5)
+
+    def run_tpu(self, df, args):
+        from spark_rapids_ml_tpu.clustering import DBSCAN
+
+        est = DBSCAN(eps=args.eps, min_samples=args.min_samples)
+        if args.num_workers:
+            est.num_workers = args.num_workers
+        model, fit_time = with_benchmark("tpu fit", lambda: est.fit(df))
+        out, transform_time = with_benchmark("tpu transform", lambda: model.transform(df))
+        labels = out["prediction"].to_numpy()
+        return {
+            "fit_time": fit_time,
+            "transform_time": transform_time,
+            "score": float(len(set(labels[labels >= 0]))),
+        }
+
+    def run_cpu(self, df, args):
+        from sklearn.cluster import DBSCAN as SkDBSCAN
+
+        X = np.stack(df["features"].to_numpy())
+        est = SkDBSCAN(eps=args.eps, min_samples=args.min_samples)
+        labels, fit_time = with_benchmark("cpu fit", lambda: est.fit_predict(X))
+        return {
+            "fit_time": fit_time,
+            "transform_time": 0.0,
+            "score": float(len(set(labels[labels >= 0]))),
+        }

@@ -1,0 +1,46 @@
+# KMeans benchmark (reference python/benchmark/benchmark/bench_kmeans.py: GPU vs CPU
+# variants + inertia quality score, bench_kmeans.py:61-177).
+
+from __future__ import annotations
+
+import numpy as np
+
+from .base import BenchmarkBase
+from .utils import inertia_score, with_benchmark
+
+
+class BenchmarkKMeans(BenchmarkBase):
+    name = "kmeans"
+
+    def add_arguments(self, parser):
+        parser.add_argument("--k", type=int, default=20)
+        parser.add_argument("--maxIter", type=int, default=20)
+        parser.add_argument("--tol", type=float, default=1e-4)
+
+    def run_tpu(self, df, args):
+        from spark_rapids_ml_tpu.clustering import KMeans
+
+        est = KMeans(k=args.k, maxIter=args.maxIter, tol=args.tol, seed=args.seed)
+        if args.num_workers:
+            est.num_workers = args.num_workers
+        model, fit_time = with_benchmark("tpu fit", lambda: est.fit(df))
+        out, transform_time = with_benchmark("tpu transform", lambda: model.transform(df))
+        X = np.stack(df["features"].to_numpy())
+        return {
+            "fit_time": fit_time,
+            "transform_time": transform_time,
+            "score": inertia_score(X, model.cluster_centers_),
+        }
+
+    def run_cpu(self, df, args):
+        from sklearn.cluster import KMeans as SkKMeans
+
+        X = np.stack(df["features"].to_numpy())
+        est = SkKMeans(n_clusters=args.k, max_iter=args.maxIter, tol=args.tol, n_init=1)
+        model, fit_time = with_benchmark("cpu fit", lambda: est.fit(X))
+        _, transform_time = with_benchmark("cpu transform", lambda: model.predict(X))
+        return {
+            "fit_time": fit_time,
+            "transform_time": transform_time,
+            "score": float(model.inertia_),
+        }

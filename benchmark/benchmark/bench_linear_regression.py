@@ -1,0 +1,59 @@
+# LinearRegression benchmark (reference bench_linear_regression.py).
+
+from __future__ import annotations
+
+import numpy as np
+
+from .base import BenchmarkBase
+from .utils import rmse_score, with_benchmark
+
+
+class BenchmarkLinearRegression(BenchmarkBase):
+    name = "linear_regression"
+
+    def add_arguments(self, parser):
+        parser.add_argument("--regParam", type=float, default=0.0)
+        parser.add_argument("--elasticNetParam", type=float, default=0.0)
+
+    def gen_dataframe(self, args):
+        from ..gen_data import RegressionDataGen
+
+        return RegressionDataGen(
+            num_rows=args.num_rows, num_cols=args.num_cols, seed=args.seed
+        ).gen_dataframe()
+
+    def run_tpu(self, df, args):
+        from spark_rapids_ml_tpu.regression import LinearRegression
+
+        est = LinearRegression(
+            regParam=args.regParam, elasticNetParam=args.elasticNetParam,
+            standardization=False,
+        )
+        if args.num_workers:
+            est.num_workers = args.num_workers
+        model, fit_time = with_benchmark("tpu fit", lambda: est.fit(df))
+        out, transform_time = with_benchmark("tpu transform", lambda: model.transform(df))
+        return {
+            "fit_time": fit_time,
+            "transform_time": transform_time,
+            "score": rmse_score(df["label"].to_numpy(), out["prediction"].to_numpy()),
+        }
+
+    def run_cpu(self, df, args):
+        from sklearn.linear_model import ElasticNet, LinearRegression as SkLR, Ridge
+
+        X = np.stack(df["features"].to_numpy())
+        y = df["label"].to_numpy()
+        if args.regParam == 0.0:
+            est = SkLR()
+        elif args.elasticNetParam == 0.0:
+            est = Ridge(alpha=args.regParam * len(y))
+        else:
+            est = ElasticNet(alpha=args.regParam, l1_ratio=args.elasticNetParam)
+        model, fit_time = with_benchmark("cpu fit", lambda: est.fit(X, y))
+        pred, transform_time = with_benchmark("cpu transform", lambda: model.predict(X))
+        return {
+            "fit_time": fit_time,
+            "transform_time": transform_time,
+            "score": rmse_score(df["label"].to_numpy(), pred),
+        }

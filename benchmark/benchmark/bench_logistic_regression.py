@@ -1,0 +1,52 @@
+# LogisticRegression benchmark (reference bench_logistic_regression.py).
+
+from __future__ import annotations
+
+import numpy as np
+
+from .base import BenchmarkBase
+from .utils import with_benchmark
+
+
+class BenchmarkLogisticRegression(BenchmarkBase):
+    name = "logistic_regression"
+
+    def add_arguments(self, parser):
+        parser.add_argument("--regParam", type=float, default=0.01)
+        parser.add_argument("--maxIter", type=int, default=100)
+        parser.add_argument("--num_classes", type=int, default=2)
+
+    def gen_dataframe(self, args):
+        from ..gen_data import ClassificationDataGen
+
+        return ClassificationDataGen(
+            num_rows=args.num_rows, num_cols=args.num_cols, seed=args.seed,
+            num_classes=args.num_classes,
+        ).gen_dataframe()
+
+    def run_tpu(self, df, args):
+        from spark_rapids_ml_tpu.classification import LogisticRegression
+
+        est = LogisticRegression(
+            regParam=args.regParam, maxIter=args.maxIter, standardization=False
+        )
+        if args.num_workers:
+            est.num_workers = args.num_workers
+        model, fit_time = with_benchmark("tpu fit", lambda: est.fit(df))
+        out, transform_time = with_benchmark("tpu transform", lambda: model.transform(df))
+        acc = float((out["prediction"].to_numpy() == df["label"].to_numpy()).mean())
+        return {"fit_time": fit_time, "transform_time": transform_time, "score": acc}
+
+    def run_cpu(self, df, args):
+        from sklearn.linear_model import LogisticRegression as SkLogReg
+
+        X = np.stack(df["features"].to_numpy())
+        y = df["label"].to_numpy()
+        est = SkLogReg(C=1.0 / max(args.regParam * len(y), 1e-12), max_iter=args.maxIter)
+        model, fit_time = with_benchmark("cpu fit", lambda: est.fit(X, y))
+        pred, transform_time = with_benchmark("cpu transform", lambda: model.predict(X))
+        return {
+            "fit_time": fit_time,
+            "transform_time": transform_time,
+            "score": float((pred == y).mean()),
+        }

@@ -1,0 +1,45 @@
+# Exact kNN benchmark (reference bench_nearest_neighbors.py).
+
+from __future__ import annotations
+
+import numpy as np
+import pandas as pd
+
+from .base import BenchmarkBase
+from .utils import with_benchmark
+
+
+class BenchmarkNearestNeighbors(BenchmarkBase):
+    name = "knn"
+
+    def add_arguments(self, parser):
+        parser.add_argument("--k", type=int, default=200)
+        parser.add_argument("--num_queries", type=int, default=100)
+
+    def _queries(self, df, args):
+        X = np.stack(df["features"].to_numpy())
+        return pd.DataFrame({"features": list(X[: args.num_queries])})
+
+    def run_tpu(self, df, args):
+        from spark_rapids_ml_tpu.knn import NearestNeighbors
+
+        est = NearestNeighbors(k=args.k, inputCol="features")
+        if args.num_workers:
+            est.num_workers = args.num_workers
+        model, fit_time = with_benchmark("tpu fit", lambda: est.fit(df))
+        qdf = self._queries(df, args)
+        (_, _, knn_df), search_time = with_benchmark(
+            "tpu kneighbors", lambda: model.kneighbors(qdf)
+        )
+        return {"fit_time": fit_time, "transform_time": search_time, "score": float(args.k)}
+
+    def run_cpu(self, df, args):
+        from sklearn.neighbors import NearestNeighbors as SkNN
+
+        X = np.stack(df["features"].to_numpy())
+        est = SkNN(n_neighbors=args.k)
+        model, fit_time = with_benchmark("cpu fit", lambda: est.fit(X))
+        _, search_time = with_benchmark(
+            "cpu kneighbors", lambda: model.kneighbors(X[: args.num_queries])
+        )
+        return {"fit_time": fit_time, "transform_time": search_time, "score": float(args.k)}

@@ -1,0 +1,49 @@
+# PCA benchmark (reference python/benchmark/benchmark/bench_pca.py).
+
+from __future__ import annotations
+
+import numpy as np
+
+from .base import BenchmarkBase
+from .utils import with_benchmark
+
+
+class BenchmarkPCA(BenchmarkBase):
+    name = "pca"
+
+    def add_arguments(self, parser):
+        parser.add_argument("--k", type=int, default=3)
+
+    def gen_dataframe(self, args):
+        from ..gen_data import LowRankMatrixDataGen
+
+        return LowRankMatrixDataGen(
+            num_rows=args.num_rows, num_cols=args.num_cols, seed=args.seed
+        ).gen_dataframe()
+
+    def run_tpu(self, df, args):
+        from spark_rapids_ml_tpu.feature import PCA
+
+        est = PCA(k=args.k, inputCol="features")
+        if args.num_workers:
+            est.num_workers = args.num_workers
+        model, fit_time = with_benchmark("tpu fit", lambda: est.fit(df))
+        _, transform_time = with_benchmark("tpu transform", lambda: model.transform(df))
+        return {
+            "fit_time": fit_time,
+            "transform_time": transform_time,
+            "score": float(np.sum(model.explainedVariance)),
+        }
+
+    def run_cpu(self, df, args):
+        from sklearn.decomposition import PCA as SkPCA
+
+        X = np.stack(df["features"].to_numpy())
+        est = SkPCA(n_components=args.k)
+        model, fit_time = with_benchmark("cpu fit", lambda: est.fit(X))
+        _, transform_time = with_benchmark("cpu transform", lambda: model.transform(X))
+        return {
+            "fit_time": fit_time,
+            "transform_time": transform_time,
+            "score": float(np.sum(model.explained_variance_ratio_)),
+        }

@@ -1,0 +1,87 @@
+# RandomForest classifier/regressor benchmarks (reference bench_random_forest.py).
+
+from __future__ import annotations
+
+import numpy as np
+
+from .base import BenchmarkBase
+from .utils import rmse_score, with_benchmark
+
+
+class BenchmarkRandomForestClassifier(BenchmarkBase):
+    name = "random_forest_classifier"
+
+    def add_arguments(self, parser):
+        parser.add_argument("--numTrees", type=int, default=20)
+        parser.add_argument("--maxDepth", type=int, default=6)
+        parser.add_argument("--num_classes", type=int, default=2)
+
+    def gen_dataframe(self, args):
+        from ..gen_data import ClassificationDataGen
+
+        return ClassificationDataGen(
+            num_rows=args.num_rows, num_cols=args.num_cols, seed=args.seed,
+            num_classes=args.num_classes,
+        ).gen_dataframe()
+
+    def run_tpu(self, df, args):
+        from spark_rapids_ml_tpu.classification import RandomForestClassifier
+
+        est = RandomForestClassifier(
+            numTrees=args.numTrees, maxDepth=args.maxDepth, seed=args.seed
+        )
+        if args.num_workers:
+            est.num_workers = args.num_workers
+        model, fit_time = with_benchmark("tpu fit", lambda: est.fit(df))
+        out, transform_time = with_benchmark("tpu transform", lambda: model.transform(df))
+        acc = float((out["prediction"].to_numpy() == df["label"].to_numpy()).mean())
+        return {"fit_time": fit_time, "transform_time": transform_time, "score": acc}
+
+    def run_cpu(self, df, args):
+        from sklearn.ensemble import RandomForestClassifier as SkRFC
+
+        X = np.stack(df["features"].to_numpy())
+        y = df["label"].to_numpy()
+        est = SkRFC(n_estimators=args.numTrees, max_depth=args.maxDepth, n_jobs=-1)
+        model, fit_time = with_benchmark("cpu fit", lambda: est.fit(X, y))
+        pred, transform_time = with_benchmark("cpu transform", lambda: model.predict(X))
+        return {
+            "fit_time": fit_time,
+            "transform_time": transform_time,
+            "score": float((pred == y).mean()),
+        }
+
+
+class BenchmarkRandomForestRegressor(BenchmarkRandomForestClassifier):
+    name = "random_forest_regressor"
+
+    def gen_dataframe(self, args):
+        from ..gen_data import RegressionDataGen
+
+        return RegressionDataGen(
+            num_rows=args.num_rows, num_cols=args.num_cols, seed=args.seed
+        ).gen_dataframe()
+
+    def run_tpu(self, df, args):
+        from spark_rapids_ml_tpu.regression import RandomForestRegressor
+
+        est = RandomForestRegressor(
+            numTrees=args.numTrees, maxDepth=args.maxDepth, seed=args.seed
+        )
+        if args.num_workers:
+            est.num_workers = args.num_workers
+        model, fit_time = with_benchmark("tpu fit", lambda: est.fit(df))
+        out, transform_time = with_benchmark("tpu transform", lambda: model.transform(df))
+        rmse = rmse_score(df["label"].to_numpy(), out["prediction"].to_numpy())
+        return {"fit_time": fit_time, "transform_time": transform_time, "score": rmse}
+
+    def run_cpu(self, df, args):
+        from sklearn.ensemble import RandomForestRegressor as SkRFR
+
+        X = np.stack(df["features"].to_numpy())
+        y = df["label"].to_numpy()
+        est = SkRFR(n_estimators=args.numTrees, max_depth=args.maxDepth, n_jobs=-1)
+        model, fit_time = with_benchmark("cpu fit", lambda: est.fit(X, y))
+        pred, transform_time = with_benchmark("cpu transform", lambda: model.predict(X))
+        rmse = rmse_score(y, pred)
+        return {"fit_time": fit_time, "transform_time": transform_time, "score": rmse}

@@ -1,0 +1,42 @@
+# UMAP benchmark with trustworthiness quality score (reference bench_umap.py).
+
+from __future__ import annotations
+
+import numpy as np
+
+from .base import BenchmarkBase
+from .utils import with_benchmark
+
+
+class BenchmarkUMAP(BenchmarkBase):
+    name = "umap"
+
+    def add_arguments(self, parser):
+        parser.add_argument("--n_neighbors", type=int, default=15)
+        parser.add_argument("--n_epochs", type=int, default=200)
+
+    def run_tpu(self, df, args):
+        from sklearn.manifold import trustworthiness
+
+        from spark_rapids_ml_tpu.umap import UMAP
+
+        est = UMAP(n_neighbors=args.n_neighbors, n_epochs=args.n_epochs, seed=args.seed)
+        model, fit_time = with_benchmark("tpu fit", lambda: est.fit(df))
+        _, transform_time = with_benchmark("tpu transform", lambda: model.transform(df))
+        X = np.stack(df["features"].to_numpy())
+        sample = min(len(X), 2000)
+        t = trustworthiness(
+            X[:sample], model.embedding_[:sample], n_neighbors=args.n_neighbors
+        )
+        return {"fit_time": fit_time, "transform_time": transform_time, "score": float(t)}
+
+    def run_cpu(self, df, args):
+        # umap-learn is not in this image; TSNE is the closest CPU manifold baseline
+        from sklearn.manifold import TSNE, trustworthiness
+
+        X = np.stack(df["features"].to_numpy())
+        sample = min(len(X), 2000)
+        est = TSNE(n_components=2, random_state=args.seed)
+        emb, fit_time = with_benchmark("cpu fit", lambda: est.fit_transform(X[:sample]))
+        t = trustworthiness(X[:sample], emb, n_neighbors=args.n_neighbors)
+        return {"fit_time": fit_time, "transform_time": 0.0, "score": float(t)}

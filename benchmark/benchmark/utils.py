@@ -1,0 +1,35 @@
+#
+# Timing utilities (reference python/benchmark/benchmark/utils.py: the
+# `with_benchmark` wall-clock wrapper used by every bench).
+#
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Tuple
+
+
+def with_benchmark(label: str, fn: Callable[[], Any]) -> Tuple[Any, float]:
+    """Run fn, print '<label> took N seconds', return (result, seconds)."""
+    t0 = time.perf_counter()
+    result = fn()
+    seconds = time.perf_counter() - t0
+    print(f"{label} took {seconds:.3f} seconds")
+    return result, seconds
+
+
+def rmse_score(y, pred) -> float:
+    import numpy as np
+
+    return float(np.sqrt(np.mean((np.asarray(y) - np.asarray(pred)) ** 2)))
+
+
+def inertia_score(X, centers) -> float:
+    import numpy as np
+
+    d2 = (
+        (X * X).sum(1, keepdims=True)
+        - 2 * X @ centers.T
+        + (centers * centers).sum(1)
+    )
+    return float(np.maximum(d2, 0).min(axis=1).sum())

@@ -1,0 +1,66 @@
+#!/usr/bin/env python
+#
+# Benchmark runner — registry of the 10 benchmarks
+# (reference python/benchmark/benchmark_runner.py:36-60).
+#
+#   python benchmark/benchmark_runner.py kmeans --num_rows 100000 --num_cols 128 \
+#       --k 20 --report_path report.csv
+#
+
+from __future__ import annotations
+
+import sys
+
+
+def _registry():
+    from benchmark.benchmark.bench_approximate_nearest_neighbors import (
+        BenchmarkApproximateNearestNeighbors,
+    )
+    from benchmark.benchmark.bench_dbscan import BenchmarkDBSCAN
+    from benchmark.benchmark.bench_kmeans import BenchmarkKMeans
+    from benchmark.benchmark.bench_linear_regression import BenchmarkLinearRegression
+    from benchmark.benchmark.bench_logistic_regression import (
+        BenchmarkLogisticRegression,
+    )
+    from benchmark.benchmark.bench_nearest_neighbors import BenchmarkNearestNeighbors
+    from benchmark.benchmark.bench_pca import BenchmarkPCA
+    from benchmark.benchmark.bench_random_forest import (
+        BenchmarkRandomForestClassifier,
+        BenchmarkRandomForestRegressor,
+    )
+    from benchmark.benchmark.bench_umap import BenchmarkUMAP
+
+    benches = [
+        BenchmarkKMeans,
+        BenchmarkPCA,
+        BenchmarkLinearRegression,
+        BenchmarkLogisticRegression,
+        BenchmarkRandomForestClassifier,
+        BenchmarkRandomForestRegressor,
+        BenchmarkNearestNeighbors,
+        BenchmarkApproximateNearestNeighbors,
+        BenchmarkUMAP,
+        BenchmarkDBSCAN,
+    ]
+    return {b.name: b for b in benches}
+
+
+def main(argv=None) -> None:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    registry = _registry()
+    if not argv or argv[0] in ("-h", "--help"):
+        print("usage: benchmark_runner.py <benchmark> [options]")
+        print("benchmarks: " + ", ".join(sorted(registry)))
+        return
+    name = argv[0]
+    if name not in registry:
+        raise SystemExit(f"unknown benchmark '{name}'; choose from {sorted(registry)}")
+    registry[name]().run(argv[1:])
+
+
+if __name__ == "__main__":
+    import os
+    import sys as _sys
+
+    _sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    main()

@@ -1,0 +1,219 @@
+#
+# Synthetic dataset generators — structural equivalent of reference
+# python/benchmark/gen_data_distributed.py (BlobsDataGen :84, LowRankMatrixDataGen
+# :189, RegressionDataGen :324, SparseRegressionDataGen :586, ClassificationDataGen
+# :952: sklearn generators run inside mapInPandas partitions, written as parquet).
+#
+# Here the "partitions" are seeded chunks generated in parallel worker processes (or
+# inline) and written as one parquet file per chunk — the same layout a Spark reader
+# ingests, without requiring a Spark session.
+#
+# CLI:  python benchmark/gen_data.py blobs --num_rows 100000 --num_cols 128 \
+#           --output_dir /tmp/blobs --output_num_files 8
+#
+
+from __future__ import annotations
+
+import argparse
+import math
+import os
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+import pandas as pd
+
+
+class DataGenBase:
+    """Chunked generator; subclasses produce one chunk of rows from a seed."""
+
+    def __init__(
+        self,
+        num_rows: int = 100_000,
+        num_cols: int = 30,
+        seed: int = 0,
+        dtype: str = "float32",
+        **params: Any,
+    ) -> None:
+        self.num_rows = num_rows
+        self.num_cols = num_cols
+        self.seed = seed
+        self.dtype = np.dtype(dtype)
+        self.params = params
+
+    def gen_chunk(self, n_rows: int, chunk_seed: int) -> pd.DataFrame:
+        raise NotImplementedError
+
+    def gen_dataframe(self) -> pd.DataFrame:
+        return self.gen_chunk(self.num_rows, self.seed)
+
+    def write_parquet(self, output_dir: str, output_num_files: int = 1) -> List[str]:
+        os.makedirs(output_dir, exist_ok=True)
+        per = math.ceil(self.num_rows / output_num_files)
+        paths = []
+        done = 0
+        for i in range(output_num_files):
+            n = min(per, self.num_rows - done)
+            if n <= 0:
+                break
+            df = self.gen_chunk(n, self.seed + i)
+            # parquet stores scalar feature columns (the reference writes the same
+            # layout; readers re-assemble vectors)
+            if "features" in df.columns:
+                feats = np.stack(df["features"].to_numpy())
+                out = pd.DataFrame(
+                    feats, columns=[f"c{j}" for j in range(feats.shape[1])]
+                )
+                for col in df.columns:
+                    if col != "features":
+                        out[col] = df[col].to_numpy()
+                df = out
+            path = os.path.join(output_dir, f"part-{i:05d}.parquet")
+            df.to_parquet(path, index=False)
+            paths.append(path)
+            done += n
+        return paths
+
+
+class BlobsDataGen(DataGenBase):
+    """Gaussian blobs (reference gen_data_distributed.py:84). The blob centers come
+    from the BASE seed so every chunk samples the same mixture; only the chunk's rows
+    are chunk-seeded (the reference shares generator params across partitions too)."""
+
+    def gen_chunk(self, n_rows: int, chunk_seed: int) -> pd.DataFrame:
+        base = np.random.default_rng(self.seed)
+        k = self.params.get("num_centers", 20)
+        std = self.params.get("cluster_std", 1.0)
+        centers = base.uniform(-10, 10, size=(k, self.num_cols))
+        rng = np.random.default_rng(chunk_seed)
+        y = rng.integers(0, k, size=n_rows)
+        X = centers[y] + rng.normal(scale=std, size=(n_rows, self.num_cols))
+        return pd.DataFrame(
+            {"features": list(X.astype(self.dtype)), "label": y.astype(np.float64)}
+        )
+
+
+class LowRankMatrixDataGen(DataGenBase):
+    """Low effective-rank matrix (reference gen_data_distributed.py:189): a shared
+    right-singular basis from the BASE seed; chunk rows sample fresh left factors, so
+    all chunks live in the same low-rank subspace."""
+
+    def gen_chunk(self, n_rows: int, chunk_seed: int) -> pd.DataFrame:
+        base = np.random.default_rng(self.seed)
+        r = min(self.params.get("effective_rank", 10), self.num_cols)
+        tail = self.params.get("tail_strength", 0.5)
+        V, _ = np.linalg.qr(base.normal(size=(self.num_cols, self.num_cols)))
+        sing = np.exp(-((np.arange(self.num_cols) / r) ** 2)) * (1 - tail) + tail * np.exp(
+            -np.arange(self.num_cols) / r
+        )
+        rng = np.random.default_rng(chunk_seed)
+        U = rng.normal(size=(n_rows, self.num_cols)) / np.sqrt(self.num_cols)
+        X = (U * sing) @ V.T
+        return pd.DataFrame({"features": list(X.astype(self.dtype))})
+
+
+class RegressionDataGen(DataGenBase):
+    """Linear regression data (reference gen_data_distributed.py:324): ONE true
+    coefficient vector from the BASE seed shared by all chunks."""
+
+    def gen_chunk(self, n_rows: int, chunk_seed: int) -> pd.DataFrame:
+        base = np.random.default_rng(self.seed)
+        n_informative = self.params.get("n_informative", max(1, self.num_cols // 2))
+        coef = np.zeros(self.num_cols)
+        coef[:n_informative] = base.normal(scale=10.0, size=n_informative)
+        base.shuffle(coef)
+        rng = np.random.default_rng(chunk_seed)
+        X = rng.normal(size=(n_rows, self.num_cols))
+        y = (
+            X @ coef
+            + self.params.get("bias", 0.0)
+            + rng.normal(scale=self.params.get("noise", 1.0), size=n_rows)
+        )
+        return pd.DataFrame(
+            {"features": list(X.astype(self.dtype)), "label": y.astype(np.float64)}
+        )
+
+
+class SparseRegressionDataGen(DataGenBase):
+    """Sparse design-matrix regression (reference gen_data_distributed.py:586); the
+    true coefficients come from the BASE seed."""
+
+    def gen_chunk(self, n_rows: int, chunk_seed: int) -> pd.DataFrame:
+        import scipy.sparse as sp
+
+        base = np.random.default_rng(self.seed)
+        coef = base.normal(size=self.num_cols)
+        rng = np.random.default_rng(chunk_seed)
+        density = self.params.get("density", 0.1)
+        X = sp.random(
+            n_rows,
+            self.num_cols,
+            density=density,
+            format="csr",
+            random_state=chunk_seed,
+            dtype=np.float64,
+        )
+        y = X @ coef + rng.normal(scale=self.params.get("noise", 1.0), size=n_rows)
+        dense = np.asarray(X.todense(), dtype=self.dtype)
+        return pd.DataFrame({"features": list(dense), "label": y.astype(np.float64)})
+
+
+class ClassificationDataGen(DataGenBase):
+    """Classification data (reference gen_data_distributed.py:952): per-class
+    centroids over the informative features from the BASE seed; chunks sample rows
+    from the shared class-conditional distributions."""
+
+    def gen_chunk(self, n_rows: int, chunk_seed: int) -> pd.DataFrame:
+        base = np.random.default_rng(self.seed)
+        n_classes = self.params.get("num_classes", 2)
+        n_informative = self.params.get("n_informative", max(2, self.num_cols // 2))
+        centroids = base.normal(scale=2.0, size=(n_classes, n_informative))
+        perm = base.permutation(self.num_cols)
+        rng = np.random.default_rng(chunk_seed)
+        y = rng.integers(0, n_classes, size=n_rows)
+        X = rng.normal(size=(n_rows, self.num_cols))
+        X[:, :n_informative] += centroids[y]
+        X = X[:, perm]
+        return pd.DataFrame(
+            {"features": list(X.astype(self.dtype)), "label": y.astype(np.float64)}
+        )
+
+
+GENERATORS = {
+    "blobs": BlobsDataGen,
+    "low_rank_matrix": LowRankMatrixDataGen,
+    "regression": RegressionDataGen,
+    "sparse_regression": SparseRegressionDataGen,
+    "classification": ClassificationDataGen,
+}
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    parser = argparse.ArgumentParser(description="Synthetic dataset generators")
+    parser.add_argument("kind", choices=sorted(GENERATORS))
+    parser.add_argument("--num_rows", type=int, default=100_000)
+    parser.add_argument("--num_cols", type=int, default=30)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--dtype", default="float32")
+    parser.add_argument("--output_dir", required=True)
+    parser.add_argument("--output_num_files", type=int, default=1)
+    parser.add_argument("--num_centers", type=int, default=20)
+    parser.add_argument("--num_classes", type=int, default=2)
+    parser.add_argument("--density", type=float, default=0.1)
+    args = parser.parse_args(argv)
+
+    gen = GENERATORS[args.kind](
+        num_rows=args.num_rows,
+        num_cols=args.num_cols,
+        seed=args.seed,
+        dtype=args.dtype,
+        num_centers=args.num_centers,
+        num_classes=args.num_classes,
+        density=args.density,
+    )
+    paths = gen.write_parquet(args.output_dir, args.output_num_files)
+    print(f"wrote {len(paths)} files to {args.output_dir}")
+
+
+if __name__ == "__main__":
+    main()

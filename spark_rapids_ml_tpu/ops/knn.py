@@ -77,6 +77,9 @@ def exact_knn_distributed(
     n_dev = mesh.devices.size
     shard_rows = n_total // n_dev
     k_eff = min(k, n_total)
+    # a shard can hold fewer than k rows; the all-gathered candidate pool
+    # (n_dev * k_local >= min(k_eff, n_total)) still covers the global top-k
+    k_local = min(k_eff, shard_rows)
 
     @functools.partial(
         shard_map,
@@ -88,10 +91,10 @@ def exact_knn_distributed(
     )
     def _local_then_merge(q, x_local, valid_local):
         rank = jax.lax.axis_index(DATA_AXIS)
-        d2, idx = exact_knn_single(q, x_local, valid_local, k_eff)
+        d2, idx = exact_knn_single(q, x_local, valid_local, k_local)
         gidx = idx + rank * shard_rows
         # all-to-all candidate exchange over ICI (the UCX replacement)
-        d2_all = jax.lax.all_gather(d2, DATA_AXIS, axis=1)  # (nq, n_dev, k)
+        d2_all = jax.lax.all_gather(d2, DATA_AXIS, axis=1)  # (nq, n_dev, k_local)
         gidx_all = jax.lax.all_gather(gidx, DATA_AXIS, axis=1)
         d2_all = d2_all.reshape(d2.shape[0], -1)
         gidx_all = gidx_all.reshape(d2.shape[0], -1)

@@ -1,0 +1,101 @@
+"""Benchmark-harness correctness tests (reference python/benchmark/test_gen_data.py +
+python/tests/test_benchmark.py)."""
+
+import os
+import sys
+
+import numpy as np
+import pandas as pd
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from benchmark.gen_data import (
+    BlobsDataGen,
+    ClassificationDataGen,
+    LowRankMatrixDataGen,
+    RegressionDataGen,
+    SparseRegressionDataGen,
+)
+
+
+@pytest.mark.parametrize(
+    "gen_cls,has_label",
+    [
+        (BlobsDataGen, True),
+        (LowRankMatrixDataGen, False),
+        (RegressionDataGen, True),
+        (SparseRegressionDataGen, True),
+        (ClassificationDataGen, True),
+    ],
+)
+def test_generators_shape(gen_cls, has_label):
+    gen = gen_cls(num_rows=200, num_cols=8, seed=1)
+    df = gen.gen_dataframe()
+    assert len(df) == 200
+    X = np.stack(df["features"].to_numpy())
+    assert X.shape == (200, 8)
+    assert np.isfinite(X).all()
+    assert ("label" in df.columns) == has_label
+
+
+def test_parquet_roundtrip(tmp_path):
+    gen = RegressionDataGen(num_rows=150, num_cols=6, seed=2)
+    paths = gen.write_parquet(str(tmp_path / "data"), output_num_files=3)
+    assert len(paths) == 3
+    df = pd.read_parquet(str(tmp_path / "data"))
+    assert len(df) == 150
+    # scalar feature columns c0..c5 + label
+    assert {f"c{i}" for i in range(6)} <= set(df.columns)
+
+
+def test_chunks_differ_by_seed():
+    gen = BlobsDataGen(num_rows=100, num_cols=4, seed=3)
+    a = np.stack(gen.gen_chunk(50, 3)["features"].to_numpy())
+    b = np.stack(gen.gen_chunk(50, 4)["features"].to_numpy())
+    assert not np.allclose(a, b)
+
+
+def test_benchmark_runner_end_to_end(tmp_path, n_devices):
+    from benchmark.benchmark.bench_pca import BenchmarkPCA
+
+    report = str(tmp_path / "report.csv")
+    rows = BenchmarkPCA().run(
+        ["--num_rows", "500", "--num_cols", "16", "--k", "3", "--report_path", report]
+    )
+    assert {r["mode"] for r in rows} == {"tpu", "cpu"}
+    # quality parity between TPU and sklearn on the same data
+    tpu = next(r for r in rows if r["mode"] == "tpu")
+    cpu = next(r for r in rows if r["mode"] == "cpu")
+    assert abs(tpu["score"] - cpu["score"]) < 1e-2
+    assert os.path.exists(report)
+    loaded = pd.read_csv(report)
+    assert len(loaded) == 2
+
+
+def test_benchmark_registry_complete():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "benchmark_runner",
+        os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "benchmark",
+            "benchmark_runner.py",
+        ),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    names = set(mod._registry())
+    assert names == {
+        "kmeans",
+        "pca",
+        "linear_regression",
+        "logistic_regression",
+        "random_forest_classifier",
+        "random_forest_regressor",
+        "knn",
+        "approximate_nearest_neighbors",
+        "umap",
+        "dbscan",
+    }
