@@ -1,0 +1,30 @@
+#!/usr/bin/env bash
+#
+# CI entry point (role of reference ci/test.sh:20-57: pre-merge = unit tests + small
+# benchmark run; nightly adds --runslow).
+#
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+export XLA_FLAGS="--xla_force_host_platform_device_count=8"
+export PALLAS_AXON_POOL_IPS=""
+
+MODE="${1:-premerge}"
+
+# native build (non-fatal: pure-python fallback covers it)
+./native/build.sh || echo "WARN: native build failed; numpy fallbacks in use"
+
+if [ "$MODE" = "nightly" ]; then
+  python -m pytest tests/ -q --runslow
+else
+  python -m pytest tests/ -q
+fi
+
+# small benchmark smoke (reference runs a small bench pre-merge)
+python benchmark/benchmark_runner.py kmeans --num_rows 2000 --num_cols 32 --k 5 --no_cpu
+python benchmark/benchmark_runner.py pca --num_rows 2000 --num_cols 32 --k 3 --no_cpu
+
+# driver entry points
+python __graft_entry__.py
+echo "CI $MODE PASSED"

@@ -1,0 +1,8 @@
+#!/usr/bin/env bash
+# Build the native host-runtime library (see native/src/srml_native.cpp).
+set -euo pipefail
+cd "$(dirname "$0")"
+mkdir -p ../spark_rapids_ml_tpu/lib
+g++ -O3 -march=native -fopenmp -fPIC -shared -std=c++17 \
+    src/srml_native.cpp -o ../spark_rapids_ml_tpu/lib/libsrml_native.so
+echo "built spark_rapids_ml_tpu/lib/libsrml_native.so"

@@ -1,0 +1,115 @@
+//
+// Native host-side runtime kernels for spark_rapids_ml_tpu.
+//
+// Role: the reference keeps its host/runtime hot paths native (cuDF ingest, treelite
+// forest handling, RMM allocators — SURVEY.md §2.5); the TPU build's device math is
+// XLA, but the HOST preprocessing around it deserves the same treatment. This module
+// provides the host hot loops, exposed through a plain C ABI consumed via ctypes
+// (no pybind11 in this image):
+//
+//   srml_bin_features   — feature quantile-digitization for the histogram forest
+//                         builder (ops/trees.py bin_features): n*d binary searches,
+//                         OpenMP-parallel over rows, cache-friendly per-row layout.
+//   srml_csr_to_dense   — CSR -> dense row-major densification for the sparse ingest
+//                         path (core/dataset.py), parallel over rows.
+//   srml_topk_merge     — k-way merge of per-shard top-k (distance, id) candidate
+//                         lists on the host, for merging device results across
+//                         processes (the treelite-concat analog for kNN outputs).
+//
+// Build: native/build.sh (g++ -O3 -fopenmp -shared). Python loads it lazily via
+// ctypes with a numpy fallback when the .so is absent (spark_rapids_ml_tpu/native.py).
+//
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <limits>
+#include <vector>
+
+#if defined(_OPENMP)
+#include <omp.h>
+#endif
+
+extern "C" {
+
+// Digitize X (n x d, row-major float32) against per-feature ascending edges
+// (d x (nbins-1), row-major float32): out[i,j] = #{e in edges[j] : e < x} clamped to
+// [0, nbins-1]. Matches numpy searchsorted(side='left') semantics used by
+// ops/trees.py bin_features.
+void srml_bin_features(const float* X, int64_t n, int64_t d, const float* edges,
+                       int64_t nbins, int32_t* out) {
+  const int64_t ne = nbins - 1;
+#if defined(_OPENMP)
+#pragma omp parallel for schedule(static)
+#endif
+  for (int64_t i = 0; i < n; ++i) {
+    const float* row = X + i * d;
+    int32_t* orow = out + i * d;
+    for (int64_t j = 0; j < d; ++j) {
+      const float* e = edges + j * ne;
+      // branchless-ish binary search: first index with e[idx] >= x
+      int64_t lo = 0, hi = ne;
+      const float x = row[j];
+      while (lo < hi) {
+        const int64_t mid = (lo + hi) >> 1;
+        if (e[mid] < x) {
+          lo = mid + 1;
+        } else {
+          hi = mid;
+        }
+      }
+      orow[j] = static_cast<int32_t>(lo);
+    }
+  }
+}
+
+// CSR (indptr int64, indices int32, data float32) -> dense row-major float32.
+void srml_csr_to_dense(const int64_t* indptr, const int32_t* indices,
+                       const float* data, int64_t n, int64_t d, float* out) {
+#if defined(_OPENMP)
+#pragma omp parallel for schedule(dynamic, 64)
+#endif
+  for (int64_t i = 0; i < n; ++i) {
+    float* row = out + i * d;
+    std::memset(row, 0, sizeof(float) * d);
+    for (int64_t p = indptr[i]; p < indptr[i + 1]; ++p) {
+      row[indices[p]] = data[p];
+    }
+  }
+}
+
+// Merge S sorted-or-unsorted candidate lists of length kc per query into a global
+// top-k (ascending by distance). dists/ids: (nq, S*kc) row-major. out: (nq, k).
+void srml_topk_merge(const float* dists, const int64_t* ids, int64_t nq,
+                     int64_t n_cand, int64_t k, float* out_d, int64_t* out_i) {
+#if defined(_OPENMP)
+#pragma omp parallel for schedule(static)
+#endif
+  for (int64_t q = 0; q < nq; ++q) {
+    const float* dq = dists + q * n_cand;
+    const int64_t* iq = ids + q * n_cand;
+    std::vector<int64_t> idx(n_cand);
+    for (int64_t c = 0; c < n_cand; ++c) idx[c] = c;
+    const int64_t kk = std::min(k, n_cand);
+    std::partial_sort(idx.begin(), idx.begin() + kk, idx.end(),
+                      [&](int64_t a, int64_t b) { return dq[a] < dq[b]; });
+    for (int64_t c = 0; c < kk; ++c) {
+      out_d[q * k + c] = dq[idx[c]];
+      out_i[q * k + c] = iq[idx[c]];
+    }
+    for (int64_t c = kk; c < k; ++c) {
+      out_d[q * k + c] = std::numeric_limits<float>::infinity();
+      out_i[q * k + c] = -1;
+    }
+  }
+}
+
+int srml_num_threads() {
+#if defined(_OPENMP)
+  return omp_get_max_threads();
+#else
+  return 1;
+#endif
+}
+
+}  // extern "C"

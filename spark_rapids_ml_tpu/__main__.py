@@ -1,0 +1,36 @@
+#
+# `python -m spark_rapids_ml_tpu script.py [args...]` — run a script (or -m module)
+# with the no-import-change interposer pre-installed
+# (reference python/src/spark_rapids_ml/__main__.py:25-59).
+#
+
+from __future__ import annotations
+
+import runpy
+import sys
+
+
+def main() -> None:
+    argv = sys.argv[1:]
+    if not argv:
+        print(
+            "usage: python -m spark_rapids_ml_tpu <script.py> [args...]\n"
+            "       python -m spark_rapids_ml_tpu -m <module> [args...]",
+            file=sys.stderr,
+        )
+        raise SystemExit(2)
+
+    import spark_rapids_ml_tpu.install  # noqa: F401 — installs the interposer
+
+    if argv[0] == "-m":
+        if len(argv) < 2:
+            raise SystemExit("-m requires a module name")
+        sys.argv = argv[1:]
+        runpy.run_module(argv[1], run_name="__main__", alter_sys=True)
+    else:
+        sys.argv = argv
+        runpy.run_path(argv[0], run_name="__main__")
+
+
+if __name__ == "__main__":
+    main()

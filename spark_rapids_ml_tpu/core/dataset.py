@@ -159,6 +159,25 @@ def extract_feature_data(
     raise TypeError(f"Unsupported dataset type: {type(dataset)}")
 
 
+def densify(features: Any, float32: bool = True) -> np.ndarray:
+    """Dense (n, d) view of the features: CSR input goes through the native
+    densify kernel (spark_rapids_ml_tpu/native.py, numpy/scipy fallback), dense input
+    passes through."""
+    if not _is_sparse(features):
+        return features
+    from ..native import csr_to_dense
+
+    csr = features.tocsr()
+    return csr_to_dense(
+        csr.indptr,
+        csr.indices,
+        csr.data,
+        csr.shape[0],
+        csr.shape[1],
+        dtype=np.float32 if float32 else np.float64,
+    )
+
+
 def ensure_id_col(dataset: Any, id_col_name: str) -> Any:
     """Add a monotonically-increasing id column when absent
     (reference params.py:110-129 `_ensureIdCol`)."""

@@ -29,7 +29,12 @@ from ..parallel.mesh import get_mesh, replicate_array, shard_array
 from ..parallel.partition import PartitionDescriptor, pad_rows
 from ..utils import get_logger
 from .backend_params import _TpuClass, _TpuParams
-from .dataset import FeatureData, append_output_columns, extract_feature_data  # noqa: F401
+from .dataset import (  # noqa: F401
+    FeatureData,
+    append_output_columns,
+    densify,
+    extract_feature_data,
+)
 from .params import Param, ParamMap, Params
 from .persistence import ParamsReader, ParamsWriter, load_metadata, save_instance
 
@@ -123,7 +128,7 @@ class _TpuCaller(_TpuClass, _TpuParams):
         num_workers = self.num_workers
         mesh = get_mesh(num_workers)
 
-        X = np.asarray(fd.features.todense()) if fd.is_sparse else fd.features
+        X = densify(fd.features, float32=self._float32_inputs)
         X = np.asarray(X, order=self._fit_array_order())  # type: ignore[arg-type]
         Xp, pad_weight, (label_p, sw_p) = pad_rows(X, num_workers, fd.label, fd.weight)
         row_weight = pad_weight if sw_p is None else pad_weight * sw_p
@@ -347,7 +352,7 @@ class _TpuModel(_TpuClass, _TpuParams):
             input_cols=input_cols,
             float32=self._float32_inputs,
         )
-        X = np.asarray(fd.features.todense()) if fd.is_sparse else fd.features
+        X = densify(fd.features, float32=self._float32_inputs)
         outputs = self._transform_arrays(X)
         return append_output_columns(dataset, outputs)
 

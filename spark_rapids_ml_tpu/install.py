@@ -1,0 +1,133 @@
+#
+# No-code-change acceleration: module interposer (reference
+# python/src/spark_rapids_ml/install.py:22-81).
+#
+# Importing this module installs proxy modules at sys.modules["pyspark.ml(.sub)"]
+# whose __getattr__ serves the TPU-accelerated classes for accelerated names and
+# falls through to the real pyspark for everything else. Like the reference, the
+# proxy is caller-path-sensitive: lookups coming from inside spark_rapids_ml_tpu or
+# pyspark itself get the original attributes, so the accelerated classes' own
+# pyspark usage never self-intercepts.
+#
+# Bonus over the reference: when pyspark is NOT installed, the proxies are still
+# created, so scripts written against pyspark.ml run standalone on the TPU backend.
+#
+
+from __future__ import annotations
+
+import importlib
+import sys
+import types
+from typing import Any, Dict, Optional
+
+_accelerated_attributes: Dict[str, Dict[str, str]] = {
+    # pyspark module -> {class name -> spark_rapids_ml_tpu module}
+    "pyspark.ml.feature": {"PCA": "feature", "PCAModel": "feature"},
+    "pyspark.ml.clustering": {
+        "KMeans": "clustering",
+        "KMeansModel": "clustering",
+        "DBSCAN": "clustering",
+    },
+    "pyspark.ml.classification": {
+        "LogisticRegression": "classification",
+        "LogisticRegressionModel": "classification",
+        "RandomForestClassifier": "classification",
+        "RandomForestClassificationModel": "classification",
+    },
+    "pyspark.ml.regression": {
+        "LinearRegression": "regression",
+        "LinearRegressionModel": "regression",
+        "RandomForestRegressor": "regression",
+        "RandomForestRegressionModel": "regression",
+    },
+    "pyspark.ml.tuning": {"CrossValidator": "tuning", "CrossValidatorModel": "tuning"},
+    "pyspark.ml.evaluation": {
+        "MulticlassClassificationEvaluator": "evaluation",
+        "RegressionEvaluator": "evaluation",
+        "BinaryClassificationEvaluator": "evaluation",
+    },
+    "pyspark.ml": {"Pipeline": "pipeline", "PipelineModel": "pipeline"},
+}
+
+_SELF_PREFIXES = ("spark_rapids_ml_tpu", "pyspark")
+
+
+def _caller_is_internal() -> bool:
+    import inspect
+
+    frame = inspect.currentframe()
+    try:
+        # walk out of this module + the proxy __getattr__
+        f = frame
+        for _ in range(8):
+            if f is None:
+                return False
+            mod = f.f_globals.get("__name__", "")
+            if mod.startswith("spark_rapids_ml_tpu") and not mod.endswith("install"):
+                return True
+            if mod.startswith("pyspark"):
+                return True
+            f = f.f_back
+        return False
+    finally:
+        del frame
+
+
+def _set_mod_getattr(mod_name: str, attrs: Dict[str, str]) -> None:
+    real = sys.modules.get(mod_name)
+
+    proxy = types.ModuleType(mod_name)
+    proxy.__dict__["_srml_tpu_real"] = real
+    proxy.__dict__["_srml_tpu_attrs"] = dict(attrs)
+
+    def __getattr__(name: str, _mod=mod_name, _proxy=proxy) -> Any:
+        attrs_map = _proxy.__dict__["_srml_tpu_attrs"]
+        real_mod = _proxy.__dict__["_srml_tpu_real"]
+        if name in attrs_map and not _caller_is_internal():
+            sub = importlib.import_module(f"spark_rapids_ml_tpu.{attrs_map[name]}")
+            return getattr(sub, name)
+        if real_mod is not None:
+            return getattr(real_mod, name)
+        raise AttributeError(
+            f"module {_mod!r} has no attribute {name!r} "
+            "(pyspark is not installed; only TPU-accelerated names are available)"
+        )
+
+    proxy.__getattr__ = __getattr__  # type: ignore[attr-defined]
+    sys.modules[mod_name] = proxy
+    # also rebind the submodule attribute on the parent package: attribute-chain
+    # access (`import pyspark.ml.clustering; pyspark.ml.clustering.KMeans`) resolves
+    # through the parent's attributes, not sys.modules
+    parent_name, _, child = mod_name.rpartition(".")
+    if parent_name:
+        parent = sys.modules.get(parent_name)
+        if parent is not None:
+            setattr(parent, child, proxy)
+
+
+def install() -> None:
+    """Install the interposer over pyspark.ml (idempotent)."""
+    try:
+        import pyspark.ml  # noqa: F401 — materialize real modules first when present
+        for mod_name in _accelerated_attributes:
+            try:
+                importlib.import_module(mod_name)
+            except ImportError:
+                pass
+    except ImportError:
+        # standalone mode: fabricate the pyspark/pyspark.ml package skeleton
+        for pkg in ("pyspark", "pyspark.ml"):
+            if pkg not in sys.modules:
+                sys.modules[pkg] = types.ModuleType(pkg)
+    # children before parents: a parent proxy's fallthrough resolves submodule
+    # attributes on the module it wrapped, which must already hold the child proxies
+    for mod_name, attrs in sorted(
+        _accelerated_attributes.items(), key=lambda kv: -kv[0].count(".")
+    ):
+        if not isinstance(
+            getattr(sys.modules.get(mod_name), "__getattr__", None), types.FunctionType
+        ):
+            _set_mod_getattr(mod_name, attrs)
+
+
+install()

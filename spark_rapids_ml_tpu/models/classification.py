@@ -22,6 +22,7 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from ..core.dataset import densify
 from ..core.backend_params import HasFeaturesCols, _TpuClass
 from ..core.estimator import (
     FitInputs,
@@ -260,7 +261,7 @@ class LogisticRegression(
         return LogisticRegressionModel(**attrs)
 
     def _fit_fallback_model(self, twin: type, fd) -> Dict[str, Any]:
-        X = np.asarray(fd.features.todense()) if fd.is_sparse else fd.features
+        X = densify(fd.features, float32=self._float32_inputs)
         reg = self.getOrDefault("regParam")
         l1r = self.getOrDefault("elasticNetParam")
         kwargs: Dict[str, Any] = {

@@ -16,6 +16,7 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from ..core.dataset import densify
 from ..core.backend_params import HasFeaturesCols, _TpuClass
 from ..core.estimator import FitInputs, _TpuEstimator, _TpuModelWithPredictionCol
 from ..core.params import (
@@ -196,7 +197,7 @@ class KMeans(_KMeansClass, _TpuEstimator, _KMeansParams):
                 "distanceMeasure='cosine' is supported neither by the TPU backend nor "
                 "by the sklearn CPU fallback; use the pyspark.ml KMeans for cosine."
             )
-        X = np.asarray(fd.features.todense()) if fd.is_sparse else fd.features
+        X = densify(fd.features, float32=self._float32_inputs)
         init = self.getOrDefault("initMode")
         sk = twin(
             n_clusters=self.getOrDefault("k"),

@@ -15,6 +15,7 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from ..core.dataset import densify
 from ..core.backend_params import HasFeaturesCols, _TpuClass
 from ..core.estimator import FitInputs, _TpuEstimator, _TpuModelWithColumns
 from ..core.params import (
@@ -114,7 +115,7 @@ class PCA(_PCAClass, _TpuEstimator, _PCAParams):
         return PCAModel(**attrs)
 
     def _fit_fallback_model(self, twin: type, fd) -> Dict[str, Any]:
-        X = np.asarray(fd.features.todense()) if fd.is_sparse else fd.features
+        X = densify(fd.features, float32=self._float32_inputs)
         sk = twin(n_components=self.getOrDefault("k")).fit(np.asarray(X, dtype=np.float64))
         return {
             "mean": sk.mean_.astype(np.float32),

@@ -21,6 +21,7 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from ..core.dataset import densify
 from ..core.backend_params import HasFeaturesCols, _TpuClass
 from ..core.estimator import (
     FitInputs,
@@ -209,7 +210,7 @@ class LinearRegression(_LinearRegressionClass, _TpuEstimatorSupervised, _LinearR
         return LinearRegressionModel(**attrs)
 
     def _fit_fallback_model(self, twin: type, fd) -> Dict[str, Any]:
-        X = np.asarray(fd.features.todense()) if fd.is_sparse else fd.features
+        X = densify(fd.features, float32=self._float32_inputs)
         X64 = np.asarray(X, dtype=np.float64)
         fit_intercept = self.getOrDefault("fitIntercept")
         if self.getOrDefault("loss") == "huber":

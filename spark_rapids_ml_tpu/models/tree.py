@@ -21,6 +21,7 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from ..core.dataset import densify
 from ..core.backend_params import HasFeaturesCols, _TpuClass
 from ..core.estimator import (
     FitInputs,
@@ -311,7 +312,7 @@ class RandomForestRegressor(_RandomForestEstimator):
         return "variance"
 
     def _fit_fallback_model(self, twin: type, fd) -> Dict[str, Any]:
-        X = np.asarray(fd.features.todense()) if fd.is_sparse else fd.features
+        X = densify(fd.features, float32=self._float32_inputs)
         sk = twin(
             n_estimators=self.getOrDefault("numTrees"),
             max_depth=max(self.getOrDefault("maxDepth"), 1),
@@ -360,7 +361,7 @@ class RandomForestClassifier(
         return self._tpu_params.get("split_criterion", "gini")
 
     def _fit_fallback_model(self, twin: type, fd) -> Dict[str, Any]:
-        X = np.asarray(fd.features.todense()) if fd.is_sparse else fd.features
+        X = densify(fd.features, float32=self._float32_inputs)
         sk = twin(
             n_estimators=self.getOrDefault("numTrees"),
             max_depth=max(self.getOrDefault("maxDepth"), 1),

@@ -1,0 +1,117 @@
+#
+# ctypes bindings for the native host-runtime library (native/src/srml_native.cpp) —
+# the role the reference fills with cuDF/treelite/RMM native code on the host side
+# (SURVEY.md §2.5). Every entry point has a numpy fallback so the pure-Python install
+# keeps working when the .so has not been built (native/build.sh).
+#
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Optional, Tuple
+
+import numpy as np
+
+from .utils import get_logger
+
+_lib: Optional[ctypes.CDLL] = None
+_load_attempted = False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _load_attempted
+    if _load_attempted:
+        return _lib
+    _load_attempted = True
+    path = os.path.join(os.path.dirname(__file__), "lib", "libsrml_native.so")
+    if not os.path.exists(path):
+        return None
+    try:
+        lib = ctypes.CDLL(path)
+        lib.srml_bin_features.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
+        ]
+        lib.srml_csr_to_dense.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_void_p,
+        ]
+        lib.srml_topk_merge.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p,
+        ]
+        lib.srml_num_threads.restype = ctypes.c_int
+        _lib = lib
+        get_logger("native").info(
+            "loaded libsrml_native.so (%d threads)", lib.srml_num_threads()
+        )
+    except OSError as e:  # pragma: no cover
+        get_logger("native").warning("failed to load native library: %s", e)
+        _lib = None
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def bin_features(X: np.ndarray, edges: np.ndarray) -> np.ndarray:
+    """Digitize X against per-feature edges; native when built, numpy otherwise.
+    Semantics: searchsorted(side='left') per feature (ops/trees.py)."""
+    lib = _load()
+    X = np.ascontiguousarray(X, dtype=np.float32)
+    edges = np.ascontiguousarray(edges, dtype=np.float32)
+    n, d = X.shape
+    if lib is not None:
+        out = np.empty((n, d), dtype=np.int32)
+        # X/edges are bound locals; they outlive the C call
+        lib.srml_bin_features(
+            X.ctypes.data, n, d, edges.ctypes.data, edges.shape[1] + 1, out.ctypes.data
+        )
+        return out
+    out = np.empty((n, d), dtype=np.int32)
+    for j in range(d):
+        out[:, j] = np.searchsorted(edges[j], X[:, j], side="left")
+    return out
+
+
+def csr_to_dense(indptr: np.ndarray, indices: np.ndarray, data: np.ndarray,
+                 n: int, d: int, dtype=np.float32) -> np.ndarray:
+    lib = _load()
+    if lib is not None and np.dtype(dtype) == np.float32:
+        # the converted arrays MUST stay bound to locals until after the C call —
+        # .ctypes.data is a bare pointer that does not keep its array alive
+        indptr64 = np.ascontiguousarray(indptr, np.int64)
+        indices32 = np.ascontiguousarray(indices, np.int32)
+        data32 = np.ascontiguousarray(data, np.float32)
+        out = np.empty((n, d), dtype=np.float32)
+        lib.srml_csr_to_dense(
+            indptr64.ctypes.data, indices32.ctypes.data, data32.ctypes.data,
+            n, d, out.ctypes.data,
+        )
+        return out
+    import scipy.sparse as sp
+
+    return np.asarray(
+        sp.csr_matrix((data, indices, indptr), shape=(n, d)).todense(), dtype
+    )
+
+
+def topk_merge(dists: np.ndarray, ids: np.ndarray, k: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Merge per-shard candidates (nq, n_cand) into global ascending top-k."""
+    lib = _load()
+    dists = np.ascontiguousarray(dists, np.float32)
+    ids = np.ascontiguousarray(ids, np.int64)
+    nq, n_cand = dists.shape
+    if lib is not None:
+        out_d = np.empty((nq, k), np.float32)
+        out_i = np.empty((nq, k), np.int64)
+        # dists/ids are bound locals; they outlive the C call
+        lib.srml_topk_merge(
+            dists.ctypes.data, ids.ctypes.data, nq, n_cand, k,
+            out_d.ctypes.data, out_i.ctypes.data,
+        )
+        return out_d, out_i
+    order = np.argsort(dists, axis=1)[:, :k]
+    return np.take_along_axis(dists, order, axis=1), np.take_along_axis(ids, order, axis=1)

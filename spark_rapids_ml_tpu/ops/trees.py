@@ -55,12 +55,12 @@ def quantile_bin_edges(
 
 
 def bin_features(X: np.ndarray, edges: np.ndarray) -> np.ndarray:
-    """Digitize to int32 bins (n, d): bin = #edges < x, in [0, max_bins-1]."""
-    d = X.shape[1]
-    out = np.empty(X.shape, dtype=np.int32)
-    for j in range(d):
-        out[:, j] = np.searchsorted(edges[j], X[:, j], side="left")
-    return out
+    """Digitize to int32 bins (n, d): bin = #edges < x, in [0, max_bins-1].
+    Dispatches to the native OpenMP kernel when built (spark_rapids_ml_tpu/native.py),
+    numpy searchsorted otherwise."""
+    from ..native import bin_features as _native_bin
+
+    return _native_bin(X, edges)
 
 
 # ---------------------------------------------------------------------------
