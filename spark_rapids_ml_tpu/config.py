@@ -1,0 +1,78 @@
+#
+# Global config/flag system — the TPU analog of the reference's Spark-conf tier
+# (SURVEY.md §5.6; reference reads spark.rapids.ml.{uvm.enabled, sam.enabled,
+# cpu.fallback.enabled, verbose, float32_inputs, num_workers} at fit time,
+# core.py:776-812 / params.py:275-286; documented in docs/site/configuration.md).
+#
+# Three tiers, mirroring the reference:
+#   1. estimator Params / backend kwargs        (per-estimator, core/backend_params)
+#   2. THIS module: process-wide defaults, settable programmatically or via
+#      SRML_TPU_* environment variables         (the spark-conf analog)
+#   3. hard defaults below
+#
+# Keys:
+#   fallback.enabled   (bool, env SRML_TPU_FALLBACK_ENABLED)  — CPU fallback on
+#                      unsupported params (reference spark.rapids.ml.cpu.fallback.enabled)
+#   float32_inputs     (bool, env SRML_TPU_FLOAT32_INPUTS)
+#   num_workers        (int,  env SRML_TPU_NUM_WORKERS)       — default mesh width
+#   verbose            (bool, env SRML_TPU_VERBOSE)
+#   trace_dir          (str,  env SRML_TPU_TRACE_DIR)         — xplane capture per fit
+#
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict, Optional
+
+_DEFAULTS: Dict[str, Any] = {
+    "fallback.enabled": True,
+    "float32_inputs": True,
+    "num_workers": None,
+    "verbose": False,
+    "trace_dir": None,
+}
+
+_ENV_KEYS: Dict[str, str] = {
+    "fallback.enabled": "SRML_TPU_FALLBACK_ENABLED",
+    "float32_inputs": "SRML_TPU_FLOAT32_INPUTS",
+    "num_workers": "SRML_TPU_NUM_WORKERS",
+    "verbose": "SRML_TPU_VERBOSE",
+    "trace_dir": "SRML_TPU_TRACE_DIR",
+}
+
+_overrides: Dict[str, Any] = {}
+
+
+def _coerce(key: str, raw: str) -> Any:
+    default = _DEFAULTS[key]
+    if isinstance(default, bool) or key in ("fallback.enabled", "float32_inputs", "verbose"):
+        return raw.strip().lower() in ("1", "true", "yes", "on")
+    if key == "num_workers":
+        return int(raw)
+    return raw
+
+
+def get(key: str) -> Any:
+    """Resolution order: programmatic set() > environment > default."""
+    if key not in _DEFAULTS:
+        raise KeyError(f"Unknown config key '{key}'; known: {sorted(_DEFAULTS)}")
+    if key in _overrides:
+        return _overrides[key]
+    env = os.environ.get(_ENV_KEYS[key])
+    if env is not None and env != "":
+        return _coerce(key, env)
+    return _DEFAULTS[key]
+
+
+def set(key: str, value: Any) -> None:  # noqa: A001 — spark-conf style name
+    if key not in _DEFAULTS:
+        raise KeyError(f"Unknown config key '{key}'; known: {sorted(_DEFAULTS)}")
+    _overrides[key] = value
+
+
+def unset(key: str) -> None:
+    _overrides.pop(key, None)
+
+
+def all() -> Dict[str, Any]:  # noqa: A001
+    return {k: get(k) for k in _DEFAULTS}

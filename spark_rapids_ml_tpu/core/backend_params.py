@@ -166,6 +166,14 @@ class _TpuParams(HasVerboseParam):
     def __init__(self) -> None:
         super().__init__()
         self._tpu_params = {}
+        # process-wide config tier (config.py, the spark-conf analog) seeds the
+        # per-instance settings; explicit kwargs still override
+        from .. import config as _config
+
+        self._fallback_enabled = bool(_config.get("fallback.enabled"))
+        self._float32_inputs = bool(_config.get("float32_inputs"))
+        if _config.get("num_workers") is not None:
+            self._num_workers = int(_config.get("num_workers"))
 
     @property
     def tpu_params(self) -> Dict[str, Any]:

@@ -171,9 +171,17 @@ class _TpuCaller(_TpuClass, _TpuParams):
                 "Fit input is empty. An empty partition would hang the reference's "
                 "barrier stage (core.py:959-962); here it is a direct error."
             )
-        inputs = self._build_fit_inputs(fd)
-        fit_func = self._get_tpu_fit_func(extra_params)
-        result = fit_func(inputs)
+        from .. import config as _config
+        from ..profiling import span, trace
+
+        verbose = bool(self.getOrDefault("verbose")) if self.hasParam("verbose") else False
+        verbose = verbose or bool(_config.get("verbose"))
+        with trace(_config.get("trace_dir")):
+            with span(f"{type(self).__name__}.prepare", verbose):
+                inputs = self._build_fit_inputs(fd)
+            fit_func = self._get_tpu_fit_func(extra_params)
+            with span(f"{type(self).__name__}.fit", verbose):
+                result = fit_func(inputs)
         if isinstance(result, list):
             return result
         return [result]

@@ -1,0 +1,62 @@
+#
+# Tracing/profiling hooks — SURVEY.md §5.1 notes the reference has none beyond timed
+# logging (with_benchmark wall-clock wrapper) and flags JAX profiler integration as
+# the cheap win for the TPU build. This module provides:
+#   * span(name): wall-clock span that ALSO shows up on the device timeline via
+#     jax.profiler.TraceAnnotation (visible in xplane/tensorboard traces)
+#   * start_trace/stop_trace: programmatic xplane capture around a fit
+#   * fit-time logging is wired through _TpuCaller when `verbose` is set
+#
+# Enable capture with SRML_TPU_TRACE_DIR=/path (see config.py): every fit is then
+# traced automatically.
+#
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Any, Dict, Iterator, Optional
+
+from .utils import get_logger
+
+_logger = get_logger("profiling")
+_spans: Dict[str, float] = {}
+
+
+@contextlib.contextmanager
+def span(name: str, verbose: bool = False) -> Iterator[None]:
+    """Wall-clock + device-timeline span."""
+    import jax.profiler
+
+    t0 = time.perf_counter()
+    with jax.profiler.TraceAnnotation(name):
+        yield
+    dt = time.perf_counter() - t0
+    _spans[name] = _spans.get(name, 0.0) + dt
+    if verbose:
+        _logger.info("%s: %.3fs", name, dt)
+
+
+def span_totals() -> Dict[str, float]:
+    """Accumulated seconds per span name since process start (or last reset)."""
+    return dict(_spans)
+
+
+def reset_spans() -> None:
+    _spans.clear()
+
+
+@contextlib.contextmanager
+def trace(trace_dir: Optional[str]) -> Iterator[None]:
+    """Capture an xplane trace into trace_dir (no-op when trace_dir is falsy)."""
+    if not trace_dir:
+        yield
+        return
+    import jax.profiler
+
+    jax.profiler.start_trace(trace_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+        _logger.info("wrote profiler trace to %s", trace_dir)

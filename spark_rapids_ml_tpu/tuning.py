@@ -202,6 +202,91 @@ class CrossValidator(_CrossValidatorParams):
         return that  # type: ignore[return-value]
 
 
+class _TrainValidationSplitParams(HasSeed, HasParallelism, HasCollectSubModels):
+    trainRatio: Param[float] = Param(
+        "undefined",
+        "trainRatio",
+        "Param for ratio between train and validation data. Must be between 0 and 1.",
+        TypeConverters.toFloat,
+    )
+
+    def getTrainRatio(self) -> float:
+        return self.getOrDefault("trainRatio")
+
+
+class TrainValidationSplit(_TrainValidationSplitParams):
+    """Single train/validation split tuning (pyspark.ml.tuning surface) with the same
+    one-pass fitMultiple acceleration as CrossValidator."""
+
+    def __init__(
+        self,
+        estimator: Any = None,
+        estimatorParamMaps: Optional[List[ParamMap]] = None,
+        evaluator: Any = None,
+        trainRatio: float = 0.75,
+        seed: Optional[int] = None,
+        parallelism: int = 1,
+    ) -> None:
+        super().__init__()
+        self._setDefault(trainRatio=0.75, parallelism=1, collectSubModels=False, seed=42)
+        self._set(trainRatio=trainRatio, parallelism=parallelism)
+        if seed is not None:
+            self._set(seed=seed)
+        self._estimator = estimator
+        self._estimatorParamMaps = estimatorParamMaps or []
+        self._evaluator = evaluator
+        self.logger = get_logger(self.__class__)
+
+    def fit(self, dataset: Any) -> "TrainValidationSplitModel":
+        est, maps, evaluator = self._estimator, self._estimatorParamMaps, self._evaluator
+        if est is None or evaluator is None or not maps:
+            raise ValueError(
+                "TrainValidationSplit requires an estimator, a non-empty "
+                "estimatorParamMaps, and an evaluator."
+            )
+        ratio = self.getTrainRatio()
+        if not (0.0 < ratio < 1.0):
+            raise ValueError(f"trainRatio must be in (0, 1), got {ratio}")
+        rng = np.random.default_rng(self.getOrDefault("seed"))
+        mask = rng.random(len(dataset)) < ratio
+        if mask.all() or not mask.any():
+            raise ValueError(
+                f"train/validation split produced an empty side "
+                f"(n={len(dataset)}, trainRatio={ratio}); use more data or a "
+                "less extreme ratio."
+            )
+        train = dataset.iloc[mask].reset_index(drop=True)
+        val = dataset.iloc[~mask].reset_index(drop=True)
+
+        metrics = np.zeros((len(maps),), dtype=np.float64)
+        models: List[Any] = [None] * len(maps)
+        for index, model in est.fitMultiple(train, maps):
+            models[index] = model
+        for i, model in enumerate(models):
+            if getattr(model, "_supportsTransformEvaluate", lambda: False)():
+                metrics[i] = model._transformEvaluate(val, evaluator)
+            else:
+                metrics[i] = evaluator.evaluate(model.transform(val))
+        best_index = (
+            int(np.argmax(metrics)) if evaluator.isLargerBetter() else int(np.argmin(metrics))
+        )
+        best_model = est.fit(dataset, maps[best_index])
+        tvs_model = TrainValidationSplitModel(best_model, metrics.tolist())
+        self._copyValues(tvs_model)
+        return tvs_model
+
+
+class TrainValidationSplitModel(_TrainValidationSplitParams):
+    def __init__(self, bestModel: Any, validationMetrics: Optional[List[float]] = None):
+        super().__init__()
+        self._setDefault(trainRatio=0.75, parallelism=1, collectSubModels=False, seed=42)
+        self.bestModel = bestModel
+        self.validationMetrics = validationMetrics or []
+
+    def transform(self, dataset: Any) -> Any:
+        return self.bestModel.transform(dataset)
+
+
 class CrossValidatorModel(_CrossValidatorParams):
     """Holds the best model + averaged metrics (pyspark surface)."""
 

@@ -1,0 +1,83 @@
+"""Config/flag system + profiling spans (SURVEY.md §5.1/5.6 subsystems)."""
+
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_ml_tpu import config, profiling
+from spark_rapids_ml_tpu.tuning import TrainValidationSplit
+
+
+def test_config_resolution_order():
+    assert config.get("fallback.enabled") is True  # default
+    config.set("fallback.enabled", False)
+    try:
+        assert config.get("fallback.enabled") is False
+    finally:
+        config.unset("fallback.enabled")
+    os.environ["SRML_TPU_FALLBACK_ENABLED"] = "false"
+    try:
+        assert config.get("fallback.enabled") is False
+    finally:
+        del os.environ["SRML_TPU_FALLBACK_ENABLED"]
+    with pytest.raises(KeyError):
+        config.get("bogus.key")
+
+
+def test_config_seeds_estimators():
+    from spark_rapids_ml_tpu.clustering import KMeans
+
+    config.set("fallback.enabled", False)
+    try:
+        est = KMeans(k=2)
+        assert est._fallback_enabled is False
+    finally:
+        config.unset("fallback.enabled")
+    est2 = KMeans(k=2)
+    assert est2._fallback_enabled is True
+
+
+def test_profiling_spans_accumulate(n_devices):
+    from spark_rapids_ml_tpu.feature import PCA
+
+    profiling.reset_spans()
+    X = np.random.default_rng(0).normal(size=(100, 6)).astype(np.float32)
+    PCA(k=2, inputCol="features").fit(pd.DataFrame({"features": list(X)}))
+    totals = profiling.span_totals()
+    assert any(k.endswith("PCA.fit") for k in totals)
+    assert all(v >= 0 for v in totals.values())
+
+
+def test_train_validation_split(n_devices):
+    from sklearn.datasets import make_regression
+
+    from spark_rapids_ml_tpu.evaluation import RegressionEvaluator
+    from spark_rapids_ml_tpu.regression import LinearRegression
+
+    X, y, _ = make_regression(
+        n_samples=400, n_features=6, noise=2.0, coef=True, random_state=0
+    )
+    df = pd.DataFrame(
+        {"features": list(X.astype(np.float32)), "label": y.astype(np.float32)}
+    )
+    est = LinearRegression(standardization=False)
+    tvs = TrainValidationSplit(
+        estimator=est,
+        estimatorParamMaps=[{est.regParam: 0.0}, {est.regParam: 100.0}],
+        evaluator=RegressionEvaluator(metricName="rmse"),
+        trainRatio=0.75,
+        seed=4,
+    )
+    model = tvs.fit(df)
+    assert len(model.validationMetrics) == 2
+    assert model.validationMetrics[0] < model.validationMetrics[1]
+    assert model.bestModel.getOrDefault("regParam") == 0.0
+    assert "prediction" in model.transform(df).columns
+
+
+def test_train_validation_split_empty_grid():
+    tvs = TrainValidationSplit()
+    with pytest.raises(ValueError, match="non-empty"):
+        tvs.fit(pd.DataFrame({"features": []}))

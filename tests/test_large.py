@@ -1,0 +1,83 @@
+"""Scale-tier tests, gated behind --runslow (reference python/tests_large/: fits
+1e6+-row synthetic data with the distributed generators and checks the objective vs
+the CPU baseline, tests_large/test_large_logistic_regression.py:40-60)."""
+
+import os
+import sys
+
+import numpy as np
+import pandas as pd
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+pytestmark = pytest.mark.slow
+
+
+def test_large_linear_regression_objective(n_devices):
+    from benchmark.gen_data import RegressionDataGen
+
+    from spark_rapids_ml_tpu.regression import LinearRegression
+
+    df = RegressionDataGen(num_rows=1_000_000, num_cols=64, seed=0).gen_dataframe()
+    est = LinearRegression(standardization=False)
+    est.num_workers = n_devices
+    model = est.fit(df)
+    X = np.stack(df["features"].to_numpy()).astype(np.float64)
+    y = df["label"].to_numpy()
+    pred = X @ model.coefficients + model.intercept
+    rmse = np.sqrt(np.mean((y - pred) ** 2))
+    assert rmse < 1.1  # noise sigma = 1.0: the fit must reach the noise floor
+
+
+def test_large_kmeans_inertia(n_devices):
+    from benchmark.gen_data import BlobsDataGen
+
+    from spark_rapids_ml_tpu.clustering import KMeans
+    from sklearn.cluster import KMeans as SkKMeans
+
+    df = BlobsDataGen(
+        num_rows=500_000, num_cols=32, seed=1, num_centers=10
+    ).gen_dataframe()
+    est = KMeans(k=10, maxIter=30, seed=3)
+    est.num_workers = n_devices
+    model = est.fit(df)
+    X = np.stack(df["features"].to_numpy())
+    sk = SkKMeans(n_clusters=10, n_init=1, max_iter=30, random_state=0).fit(X[:100_000])
+    from benchmark.benchmark.utils import inertia_score
+
+    sk_inertia_full = inertia_score(X, sk.cluster_centers_)
+    assert model.inertia_ <= sk_inertia_full * 1.05
+
+
+def test_large_logistic_regression_objective(n_devices):
+    from benchmark.gen_data import ClassificationDataGen
+
+    from spark_rapids_ml_tpu.classification import LogisticRegression
+
+    df = ClassificationDataGen(
+        num_rows=1_000_000, num_cols=32, seed=2, num_classes=2
+    ).gen_dataframe()
+    est = LogisticRegression(regParam=1e-4, standardization=False, maxIter=50)
+    est.num_workers = n_devices
+    model = est.fit(df)
+    out_acc = (
+        model.transform(df.iloc[:50_000])["prediction"].to_numpy()
+        == df["label"].to_numpy()[:50_000]
+    ).mean()
+    assert out_acc > 0.85
+
+
+def test_large_pca_low_rank_recovery(n_devices):
+    from benchmark.gen_data import LowRankMatrixDataGen
+
+    from spark_rapids_ml_tpu.feature import PCA
+
+    df = LowRankMatrixDataGen(
+        num_rows=1_000_000, num_cols=64, seed=3, effective_rank=8
+    ).gen_dataframe()
+    est = PCA(k=8, inputCol="features")
+    est.num_workers = n_devices
+    model = est.fit(df)
+    # the top-8 subspace captures most of the variance of an effective-rank-8 matrix
+    assert model.explainedVariance.sum() > 0.7
