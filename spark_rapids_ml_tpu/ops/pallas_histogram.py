@@ -1,0 +1,130 @@
+#
+# Pallas TPU kernel: segment histogram via one-hot matmuls.
+#
+# The forest builder's hot op is the (node, feature, bin, stat) histogram
+# (ops/trees.py _histogram). XLA lowers jax.ops.segment_sum to sort/scatter — the
+# weakest op class on TPU (no hardware scatter). The TPU-native formulation is an
+# MXU one-hot contraction: for each feature and each tile of segment ids,
+#     hist_tile = onehot(seg_ids_block)ᵀ @ values_block
+# with the one-hot built on the fly in VMEM (never materialized in HBM) and the
+# output tile accumulated across row blocks by grid revisiting.
+#
+# Grid: (features, segment-tiles, row-blocks) — row-blocks innermost so each output
+# tile is revisited consecutively and zeroed on the first visit. Block shapes follow
+# Mosaic tiling rules: every minor dimension is either a multiple of the lane width
+# or the full array dimension (seg ids travel transposed (d, n) with a full-d block;
+# the kernel selects its feature row with program_id).
+#
+# The segment tile adapts to the level width (min(2048, n_segments rounded up to
+# 128)) so shallow tree levels don't pay for a 2048-wide one-hot.
+#
+# Dispatch is an explicit `use_pallas` static argument threaded from forest_fit —
+# NOT read from the environment inside traced code (jit caches would make a
+# trace-time env read sticky). Multi-device note: pallas_call has no GSPMD
+# partitioning rule, so the pallas path is only selected for single-device runs;
+# sharded multichip fits keep the segment_sum path whose replicated output makes XLA
+# psum partial histograms (shard_map-wrapped pallas is the round-2 upgrade).
+#
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+BLOCK_ROWS = 512
+MAX_SEG_TILE = 2048
+
+
+def _round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+def _hist_kernel(seg_ref, val_ref, out_ref, *, seg_tile: int):
+    """seg_ref: (d, BLOCK_ROWS) int32 (all features for this row block);
+    val_ref: (BLOCK_ROWS, s); out_ref: (1, seg_tile, s), revisited across row
+    blocks."""
+    b = pl.program_id(2)
+
+    @pl.when(b == 0)
+    def _():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    j = pl.program_id(0)
+    c = pl.program_id(1)
+    seg = seg_ref[j, :]  # (BLOCK_ROWS,)
+    local = seg - c * seg_tile
+    cols = jax.lax.broadcasted_iota(jnp.int32, (BLOCK_ROWS, seg_tile), 1)
+    onehot = (cols == local[:, None]).astype(val_ref.dtype)  # (BLOCK_ROWS, seg_tile)
+    partial = jax.lax.dot_general(
+        onehot,
+        val_ref[...],
+        (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # (seg_tile, s)
+    out_ref[...] += partial[None, :, :]
+
+
+@functools.partial(jax.jit, static_argnames=("n_segments", "interpret"))
+def segment_histogram_pallas(
+    seg_ids: jax.Array,  # (n, d) int32: per-feature segment id in [0, n_segments)
+    values: jax.Array,  # (n, s) float32
+    n_segments: int,
+    interpret: bool = False,
+) -> jax.Array:
+    """Returns (d, n_segments, s)."""
+    n, d = seg_ids.shape
+    s = values.shape[1]
+
+    pad_rows = (-n) % BLOCK_ROWS
+    if pad_rows:
+        # padded rows carry zero values, so whatever segment they point at gains 0
+        seg_ids = jnp.pad(seg_ids, ((0, pad_rows), (0, 0)), constant_values=0)
+        values = jnp.pad(values, ((0, pad_rows), (0, 0)))
+    n_padded = seg_ids.shape[0]
+    seg_t = seg_ids.T  # (d, n): minor dim = rows, blocked at BLOCK_ROWS (128-aligned)
+
+    seg_tile = min(MAX_SEG_TILE, _round_up(n_segments, 128))
+    c_tiles = _round_up(n_segments, seg_tile) // seg_tile
+
+    out = pl.pallas_call(
+        functools.partial(_hist_kernel, seg_tile=seg_tile),
+        grid=(d, c_tiles, n_padded // BLOCK_ROWS),
+        in_specs=[
+            pl.BlockSpec((d, BLOCK_ROWS), lambda j, c, b: (0, b)),
+            pl.BlockSpec((BLOCK_ROWS, s), lambda j, c, b: (b, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, seg_tile, s), lambda j, c, b: (j, c, 0)),
+        out_shape=jax.ShapeDtypeStruct((d, c_tiles * seg_tile, s), jnp.float32),
+        interpret=interpret,
+    )(seg_t, values)
+    return out[:, :n_segments, :]
+
+
+def default_use_pallas() -> bool:
+    """Pallas histogram is worthwhile (and partitionable) only on a single real TPU
+    device; multi-device meshes keep the GSPMD-friendly segment_sum path."""
+    import os
+
+    if os.environ.get("SRML_TPU_PALLAS_HISTOGRAM", "") == "1":
+        return True
+    return jax.default_backend() == "tpu" and jax.device_count() == 1
+
+
+def segment_histogram(
+    seg_ids: jax.Array, values: jax.Array, n_segments: int, use_pallas: bool = False
+) -> jax.Array:
+    """Returns (d, n_segments, s). `use_pallas` must be decided OUTSIDE traced code
+    (see default_use_pallas)."""
+    if use_pallas:
+        return segment_histogram_pallas(
+            seg_ids, values, n_segments, interpret=(jax.default_backend() != "tpu")
+        )
+
+    def per_feature(seg_j):
+        return jax.ops.segment_sum(values, seg_j, num_segments=n_segments)
+
+    return jax.vmap(per_feature, in_axes=1)(seg_ids)

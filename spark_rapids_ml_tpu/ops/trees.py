@@ -105,16 +105,21 @@ def _leaf_value(stats: jax.Array, impurity: str) -> jax.Array:
 
 
 def _histogram(
-    Xb: jax.Array, values: jax.Array, node_id: jax.Array, n_nodes: int, nbins: int
+    Xb: jax.Array,
+    values: jax.Array,
+    node_id: jax.Array,
+    n_nodes: int,
+    nbins: int,
+    use_pallas: bool = False,
 ) -> jax.Array:
-    """(n_nodes, d, nbins, s) histogram via per-feature segment sums. With row-sharded
-    inputs the replicated output forces XLA to psum partial histograms over the mesh."""
+    """(n_nodes, d, nbins, s) histogram. On a single TPU device this runs the pallas
+    one-hot-matmul kernel (ops/pallas_histogram.py — MXU contraction instead of XLA
+    scatter); otherwise a per-feature segment_sum, whose replicated output makes XLA
+    psum partial histograms across row-sharded meshes."""
+    from .pallas_histogram import segment_histogram
 
-    def per_feature(xb_j):
-        idx = node_id * nbins + xb_j
-        return jax.ops.segment_sum(values, idx, num_segments=n_nodes * nbins)
-
-    hist = jax.vmap(per_feature, in_axes=1)(Xb)  # (d, n_nodes*nbins, s)
+    seg_ids = node_id[:, None] * nbins + Xb  # (n, d)
+    hist = segment_histogram(seg_ids, values, n_nodes * nbins, use_pallas)
     d = Xb.shape[1]
     return hist.reshape(d, n_nodes, nbins, values.shape[1]).transpose(1, 0, 2, 3)
 
@@ -128,6 +133,7 @@ def _histogram(
         "k_features",
         "min_instances",
         "min_info_gain",
+        "use_pallas",
     ),
 )
 def build_tree(
@@ -141,6 +147,7 @@ def build_tree(
     k_features: int,
     min_instances: int,
     min_info_gain: float,
+    use_pallas: bool = False,
 ) -> Dict[str, jax.Array]:
     """Grow one tree; returns heap arrays of size 2^(max_depth+1):
     feature (int32, -1 for leaf), threshold (f32), is_leaf (bool), value (slots, v)."""
@@ -159,7 +166,7 @@ def build_tree(
 
     for t in range(max_depth):
         width = 2**t
-        hist = _histogram(Xb, values, node_id, width, nbins)  # (w, d, b, s)
+        hist = _histogram(Xb, values, node_id, width, nbins, use_pallas)  # (w, d, b, s)
         cum = jnp.cumsum(hist, axis=2)
         L = cum[:, :, :-1, :]  # split at bin 0..b-2
         R = T[:, None, None, :] - L
@@ -299,6 +306,9 @@ def forest_fit(
         raise ValueError(f"numTrees must be >= 1, got {n_trees}")
     if max_depth < 0:
         raise ValueError(f"maxDepth must be >= 0, got {max_depth}")
+    from .pallas_histogram import default_use_pallas
+
+    use_pallas = default_use_pallas()
     n, d = X_host.shape
     edges = quantile_bin_edges(X_host, max_bins, seed=seed)
     Xb_host = bin_features(X_host, edges)
@@ -330,6 +340,7 @@ def forest_fit(
             k_features=feature_subset,
             min_instances=min_instances,
             min_info_gain=min_info_gain,
+            use_pallas=use_pallas,
         )
         trees.append({k: np.asarray(v) for k, v in tree.items()})
 
