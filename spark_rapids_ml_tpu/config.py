@@ -30,6 +30,11 @@ _DEFAULTS: Dict[str, Any] = {
     "num_workers": None,
     "verbose": False,
     "trace_dir": None,
+    # streamed out-of-core fit (ops/streaming.py): estimators with a streaming path
+    # switch to it when the design matrix exceeds this many bytes (the TPU analog of
+    # the reference's UVM/SAM managed memory, utils.py:184-241)
+    "stream_threshold_bytes": 4 << 30,
+    "stream_batch_rows": 1 << 20,
 }
 
 _ENV_KEYS: Dict[str, str] = {
@@ -38,6 +43,8 @@ _ENV_KEYS: Dict[str, str] = {
     "num_workers": "SRML_TPU_NUM_WORKERS",
     "verbose": "SRML_TPU_VERBOSE",
     "trace_dir": "SRML_TPU_TRACE_DIR",
+    "stream_threshold_bytes": "SRML_TPU_STREAM_THRESHOLD_BYTES",
+    "stream_batch_rows": "SRML_TPU_STREAM_BATCH_ROWS",
 }
 
 _overrides: Dict[str, Any] = {}
@@ -47,7 +54,7 @@ def _coerce(key: str, raw: str) -> Any:
     default = _DEFAULTS[key]
     if isinstance(default, bool) or key in ("fallback.enabled", "float32_inputs", "verbose"):
         return raw.strip().lower() in ("1", "true", "yes", "on")
-    if key == "num_workers":
+    if key in ("num_workers", "stream_threshold_bytes", "stream_batch_rows"):
         return int(raw)
     return raw
 

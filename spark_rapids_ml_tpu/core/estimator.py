@@ -176,6 +176,27 @@ class _TpuCaller(_TpuClass, _TpuParams):
 
         verbose = bool(self.getOrDefault("verbose")) if self.hasParam("verbose") else False
         verbose = verbose or bool(_config.get("verbose"))
+
+        # out-of-core path: stream batches through the device instead of staging the
+        # whole design matrix (the reference's UVM/SAM role; ops/streaming.py)
+        threshold = _config.get("stream_threshold_bytes")
+        feature_bytes = fd.n_rows * fd.n_cols * (4 if self._float32_inputs else 8)
+        if (
+            extra_params is None
+            and threshold
+            and feature_bytes > threshold
+            and hasattr(self, "_streaming_fit")
+        ):
+            self.logger.info(
+                "design matrix ~%.0f MiB exceeds stream_threshold_bytes=%d; using "
+                "the streamed out-of-core fit path",
+                feature_bytes / 2**20,
+                threshold,
+            )
+            with trace(_config.get("trace_dir")):
+                with span(f"{type(self).__name__}.fit_streaming", verbose):
+                    return [self._streaming_fit(fd)]
+
         with trace(_config.get("trace_dir")):
             with span(f"{type(self).__name__}.prepare", verbose):
                 inputs = self._build_fit_inputs(fd)

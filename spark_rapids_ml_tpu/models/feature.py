@@ -114,6 +114,28 @@ class PCA(_PCAClass, _TpuEstimator, _PCAParams):
     def _create_pyspark_model(self, attrs: Dict[str, Any]) -> "PCAModel":
         return PCAModel(**attrs)
 
+    def _streaming_fit(self, fd) -> Dict[str, Any]:
+        """Out-of-core fit: stream batches, accumulate the covariance on device
+        (ops/streaming.py; selected by core/estimator.py when the design matrix
+        exceeds the stream threshold)."""
+        from .. import config as _config
+        from ..ops.pca import pca_attrs_from_cov
+        from ..ops.streaming import streaming_covariance
+        from ..parallel.mesh import get_mesh
+
+        k = self.getOrDefault("k")
+        if k > fd.n_cols:
+            raise ValueError(f"k={k} exceeds the number of features {fd.n_cols}")
+        mesh = get_mesh(self.num_workers)
+        cov, mean, wsum = streaming_covariance(
+            densify(fd.features, self._float32_inputs),
+            fd.weight,
+            batch_rows=int(_config.get("stream_batch_rows")),
+            mesh=mesh,
+            float32=self._float32_inputs,
+        )
+        return pca_attrs_from_cov(cov, mean, wsum, k)
+
     def _fit_fallback_model(self, twin: type, fd) -> Dict[str, Any]:
         X = densify(fd.features, float32=self._float32_inputs)
         sk = twin(n_components=self.getOrDefault("k")).fit(np.asarray(X, dtype=np.float64))

@@ -25,7 +25,13 @@ from ..core.backend_params import DictTypeConverters, HasFeaturesCols
 from ..core.params import HasInputCol, HasLabelCol
 from ..parallel.mesh import get_mesh, shard_array
 from ..parallel.partition import pad_rows
-from ..ops.knn import exact_knn_distributed, ivfflat_build, ivfflat_search
+from ..ops.knn import (
+    exact_knn_distributed,
+    ivfflat_build,
+    ivfflat_search,
+    ivfpq_build,
+    ivfpq_search,
+)
 from ..utils import get_logger
 
 
@@ -178,7 +184,9 @@ class _ApproxNNClass(_TpuClass):
     @classmethod
     def _param_value_mapping(cls):
         return {
-            "algorithm": lambda x: x if x in ("ivfflat", "ivf_flat", "brute_force") else None,
+            "algorithm": lambda x: x
+            if x in ("ivfflat", "ivf_flat", "ivfpq", "ivf_pq", "brute_force")
+            else None,
             "metric": lambda x: x if x in ("euclidean", "sqeuclidean", "l2") else None,
         }
 
@@ -226,8 +234,20 @@ class ApproximateNearestNeighbors(_ApproxNNClass, _TpuEstimator, _NNParams):
         algo_params = self.getOrDefault("algoParams") or {}
         nlist = int(algo_params.get("nlist", 64))
         seed = int(algo_params.get("seed", 42))
+        algo = self.getOrDefault("algorithm")
 
         def _fit(inputs: FitInputs) -> Dict[str, Any]:
+            if algo in ("ivfpq", "ivf_pq"):
+                # cuVS ivf_pq param names (reference translation table knn.py:1324-1404)
+                return ivfpq_build(
+                    inputs.features,
+                    inputs.row_weight,
+                    nlist=min(nlist, inputs.desc.m),
+                    m_subvectors=int(algo_params.get("M", algo_params.get("pq_dim", 4))),
+                    n_bits=int(algo_params.get("n_bits", algo_params.get("pq_bits", 8))),
+                    max_iter=20,
+                    seed=seed,
+                )
             return ivfflat_build(
                 inputs.features, inputs.row_weight, nlist=min(nlist, inputs.desc.m),
                 max_iter=20, seed=seed,
@@ -273,13 +293,19 @@ class ApproximateNearestNeighborsModel(_ApproxNNClass, _TpuModel, _NNParams):
         cells: np.ndarray,
         cell_ids: np.ndarray,
         cell_sizes: np.ndarray,
+        codebooks: Optional[np.ndarray] = None,
+        codes: Optional[np.ndarray] = None,
     ) -> None:
-        super().__init__(
+        attrs = dict(
             centers=np.asarray(centers),
             cells=np.asarray(cells),
             cell_ids=np.asarray(cell_ids),
             cell_sizes=np.asarray(cell_sizes),
         )
+        if codebooks is not None:
+            attrs["codebooks"] = np.asarray(codebooks)
+            attrs["codes"] = np.asarray(codes)
+        super().__init__(**attrs)
         self._setDefault(k=5, algorithm="ivfflat", metric="euclidean", algoParams=None)
         self._brute_items: Optional[np.ndarray] = None
         self._item_row_ids: Optional[np.ndarray] = None
@@ -318,14 +344,37 @@ class ApproximateNearestNeighborsModel(_ApproxNNClass, _TpuModel, _NNParams):
             algo_params = self.getOrDefault("algoParams") or {}
             nlist = self._model_attributes["centers"].shape[0]
             nprobe = int(algo_params.get("nprobe", max(1, nlist // 8)))
-            dists_j, ids_j = ivfflat_search(
-                jnp.asarray(Q),
-                jnp.asarray(self._model_attributes["centers"]),
-                jnp.asarray(self._model_attributes["cells"]),
-                jnp.asarray(self._model_attributes["cell_ids"]),
-                k=k,
-                nprobe=min(nprobe, nlist),
-            )
+            if "codebooks" in self._model_attributes:
+                from ..ops.knn import pq_refine
+
+                refine_ratio = int(algo_params.get("refine_ratio", 2))
+                dists_j, ids_j, flat_pos = ivfpq_search(
+                    jnp.asarray(Q),
+                    jnp.asarray(self._model_attributes["centers"]),
+                    jnp.asarray(self._model_attributes["codebooks"]),
+                    jnp.asarray(self._model_attributes["codes"]),
+                    jnp.asarray(self._model_attributes["cell_ids"]),
+                    k=k * max(refine_ratio, 1),
+                    nprobe=min(nprobe, nlist),
+                )
+                if refine_ratio > 1:
+                    # exact re-rank of the ADC candidates (reference knn.py:1642-1666)
+                    dists_j, ids_j = pq_refine(
+                        jnp.asarray(Q),
+                        jnp.asarray(self._model_attributes["cells"]),
+                        flat_pos,
+                        ids_j,
+                        k=k,
+                    )
+            else:
+                dists_j, ids_j = ivfflat_search(
+                    jnp.asarray(Q),
+                    jnp.asarray(self._model_attributes["centers"]),
+                    jnp.asarray(self._model_attributes["cells"]),
+                    jnp.asarray(self._model_attributes["cell_ids"]),
+                    k=k,
+                    nprobe=min(nprobe, nlist),
+                )
             dists = np.asarray(dists_j)
             pos = np.asarray(ids_j)
 

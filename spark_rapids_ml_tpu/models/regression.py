@@ -209,6 +209,35 @@ class LinearRegression(_LinearRegressionClass, _TpuEstimatorSupervised, _LinearR
     def _create_pyspark_model(self, attrs: Dict[str, Any]) -> "LinearRegressionModel":
         return LinearRegressionModel(**attrs)
 
+    def _streaming_fit(self, fd) -> Dict[str, Any]:
+        """Out-of-core fit: stream batches, accumulate (XᵀWX, XᵀWy) on device
+        (ops/streaming.py) — numerically identical to the in-core stats pass."""
+        from .. import config as _config
+        from ..core.dataset import densify as _densify
+        from ..ops.linear import solve_from_stats
+        from ..ops.streaming import streaming_linreg_stats
+        from ..parallel.mesh import get_mesh
+
+        p = self._tpu_params
+        mesh = get_mesh(self.num_workers)
+        A, b, xbar, ybar, sw = streaming_linreg_stats(
+            _densify(fd.features, self._float32_inputs),
+            fd.label,
+            fd.weight,
+            batch_rows=int(_config.get("stream_batch_rows")),
+            mesh=mesh,
+            float32=self._float32_inputs,
+        )
+        return solve_from_stats(
+            A, b, xbar, ybar, sw,
+            reg=float(p["alpha"]),
+            l1_ratio=float(p["l1_ratio"]),
+            fit_intercept=bool(p["fit_intercept"]),
+            standardize=bool(p["normalize"]),
+            max_iter=int(p["max_iter"]),
+            tol=float(p["tol"]),
+        )[0]
+
     def _fit_fallback_model(self, twin: type, fd) -> Dict[str, Any]:
         X = densify(fd.features, float32=self._float32_inputs)
         X64 = np.asarray(X, dtype=np.float64)

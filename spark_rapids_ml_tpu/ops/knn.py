@@ -106,12 +106,13 @@ def exact_knn_distributed(
 
 
 # ---------------------------------------------------------------------------
-# IVF-Flat
+# IVF-Flat / IVF-PQ
 # ---------------------------------------------------------------------------
 
 
 def ivfflat_build(
-    X: jax.Array, w: jax.Array, nlist: int, max_iter: int, seed: int
+    X: jax.Array, w: jax.Array, nlist: int, max_iter: int, seed: int,
+    return_assign: bool = False,
 ) -> Dict[str, np.ndarray]:
     """Partition items into nlist cells via our kmeans; lay cells out densely padded
     to the max cell size (static shapes for the probe scan)."""
@@ -136,12 +137,157 @@ def ivfflat_build(
         cells[c, fill[c]] = Xh[i]
         cell_ids[c, fill[c]] = i
         fill[c] += 1
-    return {
+    out = {
         "centers": centers,
         "cells": cells,
         "cell_ids": cell_ids,
         "cell_sizes": cell_sizes.astype(np.int32),
     }
+    if return_assign:
+        out["assign"] = assign
+    return out
+
+
+def ivfpq_build(
+    X: jax.Array,
+    w: jax.Array,
+    nlist: int,
+    m_subvectors: int,
+    n_bits: int,
+    max_iter: int,
+    seed: int,
+) -> Dict[str, np.ndarray]:
+    """IVF-PQ index: coarse kmeans cells + per-subspace product-quantization
+    codebooks over the residuals (the cuVS ivf_pq equivalent, reference
+    knn.py:1510-1524, re-expressed as dense kmeans + gathers).
+
+    Returns centers (nlist,d), codebooks (m, 2^bits, d/m), codes (nlist, max_cell, m)
+    uint8, cell_ids."""
+    from .kmeans import kmeans_fit, kmeans_predict
+
+    n, d = X.shape
+    if d % m_subvectors != 0:
+        raise ValueError(f"n features {d} not divisible by pq m={m_subvectors}")
+    sub_d = d // m_subvectors
+    n_codes = 2**n_bits
+    flat = ivfflat_build(X, w, nlist, max_iter, seed, return_assign=True)
+    coarse = flat["centers"]
+
+    # residuals of real rows w.r.t. their coarse center (assignment reused from the
+    # flat build — no second distance pass)
+    assign = flat.pop("assign")
+    Xh = np.asarray(X)
+    valid = np.asarray(w) > 0
+    resid = Xh - coarse[assign]
+
+    codebooks = np.zeros((m_subvectors, n_codes, sub_d), np.float32)
+    codes_flat = np.zeros((n, m_subvectors), np.uint8)
+    rv = resid[valid]
+    wv = jnp.ones((rv.shape[0],), jnp.float32)
+    for m_i in range(m_subvectors):
+        sub = rv[:, m_i * sub_d : (m_i + 1) * sub_d].astype(np.float32)
+        k_eff = min(n_codes, sub.shape[0])
+        fitted = kmeans_fit(
+            jnp.asarray(sub), wv, k=k_eff, max_iter=max_iter, tol=1e-4,
+            init="k-means||", init_steps=2, seed=seed + m_i,
+        )
+        cb = np.zeros((n_codes, sub_d), np.float32)
+        cb[:k_eff] = fitted["cluster_centers"]
+        if k_eff < n_codes:
+            cb[k_eff:] = 1e18  # unused codes: unreachable
+        codebooks[m_i] = cb
+        all_sub = resid[:, m_i * sub_d : (m_i + 1) * sub_d].astype(np.float32)
+        codes_flat[:, m_i] = np.asarray(
+            kmeans_predict(jnp.asarray(all_sub), jnp.asarray(cb))
+        ).astype(np.uint8)
+
+    # lay codes out per cell, padded like the flat cells
+    cell_ids = flat["cell_ids"]
+    max_cell = cell_ids.shape[1]
+    codes = np.zeros((nlist, max_cell, m_subvectors), np.uint8)
+    pos = cell_ids >= 0
+    codes[pos] = codes_flat[cell_ids[pos]]
+    return {
+        "centers": coarse,
+        "codebooks": codebooks,
+        "codes": codes,
+        "cell_ids": cell_ids,
+        "cell_sizes": flat["cell_sizes"],
+        "cells": flat["cells"],  # kept for optional exact refine
+    }
+
+
+@functools.partial(jax.jit, static_argnames=("k", "nprobe"))
+def ivfpq_search(
+    Q: jax.Array,
+    centers: jax.Array,  # (nlist, d)
+    codebooks: jax.Array,  # (m, n_codes, sub_d)
+    codes: jax.Array,  # (nlist, max_cell, m) uint8
+    cell_ids: jax.Array,  # (nlist, max_cell)
+    k: int,
+    nprobe: int,
+) -> Tuple[jax.Array, jax.Array]:
+    """Asymmetric-distance (ADC) probe search: per query, build the (m, n_codes)
+    lookup table of residual-subvector distances to each probed cell's center, then
+    score codes by LUT gathers. Returns (approx euclidean distances, item ids)."""
+    nlist, max_cell, m = codes.shape
+    n_codes, sub_d = codebooks.shape[1], codebooks.shape[2]
+    nq, d = Q.shape
+
+    cd2 = _block_sq_dists(Q, centers)  # (nq, nlist)
+    _, probe = jax.lax.top_k(-cd2, nprobe)  # (nq, nprobe)
+
+    # per (query, probed cell): residual q - center, split into m subvectors
+    qres = Q[:, None, :] - centers[probe]  # (nq, nprobe, d)
+    qsub = qres.reshape(nq, nprobe, m, sub_d)
+    # LUT[nq, nprobe, m, n_codes] = ||qsub - codebook||²
+    diff = qsub[:, :, :, None, :] - codebooks[None, None, :, :, :]
+    lut = jnp.sum(diff * diff, axis=-1)
+
+    cell_codes = codes[probe].astype(jnp.int32)  # (nq, nprobe, max_cell, m)
+    # gather LUT entries per code: sum over m subspaces
+    lut_t = jnp.swapaxes(lut, 2, 3)  # (nq, nprobe, n_codes, m)
+    d2 = jnp.sum(
+        jnp.take_along_axis(
+            lut_t, cell_codes, axis=2
+        ),
+        axis=-1,
+    )  # (nq, nprobe, max_cell)
+
+    probed_ids = cell_ids[probe]  # (nq, nprobe, max_cell)
+    flat_ids = probed_ids.reshape(nq, -1)
+    flat_d2 = jnp.where(flat_ids >= 0, d2.reshape(nq, -1), jnp.inf)
+    k_eff = min(k, nprobe * max_cell)
+    neg, pos = jax.lax.top_k(-flat_d2, k_eff)
+    ids = jnp.take_along_axis(flat_ids, pos, axis=1)
+    dists = jnp.sqrt(jnp.maximum(-neg, 0.0))
+    # candidate positions in the (nlist*max_cell) flattened cell layout, for refine
+    probe_of_pos = jnp.take_along_axis(probe, pos // max_cell, axis=1)
+    flat_pos = probe_of_pos * max_cell + pos % max_cell
+    return jnp.where(ids >= 0, dists, jnp.inf), ids, flat_pos
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def pq_refine(
+    Q: jax.Array,
+    cells: jax.Array,  # (nlist, max_cell, d) raw item vectors
+    cand_ids_flat: jax.Array,  # (nq, kc) positions into the flattened cell layout
+    cand_item_ids: jax.Array,  # (nq, kc) item ids (-1 invalid)
+    k: int,
+) -> Tuple[jax.Array, jax.Array]:
+    """Exact re-ranking of the ADC candidates (the reference's ivf_pq refine step,
+    knn.py:1642-1666): gather the raw vectors of the top candidates, recompute true
+    euclidean distances, take the final top-k."""
+    nq, kc = cand_item_ids.shape
+    flat_items = cells.reshape(-1, cells.shape[-1])
+    vecs = flat_items[jnp.maximum(cand_ids_flat, 0)]  # (nq, kc, d)
+    d2 = jnp.sum((vecs - Q[:, None, :]) ** 2, axis=-1)
+    d2 = jnp.where(cand_item_ids >= 0, d2, jnp.inf)
+    k_eff = min(k, kc)
+    neg, pos = jax.lax.top_k(-d2, k_eff)
+    ids = jnp.take_along_axis(cand_item_ids, pos, axis=1)
+    dists = jnp.sqrt(jnp.maximum(-neg, 0.0))
+    return jnp.where(ids >= 0, dists, jnp.inf), ids
 
 
 @functools.partial(jax.jit, static_argnames=("k", "nprobe"))

@@ -150,10 +150,35 @@ def linreg_fit(
     concatenated data (regression.py:657-674); here the data pass itself is shared.
     Returns one attribute dict per model."""
     A, b, xbar, ybar, n = linreg_sufficient_stats(X, y, w)
+    return solve_from_stats(
+        A, b, xbar, ybar, n,
+        reg=reg, l1_ratio=l1_ratio, fit_intercept=fit_intercept,
+        standardize=standardize, max_iter=max_iter, tol=tol,
+        extra_param_sets=extra_param_sets,
+    )
+
+
+def solve_from_stats(
+    A: jax.Array,
+    b: jax.Array,
+    xbar: jax.Array,
+    ybar: jax.Array,
+    n: jax.Array,
+    reg: float,
+    l1_ratio: float,
+    fit_intercept: bool,
+    standardize: bool,
+    max_iter: int,
+    tol: float,
+    extra_param_sets: Optional[List[Dict[str, Any]]] = None,
+) -> List[Dict[str, Any]]:
+    """Solve per param map from sufficient statistics (shared by the in-core and
+    streaming out-of-core paths; ops/streaming.py accumulates the same stats). The
+    column std for standardization comes from diag(A): var = (ΣwX² - n·x̄²)/(n-1)."""
     if standardize:
         # unbiased column std, Spark's Summarizer convention (reference utils.py:876-982)
-        _, var, _ = weighted_moments(X, w)
-        scale = jnp.sqrt(var)
+        var = (jnp.diagonal(A) - n * xbar * xbar) / jnp.maximum(n - 1.0, 1.0)
+        scale = jnp.sqrt(jnp.maximum(var, 0.0))
         scale = jnp.where(scale <= 0.0, 1.0, scale)
     else:
         scale = jnp.ones_like(xbar)

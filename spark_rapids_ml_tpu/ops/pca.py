@@ -50,6 +50,13 @@ def pca_fit(X: jax.Array, w: jax.Array, k: int) -> Dict[str, np.ndarray]:
     sample weights. Returns host-side model attributes (the analog of the model row the
     reference collects, feature.py:260-285)."""
     cov, mean, wsum = weighted_covariance(X, w)
+    return pca_attrs_from_cov(cov, mean, wsum, k)
+
+
+def pca_attrs_from_cov(
+    cov: jax.Array, mean: jax.Array, wsum: jax.Array, k: int
+) -> Dict[str, np.ndarray]:
+    """Model attributes from a (possibly streamed, ops/streaming.py) covariance."""
     vals, vecs, total_var = _pca_from_cov(cov, k)
     n = float(wsum)
     vals_h = np.asarray(vals, dtype=np.float64)

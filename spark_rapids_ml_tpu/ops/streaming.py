@@ -1,0 +1,133 @@
+#
+# Out-of-core fitting: streamed sufficient-statistics accumulation.
+#
+# The reference fits datasets larger than device memory through RMM UVM/SAM managed
+# memory (reference utils.py:184-241, SURVEY.md §2.5 last row). TPUs have no UVM;
+# the TPU-native answer (SURVEY.md §7 "hard parts") is to stream host batches through
+# the device and ACCUMULATE the model-sufficient statistics on device:
+#   * PCA / LinearRegression: (XᵀWX, XᵀWy, Σwx, Σwy, Σw) accumulate exactly —
+#     the fit result is IDENTICAL to the in-core path, with device residency bounded
+#     by one batch + the d×d stats,
+#   * KMeans: per-pass Lloyd over batches (minibatch-free exact variant: each
+#     iteration streams all batches, accumulating one-hotᵀX sums and counts).
+# Estimators switch to this path automatically when the padded design matrix would
+# exceed `config` threshold SRML_TPU_STREAM_THRESHOLD_BYTES (see core/estimator.py).
+#
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ._precision import pdot
+
+
+@jax.jit
+def _accum_linreg(carry, X, y, w):
+    A, b, sx, sy, sw = carry
+    Xw = X * w[:, None]
+    return (
+        A + pdot(Xw.T, X),
+        b + pdot(Xw.T, y),
+        sx + pdot(w, X),
+        sy + jnp.sum(w * y),
+        sw + jnp.sum(w),
+    )
+
+
+@jax.jit
+def _accum_cov(carry, X, w):
+    S2, sx, sw = carry
+    return (
+        S2 + pdot((X * w[:, None]).T, X),
+        sx + pdot(w, X),
+        sw + jnp.sum(w),
+    )
+
+
+def streaming_linreg_stats(
+    X: np.ndarray,
+    y: np.ndarray,
+    w: Optional[np.ndarray],
+    batch_rows: int,
+    mesh=None,
+    float32: bool = True,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Streamed (XᵀWX, XᵀWy, x̄, ȳ, Σw): the same statistics as
+    ops/linear.linreg_sufficient_stats but with O(batch) device residency.
+    Each batch is device_put (sharded over the mesh when given) and accumulated.
+    dtype follows float32 (float64 additionally needs jax x64 mode, matching the
+    in-core path's device behavior)."""
+    from ..parallel.mesh import shard_array
+    from ..parallel.partition import pad_rows
+
+    dt = np.float32 if float32 else np.float64
+    d = X.shape[1]
+    A = jnp.zeros((d, d), dt)
+    b = jnp.zeros((d,), dt)
+    sx = jnp.zeros((d,), dt)
+    sy = jnp.zeros((), dt)
+    sw = jnp.zeros((), dt)
+    carry = (A, b, sx, sy, sw)
+
+    n = X.shape[0]
+    for s in range(0, n, batch_rows):
+        e = min(s + batch_rows, n)
+        Xb = np.ascontiguousarray(X[s:e], dtype=dt)
+        yb = np.ascontiguousarray(y[s:e], dtype=dt)
+        wb = (
+            np.ones((e - s,), dt)
+            if w is None
+            else np.ascontiguousarray(w[s:e], dtype=dt)
+        )
+        if mesh is not None:
+            Xb, pad_w, (yb_p, wb_p) = pad_rows(Xb, mesh.devices.size, yb, wb)
+            Xb = shard_array(Xb, mesh)
+            yb = shard_array(yb_p, mesh)
+            wb = shard_array(pad_w * wb_p, mesh)
+        carry = _accum_linreg(carry, jnp.asarray(Xb), jnp.asarray(yb), jnp.asarray(wb))
+    A, b, sx, sy, sw = carry
+    return A, b, sx / sw, sy / sw, sw
+
+
+def streaming_covariance(
+    X: np.ndarray,
+    w: Optional[np.ndarray],
+    batch_rows: int,
+    mesh=None,
+    float32: bool = True,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Streamed weighted covariance (cov, mean, Σw) for PCA — the same math as
+    ops/linalg.weighted_covariance, dtype per `float32` (see streaming_linreg_stats)."""
+    from ..parallel.mesh import shard_array
+    from ..parallel.partition import pad_rows
+
+    dt = np.float32 if float32 else np.float64
+    d = X.shape[1]
+    carry = (
+        jnp.zeros((d, d), dt),
+        jnp.zeros((d,), dt),
+        jnp.zeros((), dt),
+    )
+    n = X.shape[0]
+    for s in range(0, n, batch_rows):
+        e = min(s + batch_rows, n)
+        Xb = np.ascontiguousarray(X[s:e], dtype=dt)
+        wb = (
+            np.ones((e - s,), dt)
+            if w is None
+            else np.ascontiguousarray(w[s:e], dtype=dt)
+        )
+        if mesh is not None:
+            Xb, pad_w, (wb_p,) = pad_rows(Xb, mesh.devices.size, wb)
+            Xb = shard_array(Xb, mesh)
+            wb = shard_array(pad_w * wb_p, mesh)
+        carry = _accum_cov(carry, jnp.asarray(Xb), jnp.asarray(wb))
+    S2, sx, sw = carry
+    mean = sx / sw
+    cov = (S2 - sw * jnp.outer(mean, mean)) / (sw - 1.0)
+    return cov, mean, sw

@@ -116,3 +116,31 @@ def test_ann_join_filters_invalid(n_devices):
     joined = model.approxSimilarityJoin(pd.DataFrame({"features": list(queries)}))
     assert (joined["distCol"] < np.inf).all()
     assert (joined["item_" + model.getIdCol()] >= 0).all()
+
+
+def test_ivfpq_recall(n_devices):
+    """IVF-PQ with 8-bit codes and generous probes: approximate but useful recall."""
+    items, queries = _data(n_items=600, n_queries=40, d=16, seed=7)
+    est = ApproximateNearestNeighbors(
+        k=10,
+        inputCol="features",
+        algorithm="ivfpq",
+        algoParams={"nlist": 8, "nprobe": 8, "M": 4, "n_bits": 8},
+    )
+    est.num_workers = n_devices
+    model = est.fit(pd.DataFrame({"features": list(items)}))
+    _, _, knn_df = model.kneighbors(pd.DataFrame({"features": list(queries)}))
+    sk = SkNN(n_neighbors=10).fit(items)
+    _, sk_idx = sk.kneighbors(queries)
+    got = np.stack(knn_df["indices"].to_numpy())
+    recall = np.mean([len(set(g) & set(s)) / 10.0 for g, s in zip(got, sk_idx)])
+    assert recall > 0.9  # ADC candidates + exact refine (default refine_ratio=2)
+
+
+def test_ivfpq_bad_subvector_split():
+    items, _ = _data(n_items=50, d=10, seed=8)
+    est = ApproximateNearestNeighbors(
+        k=3, inputCol="features", algorithm="ivfpq", algoParams={"M": 3}
+    )
+    with pytest.raises(ValueError, match="divisible"):
+        est.fit(pd.DataFrame({"features": list(items)}))

@@ -1,0 +1,79 @@
+"""Streamed out-of-core fit path (ops/streaming.py — the TPU analog of the
+reference's UVM/SAM managed-memory fits, utils.py:184-241): forcing a tiny stream
+threshold must give results numerically identical to the in-core path."""
+
+import numpy as np
+import pandas as pd
+import pytest
+from sklearn.datasets import make_regression
+
+from spark_rapids_ml_tpu import config
+from spark_rapids_ml_tpu.feature import PCA
+from spark_rapids_ml_tpu.regression import LinearRegression
+
+
+@pytest.fixture
+def tiny_stream_threshold():
+    config.set("stream_threshold_bytes", 1024)  # force streaming for any real dataset
+    config.set("stream_batch_rows", 64)
+    yield
+    config.unset("stream_threshold_bytes")
+    config.unset("stream_batch_rows")
+
+
+def test_streaming_pca_matches_incore(n_devices, tiny_stream_threshold):
+    rng = np.random.default_rng(0)
+    X = (rng.normal(size=(500, 12)) * np.linspace(1, 3, 12)).astype(np.float32)
+    df = pd.DataFrame({"features": list(X)})
+    streamed = PCA(k=3, inputCol="features").fit(df)
+
+    config.set("stream_threshold_bytes", 1 << 40)  # disable streaming
+    incore = PCA(k=3, inputCol="features").fit(df)
+
+    np.testing.assert_allclose(streamed.mean, incore.mean, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(
+        streamed.components_, incore.components_, rtol=1e-4, atol=1e-4
+    )
+    np.testing.assert_allclose(
+        streamed.explained_variance_, incore.explained_variance_, rtol=1e-4
+    )
+
+
+def test_streaming_linreg_matches_incore(n_devices, tiny_stream_threshold):
+    X, y, _ = make_regression(
+        n_samples=700, n_features=10, noise=2.0, coef=True, random_state=1
+    )
+    df = pd.DataFrame(
+        {"features": list(X.astype(np.float32)), "label": y.astype(np.float32)}
+    )
+    streamed = LinearRegression(regParam=0.1).fit(df)
+
+    config.set("stream_threshold_bytes", 1 << 40)
+    incore = LinearRegression(regParam=0.1).fit(df)
+
+    np.testing.assert_allclose(
+        streamed.coefficients, incore.coefficients, rtol=1e-4, atol=1e-4
+    )
+    np.testing.assert_allclose(streamed.intercept, incore.intercept, rtol=1e-3, atol=1e-3)
+
+
+def test_streaming_weighted(n_devices, tiny_stream_threshold):
+    rng = np.random.default_rng(2)
+    X = rng.normal(size=(300, 6)).astype(np.float32)
+    y = (X @ rng.normal(size=6)).astype(np.float32)
+    w = rng.uniform(0.1, 2.0, 300).astype(np.float32)
+    df = pd.DataFrame({"features": list(X), "label": y, "w": w})
+    streamed = LinearRegression(weightCol="w").fit(df)
+    from sklearn.linear_model import LinearRegression as SkLR
+
+    sk = SkLR().fit(X.astype(np.float64), y, sample_weight=w)
+    np.testing.assert_allclose(streamed.coefficients, sk.coef_, rtol=1e-3, atol=1e-3)
+
+
+def test_kmeans_has_no_streaming_path_yet(n_devices, tiny_stream_threshold):
+    """Estimators without a streaming fit keep the in-core path even over threshold."""
+    from spark_rapids_ml_tpu.clustering import KMeans
+
+    X = np.random.default_rng(3).normal(size=(200, 4)).astype(np.float32)
+    model = KMeans(k=2, seed=1).fit(pd.DataFrame({"features": list(X)}))
+    assert model.cluster_centers_.shape == (2, 4)
