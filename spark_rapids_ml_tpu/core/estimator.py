@@ -160,6 +160,40 @@ class _TpuCaller(_TpuClass, _TpuParams):
             row_id=fd.row_id,
         )
 
+    def _build_fit_inputs_from_global(
+        self,
+        X_global: Any,
+        row_weight_global: Any,
+        label_global: Optional[Any],
+        total_rows: int,
+        mesh: Any,
+        rank_rows: Optional[List[int]] = None,
+    ) -> FitInputs:
+        """FitInputs from pre-placed GLOBAL arrays (multi-host Spark path,
+        spark/integration.py: each process contributed its local shard via
+        jax.make_array_from_process_local_data). `rank_rows` carries the true
+        per-process real-row counts when the caller knows them (allGathered
+        PartitionInfo); otherwise a contiguous layout is assumed."""
+        n_dev = mesh.devices.size
+        padded_m = X_global.shape[0]
+        if rank_rows is None:
+            shard = padded_m // n_dev
+            rank_rows = [
+                max(0, min(total_rows - r * shard, shard)) for r in range(n_dev)
+            ]
+        desc = PartitionDescriptor.build(
+            rank_rows, X_global.shape[1], padded_m=padded_m
+        )
+        return FitInputs(
+            features=X_global,
+            row_weight=row_weight_global,
+            label=label_global,
+            desc=desc,
+            mesh=mesh,
+            params=dict(self._tpu_params),
+            dtype=np.float32 if self._float32_inputs else np.float64,
+        )
+
     def _call_tpu_fit_func(
         self, dataset: Any, extra_params: Optional[List[Dict[str, Any]]] = None
     ) -> List[Dict[str, Any]]:

@@ -168,6 +168,8 @@ def ivfpq_build(
     n, d = X.shape
     if d % m_subvectors != 0:
         raise ValueError(f"n features {d} not divisible by pq m={m_subvectors}")
+    if not 1 <= n_bits <= 8:
+        raise ValueError(f"n_bits must be in [1, 8] (uint8 codes), got {n_bits}")
     sub_d = d // m_subvectors
     n_codes = 2**n_bits
     flat = ivfflat_build(X, w, nlist, max_iter, seed, return_assign=True)
@@ -217,7 +219,7 @@ def ivfpq_build(
     }
 
 
-@functools.partial(jax.jit, static_argnames=("k", "nprobe"))
+@functools.partial(jax.jit, static_argnames=("k", "nprobe", "block"))
 def ivfpq_search(
     Q: jax.Array,
     centers: jax.Array,  # (nlist, d)
@@ -226,45 +228,55 @@ def ivfpq_search(
     cell_ids: jax.Array,  # (nlist, max_cell)
     k: int,
     nprobe: int,
-) -> Tuple[jax.Array, jax.Array]:
+    block: int = 256,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Asymmetric-distance (ADC) probe search: per query, build the (m, n_codes)
     lookup table of residual-subvector distances to each probed cell's center, then
-    score codes by LUT gathers. Returns (approx euclidean distances, item ids)."""
+    score codes by LUT gathers. The LUT uses the ‖a‖²-2ab+‖b‖² expansion (no
+    (…, n_codes, sub_d) broadcast intermediate) and queries run in blocks to bound
+    HBM. Returns (approx euclidean distances, item ids, flat candidate positions)."""
     nlist, max_cell, m = codes.shape
     n_codes, sub_d = codebooks.shape[1], codebooks.shape[2]
     nq, d = Q.shape
-
-    cd2 = _block_sq_dists(Q, centers)  # (nq, nlist)
-    _, probe = jax.lax.top_k(-cd2, nprobe)  # (nq, nprobe)
-
-    # per (query, probed cell): residual q - center, split into m subvectors
-    qres = Q[:, None, :] - centers[probe]  # (nq, nprobe, d)
-    qsub = qres.reshape(nq, nprobe, m, sub_d)
-    # LUT[nq, nprobe, m, n_codes] = ||qsub - codebook||²
-    diff = qsub[:, :, :, None, :] - codebooks[None, None, :, :, :]
-    lut = jnp.sum(diff * diff, axis=-1)
-
-    cell_codes = codes[probe].astype(jnp.int32)  # (nq, nprobe, max_cell, m)
-    # gather LUT entries per code: sum over m subspaces
-    lut_t = jnp.swapaxes(lut, 2, 3)  # (nq, nprobe, n_codes, m)
-    d2 = jnp.sum(
-        jnp.take_along_axis(
-            lut_t, cell_codes, axis=2
-        ),
-        axis=-1,
-    )  # (nq, nprobe, max_cell)
-
-    probed_ids = cell_ids[probe]  # (nq, nprobe, max_cell)
-    flat_ids = probed_ids.reshape(nq, -1)
-    flat_d2 = jnp.where(flat_ids >= 0, d2.reshape(nq, -1), jnp.inf)
+    cb2 = jnp.sum(codebooks * codebooks, axis=-1)  # (m, n_codes)
     k_eff = min(k, nprobe * max_cell)
-    neg, pos = jax.lax.top_k(-flat_d2, k_eff)
-    ids = jnp.take_along_axis(flat_ids, pos, axis=1)
-    dists = jnp.sqrt(jnp.maximum(-neg, 0.0))
-    # candidate positions in the (nlist*max_cell) flattened cell layout, for refine
-    probe_of_pos = jnp.take_along_axis(probe, pos // max_cell, axis=1)
-    flat_pos = probe_of_pos * max_cell + pos % max_cell
-    return jnp.where(ids >= 0, dists, jnp.inf), ids, flat_pos
+
+    def search_block(qb):
+        bq = qb.shape[0]
+        cd2 = _block_sq_dists(qb, centers)  # (bq, nlist)
+        _, probe = jax.lax.top_k(-cd2, nprobe)  # (bq, nprobe)
+
+        qres = qb[:, None, :] - centers[probe]  # (bq, nprobe, d)
+        qsub = qres.reshape(bq, nprobe, m, sub_d)
+        # LUT[bq, nprobe, m, n_codes] = ‖qsub‖² - 2·qsub·cb + ‖cb‖²
+        cross = jnp.einsum("qpms,mcs->qpmc", qsub, codebooks, precision=FAST)
+        q2 = jnp.sum(qsub * qsub, axis=-1)[..., None]
+        lut = jnp.maximum(q2 - 2.0 * cross + cb2[None, None], 0.0)
+
+        cell_codes = codes[probe].astype(jnp.int32)  # (bq, nprobe, max_cell, m)
+        lut_t = jnp.swapaxes(lut, 2, 3)  # (bq, nprobe, n_codes, m)
+        d2 = jnp.sum(
+            jnp.take_along_axis(lut_t, cell_codes, axis=2), axis=-1
+        )  # (bq, nprobe, max_cell)
+
+        probed_ids = cell_ids[probe]
+        flat_ids = probed_ids.reshape(bq, -1)
+        flat_d2 = jnp.where(flat_ids >= 0, d2.reshape(bq, -1), jnp.inf)
+        neg, pos = jax.lax.top_k(-flat_d2, k_eff)
+        ids = jnp.take_along_axis(flat_ids, pos, axis=1)
+        dists = jnp.sqrt(jnp.maximum(-neg, 0.0))
+        probe_of_pos = jnp.take_along_axis(probe, pos // max_cell, axis=1)
+        flat_pos = probe_of_pos * max_cell + pos % max_cell
+        return jnp.where(ids >= 0, dists, jnp.inf), ids, flat_pos
+
+    pad = (-nq) % block
+    Qp = jnp.pad(Q, ((0, pad), (0, 0)))
+    db, ib, pb = jax.lax.map(search_block, Qp.reshape(-1, block, d))
+    return (
+        db.reshape(-1, k_eff)[:nq],
+        ib.reshape(-1, k_eff)[:nq],
+        pb.reshape(-1, k_eff)[:nq],
+    )
 
 
 @functools.partial(jax.jit, static_argnames=("k",))

@@ -1,0 +1,27 @@
+#
+# `pyspark-tpu` launcher — role of the reference's `pyspark-rapids` CLI
+# (reference pyspark_rapids.py:24-44): start a pyspark shell with the
+# no-import-change interposer pre-imported via PYTHONSTARTUP.
+#
+
+from __future__ import annotations
+
+import os
+import shutil
+import sys
+
+
+def main() -> None:
+    pyspark_bin = shutil.which("pyspark")
+    if pyspark_bin is None:
+        raise SystemExit(
+            "pyspark not found on PATH; install pyspark to use the pyspark-tpu shell."
+        )
+    startup = os.path.join(os.path.dirname(os.path.abspath(__file__)), "install.py")
+    env = dict(os.environ)
+    env["PYTHONSTARTUP"] = startup
+    os.execve(pyspark_bin, [pyspark_bin] + sys.argv[1:], env)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,16 @@
+# Spark integration layer (L3 of the layer map): barrier-task fan-out glue that runs
+# the TPU SPMD fit from inside a Spark cluster. Requires pyspark at call time; the
+# pure bookkeeping helpers are importable (and tested) without it.
+from .integration import (
+    PartitionInfo,
+    decode_partition_info,
+    encode_partition_info,
+    fit_on_spark,
+)
+
+__all__ = [
+    "PartitionInfo",
+    "decode_partition_info",
+    "encode_partition_info",
+    "fit_on_spark",
+]

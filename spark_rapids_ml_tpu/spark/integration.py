@@ -1,0 +1,191 @@
+#
+# Spark barrier-task fan-out for TPU SPMD fits — the structural replacement for the
+# reference's `dataset.mapInPandas(_train_udf).rdd.barrier()` execution pattern
+# (reference core.py:845-1011) on a TPU-attached Spark cluster.
+#
+# Architecture (one barrier task per TPU HOST, not per chip — SURVEY.md §7 notes the
+# worker=host topology change vs the reference's task↔GPU pinning):
+#   1. each task concatenates its partition's Arrow batches to host arrays,
+#   2. the barrier allGather carries (a) the jax.distributed coordinator address the
+#      way the reference carries the NCCL uid (cuml_context.py:75-110), and (b) the
+#      per-task PartitionInfo (row counts) the way the reference builds its
+#      PartitionDescriptor (utils.py:325-355),
+#   3. jax.distributed.initialize links the hosts; a global mesh spans the pod,
+#   4. every task places its rows into the global array via
+#      jax.make_array_from_process_local_data and runs the SAME jitted fit program —
+#      collectives ride ICI/DCN; rank 0 yields the model-attribute row.
+#
+# pyspark is imported lazily: this module parses/serializes and orchestrates, and is
+# testable without Spark down to the UDF boundary.
+#
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..utils import get_logger
+
+
+@dataclass
+class PartitionInfo:
+    """Per-barrier-task facts exchanged via allGather (the reference's
+    PartitionDescriptor payload, utils.py:325-355)."""
+
+    rank: int
+    n_rows: int
+    coordinator: str = ""  # rank 0 advertises host:port for jax.distributed
+
+
+def encode_partition_info(info: PartitionInfo) -> str:
+    return json.dumps({"rank": info.rank, "n_rows": info.n_rows, "coordinator": info.coordinator})
+
+
+def decode_partition_info(payloads: List[str]) -> List[PartitionInfo]:
+    infos = [PartitionInfo(**json.loads(p)) for p in payloads]
+    return sorted(infos, key=lambda i: i.rank)
+
+
+def _collect_partition(pdf_iter, input_col: Optional[str], input_cols, label_col, weight_col):
+    """Concatenate a task's pandas batches into host arrays (the reference's
+    executor-side HOT LOOP 1, core.py:906-941)."""
+    import pandas as pd
+
+    from ..core.dataset import extract_feature_data
+
+    pdfs = [pdf for pdf in pdf_iter]
+    pdf = pd.concat(pdfs, ignore_index=True) if len(pdfs) != 1 else pdfs[0]
+    return extract_feature_data(
+        pdf,
+        input_col=input_col,
+        input_cols=input_cols,
+        label_col=label_col,
+        weight_col=weight_col,
+    )
+
+
+def _barrier_train_udf(estimator_payload: bytes) -> Callable:
+    """Build the barrier mapInPandas UDF. Runs on executors; requires pyspark."""
+    import pickle
+
+    def train_udf(pdf_iter):
+        import pandas as pd
+        from pyspark import BarrierTaskContext
+
+        from ..parallel.bootstrap import init_process_group
+        from ..parallel.mesh import get_mesh
+
+        est = pickle.loads(estimator_payload)
+        ctx = BarrierTaskContext.get()
+        rank = ctx.partitionId()
+        n_tasks = ctx.getTaskInfos().__len__()
+
+        input_col, input_cols = est._get_input_columns()
+        fd = _collect_partition(
+            pdf_iter,
+            input_col,
+            input_cols,
+            est.getOrDefault("labelCol") if est.hasParam("labelCol") else None,
+            est.getOrDefault("weightCol")
+            if est.hasParam("weightCol") and est.isDefined("weightCol")
+            else None,
+        )
+
+        # control plane: coordinator + partition sizes in ONE allGather round.
+        # rank 0's reachable address comes from Spark's own task info (hostname
+        # resolution can map to loopback); the port is a freshly-probed ephemeral
+        # port, so concurrent jobs on one host don't collide.
+        coordinator = ""
+        if rank == 0:
+            import socket
+
+            host = ctx.getTaskInfos()[0].address.split(":")[0]
+            probe = socket.socket()
+            probe.bind(("", 0))
+            port = probe.getsockname()[1]
+            probe.close()
+            coordinator = f"{host}:{port}"
+        payloads = ctx.allGather(
+            encode_partition_info(PartitionInfo(rank, fd.n_rows, coordinator))
+        )
+        infos = decode_partition_info(payloads)
+        init_process_group(
+            coordinator_address=next(i.coordinator for i in infos if i.coordinator),
+            num_processes=n_tasks,
+            process_id=rank,
+        )
+
+        # global mesh over the pod; every host pads its rows to the common local
+        # size (XLA needs equal shards), real rows marked by the weight vector
+        import jax
+
+        mesh = get_mesh()
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        max_rows = max(i.n_rows for i in infos)
+        local_devices = jax.local_device_count()
+        pad_to = -(-max_rows // (8 * local_devices)) * (8 * local_devices)
+        X_local = np.zeros((pad_to, fd.n_cols), np.float32)
+        X_local[: fd.n_rows] = np.asarray(fd.features, dtype=np.float32)
+        w_local = np.zeros((pad_to,), np.float32)
+        w_local[: fd.n_rows] = 1.0 if fd.weight is None else fd.weight
+        total_rows = sum(i.n_rows for i in infos)
+
+        sharding2 = NamedSharding(mesh, P("data", None))
+        sharding1 = NamedSharding(mesh, P("data"))
+        X_global = jax.make_array_from_process_local_data(sharding2, X_local)
+        w_global = jax.make_array_from_process_local_data(sharding1, w_local)
+        label_global = None
+        if fd.label is not None:
+            y_local = np.zeros((pad_to,), np.float32)
+            y_local[: fd.n_rows] = fd.label
+            label_global = jax.make_array_from_process_local_data(sharding1, y_local)
+
+        # run the estimator's fit program (same SPMD program on every host)
+        fit_inputs = est._build_fit_inputs_from_global(
+            X_global, w_global, label_global, total_rows, mesh,
+            rank_rows=[i.n_rows for i in infos],
+        )
+        attrs = est._get_tpu_fit_func(None)(fit_inputs)
+
+        if rank == 0:
+            import pickle as _p
+
+            yield pd.DataFrame({"model": [_p.dumps(attrs)]})
+        else:
+            yield pd.DataFrame({"model": []})
+
+    return train_udf
+
+
+def fit_on_spark(estimator: Any, spark_df: Any, num_hosts: int) -> Any:
+    """Driver-side: run a TPU estimator's fit as barrier tasks on a Spark cluster.
+
+    `num_hosts` is the number of TPU HOSTS (== barrier tasks == jax processes), NOT
+    the chip count: each host process owns all its local chips and the global mesh
+    spans num_hosts × local_device_count devices (SURVEY.md §7's worker=host
+    topology). Requires pyspark."""
+    import pickle
+
+    if num_hosts < 1:
+        raise ValueError(f"num_hosts must be >= 1, got {num_hosts}")
+    logger = get_logger("spark.integration")
+    df = spark_df.repartition(num_hosts)
+    udf = _barrier_train_udf(pickle.dumps(estimator))
+    rows = (
+        df.mapInPandas(udf, schema="model binary")
+        .rdd.barrier()
+        .mapPartitions(lambda it: it)
+        .collect()
+    )
+    payload = next(r["model"] for r in rows if r["model"] is not None)
+    attrs = pickle.loads(bytes(payload))
+    model = estimator._create_pyspark_model(attrs)
+    model._num_workers = estimator._num_workers
+    model._float32_inputs = estimator._float32_inputs
+    estimator._copyValues(model)
+    logger.info("fit_on_spark complete: %s", type(model).__name__)
+    return model

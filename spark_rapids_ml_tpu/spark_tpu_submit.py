@@ -1,0 +1,46 @@
+#
+# `spark-tpu-submit` launcher — role of the reference's `spark-rapids-submit` CLI
+# (reference spark_rapids_submit.py:23-49): spark-submit wrapper that inserts the
+# package's __main__ runner as the driver script so user scripts get the
+# no-import-change interposer.
+#
+
+from __future__ import annotations
+
+import os
+import shutil
+import sys
+
+
+def main() -> None:
+    submit_bin = shutil.which("spark-submit")
+    if submit_bin is None:
+        raise SystemExit(
+            "spark-submit not found on PATH; install Spark to use spark-tpu-submit."
+        )
+    runner = os.path.join(os.path.dirname(os.path.abspath(__file__)), "__main__.py")
+    # find the application script: the first non-option argument, skipping the VALUES
+    # of value-taking spark-submit options (--py-files deps.py must not match)
+    args = sys.argv[1:]
+    i = 0
+    app_idx = None
+    while i < len(args):
+        a = args[i]
+        if a.startswith("-"):
+            # all spark-submit long options except --verbose/--supervise take a value
+            if "=" not in a and a not in ("--verbose", "-v", "--supervise", "--help", "-h"):
+                i += 1  # skip the option's value
+        elif a.endswith(".py"):
+            app_idx = i
+            break
+        else:  # non-.py application (jar) — not ours to wrap
+            raise SystemExit("spark-tpu-submit requires a .py application")
+        i += 1
+    if app_idx is None:
+        raise SystemExit("no .py application found in arguments")
+    args = args[:app_idx] + [runner, args[app_idx]] + args[app_idx + 1 :]
+    os.execv(submit_bin, [submit_bin] + args)
+
+
+if __name__ == "__main__":
+    main()

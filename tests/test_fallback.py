@@ -75,3 +75,20 @@ def test_overwrite_save_clears_stale_files(tmp_path, n_devices):
     est.write().overwrite().save(path)
     loaded = PCA.load(path)  # must not resurrect the old model's attributes
     assert loaded.getK() == 4
+
+
+def test_pca_fallback_fit(n_devices):
+    """PCA with an unsupported param value falls back to the sklearn twin and still
+    produces a working model (regression guard: _fit_fallback_model must coexist
+    with _streaming_fit)."""
+    df, X = _df(n=60, d=5)
+    est = PCA(k=2, inputCol="features")
+    est._fallback_requested_params = {"synthetic_reason"}
+    assert est._use_cpu_fallback()
+    model = est.fit(df)
+    from sklearn.decomposition import PCA as SkPCA
+
+    sk = SkPCA(n_components=2).fit(X.astype(np.float64))
+    np.testing.assert_allclose(
+        np.abs(model.components_), np.abs(sk.components_), atol=1e-4
+    )

@@ -1,0 +1,88 @@
+"""Spark integration layer — the pyspark-free testable parts (control-plane payloads,
+global-array fit-input construction). The full barrier flow needs a Spark cluster and
+is exercised there (spark/integration.py)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_ml_tpu.spark import (
+    PartitionInfo,
+    decode_partition_info,
+    encode_partition_info,
+)
+
+
+def test_partition_info_roundtrip():
+    infos = [
+        PartitionInfo(rank=2, n_rows=10),
+        PartitionInfo(rank=0, n_rows=7, coordinator="10.0.0.1:8476"),
+        PartitionInfo(rank=1, n_rows=9),
+    ]
+    payloads = [encode_partition_info(i) for i in infos]
+    decoded = decode_partition_info(payloads)
+    assert [i.rank for i in decoded] == [0, 1, 2]  # rank-sorted
+    assert decoded[0].coordinator == "10.0.0.1:8476"
+    assert sum(i.n_rows for i in decoded) == 26
+
+
+def test_build_fit_inputs_from_global(n_devices):
+    """Single-process stand-in for the multi-host path: global arrays in,
+    descriptor + kernel-ready FitInputs out; a real fit runs on them."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from spark_rapids_ml_tpu.feature import PCA
+    from spark_rapids_ml_tpu.ops.pca import pca_fit
+    from spark_rapids_ml_tpu.parallel.mesh import get_mesh
+
+    rng = np.random.default_rng(0)
+    n_real, pad_to = 100, 128
+    X = np.zeros((pad_to, 6), np.float32)
+    X[:n_real] = rng.normal(size=(n_real, 6))
+    w = np.zeros((pad_to,), np.float32)
+    w[:n_real] = 1.0
+
+    mesh = get_mesh()
+    Xg = jax.device_put(X, NamedSharding(mesh, P("data", None)))
+    wg = jax.device_put(w, NamedSharding(mesh, P("data")))
+
+    est = PCA(k=2, inputCol="features")
+    inputs = est._build_fit_inputs_from_global(Xg, wg, None, n_real, mesh)
+    assert inputs.desc.m == n_real
+    assert inputs.desc.padded_m == pad_to
+    attrs = pca_fit(inputs.features, inputs.row_weight, 2)
+    np.testing.assert_allclose(attrs["mean"], X[:n_real].mean(0), atol=1e-4)
+
+
+def test_launchers_require_spark(monkeypatch):
+    from spark_rapids_ml_tpu import pyspark_tpu, spark_tpu_submit
+
+    # never exec a real binary from under pytest, even when Spark is installed
+    monkeypatch.setattr("shutil.which", lambda name: None)
+    with pytest.raises(SystemExit, match="pyspark not found"):
+        pyspark_tpu.main()
+    with pytest.raises(SystemExit, match="spark-submit not found"):
+        spark_tpu_submit.main()
+
+
+def test_submit_script_detection(monkeypatch):
+    """Option values ending in .py (--py-files deps.py) must not be mistaken for the
+    application script."""
+    from spark_rapids_ml_tpu import spark_tpu_submit
+
+    captured = {}
+    monkeypatch.setattr("shutil.which", lambda name: "/usr/bin/spark-submit")
+    monkeypatch.setattr(
+        "os.execv", lambda path, argv: captured.update(path=path, argv=argv)
+    )
+    monkeypatch.setattr(
+        "sys.argv",
+        ["spark-tpu-submit", "--py-files", "deps.py", "--master", "yarn", "app.py", "arg1"],
+    )
+    spark_tpu_submit.main()
+    argv = captured["argv"]
+    # runner inserted immediately before app.py, deps.py untouched
+    runner_idx = next(i for i, a in enumerate(argv) if a.endswith("__main__.py"))
+    assert argv[runner_idx + 1] == "app.py"
+    assert argv[argv.index("--py-files") + 1] == "deps.py"
