@@ -43,7 +43,7 @@ class _KMeansClass(_TpuClass):
             "initMode": "init",
             "initSteps": "init_steps",
             "seed": "random_state",
-            "distanceMeasure": None,  # euclidean only; cosine falls back
+            "distanceMeasure": "metric",  # euclidean + cosine (spherical kmeans)
             "featuresCol": "",
             "predictionCol": "",
             "weightCol": "",
@@ -59,6 +59,7 @@ class _KMeansClass(_TpuClass):
             "init": lambda x: (
                 x if x in ("k-means||", "scalable-k-means++", "random") else None
             ),
+            "metric": lambda x: x if x in ("euclidean", "cosine") else None,
         }
 
     @classmethod
@@ -70,6 +71,7 @@ class _KMeansClass(_TpuClass):
             "init": "k-means||",
             "init_steps": 2,
             "random_state": 1,
+            "metric": "euclidean",
             "n_init": 1,  # Spark parity (reference clustering.py:317-319)
         }
 
@@ -184,6 +186,7 @@ class KMeans(_KMeansClass, _TpuEstimator, _KMeansParams):
                 init=str(p["init"]),
                 init_steps=int(p["init_steps"]),
                 seed=int(p["random_state"]) if p["random_state"] is not None else 1,
+                metric=str(p.get("metric", "euclidean")),
             )
 
         return _fit
@@ -194,8 +197,9 @@ class KMeans(_KMeansClass, _TpuEstimator, _KMeansParams):
     def _fit_fallback_model(self, twin: type, fd) -> Dict[str, Any]:
         if self.getOrDefault("distanceMeasure") != "euclidean":
             raise ValueError(
-                "distanceMeasure='cosine' is supported neither by the TPU backend nor "
-                "by the sklearn CPU fallback; use the pyspark.ml KMeans for cosine."
+                "The sklearn CPU fallback cannot preserve distanceMeasure='cosine' "
+                "(cosine IS supported on the TPU path; remove the other unsupported "
+                f"params {getattr(self, '_fallback_requested_params', set())} to use it)."
             )
         X = densify(fd.features, float32=self._float32_inputs)
         init = self.getOrDefault("initMode")
@@ -225,7 +229,11 @@ class KMeansModel(_KMeansClass, _TpuModelWithPredictionCol, _KMeansParams):
             inertia=float(inertia),
             n_iter=int(n_iter),
         )
-        self._setDefault(featuresCol="features", predictionCol="prediction")
+        self._setDefault(
+            featuresCol="features",
+            predictionCol="prediction",
+            distanceMeasure="euclidean",
+        )
 
     def clusterCenters(self) -> List[np.ndarray]:
         """Spark MLlib KMeansModel surface."""
@@ -239,11 +247,15 @@ class KMeansModel(_KMeansClass, _TpuModelWithPredictionCol, _KMeansParams):
     def inertia_(self) -> float:
         return self._model_attributes["inertia"]
 
+    @property
+    def _cosine(self) -> bool:
+        return self.getOrDefault("distanceMeasure") == "cosine"
+
     def predict(self, value: np.ndarray) -> int:
         """Single-vector prediction (Spark API)."""
         X = np.asarray(value, dtype=np.float32).reshape(1, -1)
-        return int(np.asarray(kmeans_predict(X, self.cluster_centers_))[0])
+        return int(np.asarray(kmeans_predict(X, self.cluster_centers_, self._cosine))[0])
 
     def _transform_arrays(self, X: np.ndarray) -> Dict[str, np.ndarray]:
-        pred = np.asarray(kmeans_predict(X, self.cluster_centers_))
+        pred = np.asarray(kmeans_predict(X, self.cluster_centers_, self._cosine))
         return {self.getOrDefault("predictionCol"): pred.astype(np.int32)}

@@ -42,20 +42,37 @@ def _sq_dists(X: jax.Array, centers: jax.Array) -> jax.Array:
     return jnp.maximum(d2, 0.0)
 
 
-@functools.partial(jax.jit, static_argnames=("max_iter",))
+def _normalize_rows(X: jax.Array) -> jax.Array:
+    norms = jnp.linalg.norm(X, axis=1, keepdims=True)
+    return X / jnp.maximum(norms, 1e-12)
+
+
+@functools.partial(jax.jit, static_argnames=("max_iter", "cosine"))
 def lloyd_fit(
     X: jax.Array,
     w: jax.Array,
     init_centers: jax.Array,
     tol: float,
     max_iter: int,
+    cosine: bool = False,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Lloyd iterations until max center movement² <= tol² or max_iter.
 
     Returns (centers, inertia, n_iter). Convergence on per-center movement matches
     Spark's KMeans semantics (the reference remaps tol=0 to a tiny epsilon,
-    clustering.py:84-141 — callers do that remap)."""
+    clustering.py:84-141 — callers do that remap).
+
+    cosine=True runs spherical kmeans (Spark's distanceMeasure='cosine'): callers
+    pass row-normalized X; centers are re-normalized every update and the cost is
+    Σ w·(1 - x̂·ĉ)."""
     k = init_centers.shape[0]
+    if cosine:
+        init_centers = _normalize_rows(init_centers)
+
+    def _dists(centers):
+        if cosine:
+            return 1.0 - pdot(X, centers.T)
+        return _sq_dists(X, centers)
 
     def cond(state):
         _, _, it, shift2 = state
@@ -63,7 +80,7 @@ def lloyd_fit(
 
     def body(state):
         centers, _, it, _ = state
-        d2 = _sq_dists(X, centers)
+        d2 = _dists(centers)
         assign = jnp.argmin(d2, axis=1)
         min_d2 = jnp.min(d2, axis=1)
         onehot = jax.nn.one_hot(assign, k, dtype=X.dtype) * w[:, None]
@@ -72,6 +89,8 @@ def lloyd_fit(
         new_centers = jnp.where(
             counts[:, None] > 0, sums / jnp.maximum(counts, 1.0)[:, None], centers
         )
+        if cosine:
+            new_centers = _normalize_rows(new_centers)
         inertia = jnp.sum(w * min_d2)
         shift2 = jnp.max(jnp.sum((new_centers - centers) ** 2, axis=1))
         return new_centers, inertia, it + 1, shift2
@@ -79,13 +98,14 @@ def lloyd_fit(
     init_state = (init_centers, jnp.array(0.0, X.dtype), 0, jnp.array(jnp.inf, X.dtype))
     centers, inertia, n_iter, _ = jax.lax.while_loop(cond, body, init_state)
     # inertia reported against the final centers
-    d2 = _sq_dists(X, centers)
-    inertia = jnp.sum(w * jnp.min(d2, axis=1))
+    inertia = jnp.sum(w * jnp.min(_dists(centers), axis=1))
     return centers, inertia, n_iter
 
 
-@jax.jit
-def kmeans_predict(X: jax.Array, centers: jax.Array) -> jax.Array:
+@functools.partial(jax.jit, static_argnames=("cosine",))
+def kmeans_predict(X: jax.Array, centers: jax.Array, cosine: bool = False) -> jax.Array:
+    if cosine:
+        return jnp.argmax(pdot(_normalize_rows(X), _normalize_rows(centers).T), axis=1)
     return jnp.argmin(_sq_dists(X, centers), axis=1)
 
 
@@ -184,9 +204,15 @@ def kmeans_fit(
     init: str,
     init_steps: int,
     seed: int,
+    metric: str = "euclidean",
 ) -> Dict[str, object]:
+    cosine = metric == "cosine"
+    if cosine:
+        X = _normalize_rows(X)  # spherical kmeans operates on the unit sphere
     init_centers = jnp.asarray(kmeans_init(X, w, k, init, init_steps, seed))
-    centers, inertia, n_iter = lloyd_fit(X, w, init_centers, float(tol), int(max_iter))
+    centers, inertia, n_iter = lloyd_fit(
+        X, w, init_centers, float(tol), int(max_iter), cosine=cosine
+    )
     return {
         "cluster_centers": np.asarray(centers),
         "inertia": float(inertia),

@@ -49,22 +49,21 @@ def decode_partition_info(payloads: List[str]) -> List[PartitionInfo]:
     return sorted(infos, key=lambda i: i.rank)
 
 
-def _collect_partition(pdf_iter, input_col: Optional[str], input_cols, label_col, weight_col):
-    """Concatenate a task's pandas batches into host arrays (the reference's
+def _collect_partition(pdf_iter):
+    """Concatenate a task's pandas batches into one DataFrame (the reference's
     executor-side HOT LOOP 1, core.py:906-941)."""
     import pandas as pd
 
-    from ..core.dataset import extract_feature_data
-
     pdfs = [pdf for pdf in pdf_iter]
-    pdf = pd.concat(pdfs, ignore_index=True) if len(pdfs) != 1 else pdfs[0]
-    return extract_feature_data(
-        pdf,
-        input_col=input_col,
-        input_cols=input_cols,
-        label_col=label_col,
-        weight_col=weight_col,
-    )
+    if not pdfs:
+        # an empty barrier partition would abort the whole stage with an opaque
+        # error; match the reference's actionable empty-partition message
+        # (core.py:959-962)
+        raise RuntimeError(
+            "A barrier task received an empty partition. Repartition the input so "
+            "every task holds rows (fewer hosts than rows, avoid skewed keys)."
+        )
+    return pd.concat(pdfs, ignore_index=True) if len(pdfs) != 1 else pdfs[0]
 
 
 def _barrier_train_udf(estimator_payload: bytes) -> Callable:
@@ -83,16 +82,9 @@ def _barrier_train_udf(estimator_payload: bytes) -> Callable:
         rank = ctx.partitionId()
         n_tasks = ctx.getTaskInfos().__len__()
 
-        input_col, input_cols = est._get_input_columns()
-        fd = _collect_partition(
-            pdf_iter,
-            input_col,
-            input_cols,
-            est.getOrDefault("labelCol") if est.hasParam("labelCol") else None,
-            est.getOrDefault("weightCol")
-            if est.hasParam("weightCol") and est.isDefined("weightCol")
-            else None,
-        )
+        # column resolution/casting goes through the SAME prep as the local path
+        # (_use_label gate, float32 handling, idCol — core/estimator.py)
+        fd = est._pre_process_data(_collect_partition(pdf_iter))
 
         # control plane: coordinator + partition sizes in ONE allGather round.
         # rank 0's reachable address comes from Spark's own task info (hostname

@@ -28,7 +28,9 @@ def main() -> None:
         a = args[i]
         if a.startswith("-"):
             # all spark-submit long options except --verbose/--supervise take a value
-            if "=" not in a and a not in ("--verbose", "-v", "--supervise", "--help", "-h"):
+            if "=" not in a and a not in (
+                "--verbose", "-v", "--supervise", "--help", "-h", "--version",
+            ):
                 i += 1  # skip the option's value
         elif a.endswith(".py"):
             app_idx = i

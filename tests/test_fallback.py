@@ -32,10 +32,11 @@ def test_kmeans_fallback_fit_produces_model(n_devices):
     assert set(out["prediction"].unique()) <= {0, 1, 2}
 
 
-def test_kmeans_cosine_fallback_raises_informative():
-    df, _ = _df()
-    with pytest.raises(ValueError, match="cosine"):
-        KMeans(k=2, distanceMeasure="cosine").fit(df)
+def test_kmeans_cosine_native():
+    """cosine distanceMeasure runs natively (spherical kmeans), no fallback."""
+    est = KMeans(k=2, distanceMeasure="cosine")
+    assert not est._use_cpu_fallback()
+    assert est.tpu_params["metric"] == "cosine"
 
 
 def test_fallback_disabled_raises():

@@ -94,3 +94,24 @@ def test_kmeans_uneven_rows(n_devices):
     model = KMeans(k=3, seed=0, maxIter=30).fit(df)
     # all centers near the data, none at the origin
     assert np.all(np.linalg.norm(model.cluster_centers_, axis=1) > 50)
+
+
+def test_kmeans_cosine_clusters_by_direction(n_devices):
+    """Spherical kmeans groups by direction, ignoring magnitude (Spark's
+    distanceMeasure='cosine' semantics)."""
+    rng = np.random.default_rng(0)
+    dirs = np.array([[1.0, 0.0], [0.0, 1.0], [-1.0, 0.0]], dtype=np.float32)
+    y = rng.integers(0, 3, size=240)
+    scales = rng.uniform(0.1, 50.0, size=240)[:, None].astype(np.float32)  # magnitudes vary wildly
+    X = (dirs[y] + rng.normal(scale=0.05, size=(240, 2)).astype(np.float32)) * scales
+    df = pd.DataFrame({"features": list(X)})
+    model = KMeans(k=3, distanceMeasure="cosine", seed=2, maxIter=30).fit(df)
+    pred = model.transform(df)["prediction"].to_numpy()
+    from sklearn.metrics import adjusted_rand_score
+
+    assert adjusted_rand_score(y, pred) > 0.95
+    # centers live on the unit sphere
+    np.testing.assert_allclose(
+        np.linalg.norm(model.cluster_centers_, axis=1), 1.0, atol=1e-4
+    )
+    assert model.predict(X[0]) == pred[0]
