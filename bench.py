@@ -29,6 +29,9 @@ def _device_init_watchdog(timeout_s: float = 240.0) -> None:
     backend so the driver still gets a benchmark line (clearly labeled)."""
     if os.environ.get("SRML_BENCH_NO_WATCHDOG") == "1":
         return
+    marker = "/tmp/.srml_bench_device_ok"
+    if os.path.exists(marker):
+        return  # a prior healthy probe on this machine; skip the double init
     probe = subprocess.Popen(
         [sys.executable, "-c", "import jax; jax.devices()"],
         stdout=subprocess.DEVNULL,
@@ -39,6 +42,12 @@ def _device_init_watchdog(timeout_s: float = 240.0) -> None:
     except subprocess.TimeoutExpired:
         probe.kill()
         rc = -1
+    if rc == 0:
+        try:
+            open(marker, "w").close()
+        except OSError:
+            pass
+        return
     if rc != 0:
         env = dict(os.environ)
         env.update(
@@ -108,16 +117,23 @@ def main() -> None:
                 base = json.load(f)
             if base.get("platform") == platform and base.get("value", 0) > 0:
                 vs_baseline = value / base["value"]
-        else:
+        elif on_tpu:
+            # only a real-TPU run may seed the local baseline; a transient
+            # CPU-fallback run must not poison it
             with open(baseline_path, "w") as f:
                 json.dump({"platform": platform, "value": value, "unit": "rows*iters/sec/chip"}, f)
     except OSError:
         pass
 
+    # a non-TPU run (watchdog fallback) is labeled in the metric name itself so the
+    # recorded number can never masquerade as a TPU result
+    metric = "kmeans_lloyd_rows_per_sec_per_chip"
+    if not on_tpu:
+        metric += f"_{platform}_fallback"
     print(
         json.dumps(
             {
-                "metric": "kmeans_lloyd_rows_per_sec_per_chip",
+                "metric": metric,
                 "value": round(value, 1),
                 "unit": "rows*iters/sec/chip",
                 "vs_baseline": round(vs_baseline, 4),

@@ -257,5 +257,10 @@ class KMeansModel(_KMeansClass, _TpuModelWithPredictionCol, _KMeansParams):
         return int(np.asarray(kmeans_predict(X, self.cluster_centers_, self._cosine))[0])
 
     def _transform_arrays(self, X: np.ndarray) -> Dict[str, np.ndarray]:
+        if self._cosine and not np.all(np.linalg.norm(X, axis=1) > 0):
+            raise ValueError(
+                "Cosine distance is not defined for zero-length vectors; the input "
+                "contains an all-zero feature row."
+            )
         pred = np.asarray(kmeans_predict(X, self.cluster_centers_, self._cosine))
         return {self.getOrDefault("predictionCol"): pred.astype(np.int32)}

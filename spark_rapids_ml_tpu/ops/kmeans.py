@@ -208,6 +208,14 @@ def kmeans_fit(
 ) -> Dict[str, object]:
     cosine = metric == "cosine"
     if cosine:
+        # Spark raises on zero-norm vectors with cosine distance; match it rather
+        # than silently assigning an arbitrary direction
+        min_norm = float(jnp.min(jnp.where(w > 0, jnp.linalg.norm(X, axis=1), jnp.inf)))
+        if min_norm <= 0.0:
+            raise ValueError(
+                "Cosine distance is not defined for zero-length vectors; the input "
+                "contains an all-zero feature row."
+            )
         X = _normalize_rows(X)  # spherical kmeans operates on the unit sphere
     init_centers = jnp.asarray(kmeans_init(X, w, k, init, init_steps, seed))
     centers, inertia, n_iter = lloyd_fit(

@@ -93,3 +93,13 @@ def test_pca_fallback_fit(n_devices):
     np.testing.assert_allclose(
         np.abs(model.components_), np.abs(sk.components_), atol=1e-4
     )
+
+
+def test_kmeans_cosine_with_fallback_params_raises(n_devices):
+    """cosine + another unsupported param: the sklearn fallback cannot preserve
+    cosine, so fit raises with guidance instead of silently going euclidean."""
+    df, _ = _df()
+    est = KMeans(k=2, distanceMeasure="cosine", solver="weird")
+    assert est._use_cpu_fallback()
+    with pytest.raises(ValueError, match="cosine"):
+        est.fit(df)

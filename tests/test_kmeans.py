@@ -115,3 +115,11 @@ def test_kmeans_cosine_clusters_by_direction(n_devices):
         np.linalg.norm(model.cluster_centers_, axis=1), 1.0, atol=1e-4
     )
     assert model.predict(X[0]) == pred[0]
+
+
+def test_kmeans_cosine_zero_vector_raises(n_devices):
+    X = np.zeros((10, 3), dtype=np.float32)
+    X[1:] = 1.0
+    df = pd.DataFrame({"features": list(X)})
+    with pytest.raises(ValueError, match="zero-length"):
+        KMeans(k=2, distanceMeasure="cosine").fit(df)
