@@ -5,13 +5,50 @@
 # is simulated by forcing the host platform device count, never by mocking.
 #
 import os
+import sys
 
-# tests always run on the virtual CPU mesh, even when the ambient env points jax at a
-# real accelerator platform
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+# tests always run on the virtual 8-device CPU mesh, even when the ambient env points
+# jax at a real accelerator platform. Setting env vars here is NOT sufficient on its
+# own: this machine's sitecustomize imports jax at *interpreter startup* (before
+# pytest loads conftest) whenever PALLAS_AXON_POOL_IPS is non-empty, binding jax to
+# the axon TPU platform — and on a wedged tunnel any later jax.devices() hangs the
+# whole suite. The only reliable guard is to re-exec pytest with a clean env so the
+# next interpreter never registers the axon plugin at all.
+_NEEDS_REEXEC = (
+    os.environ.get("JAX_PLATFORMS", "").split(",")[0] != "cpu"
+    or os.environ.get("PALLAS_AXON_POOL_IPS", "127.0.0.1") != ""
+) and os.environ.get("SRML_TESTS_HERMETIC") != "1"
+
+if not _NEEDS_REEXEC:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["PALLAS_AXON_POOL_IPS"] = ""
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+
+
+def _hermetic_reexec(config) -> None:
+    """Replace this pytest process with one whose env can never touch the axon
+    plugin. Must run from pytest_configure (not module import): pytest's global fd
+    capture is active while conftest imports, and an execve at that point leaves the
+    new process writing to the about-to-be-discarded capture fd — the suite then
+    "passes" with zero visible output."""
+    _env = dict(os.environ)
+    _env["JAX_PLATFORMS"] = "cpu"
+    _env["PALLAS_AXON_POOL_IPS"] = ""
+    _env["SRML_TESTS_HERMETIC"] = "1"
+    import re as _re
+
+    _flags = _re.sub(
+        r"--xla_force_host_platform_device_count=\d+", "", _env.get("XLA_FLAGS", "")
+    )
+    _env["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+    capman = config.pluginmanager.getplugin("capturemanager")
+    if capman is not None:
+        capman.stop_global_capturing()
+    os.execve(sys.executable, [sys.executable, "-m", "pytest"] + sys.argv[1:], _env)
 
 import numpy as np
 import pytest
@@ -31,6 +68,8 @@ def pytest_addoption(parser):
 
 
 def pytest_configure(config):
+    if _NEEDS_REEXEC:
+        _hermetic_reexec(config)
     config.addinivalue_line("markers", "slow: mark test as slow to run")
 
 
