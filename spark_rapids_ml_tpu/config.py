@@ -35,6 +35,10 @@ _DEFAULTS: Dict[str, Any] = {
     # the reference's UVM/SAM managed memory, utils.py:184-241)
     "stream_threshold_bytes": 4 << 30,
     "stream_batch_rows": 1 << 20,
+    # Spark-input fit data plane: "barrier" fans the fit out as barrier tasks over
+    # TPU hosts (spark/integration.py), "collect" materializes on the driver (local
+    # mode / tiny data), "auto" picks barrier when a usable pyspark is importable
+    "spark_fit_mode": "auto",
 }
 
 _ENV_KEYS: Dict[str, str] = {
@@ -45,6 +49,7 @@ _ENV_KEYS: Dict[str, str] = {
     "trace_dir": "SRML_TPU_TRACE_DIR",
     "stream_threshold_bytes": "SRML_TPU_STREAM_THRESHOLD_BYTES",
     "stream_batch_rows": "SRML_TPU_STREAM_BATCH_ROWS",
+    "spark_fit_mode": "SRML_TPU_SPARK_FIT_MODE",
 }
 
 _overrides: Dict[str, Any] = {}

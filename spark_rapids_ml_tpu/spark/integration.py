@@ -147,8 +147,9 @@ def _barrier_train_udf(estimator_payload: bytes) -> Callable:
             import pickle as _p
 
             yield pd.DataFrame({"model": [_p.dumps(attrs)]})
-        else:
-            yield pd.DataFrame({"model": []})
+        # rank != 0 yields NOTHING: an empty object-dtype DataFrame against the
+        # 'model binary' Arrow schema is a type-inference crash; mapInPandas
+        # generators may legitimately emit zero batches
 
     return train_udf
 
