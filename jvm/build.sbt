@@ -1,0 +1,13 @@
+// JVM half of the spark-rapids-ml-tpu Spark Connect plugin.
+// Build: sbt package  (requires Spark 4.0+ on the classpath for the
+// MLBackendPlugin / PythonPlannerRunner Connect APIs).
+name := "spark-rapids-ml-tpu-jvm"
+version := "0.2.0"
+scalaVersion := "2.13.14"
+
+libraryDependencies ++= Seq(
+  "org.apache.spark" %% "spark-sql" % "4.0.0" % "provided",
+  "org.apache.spark" %% "spark-mllib" % "4.0.0" % "provided",
+  "org.apache.spark" %% "spark-connect" % "4.0.0" % "provided",
+  "org.scalatest" %% "scalatest" % "3.2.18" % Test
+)

@@ -1,0 +1,49 @@
+/*
+ * Spark Connect ML backend plugin, JVM half — swaps Spark's built-in estimators for
+ * the spark-rapids-ml-tpu Python implementations on the Connect server, so Connect
+ * clients accelerate with zero code change.
+ *
+ * Structural counterpart of reference jvm/src/main/scala/com/nvidia/rapids/ml/
+ * Plugin.scala:26-57 (class-name remap via MLBackendPlugin), re-written for the TPU
+ * backend: the Python process it ultimately launches is
+ * spark_rapids_ml_tpu.connect_plugin, speaking the framed OK/ERR protocol
+ * (connect_plugin.py in this repo).
+ */
+package com.srml.tpu
+
+import java.util.Optional
+
+import org.apache.spark.sql.connect.plugin.MLBackendPlugin
+
+class Plugin extends MLBackendPlugin {
+
+  private val remap: Map[String, String] = Map(
+    "org.apache.spark.ml.classification.LogisticRegression" ->
+      "com.srml.tpu.TpuLogisticRegression",
+    "org.apache.spark.ml.classification.LogisticRegressionModel" ->
+      "org.apache.spark.ml.tpu.TpuLogisticRegressionModel",
+    "org.apache.spark.ml.classification.RandomForestClassifier" ->
+      "com.srml.tpu.TpuRandomForestClassifier",
+    "org.apache.spark.ml.classification.RandomForestClassificationModel" ->
+      "org.apache.spark.ml.tpu.TpuRandomForestClassificationModel",
+    "org.apache.spark.ml.regression.RandomForestRegressor" ->
+      "com.srml.tpu.TpuRandomForestRegressor",
+    "org.apache.spark.ml.regression.RandomForestRegressionModel" ->
+      "org.apache.spark.ml.tpu.TpuRandomForestRegressionModel",
+    "org.apache.spark.ml.regression.LinearRegression" ->
+      "com.srml.tpu.TpuLinearRegression",
+    "org.apache.spark.ml.regression.LinearRegressionModel" ->
+      "org.apache.spark.ml.tpu.TpuLinearRegressionModel",
+    "org.apache.spark.ml.feature.PCA" ->
+      "com.srml.tpu.TpuPCA",
+    "org.apache.spark.ml.feature.PCAModel" ->
+      "org.apache.spark.ml.tpu.TpuPCAModel",
+    "org.apache.spark.ml.clustering.KMeans" ->
+      "com.srml.tpu.TpuKMeans",
+    "org.apache.spark.ml.clustering.KMeansModel" ->
+      "org.apache.spark.ml.tpu.TpuKMeansModel"
+  )
+
+  override def transform(mlName: String): Optional[String] =
+    remap.get(mlName).map(Optional.of[String]).getOrElse(Optional.empty[String]())
+}

@@ -1,0 +1,140 @@
+/*
+ * Estimator wrappers the Connect plugin substitutes for Spark's built-ins
+ * (structural counterparts of reference jvm/src/main/scala/com/nvidia/rapids/ml/
+ * Rapids{LogisticRegression,LinearRegression,KMeans,PCA,RandomForest*}.scala and
+ * RapidsTraits.scala:46-61, re-designed for the TPU backend's dict-JSON attribute
+ * protocol).
+ *
+ * Each wrapper extends the REAL Spark estimator (so Params, schema validation and
+ * persistence behave identically), overrides train() to run the Python TPU fit, and
+ * wraps the returned attribute JSON in a Tpu*Model.
+ */
+package com.srml.tpu
+
+import org.apache.commons.logging.LogFactory
+import org.apache.spark.ml.classification.{LogisticRegression, RandomForestClassifier}
+import org.apache.spark.ml.clustering.KMeans
+import org.apache.spark.ml.feature.PCA
+import org.apache.spark.ml.param.Params
+import org.apache.spark.ml.regression.{LinearRegression, RandomForestRegressor}
+import org.apache.spark.ml.tpu._
+import org.apache.spark.ml.util.{DefaultParamsReadable, DefaultParamsWritable, Identifiable}
+import org.apache.spark.sql.Dataset
+import org.apache.spark.sql.types.StructType
+
+trait TpuEstimator extends Params {
+  protected val log = LogFactory.getLog("spark-rapids-ml-tpu plugin")
+
+  /** Operator name understood by spark_rapids_ml_tpu.connect_plugin. */
+  def operatorName: String
+
+  def trainOnPython(dataset: Dataset[_]): TrainedModel = {
+    log.info(s"Dispatching $operatorName fit to the TPU python backend")
+    val params = ModelHelper.userParamsJson(this)
+    val runner = new PythonTpuRunner(Fit(operatorName, params), dataset.toDF)
+    try {
+      TrainedModel(runner.runInPython(useDaemon = false))
+    } finally {
+      runner.close()
+    }
+  }
+}
+
+class TpuLogisticRegression(override val uid: String) extends LogisticRegression
+    with DefaultParamsWritable with TpuEstimator {
+  def this() = this(Identifiable.randomUID("tpu-logreg"))
+  override def operatorName: String = "LogisticRegression"
+  // features may arrive as array<float> rather than VectorUDT; skip strict checks
+  override def transformSchema(schema: StructType): StructType = schema
+
+  override def train(dataset: Dataset[_]): TpuLogisticRegressionModel = {
+    val trained = trainOnPython(dataset)
+    val (coefficients, intercepts, numClasses) =
+      ModelHelper.logisticRegressionAttributes(trained.modelAttributes)
+    copyValues(new TpuLogisticRegressionModel(
+      uid, coefficients, intercepts, numClasses, trained.modelAttributes))
+  }
+}
+
+object TpuLogisticRegression extends DefaultParamsReadable[TpuLogisticRegression]
+
+class TpuLinearRegression(override val uid: String) extends LinearRegression
+    with DefaultParamsWritable with TpuEstimator {
+  def this() = this(Identifiable.randomUID("tpu-linreg"))
+  override def operatorName: String = "LinearRegression"
+  override def transformSchema(schema: StructType): StructType = schema
+
+  override def train(dataset: Dataset[_]): TpuLinearRegressionModel = {
+    val trained = trainOnPython(dataset)
+    val (coefficients, intercept) =
+      ModelHelper.linearRegressionAttributes(trained.modelAttributes)
+    copyValues(new TpuLinearRegressionModel(
+      uid, coefficients, intercept, trained.modelAttributes))
+  }
+}
+
+object TpuLinearRegression extends DefaultParamsReadable[TpuLinearRegression]
+
+class TpuKMeans(override val uid: String) extends KMeans
+    with DefaultParamsWritable with TpuEstimator {
+  def this() = this(Identifiable.randomUID("tpu-kmeans"))
+  override def operatorName: String = "KMeans"
+  override def transformSchema(schema: StructType): StructType = schema
+
+  override def fit(dataset: Dataset[_]): org.apache.spark.ml.clustering.KMeansModel = {
+    val trained = trainOnPython(dataset)
+    val centers = ModelHelper.kmeansCenters(trained.modelAttributes)
+    TpuKMeansModel.create(uid, centers, trained.modelAttributes, this)
+  }
+}
+
+object TpuKMeans extends DefaultParamsReadable[TpuKMeans]
+
+class TpuPCA(override val uid: String) extends PCA
+    with DefaultParamsWritable with TpuEstimator {
+  def this() = this(Identifiable.randomUID("tpu-pca"))
+  override def operatorName: String = "PCA"
+  override def transformSchema(schema: StructType): StructType = schema
+
+  override def fit(dataset: Dataset[_]): org.apache.spark.ml.feature.PCAModel = {
+    val trained = trainOnPython(dataset)
+    val (pc, explainedVariance) = ModelHelper.pcaAttributes(trained.modelAttributes)
+    TpuPCAModel.create(uid, pc, explainedVariance, trained.modelAttributes, this)
+  }
+}
+
+object TpuPCA extends DefaultParamsReadable[TpuPCA]
+
+class TpuRandomForestClassifier(override val uid: String) extends RandomForestClassifier
+    with DefaultParamsWritable with TpuEstimator {
+  def this() = this(Identifiable.randomUID("tpu-rfc"))
+  override def operatorName: String = "RandomForestClassifier"
+  override def transformSchema(schema: StructType): StructType = schema
+
+  override def train(dataset: Dataset[_]): TpuRandomForestClassificationModel = {
+    val trained = trainOnPython(dataset)
+    val (numFeatures, numClasses) =
+      ModelHelper.forestShape(trained.modelAttributes, classification = true)
+    copyValues(new TpuRandomForestClassificationModel(
+      uid, numFeatures, numClasses, trained.modelAttributes))
+  }
+}
+
+object TpuRandomForestClassifier extends DefaultParamsReadable[TpuRandomForestClassifier]
+
+class TpuRandomForestRegressor(override val uid: String) extends RandomForestRegressor
+    with DefaultParamsWritable with TpuEstimator {
+  def this() = this(Identifiable.randomUID("tpu-rfr"))
+  override def operatorName: String = "RandomForestRegressor"
+  override def transformSchema(schema: StructType): StructType = schema
+
+  override def train(dataset: Dataset[_]): TpuRandomForestRegressionModel = {
+    val trained = trainOnPython(dataset)
+    val (numFeatures, _) =
+      ModelHelper.forestShape(trained.modelAttributes, classification = false)
+    copyValues(new TpuRandomForestRegressionModel(
+      uid, numFeatures, trained.modelAttributes))
+  }
+}
+
+object TpuRandomForestRegressor extends DefaultParamsReadable[TpuRandomForestRegressor]

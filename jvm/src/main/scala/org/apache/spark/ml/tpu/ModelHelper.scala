@@ -1,0 +1,118 @@
+/*
+ * Parses the TPU backend's tagged-JSON model attributes into Spark linalg objects
+ * (structural counterpart of reference jvm/src/main/scala/org/apache/spark/ml/
+ * rapids/ModelHelper.scala, re-designed for the dict format: ndarrays are encoded
+ * as {"__nd__": nested-list, "dtype": str} by
+ * spark_rapids_ml_tpu/connect_plugin.py encode_model_attributes).
+ */
+package org.apache.spark.ml.tpu
+
+import org.apache.spark.ml.linalg.{DenseMatrix, DenseVector, Matrices, Matrix, Vector, Vectors}
+import org.apache.spark.ml.param.{Param, Params}
+import org.json4s._
+import org.json4s.jackson.JsonMethods
+
+object ModelHelper {
+
+  private implicit val formats: Formats = DefaultFormats
+
+  /** Serialize the user-set params of an estimator to the JSON dict the Python half
+   * feeds to `Estimator(**params)` (reference RapidsUtils.getUserDefinedParams). */
+  def userParamsJson(est: Params): String = {
+    val fields = est.params.flatMap { p: Param[_] =>
+      if (est.isSet(p)) Some(JField(p.name, anyToJson(est.get(p).get))) else None
+    }
+    JsonMethods.compact(JsonMethods.render(JObject(fields.toList)))
+  }
+
+  private def anyToJson(v: Any): JValue = v match {
+    case b: Boolean => JBool(b)
+    case i: Int => JInt(i)
+    case l: Long => JInt(l)
+    case f: Float => JDouble(f)
+    case d: Double => JDouble(d)
+    case s: String => JString(s)
+    case a: Array[_] => JArray(a.map(anyToJson).toList)
+    case other => JString(other.toString)
+  }
+
+  private def parse(attributesJson: String): JValue =
+    JsonMethods.parse(attributesJson)
+
+  /** Decode a tagged {"__nd__": ...} cell as a 1-D double array. */
+  private def nd1(v: JValue): Array[Double] =
+    (v \ "__nd__").extract[List[Double]].toArray
+
+  /** Decode a tagged {"__nd__": ...} cell as a 2-D row-major matrix. */
+  private def nd2(v: JValue): Array[Array[Double]] =
+    (v \ "__nd__").extract[List[List[Double]]].map(_.toArray).toArray
+
+  private def denseMatrix(rows: Array[Array[Double]]): Matrix = {
+    val m = rows.length
+    val n = if (m == 0) 0 else rows(0).length
+    // Spark DenseMatrix is column-major
+    val values = new Array[Double](m * n)
+    var i = 0
+    while (i < m) {
+      var j = 0
+      while (j < n) {
+        values(j * m + i) = rows(i)(j)
+        j += 1
+      }
+      i += 1
+    }
+    new DenseMatrix(m, n, values)
+  }
+
+  /** (coefficients, intercepts, numClasses) from a LogisticRegressionModel dict
+   * {"coefficients": nd2, "intercepts": nd1, "num_classes": int, ...}. */
+  def logisticRegressionAttributes(json: String): (Matrix, Vector, Int) = {
+    val root = parse(json)
+    val coef = denseMatrix(nd2(root \ "coefficients"))
+    val icpt = new DenseVector(nd1(root \ "intercepts"))
+    val k = (root \ "num_classes").extract[Int]
+    (coef, icpt, k)
+  }
+
+  /** (coefficients, intercept) from a LinearRegressionModel dict
+   * {"coefficients": nd1, "intercept": double, ...}. */
+  def linearRegressionAttributes(json: String): (Vector, Double) = {
+    val root = parse(json)
+    (new DenseVector(nd1(root \ "coefficients")), (root \ "intercept").extract[Double])
+  }
+
+  /** Cluster centers from a KMeansModel dict {"cluster_centers": nd2, ...}. */
+  def kmeansCenters(json: String): Array[Vector] =
+    nd2(parse(json) \ "cluster_centers").map(r => Vectors.dense(r))
+
+  /** (principal components (n x k), explained variance) from a PCAModel dict
+   * {"components": nd2 (k x n), "explained_variance_ratio": nd1, ...}. */
+  def pcaAttributes(json: String): (Matrix, Vector) = {
+    val root = parse(json)
+    val rows = nd2(root \ "components") // k x n, rows are components
+    val k = rows.length
+    val n = if (k == 0) 0 else rows(0).length
+    // pc matrix is n x k with components as columns
+    val values = new Array[Double](n * k)
+    var c = 0
+    while (c < k) {
+      var r = 0
+      while (r < n) {
+        values(c * n + r) = rows(c)(r)
+        r += 1
+      }
+      c += 1
+    }
+    val pc = new DenseMatrix(n, k, values)
+    (pc, new DenseVector(nd1(root \ "explained_variance_ratio")))
+  }
+
+  /** (numFeatures, numClasses) from a forest dict {"forest": {...}, "num_classes"}. */
+  def forestShape(json: String, classification: Boolean): (Int, Int) = {
+    val root = parse(json)
+    val numFeatures = (root \ "num_features").extractOpt[Int].getOrElse(-1)
+    val numClasses =
+      if (classification) (root \ "num_classes").extractOpt[Int].getOrElse(2) else 0
+    (numFeatures, numClasses)
+  }
+}

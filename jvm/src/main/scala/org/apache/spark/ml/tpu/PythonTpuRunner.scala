@@ -1,0 +1,109 @@
+/*
+ * JVM ⇄ Python bridge for the TPU ML backend (structural counterpart of reference
+ * jvm/src/main/scala/org/apache/spark/ml/rapids/PythonEstimatorRunner.scala:40-67,
+ * re-designed around this repo's framed protocol).
+ *
+ * The runner extends Spark's PythonPlannerRunner so worker lifecycle, auth and
+ * faulthandler plumbing are inherited. On the wire it speaks the
+ * spark_rapids_ml_tpu.connect_plugin protocol:
+ *
+ *   JVM -> Python : auth_token | java_sc_key            (session rebuild, main())
+ *                   operator | params_json | dataset_key | [attributes_json]
+ *   Python -> JVM : "OK" | payload    (fit: model-attributes JSON;
+ *                                      transform: result DataFrame object key)
+ *                   "ERR" | message
+ *
+ * All frames are 4-byte big-endian length + UTF-8 payload.
+ */
+package org.apache.spark.ml.tpu
+
+import java.io.{DataInputStream, DataOutputStream}
+import java.nio.charset.StandardCharsets
+
+import org.apache.spark.api.python.PythonPlannerRunner
+import org.apache.spark.sql.DataFrame
+
+sealed trait TpuRequest {
+  def operator: String
+  def paramsJson: String
+}
+case class Fit(operator: String, paramsJson: String) extends TpuRequest
+case class Transform(operator: String, paramsJson: String, attributesJson: String)
+    extends TpuRequest
+
+/** Result of a fit: the model-attribute JSON produced by the Python estimator. */
+case class TrainedModel(modelAttributes: String)
+
+object Framing {
+  def write(out: DataOutputStream, s: String): Unit = {
+    val bytes = s.getBytes(StandardCharsets.UTF_8)
+    out.writeInt(bytes.length)
+    out.write(bytes)
+  }
+
+  def read(in: DataInputStream): String = {
+    val n = in.readInt()
+    val buf = new Array[Byte](n)
+    in.readFully(buf)
+    new String(buf, StandardCharsets.UTF_8)
+  }
+}
+
+class PythonTpuRunner(request: TpuRequest, dataset: DataFrame)
+    extends PythonPlannerRunner[String](null) with AutoCloseable {
+
+  override protected val workerModule: String = "spark_rapids_ml_tpu.connect_plugin"
+
+  private val jdf = dataset.queryExecution.analyzed
+  private var datasetKey: String = _
+
+  override protected def writeToPython(out: DataOutputStream, authToken: String): Unit = {
+    val session = dataset.sparkSession
+    val jscKey = org.apache.spark.api.java.JavaSparkContext
+      .fromSparkContext(session.sparkContext)
+    datasetKey = PythonObjectRegistry.register(dataset)
+    Framing.write(out, authToken)
+    Framing.write(out, PythonObjectRegistry.register(jscKey))
+    Framing.write(out, request.operator)
+    Framing.write(out, request.paramsJson)
+    Framing.write(out, datasetKey)
+    request match {
+      case Transform(_, _, attrs) => Framing.write(out, attrs)
+      case _ => ()
+    }
+    out.flush()
+  }
+
+  override protected def receiveFromPython(in: DataInputStream): String = {
+    val status = Framing.read(in)
+    val payload = Framing.read(in)
+    if (status != "OK") {
+      throw new RuntimeException(s"spark-rapids-ml-tpu python worker failed: $payload")
+    }
+    payload
+  }
+
+  def close(): Unit = {
+    if (datasetKey != null) PythonObjectRegistry.unregister(datasetKey)
+  }
+}
+
+/**
+ * Keeps JVM objects addressable by string key across the py4j boundary (the
+ * reference passes raw py4j target ids; an explicit registry survives GC cycles
+ * between the two protocol legs).
+ */
+object PythonObjectRegistry {
+  private val objects = new java.util.concurrent.ConcurrentHashMap[String, AnyRef]()
+  private val counter = new java.util.concurrent.atomic.AtomicLong(0)
+
+  def register(obj: AnyRef): String = {
+    val key = s"srml-tpu-${counter.incrementAndGet()}"
+    objects.put(key, obj)
+    key
+  }
+
+  def lookup(key: String): AnyRef = objects.get(key)
+
+  def unregister(key: String): Unit = objects.remove(key)
+}

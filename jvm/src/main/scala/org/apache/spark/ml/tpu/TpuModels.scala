@@ -1,0 +1,139 @@
+/*
+ * Model wrappers returned by the Tpu* estimators (structural counterparts of
+ * reference jvm/src/main/scala/org/apache/spark/ml/rapids/Rapids*Model.scala and
+ * RapidsModel.scala:47-95, re-designed for the TPU backend).
+ *
+ * Each wrapper IS a real Spark model (so downstream pipelines type-check) while
+ * retaining the Python model-attribute JSON. transform() dispatches to the Python
+ * TPU worker when `spark.rapids.ml.tpu.python.transform.enabled` (default true),
+ * else falls back to the in-JVM parent implementation built from the parsed
+ * attributes. Persistence stores the parent model format plus the attribute JSON
+ * alongside, so either side can reload it.
+ */
+package org.apache.spark.ml.tpu
+
+import org.apache.spark.ml.classification.{LogisticRegressionModel, ProbabilisticClassificationModel, RandomForestClassificationModel, RandomForestRegressionModel}
+import org.apache.spark.ml.linalg.{Matrix, Vector}
+import org.apache.spark.ml.param.Params
+import org.apache.spark.ml.util.Identifiable
+import org.apache.spark.sql.{DataFrame, Dataset}
+
+trait TpuModel extends Params {
+  /** Attribute JSON produced by the Python fit (tagged-ndarray dict). */
+  def modelAttributes: String
+
+  /** Operator name of the MODEL on the Python side, e.g. "KMeansModel". */
+  def modelOperatorName: String
+
+  protected def pythonTransformEnabled(dataset: Dataset[_]): Boolean =
+    dataset.sparkSession.conf
+      .get("spark.rapids.ml.tpu.python.transform.enabled", "true").toBoolean
+
+  protected def transformOnPython(dataset: Dataset[_]): DataFrame = {
+    val params = ModelHelper.userParamsJson(this)
+    val runner = new PythonTpuRunner(
+      Transform(modelOperatorName, params, modelAttributes), dataset.toDF)
+    try {
+      val resultKey = runner.runInPython(useDaemon = false)
+      PythonObjectRegistry.lookup(resultKey).asInstanceOf[DataFrame]
+    } finally {
+      runner.close()
+    }
+  }
+}
+
+class TpuLogisticRegressionModel(
+    override val uid: String,
+    coefficientMatrix: Matrix,
+    interceptVector: Vector,
+    numClasses: Int,
+    override val modelAttributes: String)
+  extends LogisticRegressionModel(
+    uid, coefficientMatrix, interceptVector, numClasses,
+    coefficientMatrix.numRows > 1) with TpuModel {
+
+  override def modelOperatorName: String = "LogisticRegressionModel"
+
+  override def transform(dataset: Dataset[_]): DataFrame =
+    if (pythonTransformEnabled(dataset)) transformOnPython(dataset)
+    else super.transform(dataset)
+}
+
+class TpuLinearRegressionModel(
+    override val uid: String,
+    coefficients: Vector,
+    intercept: Double,
+    override val modelAttributes: String)
+  extends org.apache.spark.ml.regression.LinearRegressionModel(
+    uid, coefficients, intercept) with TpuModel {
+
+  override def modelOperatorName: String = "LinearRegressionModel"
+
+  override def transform(dataset: Dataset[_]): DataFrame =
+    if (pythonTransformEnabled(dataset)) transformOnPython(dataset)
+    else super.transform(dataset)
+}
+
+class TpuRandomForestClassificationModel(
+    override val uid: String,
+    numFeaturesIn: Int,
+    numClassesIn: Int,
+    override val modelAttributes: String)
+  extends RandomForestClassificationModel(
+    uid, Array.empty, numFeaturesIn, numClassesIn) with TpuModel {
+
+  override def modelOperatorName: String = "RandomForestClassificationModel"
+
+  // the JVM side holds no trees; transform must go through Python
+  override def transform(dataset: Dataset[_]): DataFrame = transformOnPython(dataset)
+}
+
+class TpuRandomForestRegressionModel(
+    override val uid: String,
+    numFeaturesIn: Int,
+    override val modelAttributes: String)
+  extends RandomForestRegressionModel(uid, Array.empty, numFeaturesIn) with TpuModel {
+
+  override def modelOperatorName: String = "RandomForestRegressionModel"
+
+  override def transform(dataset: Dataset[_]): DataFrame = transformOnPython(dataset)
+}
+
+/*
+ * KMeansModel / PCAModel have private[ml] constructors; the wrappers are built via
+ * factory objects living in this org.apache.spark.ml.* package for access (the
+ * reference solves this the same way with
+ * org/apache/spark/ml/clustering/rapids/RapidsKMeansModel.scala).
+ */
+object TpuKMeansModel {
+  def create(
+      uid: String,
+      centers: Array[Vector],
+      attributes: String,
+      parent: Params): org.apache.spark.ml.clustering.KMeansModel = {
+    val mllibCenters = centers.map(v =>
+      org.apache.spark.mllib.linalg.Vectors.fromML(v))
+    val mllibModel = new org.apache.spark.mllib.clustering.KMeansModel(mllibCenters)
+    val model = new org.apache.spark.ml.clustering.KMeansModel(uid, mllibModel)
+    parent.asInstanceOf[org.apache.spark.ml.Estimator[_]].copyValues(
+      model.asInstanceOf[org.apache.spark.ml.Model[_]])
+    model
+  }
+}
+
+object TpuPCAModel {
+  def create(
+      uid: String,
+      pc: Matrix,
+      explainedVariance: Vector,
+      attributes: String,
+      parent: Params): org.apache.spark.ml.feature.PCAModel = {
+    val model = new org.apache.spark.ml.feature.PCAModel(
+      uid,
+      pc.asInstanceOf[org.apache.spark.ml.linalg.DenseMatrix],
+      explainedVariance.asInstanceOf[org.apache.spark.ml.linalg.DenseVector])
+    parent.asInstanceOf[org.apache.spark.ml.Estimator[_]].copyValues(
+      model.asInstanceOf[org.apache.spark.ml.Model[_]])
+    model
+  }
+}

@@ -1,0 +1,248 @@
+#
+# Spark Connect plugin, Python half — the operator-dispatch worker the JVM backend
+# plugin spawns to run accelerated fits/transforms server-side with NO client code
+# change (structural equivalent of reference
+# python/src/spark_rapids_ml/connect_plugin.py:68-273).
+#
+# Wire protocol (framed UTF-8: 4-byte big-endian length + payload, the same framing
+# pyspark's write_with_length uses):
+#
+#   request:  operator_name | params_json | dataset_key | [attributes_json]
+#             (attributes_json present only for *Model operators, i.e. transform)
+#   response: "OK" | payload            — fit: payload = model-attributes JSON
+#                                       — transform: payload = result dataset key
+#             "ERR" | message           — on any dispatch failure
+#
+# Deviation from the reference, by design: model attributes travel as a TAGGED JSON
+# DICT (ndarray cells encoded as {"__nd__": nested-list, "dtype": ...}) rather than
+# the reference's positional arrays (connect_plugin.py:131-236). The JVM half here is
+# ours too (jvm/), so the richer self-describing format costs nothing and removes the
+# order-coupling between the two halves.
+#
+# The pyspark/py4j session-rebuild wrapper (`main`) is only importable with pyspark
+# present; `serve`/`dispatch_fit`/`dispatch_transform` below are pure and are
+# exercised by the socket-protocol unit test (tests/test_connect_plugin.py).
+#
+
+from __future__ import annotations
+
+import importlib
+import json
+import struct
+from typing import Any, BinaryIO, Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+from .utils import get_logger
+
+# operator name -> (estimator "module:class", model "module:class"); the same five
+# families the reference dispatches (connect_plugin.py:127-245)
+SUPPORTED_OPERATORS: Dict[str, Tuple[str, str]] = {
+    "LogisticRegression": (
+        "spark_rapids_ml_tpu.classification:LogisticRegression",
+        "spark_rapids_ml_tpu.classification:LogisticRegressionModel",
+    ),
+    "RandomForestClassifier": (
+        "spark_rapids_ml_tpu.classification:RandomForestClassifier",
+        "spark_rapids_ml_tpu.classification:RandomForestClassificationModel",
+    ),
+    "RandomForestRegressor": (
+        "spark_rapids_ml_tpu.regression:RandomForestRegressor",
+        "spark_rapids_ml_tpu.regression:RandomForestRegressionModel",
+    ),
+    "LinearRegression": (
+        "spark_rapids_ml_tpu.regression:LinearRegression",
+        "spark_rapids_ml_tpu.regression:LinearRegressionModel",
+    ),
+    "PCA": (
+        "spark_rapids_ml_tpu.feature:PCA",
+        "spark_rapids_ml_tpu.feature:PCAModel",
+    ),
+    "KMeans": (
+        "spark_rapids_ml_tpu.clustering:KMeans",
+        "spark_rapids_ml_tpu.clustering:KMeansModel",
+    ),
+}
+
+
+def _load(path: str) -> type:
+    mod, _, cls = path.partition(":")
+    return getattr(importlib.import_module(mod), cls)
+
+
+def _operator_for(name: str) -> Tuple[str, bool]:
+    """Map 'KMeansModel' -> ('KMeans', True) and 'KMeans' -> ('KMeans', False)."""
+    if name in SUPPORTED_OPERATORS:
+        return name, False
+    if name.endswith("Model"):
+        for base, (_, model_path) in SUPPORTED_OPERATORS.items():
+            if model_path.rsplit(":", 1)[1] == name:
+                return base, True
+    raise RuntimeError(
+        f"Unsupported operator: {name}. Supported: {sorted(SUPPORTED_OPERATORS)}"
+    )
+
+
+# ---- tagged-JSON attribute codec ----
+
+
+def _encode_value(v: Any) -> Any:
+    if isinstance(v, np.ndarray):
+        return {"__nd__": v.tolist(), "dtype": str(v.dtype)}
+    if isinstance(v, (np.floating, np.integer, np.bool_)):
+        return v.item()
+    if isinstance(v, dict):
+        return {k: _encode_value(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_encode_value(x) for x in v]
+    return v
+
+
+def _decode_value(v: Any) -> Any:
+    if isinstance(v, dict):
+        if "__nd__" in v:
+            return np.asarray(v["__nd__"], dtype=np.dtype(v.get("dtype", "float64")))
+        return {k: _decode_value(x) for k, x in v.items()}
+    if isinstance(v, list):
+        return [_decode_value(x) for x in v]
+    return v
+
+
+def encode_model_attributes(attrs: Dict[str, Any]) -> str:
+    return json.dumps(_encode_value(attrs))
+
+
+def decode_model_attributes(payload: str) -> Dict[str, Any]:
+    return _decode_value(json.loads(payload))
+
+
+# ---- dispatch core (pyspark-free) ----
+
+
+def dispatch_fit(operator_name: str, params: Dict[str, Any], dataset: Any) -> str:
+    """Fit the named estimator on the dataset; returns the model-attributes JSON the
+    JVM half stores (reference connect_plugin.py:127-139 et al.)."""
+    base, is_model = _operator_for(operator_name)
+    if is_model:
+        raise RuntimeError(f"{operator_name} is a model operator; use dispatch_transform")
+    est_cls = _load(SUPPORTED_OPERATORS[base][0])
+    model = est_cls(**params).fit(dataset)
+    return encode_model_attributes(model.get_model_attributes())
+
+
+def dispatch_transform(
+    operator_name: str, params: Dict[str, Any], attributes_json: str, dataset: Any
+) -> Any:
+    """Rebuild the named model from its attribute JSON and transform the dataset
+    (reference connect_plugin.py:119-125)."""
+    base, is_model = _operator_for(operator_name)
+    if not is_model:
+        raise RuntimeError(f"{operator_name} is an estimator operator; use dispatch_fit")
+    model_cls = _load(SUPPORTED_OPERATORS[base][1])
+    model = model_cls._from_row(decode_model_attributes(attributes_json))
+    if params:
+        model._set_params(**params)
+    return model.transform(dataset)
+
+
+# ---- framed wire protocol ----
+
+
+def write_framed_utf8(out: BinaryIO, s: str) -> None:
+    payload = s.encode("utf-8")
+    out.write(struct.pack(">i", len(payload)))
+    out.write(payload)
+
+
+def read_framed_utf8(inp: BinaryIO) -> str:
+    header = inp.read(4)
+    if len(header) < 4:
+        raise EOFError("connect-plugin stream closed mid-frame")
+    (n,) = struct.unpack(">i", header)
+    data = inp.read(n)
+    if len(data) < n:
+        raise EOFError("connect-plugin stream truncated payload")
+    return data.decode("utf-8")
+
+
+def serve(
+    infile: BinaryIO,
+    outfile: BinaryIO,
+    dataset_resolver: Callable[[str], Any],
+    result_registrar: Optional[Callable[[Any], str]] = None,
+) -> None:
+    """Serve ONE request over the framed protocol.
+
+    `dataset_resolver(key)` materializes the input dataset from its key (py4j object
+    id in production; anything the test harness chooses in tests).
+    `result_registrar(df)` stores a transform result and returns the key handed back
+    to the JVM (the reference returns `_jdf._target_id`, connect_plugin.py:145)."""
+    logger = get_logger("connect_plugin")
+    try:
+        operator_name = read_framed_utf8(infile)
+        params = json.loads(read_framed_utf8(infile))
+        dataset_key = read_framed_utf8(infile)
+        _, is_model = _operator_for(operator_name)
+        attributes_json = read_framed_utf8(infile) if is_model else None
+        dataset = dataset_resolver(dataset_key)
+        logger.info("connect dispatch: %s (model=%s)", operator_name, is_model)
+        if is_model:
+            result = dispatch_transform(
+                operator_name, params, attributes_json or "{}", dataset
+            )
+            if result_registrar is None:
+                raise RuntimeError("transform dispatch requires a result_registrar")
+            payload = result_registrar(result)
+        else:
+            payload = dispatch_fit(operator_name, params, dataset)
+    except BaseException as e:  # noqa: BLE001 — every failure must cross the wire
+        logger.exception("connect dispatch failed")
+        write_framed_utf8(outfile, "ERR")
+        write_framed_utf8(outfile, f"{type(e).__name__}: {e}")
+        outfile.flush()
+        return
+    write_framed_utf8(outfile, "OK")
+    write_framed_utf8(outfile, payload)
+    outfile.flush()
+
+
+# ---- production wrapper (requires pyspark + py4j; mirrors reference main()) ----
+
+
+def main(infile: BinaryIO, outfile: BinaryIO) -> None:
+    """JVM-spawned entry: rebuild the SparkSession over the py4j gateway, resolve the
+    DataFrame from its object key, then serve the framed request (reference
+    connect_plugin.py:68-114 for the session-rebuild sequence)."""
+    import py4j
+    from py4j.java_gateway import GatewayParameters
+    from pyspark import SparkConf, SparkContext
+    from pyspark.sql import DataFrame, SparkSession
+
+    auth_token = read_framed_utf8(infile)
+    java_sc_key = read_framed_utf8(infile)
+
+    gateway = py4j.java_gateway.JavaGateway(
+        gateway_parameters=GatewayParameters(auth_token=auth_token, auto_convert=True)
+    )
+    jsc = py4j.java_gateway.JavaObject(java_sc_key, gateway._gateway_client)
+    sc = SparkContext(conf=SparkConf(_jconf=jsc.sc().conf()), gateway=gateway, jsc=jsc)
+
+    def resolver(dataset_key: str) -> Any:
+        jdf = py4j.java_gateway.JavaObject(dataset_key, gateway._gateway_client)
+        spark = SparkSession(sc, jdf.sparkSession())
+        return DataFrame(jdf, spark)
+
+    def registrar(df: Any) -> str:
+        return df._jdf._target_id  # the JVM re-resolves the result by object id
+
+    serve(infile, outfile, resolver, registrar)
+
+
+if __name__ == "__main__":  # pragma: no cover — production socket bootstrap
+    import os
+    import socket
+
+    port = int(os.environ["PYTHON_WORKER_FACTORY_PORT"])
+    sock = socket.create_connection(("127.0.0.1", port))
+    f = sock.makefile("rwb", 65536)
+    main(f, f)
