@@ -47,6 +47,10 @@ class FitInputs:
     features: Any  # jax.Array (padded_m, n), rows sharded over the data axis
     row_weight: Any  # jax.Array (padded_m,), 1.0 real / 0.0 padding, times sample weight
     label: Optional[Any] = None  # jax.Array (padded_m,)
+    # ELL sparse alternative to `features` (ops/sparse.py): values/indices row-sharded;
+    # when set, `features` is None and kernels must take the sparse path
+    sparse_values: Optional[Any] = None  # jax.Array (padded_m, r)
+    sparse_indices: Optional[Any] = None  # jax.Array (padded_m, r) int32/int64
     desc: Optional[PartitionDescriptor] = None
     mesh: Any = None
     params: Dict[str, Any] = field(default_factory=dict)
@@ -124,7 +128,71 @@ class _TpuCaller(_TpuClass, _TpuParams):
             float32=self._float32_inputs,
         )
 
+    def _supports_sparse_fit(self) -> bool:
+        """Whether this estimator has a true sparse device kernel (ops/sparse.py).
+        Estimators without one densify at ingest (the pre-round-2 behavior for all)."""
+        return False
+
+    def _sparse_fit_wanted(self, fd: FeatureData) -> bool:
+        """Sparse-path gate, mirroring the reference's enable_sparse_data_optim
+        semantics (params.py:45-66): None/unset = auto (sparse input stays sparse),
+        False = force densify, True = require the sparse path."""
+        if not fd.is_sparse:
+            return False
+        optim = (
+            self.getOrDefault("enable_sparse_data_optim")
+            if self.hasParam("enable_sparse_data_optim")
+            and self.isDefined("enable_sparse_data_optim")
+            else None
+        )
+        if optim is False:
+            return False
+        if not self._supports_sparse_fit():
+            if optim is True:
+                raise ValueError(
+                    f"{type(self).__name__} has no sparse device kernel but "
+                    "enable_sparse_data_optim=True was requested."
+                )
+            return False
+        return True
+
+    def _build_sparse_fit_inputs(self, fd: FeatureData) -> FitInputs:
+        """ELL-format FitInputs: O(nnz) device memory, never densified
+        (ops/sparse.py; reference sparse path classification.py:1002-1055)."""
+        from ..ops.sparse import csr_to_ell, pad_ell_rows
+
+        num_workers = self.num_workers
+        mesh = get_mesh(num_workers)
+        values, indices = csr_to_ell(fd.features, float32=self._float32_inputs)
+        values, indices, pad_weight, (label_p, sw_p) = pad_ell_rows(
+            values, indices, num_workers, fd.label, fd.weight
+        )
+        row_weight = pad_weight if sw_p is None else pad_weight * sw_p
+        shard = values.shape[0] // num_workers
+        rank_rows = [
+            max(0, min(fd.n_rows - r * shard, shard)) for r in range(num_workers)
+        ]
+        desc = PartitionDescriptor.build(
+            rank_rows, fd.n_cols, nnz=int(fd.features.nnz), padded_m=values.shape[0]
+        )
+        return FitInputs(
+            features=None,
+            sparse_values=shard_array(values, mesh),
+            sparse_indices=shard_array(indices, mesh),
+            row_weight=shard_array(row_weight, mesh),
+            label=shard_array(label_p, mesh) if label_p is not None else None,
+            desc=desc,
+            mesh=mesh,
+            params=dict(self._tpu_params),
+            dtype=np.float32 if self._float32_inputs else np.float64,
+            host_label=fd.label,
+            host_row_weight=fd.weight,
+            row_id=fd.row_id,
+        )
+
     def _build_fit_inputs(self, fd: FeatureData) -> FitInputs:
+        if self._sparse_fit_wanted(fd):
+            return self._build_sparse_fit_inputs(fd)
         num_workers = self.num_workers
         mesh = get_mesh(num_workers)
 

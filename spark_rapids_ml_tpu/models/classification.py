@@ -187,6 +187,11 @@ class LogisticRegression(
         # fits over the concatenated arrays, classification.py:1173-1190)
         return True
 
+    def _supports_sparse_fit(self) -> bool:
+        # matrix-free ELL kernels in ops/sparse.py (reference CSR training path,
+        # classification.py:1002-1055)
+        return True
+
     def _get_tpu_fit_func(self, extra_params: Optional[List[Dict[str, Any]]] = None):
         base = dict(self._tpu_params)
 
@@ -243,10 +248,7 @@ class LogisticRegression(
                         }
                     )
                     continue
-                attrs = logreg_fit(
-                    inputs.features,
-                    inputs.label,
-                    inputs.row_weight,
+                common = dict(
                     n_classes=n_classes,
                     reg=float(p["alpha"]),
                     l1_ratio=float(p["l1_ratio"]),
@@ -256,6 +258,21 @@ class LogisticRegression(
                     tol=float(p["tol"]),
                     multinomial=multinomial,
                 )
+                if inputs.sparse_values is not None:
+                    from ..ops.sparse import sparse_logreg_fit
+
+                    attrs = sparse_logreg_fit(
+                        inputs.sparse_values,
+                        inputs.sparse_indices,
+                        inputs.desc.n,
+                        inputs.label,
+                        inputs.row_weight,
+                        **common,
+                    )
+                else:
+                    attrs = logreg_fit(
+                        inputs.features, inputs.label, inputs.row_weight, **common
+                    )
                 attrs["num_classes"] = n_classes
                 results.append(attrs)
             return results if extra_params is not None else results[0]

@@ -186,14 +186,15 @@ class LinearRegression(_LinearRegressionClass, _TpuEstimatorSupervised, _LinearR
         # the sufficient-statistics pass is shared across all param maps
         return True
 
+    def _supports_sparse_fit(self) -> bool:
+        # matrix-free ELL normal-equation solver in ops/sparse.py
+        return True
+
     def _get_tpu_fit_func(self, extra_params: Optional[List[Dict[str, Any]]] = None):
         p = dict(self._tpu_params)
 
         def _fit(inputs: FitInputs):
-            results = linreg_fit(
-                inputs.features,
-                inputs.label,
-                inputs.row_weight,
+            common = dict(
                 reg=float(p["alpha"]),
                 l1_ratio=float(p["l1_ratio"]),
                 fit_intercept=bool(p["fit_intercept"]),
@@ -202,6 +203,21 @@ class LinearRegression(_LinearRegressionClass, _TpuEstimatorSupervised, _LinearR
                 tol=float(p["tol"]),
                 extra_param_sets=extra_params,
             )
+            if inputs.sparse_values is not None:
+                from ..ops.sparse import sparse_linreg_fit
+
+                results = sparse_linreg_fit(
+                    inputs.sparse_values,
+                    inputs.sparse_indices,
+                    inputs.desc.n,
+                    inputs.label,
+                    inputs.row_weight,
+                    **common,
+                )
+            else:
+                results = linreg_fit(
+                    inputs.features, inputs.label, inputs.row_weight, **common
+                )
             return results if extra_params is not None else results[0]
 
         return _fit

@@ -56,3 +56,142 @@ def test_pca_sparse_input(n_devices):
     np.testing.assert_allclose(
         model.explained_variance_, sk.explained_variance_, rtol=5e-3
     )
+
+
+# ---- round 2: true sparse device kernels (ops/sparse.py) ----
+
+
+def _csr_reg_data(n=300, d=25, density=0.15, seed=3):
+    rng = np.random.default_rng(seed)
+    X = sp.random(n, d, density=density, format="csr", dtype=np.float32, random_state=seed)
+    coef = rng.normal(size=d)
+    y = np.asarray(X @ coef).ravel() + 0.3 + rng.normal(0, 0.01, n)
+    return X, y.astype(np.float64)
+
+
+def test_csr_to_ell_roundtrip_and_dtypes():
+    from spark_rapids_ml_tpu.ops import sparse as ops_sparse
+
+    X, _ = _sparse_cls_data(n=50, d=10)
+    values, indices = ops_sparse.csr_to_ell(X)
+    assert indices.dtype == np.int32
+    # reconstruct dense and compare
+    dense = np.zeros(X.shape, np.float32)
+    rows = np.repeat(np.arange(X.shape[0]), values.shape[1])
+    np.add.at(dense, (rows, indices.ravel()), values.ravel())
+    np.testing.assert_allclose(dense, np.asarray(X.todense()), atol=1e-6)
+
+
+def test_int64_escalation(monkeypatch):
+    """nnz beyond the int32 limit escalates index dtype (reference
+    classification.py:960-966)."""
+    from spark_rapids_ml_tpu.ops import sparse as ops_sparse
+
+    X, _ = _sparse_cls_data(n=50, d=10)
+    monkeypatch.setattr(ops_sparse, "INT32_LIMIT", 10)
+    values, indices = ops_sparse.csr_to_ell(X)
+    assert indices.dtype == np.int64
+
+
+def test_sparse_moments_match_dense(n_devices):
+    import jax.numpy as jnp
+
+    from spark_rapids_ml_tpu.ops.linalg import weighted_moments
+    from spark_rapids_ml_tpu.ops.sparse import csr_to_ell, sparse_weighted_moments
+
+    X, _ = _sparse_cls_data(n=100, d=12)
+    w = np.random.default_rng(0).uniform(0.5, 2.0, 100).astype(np.float32)
+    values, indices = csr_to_ell(X)
+    mean_s, var_s, wsum_s = sparse_weighted_moments(
+        jnp.asarray(values), jnp.asarray(indices), jnp.asarray(w), 12
+    )
+    mean_d, var_d, wsum_d = weighted_moments(
+        jnp.asarray(np.asarray(X.todense())), jnp.asarray(w)
+    )
+    np.testing.assert_allclose(np.asarray(mean_s), np.asarray(mean_d), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(var_s), np.asarray(var_d), atol=1e-4)
+    assert float(wsum_s) == pytest.approx(float(wsum_d))
+
+
+def test_logreg_sparse_device_path_taken(n_devices):
+    """CSR input must flow to the ELL kernels: FitInputs carries sparse arrays and no
+    dense features (the pre-round-2 path densified at ingest)."""
+    X, y = _sparse_cls_data()
+    est = LogisticRegression(regParam=0.01, maxIter=5)
+    fd = est._pre_process_data(
+        pd.DataFrame({"features": [X.getrow(i) for i in range(X.shape[0])], "label": y})
+    )
+    inputs = est._build_fit_inputs(fd)
+    assert inputs.features is None
+    assert inputs.sparse_values is not None
+    assert inputs.desc.nnz == X.nnz
+
+
+def test_logreg_sparse_parity_with_dense(n_devices):
+    X, y = _sparse_cls_data()
+    df_sparse = pd.DataFrame(
+        {"features": [X.getrow(i) for i in range(X.shape[0])], "label": y}
+    )
+    df_dense = pd.DataFrame({"features": list(np.asarray(X.todense())), "label": y})
+    kw = dict(regParam=0.01, standardization=True, maxIter=100, tol=1e-8)
+    m_sparse = LogisticRegression(**kw).fit(df_sparse)
+    m_dense = LogisticRegression(**kw).fit(df_dense)
+    np.testing.assert_allclose(
+        m_sparse.coefficients, m_dense.coefficients, rtol=5e-3, atol=5e-4
+    )
+    np.testing.assert_allclose(
+        m_sparse.interceptVector, m_dense.interceptVector, rtol=5e-3, atol=5e-4
+    )
+
+
+def test_logreg_sparse_l1_and_multinomial(n_devices):
+    rng = np.random.default_rng(5)
+    X = sp.random(240, 15, density=0.25, format="csr", dtype=np.float32, random_state=5)
+    logits = np.asarray(X @ rng.normal(size=(15, 3)))
+    y = logits.argmax(axis=1).astype(np.float64)
+    df_sparse = pd.DataFrame(
+        {"features": [X.getrow(i) for i in range(X.shape[0])], "label": y}
+    )
+    df_dense = pd.DataFrame({"features": list(np.asarray(X.todense())), "label": y})
+    kw = dict(regParam=0.05, elasticNetParam=0.5, maxIter=200, tol=1e-8)
+    m_sparse = LogisticRegression(**kw).fit(df_sparse)
+    m_dense = LogisticRegression(**kw).fit(df_dense)
+    assert m_sparse.numClasses == 3
+    np.testing.assert_allclose(
+        m_sparse.coefficientMatrix, m_dense.coefficientMatrix, rtol=5e-2, atol=5e-3
+    )
+
+
+def test_linreg_sparse_parity_with_dense(n_devices):
+    from spark_rapids_ml_tpu.regression import LinearRegression
+
+    X, y = _csr_reg_data()
+    df_sparse = pd.DataFrame(
+        {"features": [X.getrow(i) for i in range(X.shape[0])], "label": y}
+    )
+    df_dense = pd.DataFrame({"features": list(np.asarray(X.todense())), "label": y})
+    for kw in (
+        dict(regParam=0.0),
+        dict(regParam=0.1),  # ridge
+        dict(regParam=0.1, elasticNetParam=0.5, maxIter=500, tol=1e-9),  # EN
+        dict(regParam=0.1, standardization=True),
+    ):
+        m_sparse = LinearRegression(**kw).fit(df_sparse)
+        m_dense = LinearRegression(**kw).fit(df_dense)
+        np.testing.assert_allclose(
+            np.asarray(m_sparse.coefficients),
+            np.asarray(m_dense.coefficients),
+            rtol=5e-3,
+            atol=5e-4,
+        )
+        assert m_sparse.intercept == pytest.approx(m_dense.intercept, rel=5e-3, abs=1e-3)
+
+
+def test_force_dense_with_optim_false(n_devices):
+    X, y = _sparse_cls_data()
+    est = LogisticRegression(enable_sparse_data_optim=False, maxIter=5)
+    fd = est._pre_process_data(
+        pd.DataFrame({"features": [X.getrow(i) for i in range(X.shape[0])], "label": y})
+    )
+    inputs = est._build_fit_inputs(fd)
+    assert inputs.features is not None and inputs.sparse_values is None
