@@ -208,7 +208,7 @@ class ApproximateNearestNeighbors(_ApproxNNClass, _TpuEstimator, _NNParams):
     algorithm: Param[str] = Param(
         "undefined",
         "algorithm",
-        "algorithm to use: 'ivfflat' or 'brute_force' (ivfpq/cagra: future rounds).",
+        "algorithm to use: 'ivfflat', 'ivfpq', 'cagra' or 'brute_force'.",
         TypeConverters.toString,
     )
     algoParams: Param[Dict[str, Any]] = Param(
@@ -237,6 +237,17 @@ class ApproximateNearestNeighbors(_ApproxNNClass, _TpuEstimator, _NNParams):
         algo = self.getOrDefault("algorithm")
 
         def _fit(inputs: FitInputs) -> Dict[str, Any]:
+            if algo == "cagra":
+                # cuVS cagra param names (reference knn.py:1324-1404,1513-1524)
+                from ..ops.knn import cagra_build
+
+                return cagra_build(
+                    inputs.features,
+                    inputs.row_weight,
+                    graph_degree=int(algo_params.get("graph_degree", 32)),
+                    nlist=int(algo_params.get("nlist", 0)),
+                    seed=seed,
+                )
             if algo in ("ivfpq", "ivf_pq"):
                 # cuVS ivf_pq param names (reference translation table knn.py:1324-1404)
                 return ivfpq_build(
@@ -289,19 +300,25 @@ class ApproximateNearestNeighborsModel(_ApproxNNClass, _TpuModel, _NNParams):
 
     def __init__(
         self,
-        centers: np.ndarray,
-        cells: np.ndarray,
-        cell_ids: np.ndarray,
-        cell_sizes: np.ndarray,
+        centers: Optional[np.ndarray] = None,
+        cells: Optional[np.ndarray] = None,
+        cell_ids: Optional[np.ndarray] = None,
+        cell_sizes: Optional[np.ndarray] = None,
         codebooks: Optional[np.ndarray] = None,
         codes: Optional[np.ndarray] = None,
+        items: Optional[np.ndarray] = None,
+        graph: Optional[np.ndarray] = None,
     ) -> None:
-        attrs = dict(
-            centers=np.asarray(centers),
-            cells=np.asarray(cells),
-            cell_ids=np.asarray(cell_ids),
-            cell_sizes=np.asarray(cell_sizes),
-        )
+        if graph is not None:
+            # CAGRA-class graph index (ops/knn.py cagra_build)
+            attrs = dict(items=np.asarray(items), graph=np.asarray(graph))
+        else:
+            attrs = dict(
+                centers=np.asarray(centers),
+                cells=np.asarray(cells),
+                cell_ids=np.asarray(cell_ids),
+                cell_sizes=np.asarray(cell_sizes),
+            )
         if codebooks is not None:
             attrs["codebooks"] = np.asarray(codebooks)
             attrs["codes"] = np.asarray(codes)
@@ -340,6 +357,20 @@ class ApproximateNearestNeighborsModel(_ApproxNNClass, _TpuModel, _NNParams):
             )
             dists = np.sqrt(np.asarray(d2))
             pos = np.asarray(idx)
+        elif "graph" in self._model_attributes:
+            from ..ops.knn import cagra_search
+
+            algo_params = self.getOrDefault("algoParams") or {}
+            dists_j, ids_j = cagra_search(
+                jnp.asarray(Q),
+                jnp.asarray(self._model_attributes["items"]),
+                jnp.asarray(self._model_attributes["graph"]),
+                k=k,
+                itopk=int(algo_params.get("itopk_size", max(64, k))),
+                iterations=int(algo_params.get("max_iterations", 32)),
+            )
+            dists = np.asarray(dists_j)
+            pos = np.asarray(ids_j)
         else:
             algo_params = self.getOrDefault("algoParams") or {}
             nlist = self._model_attributes["centers"].shape[0]

@@ -131,12 +131,18 @@ def ivfflat_build(
     cells = np.zeros((nlist, max_cell, d), dtype=np.float32)
     cell_ids = np.full((nlist, max_cell), -1, dtype=np.int64)
     Xh = np.asarray(X)
-    fill = np.zeros(nlist, dtype=np.int64)
-    for i in np.nonzero(valid)[0]:
-        c = assign[i]
-        cells[c, fill[c]] = Xh[i]
-        cell_ids[c, fill[c]] = i
-        fill[c] += 1
+    # vectorized cell layout: stable-sort rows by cell, then each row's slot within
+    # its cell is its sorted position minus the cell's start offset (the former
+    # per-row Python loop was O(n) interpreted — disqualifying at 10M items)
+    valid_idx = np.nonzero(valid)[0]
+    order = np.argsort(assign[valid_idx], kind="stable")
+    sorted_rows = valid_idx[order]
+    sorted_cells = assign[sorted_rows]
+    within = np.arange(len(sorted_rows)) - np.repeat(
+        np.concatenate([[0], np.cumsum(cell_sizes)[:-1]]), cell_sizes
+    )
+    cells[sorted_cells, within] = Xh[sorted_rows]
+    cell_ids[sorted_cells, within] = sorted_rows
     out = {
         "centers": centers,
         "cells": cells,
@@ -332,3 +338,131 @@ def ivfflat_search(
     dists = jnp.sqrt(jnp.maximum(-neg, 0.0))
     dists = jnp.where(ids >= 0, dists, jnp.inf)
     return dists, ids
+
+
+# ---------------------------------------------------------------------------
+# CAGRA-class graph index (the cuVS cagra equivalent, reference knn.py:1513-1524)
+# ---------------------------------------------------------------------------
+#
+# Build: a fixed-degree kNN graph — exact for small item sets, IVF-Flat-assisted for
+# large ones (cuVS builds its graph from an IVF-PQ/NN-descent pass the same way).
+# Search: greedy beam traversal re-expressed with static shapes for XLA: a fixed-size
+# candidate pool per query; each iteration expands the best unvisited node, gathers
+# its fixed-degree adjacency row, scores the neighbors (gather + fused distance), and
+# re-top-ks the pool. Duplicate ids are neutralized by a sort-adjacent-compare pass
+# (they get distance=inf + visited=True so they neither rank nor re-expand). All
+# iterations are a lax.fori_loop over purely dense ops — no dynamic frontier.
+
+
+def cagra_build(
+    X: jax.Array,
+    w: jax.Array,
+    graph_degree: int = 32,
+    nlist: int = 0,
+    seed: int = 42,
+    exact_threshold: int = 32768,
+) -> Dict[str, np.ndarray]:
+    """Build the fixed-degree neighbor graph. Returns {"items", "graph"} over the
+    COMPACTED valid rows (padding rows are dropped so graph node ids align 1:1 with
+    the caller's item row positions)."""
+    valid = np.asarray(w) > 0
+    Xv = np.asarray(X)[valid].astype(np.float32)
+    n_real = Xv.shape[0]
+    deg = min(graph_degree, max(n_real - 1, 1))
+    Xj = jnp.asarray(Xv)
+    ones = jnp.ones((n_real,), jnp.float32)
+
+    if n_real <= exact_threshold:
+        _, idx = exact_knn_single(Xj, Xj, jnp.ones((n_real,), bool), deg + 1)
+        idx = np.asarray(idx)
+    else:
+        if nlist <= 0:
+            nlist = max(int(np.sqrt(n_real)), 8)
+        index = ivfflat_build(Xj, ones, nlist=nlist, max_iter=10, seed=seed)
+        _, idx = ivfflat_search(
+            Xj,
+            jnp.asarray(index["centers"]),
+            jnp.asarray(index["cells"]),
+            jnp.asarray(index["cell_ids"]),
+            k=deg + 1,
+            nprobe=max(2, nlist // 8),
+        )
+        idx = np.asarray(idx)
+
+    # drop self-edges (usually slot 0); compact each row back to `deg` entries
+    rows = np.arange(n_real)[:, None]
+    not_self = idx != rows
+    # stable partition: self (or any overflow) pushed to the end, then cut
+    order = np.argsort(~not_self, axis=1, kind="stable")
+    graph = np.take_along_axis(idx, order, axis=1)[:, :deg].astype(np.int32)
+    graph = np.maximum(graph, 0)  # any -1 from an undersized IVF probe -> node 0
+    return {"items": Xv, "graph": graph}
+
+
+@functools.partial(jax.jit, static_argnames=("k", "itopk", "iterations"))
+def cagra_search(
+    Q: jax.Array,
+    items: jax.Array,  # (n, d)
+    graph: jax.Array,  # (n, deg) int32
+    k: int,
+    itopk: int = 64,
+    iterations: int = 32,
+) -> Tuple[jax.Array, jax.Array]:
+    """Greedy beam search over the neighbor graph.
+
+    Returns (euclidean distances, item ids), shapes (nq, min(k, itopk))."""
+    n, d = items.shape
+    deg = graph.shape[1]
+    nq = Q.shape[0]
+    itopk_eff = min(itopk, n)
+    x2 = jnp.sum(items * items, axis=1)
+
+    def dists_to(ids):  # ids (nq, m) -> squared distances (nq, m)
+        vecs = items[ids]  # gather
+        cross = jnp.einsum("qmd,qd->qm", vecs, Q, precision=FAST)
+        q2 = jnp.sum(Q * Q, axis=1, keepdims=True)
+        return jnp.maximum(q2 - 2.0 * cross + x2[ids], 0.0)
+
+    # entry points: an even stride over the items (randomization-free, shape-static)
+    ids0 = jnp.linspace(0, n - 1, itopk_eff).astype(jnp.int32)
+    ids0 = jnp.broadcast_to(ids0, (nq, itopk_eff))
+    d20 = dists_to(ids0)
+    visited0 = jnp.zeros((nq, itopk_eff), bool)
+
+    def body(_, state):
+        ids, d2, visited = state
+        # expand the best unvisited pool entry
+        expand_key = jnp.where(visited, jnp.inf, d2)
+        best = jnp.argmin(expand_key, axis=1)  # (nq,)
+        visited = visited | jax.nn.one_hot(best, itopk_eff, dtype=bool)
+        best_id = jnp.take_along_axis(ids, best[:, None], axis=1)[:, 0]
+        nbrs = graph[best_id]  # (nq, deg)
+        nd2 = dists_to(nbrs)
+
+        all_ids = jnp.concatenate([ids, nbrs], axis=1)
+        all_d2 = jnp.concatenate([d2, nd2], axis=1)
+        all_vis = jnp.concatenate([visited, jnp.zeros((nq, deg), bool)], axis=1)
+
+        # duplicate suppression: sort by id; any entry equal to its left neighbor is
+        # a duplicate -> inf distance (never ranks) + visited (never re-expands).
+        # Stable sort keeps the pool's copy (with its visited flag) first.
+        order = jnp.argsort(all_ids, axis=1, stable=True)
+        sid = jnp.take_along_axis(all_ids, order, axis=1)
+        sd2 = jnp.take_along_axis(all_d2, order, axis=1)
+        svis = jnp.take_along_axis(all_vis, order, axis=1)
+        dup = jnp.concatenate(
+            [jnp.zeros((nq, 1), bool), sid[:, 1:] == sid[:, :-1]], axis=1
+        )
+        sd2 = jnp.where(dup, jnp.inf, sd2)
+        svis = svis | dup
+
+        neg, pos = jax.lax.top_k(-sd2, itopk_eff)
+        new_ids = jnp.take_along_axis(sid, pos, axis=1)
+        new_vis = jnp.take_along_axis(svis, pos, axis=1)
+        return new_ids, -neg, new_vis
+
+    ids, d2, _ = jax.lax.fori_loop(0, iterations, body, (ids0, d20, visited0))
+    k_eff = min(k, itopk_eff)
+    neg, pos = jax.lax.top_k(-d2, k_eff)
+    out_ids = jnp.take_along_axis(ids, pos, axis=1)
+    return jnp.sqrt(jnp.maximum(-neg, 0.0)), out_ids
