@@ -194,6 +194,34 @@ class KMeans(_KMeansClass, _TpuEstimator, _KMeansParams):
     def _create_pyspark_model(self, attrs: Dict[str, Any]) -> "KMeansModel":
         return KMeansModel(**attrs)
 
+    def _streaming_fit(self, fd) -> Dict[str, Any]:
+        """Out-of-core exact Lloyd (ops/streaming.py): full-pass center updates with
+        one batch resident at a time — the KMeans analog of the reference's UVM/SAM
+        large-dataset path (utils.py:184-241). Selected automatically when the design
+        matrix exceeds stream_threshold_bytes (core/estimator.py)."""
+        from .. import config as _config
+        from ..core.dataset import densify as _densify
+        from ..ops.streaming import streaming_kmeans_fit
+        from ..parallel.mesh import get_mesh
+
+        p = self._tpu_params
+        if int(p["n_clusters"]) > fd.n_rows:
+            raise ValueError(
+                f"k={p['n_clusters']} exceeds the number of rows {fd.n_rows}."
+            )
+        return streaming_kmeans_fit(
+            _densify(fd.features, self._float32_inputs),
+            fd.weight,
+            k=int(p["n_clusters"]),
+            max_iter=int(p["max_iter"]),
+            tol=float(p["tol"]),
+            seed=int(p["random_state"]) if p["random_state"] is not None else 1,
+            batch_rows=int(_config.get("stream_batch_rows")),
+            mesh=get_mesh(self.num_workers),
+            metric=str(p.get("metric", "euclidean")),
+            float32=self._float32_inputs,
+        )
+
     def _fit_fallback_model(self, twin: type, fd) -> Dict[str, Any]:
         if self.getOrDefault("distanceMeasure") != "euclidean":
             raise ValueError(

@@ -229,6 +229,7 @@ class _RandomForestEstimator(_RandomForestClass, _TpuEstimatorSupervised, _Rando
                 bootstrap=bool(p["bootstrap"]),
                 seed=int(p["random_state"]) if p["random_state"] is not None else 0,
                 shard_fn=shard_fn,
+                mesh=mesh,
             )
             attrs["num_classes"] = n_classes
             return attrs

@@ -131,3 +131,114 @@ def streaming_covariance(
     mean = sx / sw
     cov = (S2 - sw * jnp.outer(mean, mean)) / (sw - 1.0)
     return cov, mean, sw
+
+
+@functools.partial(jax.jit, static_argnames=("cosine",))
+def _accum_kmeans(carry, centers, X, w, cosine: bool = False):
+    """One batch of a streamed Lloyd iteration: accumulate per-cluster weighted sums,
+    counts and inertia against FIXED centers."""
+    sums, counts, inertia = carry
+    if cosine:
+        d2 = 1.0 - pdot(X, centers.T)
+    else:
+        x2 = jnp.sum(X * X, axis=1, keepdims=True)
+        c2 = jnp.sum(centers * centers, axis=1)
+        d2 = jnp.maximum(x2 - 2.0 * pdot(X, centers.T) + c2, 0.0)
+    assign = jnp.argmin(d2, axis=1)
+    min_d2 = jnp.min(d2, axis=1)
+    onehot = jax.nn.one_hot(assign, centers.shape[0], dtype=X.dtype) * w[:, None]
+    return (
+        sums + pdot(onehot.T, X),
+        counts + jnp.sum(onehot, axis=0),
+        inertia + jnp.sum(w * min_d2),
+    )
+
+
+def streaming_kmeans_fit(
+    X: np.ndarray,
+    w: Optional[np.ndarray],
+    k: int,
+    max_iter: int,
+    tol: float,
+    seed: int,
+    batch_rows: int,
+    mesh=None,
+    metric: str = "euclidean",
+    init_sample_rows: int = 1 << 18,
+    float32: bool = True,
+):
+    """Out-of-core EXACT Lloyd: each iteration streams every batch through the device
+    against fixed centers and accumulates (Σ one-hotᵀWX, counts, inertia); centers
+    update once per full pass, so iterates match in-core Lloyd on the same init
+    (not a minibatch approximation). Device residency is one batch + (k, d) stats —
+    the KMeans analog of the reference's UVM/SAM large-dataset path
+    (reference utils.py:184-241). Initialization runs in-core k-means|| on a row
+    subsample bounded by `init_sample_rows`."""
+    from .kmeans import _normalize_rows, kmeans_init
+    from ..parallel.mesh import shard_array
+    from ..parallel.partition import pad_rows
+
+    dt = np.float32 if float32 else np.float64
+    n, d = X.shape
+    cosine = metric == "cosine"
+    if w is None:
+        w = np.ones((n,), dt)
+
+    # init on a subsample (rows are not assumed shuffled: use a strided sample)
+    step = max(1, n // min(n, init_sample_rows))
+    Xs = np.ascontiguousarray(X[::step], dtype=dt)
+    ws = np.ascontiguousarray(w[::step], dtype=dt)
+    Xs_j = jnp.asarray(Xs if not cosine else np.asarray(
+        Xs / np.maximum(np.linalg.norm(Xs, axis=1, keepdims=True), 1e-30)))
+    centers = jnp.asarray(
+        kmeans_init(Xs_j, jnp.asarray(ws), k, "k-means||", 2, seed)
+    )
+    if cosine:
+        centers = _normalize_rows(centers)
+
+    inertia = np.inf
+    n_iter = 0
+    for it in range(max_iter):
+        carry = (
+            jnp.zeros((k, d), dt),
+            jnp.zeros((k,), dt),
+            jnp.zeros((), dt),
+        )
+        for s in range(0, n, batch_rows):
+            e = min(s + batch_rows, n)
+            Xb = np.ascontiguousarray(X[s:e], dtype=dt)
+            if cosine:
+                norms = np.linalg.norm(Xb, axis=1, keepdims=True)
+                if np.any(norms <= 0):
+                    raise ValueError(
+                        "Cosine distance is not defined for zero-length vectors."
+                    )
+                Xb = Xb / norms
+            wb = np.ascontiguousarray(w[s:e], dtype=dt)
+            if mesh is not None:
+                Xb, pad_w, (wb_p,) = pad_rows(Xb, mesh.devices.size, wb)
+                Xb = shard_array(Xb, mesh)
+                wb = shard_array(pad_w * wb_p, mesh)
+            carry = _accum_kmeans(
+                carry, centers, jnp.asarray(Xb), jnp.asarray(wb), cosine
+            )
+        sums, counts, inertia_j = carry
+        new_centers = jnp.where(
+            counts[:, None] > 0,
+            sums / jnp.maximum(counts, 1.0)[:, None],
+            centers,
+        )
+        if cosine:
+            new_centers = _normalize_rows(new_centers)
+        shift2 = float(jnp.max(jnp.sum((new_centers - centers) ** 2, axis=1)))
+        centers = new_centers
+        inertia = float(inertia_j)
+        n_iter = it + 1
+        if shift2 <= tol * tol:
+            break
+
+    return {
+        "cluster_centers": np.asarray(centers),
+        "inertia": inertia,
+        "n_iter": n_iter,
+    }

@@ -111,15 +111,17 @@ def _histogram(
     n_nodes: int,
     nbins: int,
     use_pallas: bool = False,
+    mesh=None,
 ) -> jax.Array:
-    """(n_nodes, d, nbins, s) histogram. On a single TPU device this runs the pallas
-    one-hot-matmul kernel (ops/pallas_histogram.py — MXU contraction instead of XLA
-    scatter); otherwise a per-feature segment_sum, whose replicated output makes XLA
-    psum partial histograms across row-sharded meshes."""
+    """(n_nodes, d, nbins, s) histogram. On TPU this runs the pallas one-hot-matmul
+    kernel (ops/pallas_histogram.py — MXU contraction instead of XLA scatter):
+    single-device as a plain pallas_call, multi-device per-shard under shard_map
+    with a psum merge. The segment_sum fallback's replicated output makes XLA psum
+    partial histograms the same way."""
     from .pallas_histogram import segment_histogram
 
     seg_ids = node_id[:, None] * nbins + Xb  # (n, d)
-    hist = segment_histogram(seg_ids, values, n_nodes * nbins, use_pallas)
+    hist = segment_histogram(seg_ids, values, n_nodes * nbins, use_pallas, mesh=mesh)
     d = Xb.shape[1]
     return hist.reshape(d, n_nodes, nbins, values.shape[1]).transpose(1, 0, 2, 3)
 
@@ -134,6 +136,7 @@ def _histogram(
         "min_instances",
         "min_info_gain",
         "use_pallas",
+        "mesh",  # jax.sharding.Mesh is hashable; static so shard_map can close over it
     ),
 )
 def build_tree(
@@ -148,6 +151,7 @@ def build_tree(
     min_instances: int,
     min_info_gain: float,
     use_pallas: bool = False,
+    mesh=None,
 ) -> Dict[str, jax.Array]:
     """Grow one tree; returns heap arrays of size 2^(max_depth+1):
     feature (int32, -1 for leaf), threshold (f32), is_leaf (bool), value (slots, v)."""
@@ -166,7 +170,7 @@ def build_tree(
 
     for t in range(max_depth):
         width = 2**t
-        hist = _histogram(Xb, values, node_id, width, nbins, use_pallas)  # (w, d, b, s)
+        hist = _histogram(Xb, values, node_id, width, nbins, use_pallas, mesh)  # (w, d, b, s)
         cum = jnp.cumsum(hist, axis=2)
         L = cum[:, :, :-1, :]  # split at bin 0..b-2
         R = T[:, None, None, :] - L
@@ -298,6 +302,7 @@ def forest_fit(
     bootstrap: bool,
     seed: int,
     shard_fn=None,
+    mesh=None,
 ) -> Dict[str, np.ndarray]:
     """Bin once, then grow the forest tree-by-tree (one XLA compile; trees differ
     only in their bootstrap weights and PRNG key). `shard_fn` optionally places the
@@ -341,6 +346,7 @@ def forest_fit(
             min_instances=min_instances,
             min_info_gain=min_info_gain,
             use_pallas=use_pallas,
+            mesh=mesh if (mesh is not None and mesh.devices.size > 1) else None,
         )
         trees.append({k: np.asarray(v) for k, v in tree.items()})
 
