@@ -390,11 +390,14 @@ class _TpuEstimator(_TpuCaller):
             return False
         if mode == "barrier":
             return True
-        try:
-            import pyspark  # noqa: F401
+        # auto: require a REAL pyspark distribution. `import pyspark` is not enough —
+        # the no-import-change interposer (install.py) plants stub parent modules at
+        # sys.modules["pyspark"] in pyspark-less environments.
+        import importlib.util
 
-            return True
-        except ImportError:
+        try:
+            return importlib.util.find_spec("pyspark.sql") is not None
+        except (ImportError, ValueError):
             return False
 
     def _fallback_fit(self, dataset: Any) -> "_TpuModel":
@@ -528,12 +531,10 @@ class _TpuModel(_TpuClass, _TpuParams):
         return True
 
     def _transformEvaluate(self, dataset: Any, evaluator: Any) -> float:
-        """Transform-then-evaluate hook used by CrossValidator. The default is the
-        plain two-step host path; subclasses may fuse prediction + partial-metric
-        computation into one device pass (the reference's
-        _transform_evaluate_internal, core.py:1572-1693) and signal support via
-        _supportsTransformEvaluate."""
-        return evaluator.evaluate(self.transform(dataset))
+        """Fused transform+evaluate used by CrossValidator: features extract once,
+        predictions stay arrays, and only the evaluator's columns materialize (the
+        reference's one-pass _transform_evaluate_internal, core.py:1572-1693)."""
+        return transform_evaluate_multi([self], dataset, evaluator)[0]
 
     # ---- persistence (reference core.py:310-355) ----
 
@@ -550,6 +551,58 @@ class _TpuModel(_TpuClass, _TpuParams):
     @classmethod
     def load(cls, path: str) -> Any:
         return cls.read().load(path)
+
+
+def transform_evaluate_multi(
+    models: Sequence["_TpuModel"], dataset: Any, evaluator: Any
+) -> List[float]:
+    """Evaluate MANY models over ONE feature-extraction scan — the structural
+    equivalent of the reference's single-scan transform+evaluate with a model_index
+    column (reference core.py:1572-1693). The dataset's features/label/weight are
+    extracted once; each model contributes only its prediction arrays, and the
+    evaluator sees a minimal frame of exactly its columns (the input's other columns
+    are never copied)."""
+    import pandas as pd
+
+    from .dataset import _is_spark_df
+
+    if not models:
+        return []
+    m0 = models[0]
+    if _is_spark_df(dataset):
+        dataset = dataset.toPandas()
+    input_col, input_cols = m0._input_col_for_transform()
+    label_col = (
+        evaluator.getOrDefault("labelCol") if evaluator.hasParam("labelCol") else None
+    )
+    weight_col = (
+        evaluator.getOrDefault("weightCol")
+        if evaluator.hasParam("weightCol") and evaluator.isDefined("weightCol")
+        else None
+    )
+    fd = extract_feature_data(
+        dataset,
+        input_col=input_col,
+        input_cols=input_cols,
+        label_col=label_col,
+        weight_col=weight_col,
+        float32=m0._float32_inputs,
+    )
+    X = densify(fd.features, float32=m0._float32_inputs)
+
+    def _colify(v):
+        return v if np.ndim(v) == 1 else list(v)
+
+    scores: List[float] = []
+    for m in models:
+        outputs = m._transform_arrays(X)
+        cols: Dict[str, Any] = {name: _colify(v) for name, v in outputs.items()}
+        if label_col is not None and fd.label is not None:
+            cols[label_col] = fd.label
+        if weight_col is not None and fd.weight is not None:
+            cols[weight_col] = fd.weight
+        scores.append(evaluator.evaluate(pd.DataFrame(cols)))
+    return scores
 
 
 class _TpuEstimatorSupervised(_TpuEstimator):

@@ -43,6 +43,12 @@ class Evaluator(Params):
     def evaluate(self, dataset: Any, params: Optional[dict] = None) -> float:
         if params:
             return self.copy(params).evaluate(dataset)
+        from .core.dataset import _is_spark_df
+
+        if _is_spark_df(dataset):
+            # columnar collect of just the evaluator's columns; the distributed
+            # evaluate path is the fused transform_evaluate_multi (core/estimator.py)
+            dataset = dataset.toPandas()
         return self._evaluate(dataset)
 
     def _evaluate(self, dataset: Any) -> float:

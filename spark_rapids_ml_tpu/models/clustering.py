@@ -169,25 +169,40 @@ class KMeans(_KMeansClass, _TpuEstimator, _KMeansParams):
     def _out_schema(self) -> List[str]:
         return ["cluster_centers", "inertia", "n_iter"]
 
+    def _enable_fit_multiple_in_single_pass(self) -> bool:
+        # the sharded design matrix is staged on the mesh ONCE and every param map's
+        # Lloyd run reuses it (reference loops cuML fits over the concatenated data,
+        # P6 pattern, SURVEY.md §2.7)
+        return True
+
     def _get_tpu_fit_func(self, extra_params: Optional[List[Dict[str, Any]]] = None):
-        def _fit(inputs: FitInputs) -> Dict[str, Any]:
-            p = inputs.params
-            if int(p["n_clusters"]) > inputs.desc.m:
-                raise ValueError(
-                    f"k={p['n_clusters']} exceeds the number of rows {inputs.desc.m}; "
-                    "initialization would select padding rows as centers."
+        base = dict(self._tpu_params)
+
+        def _fit(inputs: FitInputs):
+            param_sets = extra_params if extra_params is not None else [base]
+            results = []
+            for ep in param_sets:
+                p = {**base, **ep}
+                if int(p["n_clusters"]) > inputs.desc.m:
+                    raise ValueError(
+                        f"k={p['n_clusters']} exceeds the number of rows "
+                        f"{inputs.desc.m}; initialization would select padding rows "
+                        "as centers."
+                    )
+                results.append(
+                    kmeans_fit(
+                        inputs.features,
+                        inputs.row_weight,
+                        k=int(p["n_clusters"]),
+                        max_iter=int(p["max_iter"]),
+                        tol=float(p["tol"]),
+                        init=str(p["init"]),
+                        init_steps=int(p["init_steps"]),
+                        seed=int(p["random_state"]) if p["random_state"] is not None else 1,
+                        metric=str(p.get("metric", "euclidean")),
+                    )
                 )
-            return kmeans_fit(
-                inputs.features,
-                inputs.row_weight,
-                k=int(p["n_clusters"]),
-                max_iter=int(p["max_iter"]),
-                tol=float(p["tol"]),
-                init=str(p["init"]),
-                init_steps=int(p["init_steps"]),
-                seed=int(p["random_state"]) if p["random_state"] is not None else 1,
-                metric=str(p.get("metric", "euclidean")),
-            )
+            return results if extra_params is not None else results[0]
 
         return _fit
 

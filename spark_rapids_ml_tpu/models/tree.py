@@ -195,11 +195,16 @@ class _RandomForestEstimator(_RandomForestClass, _TpuEstimatorSupervised, _Rando
     def _impurity_name(self) -> str:
         raise NotImplementedError
 
+    def _enable_fit_multiple_in_single_pass(self) -> bool:
+        # host rows + per-tree stats are staged once; each param map re-bins only if
+        # its n_bins differs (P6 pattern, reference tree.py:475-507)
+        return True
+
     def _get_tpu_fit_func(self, extra_params: Optional[List[Dict[str, Any]]] = None):
-        p = dict(self._tpu_params)
+        base = dict(self._tpu_params)
         is_cls = self._is_classification
 
-        def _fit(inputs: FitInputs) -> Dict[str, Any]:
+        def _fit(inputs: FitInputs):
             X = inputs.host_features
             stats, n_classes = self._row_stats(inputs)
             d = X.shape[1]
@@ -213,26 +218,31 @@ class _RandomForestEstimator(_RandomForestClass, _TpuEstimatorSupervised, _Rando
                 padded, _, _ = pad_rows(arr, n_dev)
                 return shard_array(padded, mesh)
 
-            attrs = forest_fit(
-                X,
-                stats,
-                n_trees=int(p["n_estimators"]),
-                max_depth=int(p["max_depth"]),
-                max_bins=int(p["n_bins"]),
-                impurity=self._impurity_name(),
-                feature_subset=resolve_feature_subset(
-                    str(p["max_features"]), d, is_cls
-                ),
-                min_instances=int(p["min_samples_leaf"]),
-                min_info_gain=float(p["min_impurity_decrease"]),
-                subsampling_rate=float(p["max_samples"]),
-                bootstrap=bool(p["bootstrap"]),
-                seed=int(p["random_state"]) if p["random_state"] is not None else 0,
-                shard_fn=shard_fn,
-                mesh=mesh,
-            )
-            attrs["num_classes"] = n_classes
-            return attrs
+            param_sets = extra_params if extra_params is not None else [base]
+            results = []
+            for ep in param_sets:
+                p = {**base, **ep}
+                attrs = forest_fit(
+                    X,
+                    stats,
+                    n_trees=int(p["n_estimators"]),
+                    max_depth=int(p["max_depth"]),
+                    max_bins=int(p["n_bins"]),
+                    impurity=self._impurity_name(),
+                    feature_subset=resolve_feature_subset(
+                        str(p["max_features"]), d, is_cls
+                    ),
+                    min_instances=int(p["min_samples_leaf"]),
+                    min_info_gain=float(p["min_impurity_decrease"]),
+                    subsampling_rate=float(p["max_samples"]),
+                    bootstrap=bool(p["bootstrap"]),
+                    seed=int(p["random_state"]) if p["random_state"] is not None else 0,
+                    shard_fn=shard_fn,
+                    mesh=mesh,
+                )
+                attrs["num_classes"] = n_classes
+                results.append(attrs)
+            return results if extra_params is not None else results[0]
 
         return _fit
 

@@ -54,6 +54,24 @@ class ParamGridBuilder:
         ]
 
 
+def _evaluate_fold(models: List[Any], test: Any, evaluator: Any) -> List[float]:
+    """Evaluate a fold's models in ONE transform scan when they all support the fused
+    path (reference one-scan transform+evaluate with model_index, core.py:1572-1693);
+    per-model two-step otherwise."""
+    fused = (
+        models
+        and all(
+            getattr(m, "_supportsTransformEvaluate", lambda: False)() for m in models
+        )
+        and len({type(m) for m in models}) == 1
+    )
+    if fused:
+        from .core.estimator import transform_evaluate_multi
+
+        return transform_evaluate_multi(models, test, evaluator)
+    return [evaluator.evaluate(m.transform(test)) for m in models]
+
+
 class _CrossValidatorParams(HasSeed, HasParallelism, HasCollectSubModels):
     numFolds: Param[int] = Param(
         "undefined",
@@ -166,14 +184,10 @@ class CrossValidator(_CrossValidatorParams):
 
         for train, test in self._kFold(dataset):
             fold_models: List[Any] = [None] * n_models
-            # ONE pass per fold when the estimator supports it (fitMultiple)
+            # ONE fit pass per fold when the estimator supports it (fitMultiple)
             for index, model in est.fitMultiple(train, maps):
                 fold_models[index] = model
-            for i, model in enumerate(fold_models):
-                if getattr(model, "_supportsTransformEvaluate", lambda: False)():
-                    metrics[i] += model._transformEvaluate(test, evaluator)
-                else:
-                    metrics[i] += evaluator.evaluate(model.transform(test))
+            metrics += np.asarray(_evaluate_fold(fold_models, test, evaluator))
             if sub_models is not None:
                 sub_models.append(fold_models)
 
@@ -258,15 +272,10 @@ class TrainValidationSplit(_TrainValidationSplitParams):
         train = dataset.iloc[mask].reset_index(drop=True)
         val = dataset.iloc[~mask].reset_index(drop=True)
 
-        metrics = np.zeros((len(maps),), dtype=np.float64)
         models: List[Any] = [None] * len(maps)
         for index, model in est.fitMultiple(train, maps):
             models[index] = model
-        for i, model in enumerate(models):
-            if getattr(model, "_supportsTransformEvaluate", lambda: False)():
-                metrics[i] = model._transformEvaluate(val, evaluator)
-            else:
-                metrics[i] = evaluator.evaluate(model.transform(val))
+        metrics = np.asarray(_evaluate_fold(models, val, evaluator), dtype=np.float64)
         best_index = (
             int(np.argmax(metrics)) if evaluator.isLargerBetter() else int(np.argmin(metrics))
         )

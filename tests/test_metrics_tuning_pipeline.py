@@ -226,3 +226,90 @@ class TestPipeline:
         assembler = VectorAssembler(inputCols=["a", "b", "c"], outputCol="vec")
         out = assembler.transform(df)
         np.testing.assert_allclose(np.stack(out["vec"].to_numpy()), X)
+
+
+# ---- round 2: fused transform+evaluate and single-pass fitMultiple (P6) ----
+
+
+def test_cv_single_extraction_per_fold(monkeypatch):
+    """CV over an n-point grid does ONE feature extraction per fold on the fit side
+    and ONE on the evaluate side (reference one-scan path, core.py:1572-1693) —
+    asserted by a pass counter, not timing."""
+    import spark_rapids_ml_tpu.core.estimator as est_mod
+    from spark_rapids_ml_tpu.classification import LogisticRegression
+    from spark_rapids_ml_tpu.evaluation import MulticlassClassificationEvaluator
+    from spark_rapids_ml_tpu.tuning import CrossValidator, ParamGridBuilder
+
+    rng = np.random.default_rng(0)
+    X = np.concatenate(
+        [rng.normal(-2, 1, (60, 4)), rng.normal(2, 1, (60, 4))]
+    ).astype(np.float32)
+    y = np.repeat([0.0, 1.0], 60)
+    df = pd.DataFrame({"features": list(X), "label": y})
+
+    counter = {"n": 0}
+    real_extract = est_mod.extract_feature_data
+
+    def counting_extract(*args, **kwargs):
+        counter["n"] += 1
+        return real_extract(*args, **kwargs)
+
+    monkeypatch.setattr(est_mod, "extract_feature_data", counting_extract)
+
+    lr = LogisticRegression(maxIter=30)
+    grid = (
+        ParamGridBuilder().addGrid(lr.regParam, [0.0, 0.01, 0.1]).build()
+    )
+    cv = CrossValidator(
+        estimator=lr,
+        estimatorParamMaps=grid,
+        evaluator=MulticlassClassificationEvaluator(metricName="accuracy"),
+        numFolds=2,
+        seed=1,
+    )
+    cv.fit(df)
+    # 2 folds x (1 fit extraction + 1 evaluate extraction) + 1 best-model refit = 5,
+    # NOT 2 folds x 3 models x 2 = 12
+    assert counter["n"] == 5, counter["n"]
+
+
+def test_kmeans_fit_multiple_single_pass():
+    from spark_rapids_ml_tpu.clustering import KMeans
+
+    rng = np.random.default_rng(1)
+    X = np.concatenate(
+        [rng.normal(-4, 0.5, (80, 3)), rng.normal(4, 0.5, (80, 3))]
+    ).astype(np.float32)
+    df = pd.DataFrame({"features": list(X)})
+    est = KMeans(seed=7, maxIter=20)
+    assert est._enable_fit_multiple_in_single_pass()
+    maps = [{est.getParam("k"): 2}, {est.getParam("k"): 3}]
+    models = est.fit(df, maps)
+    assert np.asarray(models[0].cluster_centers_).shape == (2, 3)
+    assert np.asarray(models[1].cluster_centers_).shape == (3, 3)
+    # single-fit parity
+    single = KMeans(seed=7, maxIter=20, k=2).fit(df)
+    np.testing.assert_allclose(
+        np.sort(np.asarray(models[0].cluster_centers_), axis=0),
+        np.sort(np.asarray(single.cluster_centers_), axis=0),
+        atol=1e-5,
+    )
+
+
+def test_rf_fit_multiple_single_pass():
+    from spark_rapids_ml_tpu.classification import RandomForestClassifier
+
+    rng = np.random.default_rng(2)
+    X = np.concatenate(
+        [rng.normal(-2, 1, (60, 4)), rng.normal(2, 1, (60, 4))]
+    ).astype(np.float32)
+    y = np.repeat([0.0, 1.0], 60)
+    df = pd.DataFrame({"features": list(X), "label": y})
+    est = RandomForestClassifier(numTrees=4, seed=3)
+    assert est._enable_fit_multiple_in_single_pass()
+    maps = [{est.getParam("maxDepth"): 2}, {est.getParam("maxDepth"): 4}]
+    models = est.fit(df, maps)
+    preds0 = models[0].transform(df)["prediction"].to_numpy()
+    preds1 = models[1].transform(df)["prediction"].to_numpy()
+    assert (preds0 == y).mean() > 0.9
+    assert (preds1 == y).mean() > 0.9
