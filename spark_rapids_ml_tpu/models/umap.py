@@ -43,9 +43,10 @@ class _UMAPClass(_TpuClass):
             "seed": "random_state",
             "featuresCol": "",
             "featuresCols": "",
-            # supervised UMAP (reference supports labelCol) is not yet implemented on
-            # the TPU path: setting it must surface, not silently run unsupervised
-            "labelCol": None,
+            # supervised UMAP: labelCol switches on the categorical simplicial-set
+            # intersection (ops/umap_ops.categorical_intersection)
+            "labelCol": "",
+            "init": "init",
             "outputCol": "",
         }
 
@@ -61,6 +62,7 @@ class _UMAPClass(_TpuClass):
             "negative_sample_rate": 5,
             "learning_rate": 1.0,
             "random_state": 42,
+            "init": "spectral",
         }
 
     @classmethod
@@ -100,6 +102,13 @@ class _UMAPParams(HasFeaturesCol, HasFeaturesCols, HasLabelCol, HasOutputCol, Ha
         "fraction of the input dataset used for fit (reference umap.py:923-951).",
         TypeConverters.toFloat,
     )
+    init: Param[str] = Param(
+        "undefined",
+        "init",
+        "embedding initialization: 'spectral' (graph Laplacian eigenvectors, the "
+        "cuML default) or 'random'.",
+        TypeConverters.toString,
+    )
 
     def setFeaturesCol(self, value: str):
         return self._set(featuresCol=value)
@@ -126,6 +135,7 @@ class UMAP(_UMAPClass, _TpuEstimator, _UMAPParams):
             learning_rate=1.0,
             seed=42,
             sample_fraction=1.0,
+            init="spectral",
         )
         self.initialize_tpu_params()
         self._set_params(**kwargs)
@@ -133,17 +143,47 @@ class UMAP(_UMAPClass, _TpuEstimator, _UMAPParams):
     def _out_schema(self) -> List[str]:
         return ["embedding", "raw_data", "a", "b", "n_neighbors"]
 
+    def _use_label(self) -> bool:
+        # supervised UMAP when a labelCol is explicitly set (reference umap.py)
+        return self.hasParam("labelCol") and self.isDefined("labelCol")
+
+    def _build_fit_inputs(self, fd) -> FitInputs:
+        if fd.is_sparse:
+            # sparse UMAP fit keeps the CSR on host end-to-end (the kNN graph comes
+            # from blocked sparse-sparse products, ops/umap_ops.sparse_knn_graph —
+            # reference sparse path umap.py:955-972); no mesh staging needed
+            from ..parallel.mesh import get_mesh
+            from ..parallel.partition import PartitionDescriptor
+
+            desc = PartitionDescriptor.build(
+                [fd.n_rows], fd.n_cols, nnz=int(fd.features.nnz)
+            )
+            return FitInputs(
+                features=None,
+                row_weight=None,
+                desc=desc,
+                mesh=get_mesh(self.num_workers),
+                params=dict(self._tpu_params),
+                host_features=fd.features,
+                host_label=fd.label,
+            )
+        return super()._build_fit_inputs(fd)
+
     def _get_tpu_fit_func(self, extra_params: Optional[List[Dict[str, Any]]] = None):
         p = dict(self._tpu_params)
         frac = self.getOrDefault("sample_fraction")
+        supervised = self._use_label()
 
         def _fit(inputs: FitInputs) -> Dict[str, Any]:
             X = inputs.host_features
+            y = inputs.host_label if supervised else None
             seed = int(p["random_state"]) if p["random_state"] is not None else 42
             if frac < 1.0:
                 rng = np.random.default_rng(seed)
                 keep = rng.random(X.shape[0]) < frac
                 X = X[keep]
+                if y is not None:
+                    y = y[keep]
             return umap_fit(
                 X,
                 n_neighbors=int(p["n_neighbors"]),
@@ -155,6 +195,8 @@ class UMAP(_UMAPClass, _TpuEstimator, _UMAPParams):
                 learning_rate=float(p["learning_rate"]),
                 seed=seed,
                 mesh=inputs.mesh,
+                y=y,
+                init=str(p.get("init", "spectral")),
             )
 
         return _fit
@@ -167,14 +209,16 @@ class UMAPModel(_UMAPClass, _TpuModelWithColumns, _UMAPParams):
     def __init__(
         self,
         embedding: np.ndarray,
-        raw_data: np.ndarray,
+        raw_data: Any,
         a: float,
         b: float,
         n_neighbors: int,
     ) -> None:
+        from ..core.dataset import _is_sparse
+
         super().__init__(
             embedding=np.asarray(embedding),
-            raw_data=np.asarray(raw_data),
+            raw_data=raw_data if _is_sparse(raw_data) else np.asarray(raw_data),
             a=float(a),
             b=float(b),
             n_neighbors=int(n_neighbors),

@@ -152,8 +152,92 @@ def optimize_layout(
     return emb
 
 
+def categorical_intersection(
+    heads: np.ndarray,
+    tails: np.ndarray,
+    weights: np.ndarray,
+    y: np.ndarray,
+    unknown_dist: float = 1.0,
+    far_dist: float = 5.0,
+) -> np.ndarray:
+    """Supervised (categorical-target) intersection of the fuzzy graph: edges between
+    differently-labeled points are attenuated by exp(-far_dist), edges touching an
+    unknown label (y < 0) by exp(-unknown_dist), same-label edges untouched — the
+    standard categorical simplicial-set intersection the reference exposes via
+    labelCol (reference umap.py fit path; cuML target_metric='categorical')."""
+    yh, yt = y[heads], y[tails]
+    factor = np.where(
+        (yh < 0) | (yt < 0),
+        np.exp(-unknown_dist),
+        np.where(yh == yt, 1.0, np.exp(-far_dist)),
+    ).astype(np.float32)
+    return weights * factor
+
+
+def spectral_init(
+    heads: np.ndarray,
+    tails: np.ndarray,
+    weights: np.ndarray,
+    n: int,
+    n_components: int,
+    seed: int,
+) -> np.ndarray:
+    """Spectral embedding initialization: the first non-trivial eigenvectors of the
+    symmetric-normalized graph Laplacian of the fuzzy graph (umap-learn/cuML's
+    default init, absent in round 1). Falls back to scaled random on solver failure
+    (disconnected graphs, convergence)."""
+    import scipy.sparse as sp
+    import scipy.sparse.linalg as spla
+
+    rng = np.random.default_rng(seed & 0x7FFFFFFF)
+    try:
+        W = sp.coo_matrix((weights, (heads, tails)), shape=(n, n)).tocsr()
+        deg = np.asarray(W.sum(axis=1)).ravel()
+        dinv = 1.0 / np.sqrt(np.maximum(deg, 1e-12))
+        L = sp.identity(n) - sp.diags(dinv) @ W @ sp.diags(dinv)
+        k_eig = n_components + 1
+        # shift-invert around 0 finds the smallest eigenvalues fast on kNN graphs
+        vals, vecs = spla.eigsh(
+            L, k=k_eig, sigma=0.0, which="LM",
+            v0=rng.normal(size=n), maxiter=2000, tol=1e-4,
+        )
+        order = np.argsort(vals)
+        emb = vecs[:, order[1 : n_components + 1]]  # drop the trivial eigenvector
+        # scale to the +-10 box the SGD expects
+        emb = emb / np.maximum(np.abs(emb).max(axis=0, keepdims=True), 1e-12) * 10.0
+        noise = rng.normal(0, 1e-4, size=emb.shape)
+        return (emb + noise).astype(np.float32)
+    except Exception:
+        return rng.uniform(-10, 10, size=(n, n_components)).astype(np.float32)
+
+
+def sparse_knn_graph(
+    X_csr, k: int, block: int = 1024
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Exact kNN over a scipy CSR matrix WITHOUT densifying the data: blocked
+    sparse-sparse cross products (Qb @ Xᵀ) give the distance matrix one query block
+    at a time — memory is O(block·n + nnz), never O(n·d). This is the sparse-fit
+    path the reference supports via cuML's sparse UMAP (reference umap.py:955-972)."""
+    n = X_csr.shape[0]
+    x2 = np.asarray(X_csr.multiply(X_csr).sum(axis=1)).ravel()
+    XT = X_csr.T.tocsc()
+    k_eff = min(k, n)
+    ids = np.zeros((n, k_eff), np.int64)
+    dists = np.zeros((n, k_eff), np.float32)
+    for s in range(0, n, block):
+        e = min(s + block, n)
+        cross = np.asarray((X_csr[s:e] @ XT).todense())
+        d2 = np.maximum(x2[s:e, None] - 2.0 * cross + x2[None, :], 0.0)
+        part = np.argpartition(d2, k_eff - 1, axis=1)[:, :k_eff]
+        pd2 = np.take_along_axis(d2, part, axis=1)
+        order = np.argsort(pd2, axis=1, kind="stable")
+        ids[s:e] = np.take_along_axis(part, order, axis=1)
+        dists[s:e] = np.sqrt(np.take_along_axis(pd2, order, axis=1))
+    return ids, dists
+
+
 def umap_fit(
-    X: np.ndarray,
+    X,
     n_neighbors: int,
     n_components: int,
     n_epochs: int,
@@ -163,24 +247,43 @@ def umap_fit(
     learning_rate: float,
     seed: int,
     mesh=None,
+    y: "np.ndarray | None" = None,
+    init: str = "spectral",
 ) -> Dict[str, np.ndarray]:
-    """Full UMAP fit on host-resident X; kNN + SGD run on device."""
+    """Full UMAP fit; X may be dense (n, d) or scipy CSR (sparse stays sparse
+    end-to-end: sparse kNN graph + device SGD on the edge list). `y` switches on the
+    supervised categorical intersection; `init` is 'spectral' or 'random'."""
     from .knn import exact_knn_single
     import jax.numpy as jnp
 
+    try:
+        import scipy.sparse as sp
+
+        is_sparse = sp.issparse(X)
+    except ImportError:  # pragma: no cover
+        is_sparse = False
+
     n = X.shape[0]
     k = min(n_neighbors + 1, n)
-    d2, ids = exact_knn_single(
-        jnp.asarray(X), jnp.asarray(X), jnp.ones((n,), bool), k
-    )
-    knn_dists = np.sqrt(np.asarray(d2))
-    knn_ids = np.asarray(ids)
+    if is_sparse:
+        knn_ids, knn_dists = sparse_knn_graph(X.tocsr(), k)
+    else:
+        d2, ids = exact_knn_single(
+            jnp.asarray(X), jnp.asarray(X), jnp.ones((n,), bool), k
+        )
+        knn_dists = np.sqrt(np.asarray(d2))
+        knn_ids = np.asarray(ids)
 
     heads, tails, weights = fuzzy_simplicial_set(knn_ids, knn_dists)
+    if y is not None:
+        weights = categorical_intersection(heads, tails, weights, np.asarray(y))
     a, b = find_ab_params(spread, min_dist)
 
     rng = np.random.default_rng(seed & 0x7FFFFFFF)
-    emb0 = rng.uniform(-10, 10, size=(n, n_components)).astype(np.float32)
+    if init == "spectral":
+        emb0 = spectral_init(heads, tails, weights, n, n_components, seed)
+    else:
+        emb0 = rng.uniform(-10, 10, size=(n, n_components)).astype(np.float32)
 
     emb = optimize_layout(
         jnp.asarray(emb0),
@@ -197,7 +300,7 @@ def umap_fit(
     )
     return {
         "embedding": np.asarray(emb),
-        "raw_data": X.astype(np.float32),
+        "raw_data": X if is_sparse else X.astype(np.float32),
         "a": a,
         "b": b,
         "n_neighbors": n_neighbors,
@@ -205,19 +308,40 @@ def umap_fit(
 
 
 def umap_transform(
-    Q: np.ndarray, raw_data: np.ndarray, embedding: np.ndarray, n_neighbors: int
+    Q: np.ndarray, raw_data, embedding: np.ndarray, n_neighbors: int
 ) -> np.ndarray:
-    """Embed new points at the fuzzy-weighted mean of their neighbors' embeddings."""
+    """Embed new points at the fuzzy-weighted mean of their neighbors' embeddings.
+    `raw_data` may be dense or CSR (sparse-fitted models transform without ever
+    densifying the training data)."""
     from .knn import exact_knn_single
     import jax.numpy as jnp
 
+    try:
+        import scipy.sparse as sp
+
+        rd_sparse = sp.issparse(raw_data)
+    except ImportError:  # pragma: no cover
+        rd_sparse = False
+
     n = raw_data.shape[0]
     k = min(n_neighbors, n)
-    d2, ids = exact_knn_single(
-        jnp.asarray(Q), jnp.asarray(raw_data), jnp.ones((n,), bool), k
-    )
-    dists = np.sqrt(np.asarray(d2))
-    ids_h = np.asarray(ids)
+    if rd_sparse:
+        Qs = Q if sp.issparse(Q) else sp.csr_matrix(np.asarray(Q))
+        x2 = np.asarray(raw_data.multiply(raw_data).sum(axis=1)).ravel()
+        q2 = np.asarray(Qs.multiply(Qs).sum(axis=1)).ravel()
+        cross = np.asarray((Qs @ raw_data.T).todense())
+        d2_full = np.maximum(q2[:, None] - 2.0 * cross + x2[None, :], 0.0)
+        part = np.argpartition(d2_full, k - 1, axis=1)[:, :k]
+        pd2 = np.take_along_axis(d2_full, part, axis=1)
+        order = np.argsort(pd2, axis=1, kind="stable")
+        ids_h = np.take_along_axis(part, order, axis=1)
+        dists = np.sqrt(np.take_along_axis(pd2, order, axis=1)).astype(np.float32)
+    else:
+        d2, ids = exact_knn_single(
+            jnp.asarray(Q), jnp.asarray(raw_data), jnp.ones((n,), bool), k
+        )
+        dists = np.sqrt(np.asarray(d2))
+        ids_h = np.asarray(ids)
     rho, sigma = smooth_knn(jnp.asarray(dists))
     w = np.exp(
         -np.maximum(dists - np.asarray(rho)[:, None], 0.0)

@@ -29,16 +29,26 @@ from ..utils import get_logger
 # core.py:1868-1878; caching per process is strictly better)
 _WORKER_MODELS: Dict[Any, Any] = {}
 
+# Spark torrent broadcast caps a single value at 8 GiB; large models (UMAP holds
+# embedding + raw data) ship as multiple chunked broadcasts the worker reassembles
+# (the reference's <=8 GiB chunked model broadcast, umap.py:1404-1446)
+BROADCAST_CHUNK_BYTES = (8 << 30) - (64 << 20)
 
-def _worker_model(bcast: Any) -> Any:
-    key = getattr(bcast, "id", None)
-    if key is None:
-        key = id(bcast)
+
+def _broadcast_chunked(sc: Any, payload: bytes) -> list:
+    return [
+        sc.broadcast(payload[i : i + BROADCAST_CHUNK_BYTES])
+        for i in range(0, len(payload), BROADCAST_CHUNK_BYTES)
+    ]
+
+
+def _worker_model(bcasts: list) -> Any:
+    key = tuple(getattr(b, "id", None) or id(b) for b in bcasts)
     model = _WORKER_MODELS.get(key)
     if model is None:
         import pickle
 
-        model = pickle.loads(bytes(bcast.value))
+        model = pickle.loads(b"".join(bytes(b.value) for b in bcasts))
         _WORKER_MODELS[key] = model
     return model
 
@@ -95,10 +105,10 @@ def transform_on_spark(model: Any, spark_df: Any) -> Any:
     schema = infer_ddl_schema(out_sample)
 
     sc = spark_df.sparkSession.sparkContext
-    bcast = sc.broadcast(pickle.dumps(model))
+    bcasts = _broadcast_chunked(sc, pickle.dumps(model))
 
     def transform_udf(pdf_iter):
-        m = _worker_model(bcast)
+        m = _worker_model(bcasts)
         for pdf in pdf_iter:
             if len(pdf) == 0:
                 continue

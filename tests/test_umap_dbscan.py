@@ -111,3 +111,85 @@ class TestUMAP:
         a = np.stack(model.transform(df)["embedding"].to_numpy())
         b = np.stack(loaded.transform(df)["embedding"].to_numpy())
         np.testing.assert_allclose(a, b, atol=1e-5)
+
+
+# ---- round 2: supervised / sparse / spectral-init UMAP ----
+
+
+def _two_blob_data(n=120, d=6, seed=0):
+    rng = np.random.default_rng(seed)
+    X = np.concatenate(
+        [rng.normal(-4, 0.6, (n // 2, d)), rng.normal(4, 0.6, (n - n // 2, d))]
+    ).astype(np.float32)
+    y = np.repeat([0.0, 1.0], [n // 2, n - n // 2])
+    return X, y
+
+
+def _cluster_separation(emb, y):
+    c0, c1 = emb[y == 0].mean(0), emb[y == 1].mean(0)
+    within = 0.5 * (emb[y == 0].std() + emb[y == 1].std())
+    return float(np.linalg.norm(c0 - c1) / max(within, 1e-9))
+
+
+def test_umap_spectral_init_separates_blobs(n_devices):
+    from spark_rapids_ml_tpu.umap import UMAP
+
+    X, y = _two_blob_data()
+    df = pd.DataFrame({"features": list(X)})
+    model = UMAP(n_epochs=80, seed=3, init="spectral").fit(df)
+    emb = np.asarray(model.embedding_)
+    assert emb.shape == (len(X), 2)
+    assert _cluster_separation(emb, y) > 2.0
+
+
+def test_umap_supervised_improves_separation(n_devices):
+    """labelCol switches on the categorical intersection: same-label edges keep
+    weight, cross-label edges attenuate — separation must not degrade vs
+    unsupervised on mixed blobs."""
+    from spark_rapids_ml_tpu.umap import UMAP
+
+    rng = np.random.default_rng(7)
+    # overlapping blobs: supervision is the separating signal
+    X = np.concatenate(
+        [rng.normal(-0.6, 1.0, (80, 5)), rng.normal(0.6, 1.0, (80, 5))]
+    ).astype(np.float32)
+    y = np.repeat([0.0, 1.0], 80)
+    df = pd.DataFrame({"features": list(X), "label": y})
+
+    unsup = UMAP(n_epochs=100, seed=5, init="random").fit(df[["features"]])
+    sup = UMAP(n_epochs=100, seed=5, init="random", labelCol="label").fit(df)
+    s_unsup = _cluster_separation(np.asarray(unsup.embedding_), y)
+    s_sup = _cluster_separation(np.asarray(sup.embedding_), y)
+    assert s_sup > s_unsup, (s_sup, s_unsup)
+
+
+def test_umap_sparse_fit_and_transform(n_devices):
+    """CSR input fits without densifying (raw_data stays sparse in the model) and
+    transform embeds new sparse queries."""
+    import scipy.sparse as sp
+
+    from spark_rapids_ml_tpu.umap import UMAP
+
+    rng = np.random.default_rng(11)
+    X = sp.random(150, 40, density=0.1, format="csr", dtype=np.float32, random_state=11)
+    df = pd.DataFrame({"features": [X.getrow(i) for i in range(X.shape[0])]})
+    model = UMAP(n_epochs=50, seed=1).fit(df)
+    assert sp.issparse(model.rawData_)
+    emb = np.asarray(model.embedding_)
+    assert emb.shape == (150, 2)
+    out = model.transform(df.head(10))
+    assert np.stack(out["embedding"].to_numpy()).shape == (10, 2)
+
+
+def test_categorical_intersection_weights():
+    from spark_rapids_ml_tpu.ops.umap_ops import categorical_intersection
+
+    heads = np.array([0, 1, 2, 3])
+    tails = np.array([1, 2, 3, 0])
+    w = np.ones(4, np.float32)
+    y = np.array([0.0, 0.0, 1.0, -1.0])
+    out = categorical_intersection(heads, tails, w, y)
+    assert out[0] == pytest.approx(1.0)            # same label
+    assert out[1] == pytest.approx(np.exp(-5.0))   # cross label
+    assert out[2] == pytest.approx(np.exp(-1.0))   # unknown label
+    assert out[3] == pytest.approx(np.exp(-1.0))   # unknown label
