@@ -1,0 +1,221 @@
+#!/usr/bin/env python
+#
+# Distributed benchmark data generation — the structural equivalent of the
+# reference's gen_data_distributed.py (reference python/benchmark/
+# gen_data_distributed.py:84,189,324,586,952: the five sklearn-style generators run
+# INSIDE mapInPandas partitions and land as parquet, so dataset size is bounded by
+# cluster storage, not one host's RAM).
+#
+# Two execution planes over the same shard-generation function:
+#   * local:  a ProcessPoolExecutor fans shards out over host cores (the default in
+#     this pyspark-less image) — each shard process generates and writes its own
+#     parquet part file and returns only the path,
+#   * spark:  --use_spark runs the same per-shard function inside mapInPandas on a
+#     cluster, executors writing shards to shared storage.
+# Shard determinism: shard i always generates from seed base_seed + i with shared
+# model structure (blob centers / ground-truth coefficients derive from the BASE
+# seed inside the generators, benchmark/gen_data.py), so the dataset is identical
+# whichever plane produced it.
+#
+
+from __future__ import annotations
+
+import argparse
+import math
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+try:  # package import (tests) or same-directory CLI import
+    from .gen_data import (
+        BlobsDataGen,
+        ClassificationDataGen,
+        DataGenBase,
+        LowRankMatrixDataGen,
+        RegressionDataGen,
+        SparseRegressionDataGen,
+    )
+except ImportError:  # pragma: no cover — direct CLI execution
+    from gen_data import (
+        BlobsDataGen,
+        ClassificationDataGen,
+        DataGenBase,
+        LowRankMatrixDataGen,
+        RegressionDataGen,
+        SparseRegressionDataGen,
+    )
+
+GENERATORS: Dict[str, type] = {
+    "blobs": BlobsDataGen,
+    "low_rank_matrix": LowRankMatrixDataGen,
+    "regression": RegressionDataGen,
+    "sparse_regression": SparseRegressionDataGen,
+    "classification": ClassificationDataGen,
+}
+
+
+def _flatten_features(df):
+    """Vector cells -> scalar parquet columns (the reference's storage layout)."""
+    import numpy as np
+    import pandas as pd
+
+    if "features" not in df.columns:
+        return df
+    feats = np.stack(df["features"].to_numpy())
+    out = pd.DataFrame(feats, columns=[f"c{j}" for j in range(feats.shape[1])])
+    for col in df.columns:
+        if col != "features":
+            out[col] = df[col].to_numpy()
+    return out
+
+
+def generate_shard(
+    kind: str,
+    shard_idx: int,
+    shard_rows: int,
+    output_dir: str,
+    num_rows: int,
+    num_cols: int,
+    seed: int,
+    dtype: str,
+    params: Dict[str, Any],
+) -> str:
+    """Generate ONE shard and write it as a parquet part file. Runs in a worker
+    process (local plane) or inside a Spark task (spark plane)."""
+    gen: DataGenBase = GENERATORS[kind](
+        num_rows=num_rows, num_cols=num_cols, seed=seed, dtype=dtype, **params
+    )
+    df = _flatten_features(gen.gen_chunk(shard_rows, seed + shard_idx))
+    path = os.path.join(output_dir, f"part-{shard_idx:05d}.parquet")
+    df.to_parquet(path, index=False)
+    return path
+
+
+def generate_distributed(
+    kind: str,
+    num_rows: int,
+    num_cols: int,
+    output_dir: str,
+    num_shards: int = 8,
+    seed: int = 0,
+    dtype: str = "float32",
+    max_workers: Optional[int] = None,
+    use_spark: bool = False,
+    **params: Any,
+) -> List[str]:
+    """Generate `num_rows` x `num_cols` of `kind` as `num_shards` parquet files."""
+    if kind not in GENERATORS:
+        raise ValueError(f"Unknown generator '{kind}'; known: {sorted(GENERATORS)}")
+    os.makedirs(output_dir, exist_ok=True)
+    per = math.ceil(num_rows / num_shards)
+    shard_sizes = [min(per, num_rows - i * per) for i in range(num_shards)]
+    shard_sizes = [s for s in shard_sizes if s > 0]
+
+    common = dict(
+        kind=kind, output_dir=output_dir, num_rows=num_rows, num_cols=num_cols,
+        seed=seed, dtype=dtype, params=params,
+    )
+
+    if use_spark:
+        from pyspark.sql import SparkSession
+
+        spark = SparkSession.builder.getOrCreate()
+        sc = spark.sparkContext
+        rdd = sc.parallelize(list(enumerate(shard_sizes)), len(shard_sizes))
+        return sorted(
+            rdd.map(lambda t: generate_shard(shard_idx=t[0], shard_rows=t[1], **common))
+            .collect()
+        )
+
+    from concurrent.futures import ProcessPoolExecutor
+
+    workers = max_workers or min(len(shard_sizes), os.cpu_count() or 1)
+    if workers <= 1 or len(shard_sizes) == 1:
+        return [
+            generate_shard(shard_idx=i, shard_rows=s, **common)
+            for i, s in enumerate(shard_sizes)
+        ]
+    with ProcessPoolExecutor(max_workers=workers) as pool:
+        futures = [
+            pool.submit(generate_shard, shard_idx=i, shard_rows=s, **common)
+            for i, s in enumerate(shard_sizes)
+        ]
+        return sorted(f.result() for f in futures)
+
+
+def read_parquet_dataset(path: str):
+    """Load a generated dataset directory back into one pandas frame with a
+    re-assembled 'features' column (the inverse of the storage layout)."""
+    import glob
+
+    import numpy as np
+    import pandas as pd
+
+    parts = sorted(glob.glob(os.path.join(path, "part-*.parquet")))
+    if not parts:
+        raise FileNotFoundError(f"no parquet parts under {path}")
+    df = pd.concat([pd.read_parquet(p) for p in parts], ignore_index=True)
+    feat_cols = [c for c in df.columns if c.startswith("c") and c[1:].isdigit()]
+    feat_cols.sort(key=lambda c: int(c[1:]))
+    if feat_cols:
+        X = df[feat_cols].to_numpy(dtype=np.float32)
+        rest = df.drop(columns=feat_cols)
+        rest.insert(0, "features", list(X))
+        return rest
+    return df
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    parser = argparse.ArgumentParser(
+        description="Distributed (sharded) synthetic dataset generation"
+    )
+    parser.add_argument("kind", choices=sorted(GENERATORS))
+    parser.add_argument("--num_rows", type=int, default=100_000)
+    parser.add_argument("--num_cols", type=int, default=30)
+    parser.add_argument("--num_shards", type=int, default=8)
+    parser.add_argument("--output_dir", required=True)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--dtype", default="float32")
+    parser.add_argument("--max_workers", type=int, default=None)
+    parser.add_argument(
+        "--use_spark", action="store_true",
+        help="generate inside Spark tasks (requires pyspark + a cluster)",
+    )
+    # generator-specific knobs forwarded as params
+    parser.add_argument("--num_centers", type=int, default=None)
+    parser.add_argument("--cluster_std", type=float, default=None)
+    parser.add_argument("--effective_rank", type=int, default=None)
+    parser.add_argument("--noise", type=float, default=None)
+    parser.add_argument("--density", type=float, default=None)
+    parser.add_argument("--n_classes", type=int, default=None)
+    parser.add_argument("--n_informative", type=int, default=None)
+    args = parser.parse_args(argv)
+
+    params = {
+        k: v
+        for k, v in vars(args).items()
+        if k
+        in (
+            "num_centers", "cluster_std", "effective_rank", "noise", "density",
+            "n_classes", "n_informative",
+        )
+        and v is not None
+    }
+    paths = generate_distributed(
+        args.kind,
+        num_rows=args.num_rows,
+        num_cols=args.num_cols,
+        output_dir=args.output_dir,
+        num_shards=args.num_shards,
+        seed=args.seed,
+        dtype=args.dtype,
+        max_workers=args.max_workers,
+        use_spark=args.use_spark,
+        **params,
+    )
+    print(f"wrote {len(paths)} shards under {args.output_dir}")
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    main()
