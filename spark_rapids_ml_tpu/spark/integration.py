@@ -154,6 +154,85 @@ def _barrier_train_udf(estimator_payload: bytes) -> Callable:
     return train_udf
 
 
+def skip_stage_level_scheduling(spark_version: str, conf: Any) -> bool:
+    """Decision matrix for the stage-level-scheduling analog (P7) — mirrors the
+    reference's gating (reference core.py:637-696) with TPU resource names: the goal
+    is that each TRAINING barrier task pins a whole TPU host while ETL stages share
+    executors freely. Returns True when stage-level scheduling must be skipped.
+
+    `conf` needs only a .get(key, default=None) -> Optional[str] surface."""
+    logger = get_logger("spark.integration")
+
+    def _get(key: str):
+        try:
+            return conf.get(key, None)
+        except TypeError:
+            return conf.get(key)
+
+    if spark_version < "3.4.0":
+        logger.info("stage-level scheduling requires spark 3.4.0+")
+        return True
+    master = _get("spark.master") or ""
+    if "3.4.0" <= spark_version < "3.5.1" and not (
+        master.startswith("spark://") or master.startswith("local-cluster")
+    ):
+        logger.info(
+            "spark %s requires standalone/local-cluster mode for stage-level "
+            "scheduling", spark_version,
+        )
+        return True
+    executor_cores = _get("spark.executor.cores")
+    executor_tpus = _get("spark.executor.resource.tpu.amount")
+    if executor_cores is None or executor_tpus is None:
+        logger.info(
+            "stage-level scheduling requires spark.executor.cores and "
+            "spark.executor.resource.tpu.amount to be set"
+        )
+        return True
+    if int(executor_cores) == 1:
+        logger.info("stage-level scheduling requires spark.executor.cores > 1")
+        return True
+    if float(executor_tpus) > 1:
+        # hosts exposing >1 TPU resource slot: the operator owns the mapping
+        logger.info(
+            "stage-level scheduling skipped for spark.executor.resource.tpu.amount>1"
+        )
+        return True
+    task_tpus = _get("spark.task.resource.tpu.amount")
+    if task_tpus is not None and float(task_tpus) == float(executor_tpus):
+        # every task would already serialize on the TPU slot
+        return True
+    return False
+
+
+def apply_stage_level_scheduling(rdd: Any, session: Any) -> Any:
+    """Attach a ResourceProfile that makes each training task claim >half the
+    executor cores + the host's TPU resource, so barrier tasks land one-per-host
+    (reference _try_stage_level_scheduling, core.py:697-740). No-op in local mode or
+    when the decision matrix says skip."""
+    logger = get_logger("spark.integration")
+    sc = session.sparkContext
+    master = sc.getConf().get("spark.master") or ""
+    if master.startswith("local") and not master.startswith("local-cluster"):
+        return rdd
+    if skip_stage_level_scheduling(session.version, sc.getConf()):
+        return rdd
+
+    from pyspark.resource.profile import ResourceProfileBuilder
+    from pyspark.resource.requests import TaskResourceRequests
+
+    executor_cores = int(sc.getConf().get("spark.executor.cores"))
+    # >half the executor cores forces one training task per executor (the TPU host);
+    # the tpu resource request keeps ETL tasks off the chips during training
+    task_cores = executor_cores // 2 + 1
+    treqs = TaskResourceRequests().cpus(task_cores).resource("tpu", 1.0)
+    rp = ResourceProfileBuilder().require(treqs).build
+    logger.info(
+        "training tasks pinned with ResourceProfile(cores=%d, tpu=1.0)", task_cores
+    )
+    return rdd.withResources(rp)
+
+
 def fit_on_spark(estimator: Any, spark_df: Any, num_hosts: int) -> Any:
     """Driver-side: run a TPU estimator's fit as barrier tasks on a Spark cluster.
 
@@ -168,12 +247,12 @@ def fit_on_spark(estimator: Any, spark_df: Any, num_hosts: int) -> Any:
     logger = get_logger("spark.integration")
     df = spark_df.repartition(num_hosts)
     udf = _barrier_train_udf(pickle.dumps(estimator))
-    rows = (
-        df.mapInPandas(udf, schema="model binary")
-        .rdd.barrier()
-        .mapPartitions(lambda it: it)
-        .collect()
-    )
+    rdd = df.mapInPandas(udf, schema="model binary").rdd
+    try:
+        rdd = apply_stage_level_scheduling(rdd, spark_df.sparkSession)
+    except Exception:  # pragma: no cover — never fail a fit over scheduling sugar
+        logger.warning("stage-level scheduling unavailable; continuing without")
+    rows = rdd.barrier().mapPartitions(lambda it: it).collect()
     payload = next(r["model"] for r in rows if r["model"] is not None)
     attrs = pickle.loads(bytes(payload))
     model = estimator._create_pyspark_model(attrs)

@@ -86,3 +86,75 @@ def test_submit_script_detection(monkeypatch):
     runner_idx = next(i for i, a in enumerate(argv) if a.endswith("__main__.py"))
     assert argv[runner_idx + 1] == "app.py"
     assert argv[argv.index("--py-files") + 1] == "deps.py"
+
+
+# ---- round 2: stage-level scheduling analog (P7) ----
+
+
+def test_stage_level_scheduling_decision_matrix():
+    """Mirrors the reference's gating (core.py:637-696) with TPU resource names."""
+    from spark_rapids_ml_tpu.spark.integration import skip_stage_level_scheduling
+
+    base = {
+        "spark.master": "spark://host:7077",
+        "spark.executor.cores": "8",
+        "spark.executor.resource.tpu.amount": "1",
+    }
+    assert skip_stage_level_scheduling("3.5.1", dict(base)) is False
+    # old spark
+    assert skip_stage_level_scheduling("3.3.2", dict(base)) is True
+    # 3.4.x requires standalone/local-cluster
+    assert skip_stage_level_scheduling("3.4.1", {**base, "spark.master": "yarn"}) is True
+    assert skip_stage_level_scheduling("3.4.1", dict(base)) is False
+    # missing confs
+    assert skip_stage_level_scheduling("3.5.1", {"spark.master": "spark://h:1"}) is True
+    # one core -> single task anyway
+    assert (
+        skip_stage_level_scheduling("3.5.1", {**base, "spark.executor.cores": "1"})
+        is True
+    )
+    # >1 tpu slots: operator-managed
+    assert (
+        skip_stage_level_scheduling(
+            "3.5.1", {**base, "spark.executor.resource.tpu.amount": "2"}
+        )
+        is True
+    )
+    # task slot == executor slot: already serialized
+    assert (
+        skip_stage_level_scheduling(
+            "3.5.1", {**base, "spark.task.resource.tpu.amount": "1"}
+        )
+        is True
+    )
+    # fractional task slot: schedulable
+    assert (
+        skip_stage_level_scheduling(
+            "3.5.1", {**base, "spark.task.resource.tpu.amount": "0.5"}
+        )
+        is False
+    )
+
+
+def test_logistic_regression_objective_utility(n_devices):
+    """In-package LR objective (metrics/utils.py, reference metrics/utils.py:14-78):
+    the fitted model's objective must beat a perturbed model's."""
+    import pandas as pd
+
+    from spark_rapids_ml_tpu.classification import LogisticRegression
+    from spark_rapids_ml_tpu.metrics.utils import logistic_regression_objective
+
+    rng = np.random.default_rng(0)
+    X = np.concatenate(
+        [rng.normal(-2, 1, (80, 5)), rng.normal(2, 1, (80, 5))]
+    ).astype(np.float32)
+    y = np.repeat([0.0, 1.0], 80)
+    df = pd.DataFrame({"features": list(X), "label": y})
+    model = LogisticRegression(regParam=0.01, maxIter=100, tol=1e-9).fit(df)
+    obj = logistic_regression_objective(df, model)
+    assert np.isfinite(obj) and obj > 0
+    # the kernel reports its own objective; the utility must agree
+    assert obj == pytest.approx(model.get_model_attributes()["objective"], rel=1e-2)
+
+    worse = LogisticRegression(regParam=0.01, maxIter=2).fit(df)
+    assert logistic_regression_objective(df, worse) >= obj - 1e-9
