@@ -16,9 +16,8 @@ from __future__ import annotations
 import argparse
 import csv
 import os
-import sys
 import time
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Dict, List
 
 import numpy as np
 import pandas as pd

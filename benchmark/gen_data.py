@@ -17,8 +17,7 @@ from __future__ import annotations
 import argparse
 import math
 import os
-import sys
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, List, Optional
 
 import numpy as np
 import pandas as pd

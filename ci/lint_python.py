@@ -1,0 +1,137 @@
+#!/usr/bin/env python
+"""Lint tier (role of reference ci/lint_python.py: black/isort/mypy gate). This
+image ships no third-party linters, so the gate is stdlib-only but real:
+
+  * syntax: every file must compile (py_compile)
+  * AST checks: unused imports, bare `except:`, mutable default arguments,
+    `__all__` names that don't resolve, tabs in indentation
+
+Exit code 1 on any finding; CI runs this before the test tiers (ci/test.sh).
+"""
+
+from __future__ import annotations
+
+import ast
+import py_compile
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+TARGETS = ["spark_rapids_ml_tpu", "benchmark", "tests", "bench.py", "__graft_entry__.py"]
+
+# modules where dynamic re-export makes unused-import analysis meaningless
+UNUSED_IMPORT_EXEMPT = {"__init__.py"}
+
+
+def iter_files():
+    for t in TARGETS:
+        p = ROOT / t
+        if p.is_file():
+            yield p
+        else:
+            yield from sorted(p.rglob("*.py"))
+
+
+def _names_bound_by_import(node):
+    for alias in node.names:
+        name = alias.asname or alias.name.split(".")[0]
+        yield name, alias
+
+
+def check_file(path: Path) -> list:
+    findings = []
+    src = path.read_text()
+    try:
+        py_compile.compile(str(path), doraise=True)
+    except py_compile.PyCompileError as e:
+        return [f"{path}: syntax error: {e.msg}"]
+    tree = ast.parse(src)
+
+    for lineno, line in enumerate(src.splitlines(), 1):
+        stripped = line.lstrip(" ")
+        if stripped.startswith("\t"):
+            findings.append(f"{path}:{lineno}: tab in indentation")
+
+    # collect import bindings and all referenced names
+    imports = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Import, ast.ImportFrom)):
+            if isinstance(node, ast.ImportFrom) and node.module == "__future__":
+                continue
+            for name, alias in _names_bound_by_import(node):
+                if name == "*":
+                    continue
+                imports.setdefault(name, node.lineno)
+        elif isinstance(node, ast.ExceptHandler) and node.type is None:
+            findings.append(f"{path}:{node.lineno}: bare `except:` (catch Exception)")
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for default in list(node.args.defaults) + [
+                d for d in node.args.kw_defaults if d is not None
+            ]:
+                if isinstance(default, (ast.List, ast.Dict, ast.Set)):
+                    findings.append(
+                        f"{path}:{default.lineno}: mutable default argument in "
+                        f"{node.name}()"
+                    )
+
+    used = set()
+    exported = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name):
+            used.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            pass  # attribute roots appear as Name nodes anyway
+    for node in ast.walk(tree):  # __all__ may live inside try/except re-export blocks
+        if (
+            isinstance(node, ast.Assign)
+            and any(getattr(t, "id", "") == "__all__" for t in node.targets)
+            and isinstance(node.value, (ast.List, ast.Tuple))
+        ):
+            for elt in node.value.elts:
+                if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                    exported.add(elt.value)
+
+    module_names = {
+        n.name
+        for n in ast.walk(tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef))
+    }
+    top_assigned = {
+        getattr(t, "id", None)
+        for node in tree.body
+        if isinstance(node, ast.Assign)
+        for t in node.targets
+    }
+    for name in exported:
+        if name not in module_names and name not in top_assigned and name not in imports:
+            findings.append(f"{path}: __all__ name '{name}' is not defined")
+
+    if path.name not in UNUSED_IMPORT_EXEMPT:
+        src_lines = src.splitlines()
+        for name, lineno in imports.items():
+            if name in used or name in exported:
+                continue
+            line = src_lines[lineno - 1] if lineno - 1 < len(src_lines) else ""
+            if "noqa" in line:
+                continue
+            findings.append(f"{path}:{lineno}: unused import '{name}'")
+    return findings
+
+
+def main() -> int:
+    all_findings = []
+    n = 0
+    for path in iter_files():
+        n += 1
+        all_findings.extend(check_file(path))
+    if all_findings:
+        print(f"LINT: {len(all_findings)} findings in {n} files")
+        for f in all_findings:
+            print("  " + f)
+        return 1
+    print(f"LINT OK: {n} files clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -12,6 +12,9 @@ export PALLAS_AXON_POOL_IPS=""
 
 MODE="${1:-premerge}"
 
+# lint tier (reference ci/lint_python.py role)
+python ci/lint_python.py
+
 # native build (non-fatal: pure-python fallback covers it)
 ./native/build.sh || echo "WARN: native build failed; numpy fallbacks in use"
 

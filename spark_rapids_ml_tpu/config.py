@@ -22,7 +22,7 @@
 from __future__ import annotations
 
 import os
-from typing import Any, Callable, Dict, Optional
+from typing import Any, Dict
 
 _DEFAULTS: Dict[str, Any] = {
     "fallback.enabled": True,

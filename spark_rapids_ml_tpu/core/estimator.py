@@ -25,7 +25,7 @@ from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Tupl
 
 import numpy as np
 
-from ..parallel.mesh import get_mesh, replicate_array, shard_array
+from ..parallel.mesh import get_mesh, shard_array
 from ..parallel.partition import PartitionDescriptor, pad_rows
 from ..utils import get_logger
 from .backend_params import _TpuClass, _TpuParams
@@ -35,8 +35,8 @@ from .dataset import (  # noqa: F401
     densify,
     extract_feature_data,
 )
-from .params import Param, ParamMap, Params
-from .persistence import ParamsReader, ParamsWriter, load_metadata, save_instance
+from .params import ParamMap
+from .persistence import ParamsReader, ParamsWriter
 
 
 @dataclass

@@ -18,7 +18,7 @@ from __future__ import annotations
 import importlib
 import sys
 import types
-from typing import Any, Dict, Optional
+from typing import Any, Dict
 
 _accelerated_attributes: Dict[str, Dict[str, str]] = {
     # pyspark module -> {class name -> spark_rapids_ml_tpu module}

@@ -11,12 +11,11 @@
 
 from __future__ import annotations
 
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List
 
 import numpy as np
 
 from ..core.backend_params import HasFeaturesCols, HasIDCol, _TpuClass
-from ..core.dataset import extract_feature_data
 from ..core.estimator import _TpuEstimator, _TpuModel
 from ..core.params import (
     HasFeaturesCol,

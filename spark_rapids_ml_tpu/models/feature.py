@@ -16,14 +16,13 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from ..core.dataset import densify
-from ..core.backend_params import HasFeaturesCols, _TpuClass
+from ..core.backend_params import _TpuClass
 from ..core.estimator import FitInputs, _TpuEstimator, _TpuModelWithColumns
 from ..core.params import (
     HasInputCol,
     HasInputCols,
     HasOutputCol,
     Param,
-    Params,
     TypeConverters,
 )
 from ..ops.pca import pca_fit, pca_transform

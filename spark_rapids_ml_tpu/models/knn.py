@@ -20,9 +20,9 @@ import pandas as pd
 from ..core.backend_params import HasIDCol, _TpuClass
 from ..core.dataset import extract_feature_data
 from ..core.estimator import FitInputs, _TpuEstimator, _TpuModel
-from ..core.params import Param, Params, TypeConverters
+from ..core.params import Param, TypeConverters
 from ..core.backend_params import DictTypeConverters, HasFeaturesCols
-from ..core.params import HasInputCol, HasLabelCol
+from ..core.params import HasInputCol
 from ..parallel.mesh import get_mesh, shard_array
 from ..parallel.partition import pad_rows
 from ..ops.knn import (

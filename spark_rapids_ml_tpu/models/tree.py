@@ -251,7 +251,6 @@ def _sk_forest_to_heap(sk_model, is_classification: bool, n_features: int) -> Di
     """Translate a fitted sklearn forest into this framework's heap-layout arrays
     (the CPU-fallback model translation; the reference's equivalent converts between
     cuML and Spark tree formats, utils.py:694-809)."""
-    import math as _math
 
     estimators = sk_model.estimators_
     depth = max(e.tree_.max_depth for e in estimators)

@@ -24,13 +24,13 @@
 from __future__ import annotations
 
 import functools
-from typing import Dict, Optional, Tuple
+from typing import Dict, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ._precision import PARITY, pdot
+from ._precision import pdot
 
 
 @functools.partial(jax.jit, static_argnames=())

@@ -27,10 +27,10 @@ from typing import Dict, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 from jax import shard_map
 
-from ._precision import FAST, pdot
+from ._precision import FAST
 from ..parallel.mesh import DATA_AXIS
 
 

@@ -30,7 +30,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ._precision import pdot
-from .linalg import power_iteration_lmax, weighted_moments
+from .linalg import power_iteration_lmax
 
 
 @jax.jit

@@ -14,8 +14,7 @@
 
 from __future__ import annotations
 
-import os
-from typing import List, Optional, Sequence
+from typing import Optional
 
 import jax
 import numpy as np

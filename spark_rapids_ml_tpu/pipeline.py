@@ -11,7 +11,7 @@ from __future__ import annotations
 from typing import Any, List, Optional
 
 from .core.backend_params import _TpuParams
-from .core.params import Param, ParamMap, Params
+from .core.params import ParamMap, Params
 from .utils import get_logger
 
 

@@ -21,7 +21,6 @@ from .core.params import (
     HasSeed,
     Param,
     ParamMap,
-    Params,
     TypeConverters,
 )
 from .utils import get_logger

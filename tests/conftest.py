@@ -50,7 +50,6 @@ def _hermetic_reexec(config) -> None:
         capman.stop_global_capturing()
     os.execve(sys.executable, [sys.executable, "-m", "pytest"] + sys.argv[1:], _env)
 
-import numpy as np
 import pytest
 
 

@@ -10,12 +10,7 @@ import numpy as np
 import pandas as pd
 import pytest
 
-from spark_rapids_ml_tpu.core import (
-    FitInputs,
-    _TpuClass,
-    _TpuEstimator,
-    _TpuModelWithColumns,
-)
+from spark_rapids_ml_tpu.core import FitInputs, _TpuEstimator, _TpuModelWithColumns
 from spark_rapids_ml_tpu.core.backend_params import HasFeaturesCols
 from spark_rapids_ml_tpu.core.params import (
     HasInputCol,

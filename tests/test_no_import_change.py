@@ -6,7 +6,6 @@ import os
 import subprocess
 import sys
 
-import pytest
 
 SCRIPT = """
 import numpy as np, pandas as pd
@@ -47,7 +46,6 @@ def test_no_import_change_runner(tmp_path):
 
 def test_install_import_direct():
     """Importing install in-process interposes pyspark.ml.* modules."""
-    import importlib
     import sys as _sys
 
     import spark_rapids_ml_tpu.install  # noqa: F401

@@ -1,7 +1,6 @@
 """Pallas histogram kernel: interpret-mode parity vs segment_sum, and the forest
 builder end-to-end with the kernel forced on."""
 
-import os
 
 import jax
 import jax.numpy as jnp

@@ -7,7 +7,6 @@ from spark_rapids_ml_tpu.core.params import (
     HasInputCol,
     HasMaxIter,
     Param,
-    Params,
     TypeConverters,
 )
 

@@ -9,11 +9,7 @@ import numpy as np
 import pandas as pd
 import pytest
 
-from spark_rapids_ml_tpu.spark.transform import (
-    _WORKER_MODELS,
-    infer_ddl_schema,
-    transform_on_spark,
-)
+from spark_rapids_ml_tpu.spark.transform import _WORKER_MODELS, infer_ddl_schema
 
 
 class FakeBroadcast:
