@@ -44,7 +44,7 @@ class _DBSCANClass(_TpuClass):
 
     @classmethod
     def _param_value_mapping(cls):
-        return {"metric": lambda x: x if x in ("euclidean",) else None}
+        return {"metric": lambda x: x if x in ("euclidean", "cosine") else None}
 
     @classmethod
     def _get_tpu_params_default(cls) -> Dict[str, Any]:
@@ -78,7 +78,8 @@ class _DBSCANParams(HasFeaturesCol, HasFeaturesCols, HasPredictionCol, HasIDCol)
         TypeConverters.toInt,
     )
     metric: Param[str] = Param(
-        "undefined", "metric", "Distance metric (euclidean).", TypeConverters.toString
+        "undefined", "metric", "Distance metric (euclidean|cosine).",
+        TypeConverters.toString,
     )
     max_mbytes_per_batch: Param[int] = Param(
         "undefined",
@@ -157,5 +158,6 @@ class DBSCANModel(_DBSCANClass, _TpuModel, _DBSCANParams):
             vd,
             eps=self.getOrDefault("eps"),
             min_samples=self.getOrDefault("min_samples"),
+            metric=self.getOrDefault("metric"),
         )
         return {self.getOrDefault("predictionCol"): labels[: X.shape[0]]}

@@ -467,6 +467,20 @@ class _RandomForestModel(_RandomForestClass, _TpuModelWithPredictionCol, _Random
         """Forest dump (the reference's treelite-JSON role, tree.py:534-559)."""
         return forest_to_json(self._model_attributes, self._is_classification)
 
+    @classmethod
+    def fromJSON(
+        cls, trees_json: List[Dict], n_features: int, num_classes: int = 0
+    ) -> "_RandomForestModel":
+        """Rebuild a model from a forest JSON dump (the import half of the
+        reference's treelite interop, tree.py:439-449): a roundtrip through
+        toJSON()/fromJSON() predicts identically, and externally-produced dumps in
+        the same shape import the same way."""
+        from ..ops.trees import forest_from_json
+
+        attrs = forest_from_json(trees_json, n_features, cls._is_classification)
+        attrs["num_classes"] = int(num_classes)
+        return cls(**attrs)
+
 
 class RandomForestRegressionModel(_RandomForestModel):
     def predict(self, value: np.ndarray) -> float:

@@ -79,11 +79,28 @@ def dbscan_fit_predict(
     eps: float,
     min_samples: int,
     max_rounds: int = 64,
+    metric: str = "euclidean",
 ) -> np.ndarray:
     """Full DBSCAN labeling; returns int labels (noise = -1) for all rows
-    (padding rows get -1)."""
+    (padding rows get -1).
+
+    metric='cosine' reduces exactly to the euclidean scan on row-normalized data:
+    for unit vectors ||a-b||^2 = 2(1 - cos(a,b)), so cosine distance <= eps is the
+    squared-euclidean threshold 2*eps (the same reduction cuML's cosine DBSCAN
+    applies; reference exposes it via the metric param, clustering.py)."""
     n = X.shape[0]
-    eps2 = float(eps) * float(eps)
+    if metric == "cosine":
+        norms = jnp.linalg.norm(X, axis=1, keepdims=True)
+        min_norm = float(jnp.min(jnp.where(valid[:, None], norms, jnp.inf)))
+        if min_norm <= 0.0:
+            raise ValueError(
+                "Cosine distance is not defined for zero-length vectors; the input "
+                "contains an all-zero feature row."
+            )
+        X = X / jnp.maximum(norms, 1e-30)
+        eps2 = 2.0 * float(eps)
+    else:
+        eps2 = float(eps) * float(eps)
     core = _core_mask(X, valid, eps2, int(min_samples))
     labels = jnp.arange(n, dtype=jnp.int32)
 

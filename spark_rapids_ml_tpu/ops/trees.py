@@ -387,3 +387,67 @@ def forest_to_json(model_attrs: Dict[str, np.ndarray], is_classification: bool) 
     return [
         {"tree_id": i, "root": node(i, 1)} for i in range(feature.shape[0])
     ]
+
+
+def forest_from_json(
+    trees_json: List[Dict], n_features: int, is_classification: bool
+) -> Dict[str, np.ndarray]:
+    """Inverse of forest_to_json: rebuild the heap-layout forest arrays from the
+    portable nested-dict dump, so forests exported by this framework (or translated
+    from treelite/cuML dumps into the same shape) can be imported as models — the
+    import half of the reference's treelite interop (reference tree.py:439-449)."""
+    leaf_key = "leaf_class_probs" if is_classification else "leaf_value"
+
+    def depth_of(node: Dict) -> int:
+        if leaf_key in node or "left_child" not in node:
+            return 0
+        return 1 + max(depth_of(node["left_child"]), depth_of(node["right_child"]))
+
+    if not trees_json:
+        raise ValueError("empty forest JSON")
+    roots = [t["root"] for t in trees_json]
+    max_depth = max(depth_of(r) for r in roots)
+    v_dims = set()
+
+    def leaf_dim(node: Dict) -> None:
+        if leaf_key in node:
+            v_dims.add(len(node[leaf_key]))
+        else:
+            leaf_dim(node["left_child"])
+            leaf_dim(node["right_child"])
+
+    for r in roots:
+        leaf_dim(r)
+    if len(v_dims) != 1:
+        raise ValueError(f"inconsistent leaf payload dims: {sorted(v_dims)}")
+    v_dim = v_dims.pop()
+
+    n_trees = len(roots)
+    n_slots = 2 ** (max_depth + 1)
+    feature = np.full((n_trees, n_slots), -1, np.int32)
+    threshold = np.zeros((n_trees, n_slots), np.float32)
+    is_leaf = np.zeros((n_trees, n_slots), bool)
+    value = np.zeros((n_trees, n_slots, v_dim), np.float32)
+
+    def fill(tree_idx: int, node: Dict, p: int) -> None:
+        if leaf_key in node:
+            is_leaf[tree_idx, p] = True
+            value[tree_idx, p] = np.asarray(node[leaf_key], np.float32)
+            return
+        f = int(node["split_feature"])
+        if not 0 <= f < n_features:
+            raise ValueError(f"split_feature {f} out of range for d={n_features}")
+        feature[tree_idx, p] = f
+        threshold[tree_idx, p] = float(node["threshold"])
+        fill(tree_idx, node["left_child"], 2 * p)
+        fill(tree_idx, node["right_child"], 2 * p + 1)
+
+    for i, r in enumerate(roots):
+        fill(i, r, 1)
+    return {
+        "feature": feature,
+        "threshold": threshold,
+        "is_leaf": is_leaf,
+        "value": value,
+        "bin_edges": np.zeros((n_features, 1), np.float32),
+    }

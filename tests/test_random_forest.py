@@ -166,3 +166,47 @@ def test_rf_feature_subset_strategies():
     assert resolve_feature_subset("3", 16, True) == 3
     with pytest.raises(ValueError):
         resolve_feature_subset("bogus", 16, True)
+
+
+def test_forest_json_roundtrip(n_devices):
+    """toJSON()/fromJSON() roundtrip predicts identically (the import half of the
+    reference's treelite interop, tree.py:439-449)."""
+    from spark_rapids_ml_tpu.classification import (
+        RandomForestClassificationModel,
+        RandomForestClassifier,
+    )
+    from spark_rapids_ml_tpu.regression import (
+        RandomForestRegressionModel,
+        RandomForestRegressor,
+    )
+
+    rng = np.random.default_rng(17)
+    X = np.concatenate(
+        [rng.normal(-2, 1, (60, 4)), rng.normal(2, 1, (60, 4))]
+    ).astype(np.float32)
+    y_cls = np.repeat([0.0, 1.0], 60)
+    y_reg = X @ np.array([1.0, -1.0, 0.5, 2.0], np.float32)
+
+    df_cls = pd.DataFrame({"features": list(X), "label": y_cls})
+    m = RandomForestClassifier(numTrees=4, maxDepth=4, seed=1).fit(df_cls)
+    rebuilt = RandomForestClassificationModel.fromJSON(
+        m.toJSON(), n_features=4, num_classes=2
+    )
+    np.testing.assert_array_equal(
+        m.transform(df_cls)["prediction"].to_numpy(),
+        rebuilt.transform(df_cls)["prediction"].to_numpy(),
+    )
+    np.testing.assert_allclose(
+        np.stack(m.transform(df_cls)["probability"].to_numpy()),
+        np.stack(rebuilt.transform(df_cls)["probability"].to_numpy()),
+        atol=1e-6,
+    )
+
+    df_reg = pd.DataFrame({"features": list(X), "label": y_reg.astype(np.float64)})
+    mr = RandomForestRegressor(numTrees=3, maxDepth=3, seed=2).fit(df_reg)
+    rebuilt_r = RandomForestRegressionModel.fromJSON(mr.toJSON(), n_features=4)
+    np.testing.assert_allclose(
+        mr.transform(df_reg)["prediction"].to_numpy(),
+        rebuilt_r.transform(df_reg)["prediction"].to_numpy(),
+        atol=1e-6,
+    )

@@ -56,7 +56,9 @@ class TestDBSCAN:
     def test_unsupported_metric_fallback(self, n_devices):
         X, _ = make_blobs(n_samples=60, centers=2, random_state=2)
         df = pd.DataFrame({"features": list(X.astype(np.float32))})
-        est = DBSCAN(eps=0.5, min_samples=5, metric="cosine")
+        # cosine is native since round 2; manhattan still falls back
+        assert not DBSCAN(eps=0.5, min_samples=5, metric="cosine")._use_cpu_fallback()
+        est = DBSCAN(eps=0.5, min_samples=5, metric="manhattan")
         assert est._use_cpu_fallback()
 
 
@@ -193,3 +195,44 @@ def test_categorical_intersection_weights():
     assert out[1] == pytest.approx(np.exp(-5.0))   # cross label
     assert out[2] == pytest.approx(np.exp(-1.0))   # unknown label
     assert out[3] == pytest.approx(np.exp(-1.0))   # unknown label
+
+
+def test_dbscan_cosine_clusters_directions(n_devices):
+    """Cosine DBSCAN (round 2): angular clusters with mixed magnitudes — euclidean
+    would split by magnitude; cosine groups by direction."""
+    from sklearn.cluster import DBSCAN as SkDBSCAN
+
+    from spark_rapids_ml_tpu.clustering import DBSCAN
+
+    rng = np.random.default_rng(3)
+    dirs = np.array([[1.0, 0.0, 0.0], [0.0, 1.0, 0.0]], np.float32)
+    X = np.concatenate(
+        [
+            d * rng.uniform(0.5, 10.0, (60, 1)).astype(np.float32)
+            + rng.normal(0, 0.02, (60, 3)).astype(np.float32)
+            for d in dirs
+        ]
+    )
+    df = pd.DataFrame({"features": list(X)})
+    est = DBSCAN(eps=0.05, min_samples=5, metric="cosine")
+    est.num_workers = n_devices
+    got = est.fit(df).transform(df)["prediction"].to_numpy()
+
+    sk = SkDBSCAN(eps=0.05, min_samples=5, metric="cosine").fit_predict(
+        X.astype(np.float64)
+    )
+    # same partition structure (labels may permute; first-appearance order matches)
+    assert len(set(got[got >= 0])) == len(set(sk[sk >= 0])) == 2
+    np.testing.assert_array_equal(got >= 0, sk >= 0)
+
+
+def test_dbscan_cosine_zero_vector_raises(n_devices):
+    from spark_rapids_ml_tpu.clustering import DBSCAN
+
+    X = np.zeros((20, 3), np.float32)
+    X[1:] = np.random.default_rng(0).normal(size=(19, 3))
+    df = pd.DataFrame({"features": list(X)})
+    est = DBSCAN(eps=0.1, min_samples=3, metric="cosine")
+    est.num_workers = n_devices
+    with pytest.raises(ValueError, match="zero-length"):
+        est.fit(df).transform(df)
