@@ -262,6 +262,41 @@ class _TpuCaller(_TpuClass, _TpuParams):
             dtype=np.float32 if self._float32_inputs else np.float64,
         )
 
+    def _build_sparse_fit_inputs_from_global(
+        self,
+        values_global: Any,
+        indices_global: Any,
+        row_weight_global: Any,
+        label_global: Optional[Any],
+        total_rows: int,
+        n_cols: int,
+        mesh: Any,
+        rank_rows: Optional[List[int]] = None,
+        nnz: int = -1,
+    ) -> FitInputs:
+        """Sparse twin of _build_fit_inputs_from_global: ELL arrays already padded to
+        the global max row-width and placed on the mesh (spark/integration.py pads
+        each host's local ELL to the allGathered global width first)."""
+        n_dev = mesh.devices.size
+        padded_m = values_global.shape[0]
+        if rank_rows is None:
+            shard = padded_m // n_dev
+            rank_rows = [
+                max(0, min(total_rows - r * shard, shard)) for r in range(n_dev)
+            ]
+        desc = PartitionDescriptor.build(rank_rows, n_cols, nnz=nnz, padded_m=padded_m)
+        return FitInputs(
+            features=None,
+            sparse_values=values_global,
+            sparse_indices=indices_global,
+            row_weight=row_weight_global,
+            label=label_global,
+            desc=desc,
+            mesh=mesh,
+            params=dict(self._tpu_params),
+            dtype=np.float32 if self._float32_inputs else np.float64,
+        )
+
     def _call_tpu_fit_func(
         self, dataset: Any, extra_params: Optional[List[Dict[str, Any]]] = None
     ) -> List[Dict[str, Any]]:
@@ -521,9 +556,20 @@ class _TpuModel(_TpuClass, _TpuParams):
             input_cols=input_cols,
             float32=self._float32_inputs,
         )
-        X = densify(fd.features, float32=self._float32_inputs)
-        outputs = self._transform_arrays(X)
+        if fd.is_sparse and self._supports_sparse_transform():
+            outputs = self._transform_sparse(fd.features)
+        else:
+            X = densify(fd.features, float32=self._float32_inputs)
+            outputs = self._transform_arrays(X)
         return append_output_columns(dataset, outputs)
+
+    def _supports_sparse_transform(self) -> bool:
+        """Whether this model predicts on CSR input without densifying (ops/sparse
+        ELL contractions); models without it densify the query block."""
+        return False
+
+    def _transform_sparse(self, csr: Any) -> Dict[str, np.ndarray]:
+        raise NotImplementedError
 
     def _supportsTransformEvaluate(self) -> bool:
         """Whether transform+evaluate can run in one pass for CrossValidator

@@ -197,6 +197,12 @@ class LogisticRegression(
 
         def _fit(inputs: FitInputs):
             y_host = inputs.host_label
+            if y_host is None and inputs.label is not None:
+                # global-array path (spark/integration.py): no host copy travels;
+                # recover the real labels from the device array, masking padding
+                lab = np.asarray(inputs.label)
+                w = np.asarray(inputs.row_weight)
+                y_host = lab[w > 0]
             classes = np.unique(y_host)
             n_classes = int(classes.max()) + 1 if len(classes) > 0 else 0
             if not np.array_equal(classes, classes.astype(np.int64)) or (
@@ -387,8 +393,37 @@ class LogisticRegressionModel(
             logreg_decision(X, coef, icpt, self._is_multinomial_layout)
         )
 
+    def _supports_sparse_transform(self) -> bool:
+        return True
+
+    def _transform_sparse(self, csr: Any) -> Dict[str, np.ndarray]:
+        """Predict on CSR queries without densifying: margins via the ELL gather
+        contraction (ops/sparse.py), then the shared output math."""
+        import jax.numpy as jnp
+
+        from ..ops.sparse import csr_to_ell, ell_matmat, ell_matvec
+
+        coef = self._model_attributes["coefficients"].astype(np.float32)
+        icpt = self._model_attributes["intercepts"].astype(np.float32)
+        if not np.all(np.isfinite(icpt)):
+            n = csr.shape[0]
+            if self._is_multinomial_layout:
+                z = np.broadcast_to(icpt, (n, icpt.shape[0])).copy()
+            else:
+                z = np.broadcast_to(icpt[0], (n,)).copy()
+            return self._outputs_from_margins(z)
+        values, indices = csr_to_ell(csr, float32=True)
+        vj, ij = jnp.asarray(values), jnp.asarray(indices)
+        if self._is_multinomial_layout:
+            z = np.asarray(ell_matmat(vj, ij, jnp.asarray(coef.T))) + icpt
+        else:
+            z = np.asarray(ell_matvec(vj, ij, jnp.asarray(coef[0]))) + icpt[0]
+        return self._outputs_from_margins(z)
+
     def _transform_arrays(self, X: np.ndarray) -> Dict[str, np.ndarray]:
-        z = self._margins(X)
+        return self._outputs_from_margins(self._margins(X))
+
+    def _outputs_from_margins(self, z: np.ndarray) -> Dict[str, np.ndarray]:
         if z.ndim == 1:  # binomial
             raw = np.stack([-z, z], axis=1)
             with np.errstate(over="ignore"):

@@ -330,3 +330,25 @@ class LinearRegressionModel(
     def _transform_arrays(self, X: np.ndarray) -> Dict[str, np.ndarray]:
         pred = np.asarray(linreg_predict(X, self.coefficients, np.float32(self.intercept)))
         return {self.getOrDefault("predictionCol"): pred}
+
+    def _supports_sparse_transform(self) -> bool:
+        return True
+
+    def _transform_sparse(self, csr) -> Dict[str, np.ndarray]:
+        """Predict on CSR queries without densifying (ELL gather matvec)."""
+        import jax.numpy as jnp
+
+        from ..ops.sparse import csr_to_ell, ell_matvec
+
+        values, indices = csr_to_ell(csr, float32=True)
+        pred = (
+            np.asarray(
+                ell_matvec(
+                    jnp.asarray(values),
+                    jnp.asarray(indices),
+                    jnp.asarray(np.asarray(self.coefficients, np.float32)),
+                )
+            )
+            + self.intercept
+        )
+        return {self.getOrDefault("predictionCol"): pred}

@@ -33,15 +33,28 @@ from ..utils import get_logger
 @dataclass
 class PartitionInfo:
     """Per-barrier-task facts exchanged via allGather (the reference's
-    PartitionDescriptor payload, utils.py:325-355)."""
+    PartitionDescriptor payload, utils.py:325-355). For sparse fits the ELL width
+    travels too: every host must pad its ELL rows to the GLOBAL max nonzeros-per-row
+    before the global array assembles (the sparse analog of the reference's nnz
+    exchange, classification.py:1012-1016)."""
 
     rank: int
     n_rows: int
     coordinator: str = ""  # rank 0 advertises host:port for jax.distributed
+    nnz: int = -1  # local nonzeros (sparse fits)
+    ell_width: int = 0  # local max nonzeros/row (sparse fits)
 
 
 def encode_partition_info(info: PartitionInfo) -> str:
-    return json.dumps({"rank": info.rank, "n_rows": info.n_rows, "coordinator": info.coordinator})
+    return json.dumps(
+        {
+            "rank": info.rank,
+            "n_rows": info.n_rows,
+            "coordinator": info.coordinator,
+            "nnz": info.nnz,
+            "ell_width": info.ell_width,
+        }
+    )
 
 
 def decode_partition_info(payloads: List[str]) -> List[PartitionInfo]:
@@ -85,6 +98,17 @@ def _barrier_train_udf(estimator_payload: bytes) -> Callable:
         # column resolution/casting goes through the SAME prep as the local path
         # (_use_label gate, float32 handling, idCol — core/estimator.py)
         fd = est._pre_process_data(_collect_partition(pdf_iter))
+        sparse_fit = est._sparse_fit_wanted(fd)
+        ell_vals = ell_idx = None
+        if sparse_fit:
+            from ..ops.sparse import csr_to_ell
+
+            ell_vals, ell_idx = csr_to_ell(fd.features, float32=est._float32_inputs)
+        elif fd.is_sparse:
+            # no sparse kernel for this estimator: densify locally as usual
+            from ..core.dataset import densify
+
+            fd.features = densify(fd.features, est._float32_inputs)
 
         # control plane: coordinator + partition sizes in ONE allGather round.
         # rank 0's reachable address comes from Spark's own task info (hostname
@@ -101,7 +125,15 @@ def _barrier_train_udf(estimator_payload: bytes) -> Callable:
             probe.close()
             coordinator = f"{host}:{port}"
         payloads = ctx.allGather(
-            encode_partition_info(PartitionInfo(rank, fd.n_rows, coordinator))
+            encode_partition_info(
+                PartitionInfo(
+                    rank,
+                    fd.n_rows,
+                    coordinator,
+                    nnz=int(fd.features.nnz) if sparse_fit else -1,
+                    ell_width=int(ell_vals.shape[1]) if sparse_fit else 0,
+                )
+            )
         )
         infos = decode_partition_info(payloads)
         init_process_group(
@@ -120,15 +152,12 @@ def _barrier_train_udf(estimator_payload: bytes) -> Callable:
         max_rows = max(i.n_rows for i in infos)
         local_devices = jax.local_device_count()
         pad_to = -(-max_rows // (8 * local_devices)) * (8 * local_devices)
-        X_local = np.zeros((pad_to, fd.n_cols), np.float32)
-        X_local[: fd.n_rows] = np.asarray(fd.features, dtype=np.float32)
         w_local = np.zeros((pad_to,), np.float32)
         w_local[: fd.n_rows] = 1.0 if fd.weight is None else fd.weight
         total_rows = sum(i.n_rows for i in infos)
 
         sharding2 = NamedSharding(mesh, P("data", None))
         sharding1 = NamedSharding(mesh, P("data"))
-        X_global = jax.make_array_from_process_local_data(sharding2, X_local)
         w_global = jax.make_array_from_process_local_data(sharding1, w_local)
         label_global = None
         if fd.label is not None:
@@ -136,11 +165,32 @@ def _barrier_train_udf(estimator_payload: bytes) -> Callable:
             y_local[: fd.n_rows] = fd.label
             label_global = jax.make_array_from_process_local_data(sharding1, y_local)
 
+        if sparse_fit:
+            # pad the local ELL width to the GLOBAL max so every host contributes
+            # equally-shaped shards, then assemble the global sparse arrays
+            r_global = max(i.ell_width for i in infos)
+            v_local = np.zeros((pad_to, r_global), ell_vals.dtype)
+            i_local = np.zeros((pad_to, r_global), ell_idx.dtype)
+            v_local[: fd.n_rows, : ell_vals.shape[1]] = ell_vals
+            i_local[: fd.n_rows, : ell_idx.shape[1]] = ell_idx
+            values_global = jax.make_array_from_process_local_data(sharding2, v_local)
+            indices_global = jax.make_array_from_process_local_data(sharding2, i_local)
+            fit_inputs = est._build_sparse_fit_inputs_from_global(
+                values_global, indices_global, w_global, label_global, total_rows,
+                fd.n_cols, mesh,
+                rank_rows=[i.n_rows for i in infos],
+                nnz=sum(i.nnz for i in infos if i.nnz > 0),
+            )
+        else:
+            X_local = np.zeros((pad_to, fd.n_cols), np.float32)
+            X_local[: fd.n_rows] = np.asarray(fd.features, dtype=np.float32)
+            X_global = jax.make_array_from_process_local_data(sharding2, X_local)
+            fit_inputs = est._build_fit_inputs_from_global(
+                X_global, w_global, label_global, total_rows, mesh,
+                rank_rows=[i.n_rows for i in infos],
+            )
+
         # run the estimator's fit program (same SPMD program on every host)
-        fit_inputs = est._build_fit_inputs_from_global(
-            X_global, w_global, label_global, total_rows, mesh,
-            rank_rows=[i.n_rows for i in infos],
-        )
         attrs = est._get_tpu_fit_func(None)(fit_inputs)
 
         if rank == 0:

@@ -195,3 +195,61 @@ def test_force_dense_with_optim_false(n_devices):
     )
     inputs = est._build_fit_inputs(fd)
     assert inputs.features is not None and inputs.sparse_values is None
+
+
+def test_sparse_transform_never_densifies(n_devices, monkeypatch):
+    """LogReg/LinReg transform on CSR queries goes through the ELL contraction —
+    densify must never be called (memory stays O(nnz) at predict time too)."""
+    import spark_rapids_ml_tpu.core.estimator as est_mod
+    from spark_rapids_ml_tpu.regression import LinearRegression
+
+    X, y = _sparse_cls_data()
+    df_sparse = pd.DataFrame(
+        {"features": [X.getrow(i) for i in range(X.shape[0])], "label": y}
+    )
+    df_dense = pd.DataFrame({"features": list(np.asarray(X.todense())), "label": y})
+    m_log = LogisticRegression(regParam=0.01, maxIter=50).fit(df_sparse)
+
+    Xr, yr = _csr_reg_data()
+    dfr_sparse = pd.DataFrame(
+        {"features": [Xr.getrow(i) for i in range(Xr.shape[0])], "label": yr}
+    )
+    dfr_dense = pd.DataFrame({"features": list(np.asarray(Xr.todense())), "label": yr})
+    m_lin = LinearRegression(regParam=0.1).fit(dfr_sparse)
+
+    expected_log = m_log.transform(df_dense)
+    expected_lin = m_lin.transform(dfr_dense)
+
+    def no_densify(*a, **k):
+        raise AssertionError("densify called on the sparse transform path")
+
+    monkeypatch.setattr(est_mod, "densify", no_densify)
+    got_log = m_log.transform(df_sparse)
+    got_lin = m_lin.transform(dfr_sparse)
+    np.testing.assert_allclose(
+        np.stack(got_log["probability"].to_numpy()),
+        np.stack(expected_log["probability"].to_numpy()),
+        atol=1e-5,
+    )
+    np.testing.assert_allclose(
+        got_lin["prediction"].to_numpy(),
+        expected_lin["prediction"].to_numpy(),
+        rtol=1e-4,
+        atol=1e-4,
+    )
+
+
+def test_sparse_transform_multinomial(n_devices):
+    rng = np.random.default_rng(21)
+    X = sp.random(150, 12, density=0.3, format="csr", dtype=np.float32, random_state=21)
+    y = np.asarray(X @ rng.normal(size=(12, 3))).argmax(axis=1).astype(np.float64)
+    df_sparse = pd.DataFrame(
+        {"features": [X.getrow(i) for i in range(X.shape[0])], "label": y}
+    )
+    df_dense = pd.DataFrame({"features": list(np.asarray(X.todense())), "label": y})
+    m = LogisticRegression(regParam=0.01, maxIter=60).fit(df_sparse)
+    np.testing.assert_allclose(
+        np.stack(m.transform(df_sparse)["probability"].to_numpy()),
+        np.stack(m.transform(df_dense)["probability"].to_numpy()),
+        atol=1e-5,
+    )
