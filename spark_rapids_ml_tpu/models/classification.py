@@ -450,6 +450,22 @@ class LogisticRegressionModel(
             self.getOrDefault("rawPredictionCol"): raw,
         }
 
+    def cpu(self):
+        """sklearn LogisticRegression twin with the fitted state installed (the
+        reference builds the pyspark twin via py4j; pyspark is optional here)."""
+        from sklearn.linear_model import LogisticRegression as SkLR
+
+        coef = np.asarray(self._model_attributes["coefficients"], np.float64)
+        icpt = np.asarray(self._model_attributes["intercepts"], np.float64)
+        k = int(self._model_attributes["num_classes"])
+        sk = SkLR()
+        sk.coef_ = coef
+        sk.intercept_ = icpt
+        sk.classes_ = np.arange(max(k, 2), dtype=np.float64)
+        sk.n_features_in_ = coef.shape[1]
+        sk.n_iter_ = np.array([int(self._model_attributes["n_iter"])])
+        return sk
+
     def predict(self, value: np.ndarray) -> float:
         X = np.asarray(value, dtype=np.float32).reshape(1, -1)
         return float(self._transform_arrays(X)[self.getOrDefault("predictionCol")][0])

@@ -282,6 +282,22 @@ class KMeansModel(_KMeansClass, _TpuModelWithPredictionCol, _KMeansParams):
         """Spark MLlib KMeansModel surface."""
         return list(self._model_attributes["cluster_centers"])
 
+    def cpu(self):
+        """CPU twin of this model (the reference's model.cpu() builds the pyspark
+        twin via py4j, clustering.py:524-544; pyspark is optional here so the twin
+        is the sklearn estimator with the fitted state installed)."""
+        from sklearn.cluster import KMeans as SkKMeans
+
+        centers = np.asarray(self._model_attributes["cluster_centers"], np.float64)
+        sk = SkKMeans(n_clusters=centers.shape[0], n_init=1)
+        sk.cluster_centers_ = centers
+        sk.inertia_ = float(self._model_attributes["inertia"])
+        sk.n_iter_ = int(self._model_attributes["n_iter"])
+        sk._n_threads = 1
+        sk.n_features_in_ = centers.shape[1]
+        sk.labels_ = None
+        return sk
+
     @property
     def cluster_centers_(self) -> np.ndarray:
         return self._model_attributes["cluster_centers"]

@@ -201,6 +201,31 @@ class PCAModel(_PCAClass, _TpuModelWithColumns, _PCAParams):
         out = np.asarray(pca_transform(X, self._model_attributes["components"]))
         return {self.getOrDefault("outputCol"): out}
 
+    def cpu(self):
+        """sklearn PCA twin with the fitted state installed (the reference builds
+        the pyspark PCAModel via py4j, feature.py:375-389)."""
+        from sklearn.decomposition import PCA as SkPCA
+
+        comps = np.asarray(self._model_attributes["components"], np.float64)
+        k, d = comps.shape
+        sk = SkPCA(n_components=k)
+        sk.components_ = comps
+        sk.mean_ = np.asarray(self._model_attributes["mean"], np.float64)
+        sk.explained_variance_ = np.asarray(
+            self._model_attributes["explained_variance"], np.float64
+        )
+        sk.explained_variance_ratio_ = np.asarray(
+            self._model_attributes["explained_variance_ratio"], np.float64
+        )
+        sk.singular_values_ = np.asarray(
+            self._model_attributes["singular_values"], np.float64
+        )
+        sk.n_components_ = k
+        sk.n_features_in_ = d
+        sk.noise_variance_ = 0.0
+        sk.whiten = False
+        return sk
+
 
 class VectorAssembler(HasInputCols, HasOutputCol):
     """Combines scalar columns into one array-valued feature column —

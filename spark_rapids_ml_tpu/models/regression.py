@@ -316,6 +316,16 @@ class LinearRegressionModel(
     def numFeatures(self) -> int:
         return int(self._model_attributes["coefficients"].shape[0])
 
+    def cpu(self):
+        """sklearn LinearRegression twin with the fitted state installed."""
+        from sklearn.linear_model import LinearRegression as SkLinReg
+
+        sk = SkLinReg()
+        sk.coef_ = np.asarray(self._model_attributes["coefficients"], np.float64)
+        sk.intercept_ = float(self._model_attributes["intercept"])
+        sk.n_features_in_ = sk.coef_.shape[0]
+        return sk
+
     def predict(self, value: np.ndarray) -> float:
         X = np.asarray(value, dtype=np.float32).reshape(1, -1)
         return float(np.asarray(linreg_predict(X, self.coefficients, self.intercept))[0])

@@ -86,3 +86,56 @@ def test_setters_chain():
     assert est.getLabelCol() == "y"
     assert est.tpu_params["max_iter"] == 7
     assert est.tpu_params["alpha"] == 0.5
+
+
+def test_model_cpu_twins(n_devices):
+    """model.cpu() returns a fitted sklearn twin whose predictions agree (the
+    reference's cpu() builds pyspark twins via py4j; pyspark is optional here)."""
+    import pandas as pd
+
+    from spark_rapids_ml_tpu.classification import LogisticRegression
+    from spark_rapids_ml_tpu.clustering import KMeans
+    from spark_rapids_ml_tpu.feature import PCA
+    from spark_rapids_ml_tpu.regression import LinearRegression
+
+    rng = np.random.default_rng(0)
+    X = np.concatenate(
+        [rng.normal(-3, 0.8, (50, 4)), rng.normal(3, 0.8, (50, 4))]
+    ).astype(np.float32)
+    y_cls = np.repeat([0.0, 1.0], 50)
+    y_reg = X @ np.array([1.0, 2.0, -1.0, 0.5], np.float32) + 0.25
+    df_cls = pd.DataFrame({"features": list(X), "label": y_cls})
+    df_reg = pd.DataFrame({"features": list(X), "label": y_reg.astype(np.float64)})
+    df_unsup = pd.DataFrame({"features": list(X)})
+
+    km = KMeans(k=2, seed=1, maxIter=20).fit(df_unsup)
+    sk_km = km.cpu()
+    np.testing.assert_array_equal(
+        sk_km.predict(X.astype(np.float64)),
+        km.transform(df_unsup)["prediction"].to_numpy().astype(int),
+    )
+
+    pca = PCA(k=2, inputCol="features").fit(df_unsup)
+    sk_pca = pca.cpu()
+    ours = np.stack(pca.transform(df_unsup)["pca_features"].to_numpy())
+    theirs = sk_pca.transform(X.astype(np.float64))
+    # our transform keeps Spark's UNCENTERED projection (reference feature.py:438-451
+    # re-adds the projected mean); sklearn centers — the twin differs by that offset
+    offset = sk_pca.mean_ @ sk_pca.components_.T
+    np.testing.assert_allclose(ours - offset, theirs, atol=1e-3)
+
+    lr = LogisticRegression(maxIter=60).fit(df_cls)
+    sk_lr = lr.cpu()
+    np.testing.assert_array_equal(
+        sk_lr.predict(X.astype(np.float64)),
+        lr.transform(df_cls)["prediction"].to_numpy(),
+    )
+
+    lin = LinearRegression().fit(df_reg)
+    sk_lin = lin.cpu()
+    np.testing.assert_allclose(
+        sk_lin.predict(X.astype(np.float64)),
+        lin.transform(df_reg)["prediction"].to_numpy(),
+        rtol=1e-4,
+        atol=1e-3,
+    )
