@@ -104,6 +104,31 @@ void srml_topk_merge(const float* dists, const int64_t* ids, int64_t nq,
   }
 }
 
+// CSR -> ELL (padded row-wise) layout for the sparse device kernels
+// (ops/sparse.py): out_vals/out_idx are (n x r_max) row-major, padding cells
+// (value 0, column 0). Parallel over rows; each row is a straight copy.
+void srml_csr_to_ell(const int64_t* indptr, const int32_t* indices,
+                     const float* data, int64_t n, int64_t r_max, float* out_vals,
+                     int32_t* out_idx) {
+#if defined(_OPENMP)
+#pragma omp parallel for schedule(dynamic, 128)
+#endif
+  for (int64_t i = 0; i < n; ++i) {
+    float* vrow = out_vals + i * r_max;
+    int32_t* irow = out_idx + i * r_max;
+    const int64_t beg = indptr[i], len = indptr[i + 1] - beg;
+    int64_t p = 0;
+    for (; p < len; ++p) {
+      vrow[p] = data[beg + p];
+      irow[p] = indices[beg + p];
+    }
+    for (; p < r_max; ++p) {
+      vrow[p] = 0.0f;
+      irow[p] = 0;
+    }
+  }
+}
+
 int srml_num_threads() {
 #if defined(_OPENMP)
   return omp_get_max_threads();

@@ -41,6 +41,10 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
             ctypes.c_int64, ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p,
         ]
+        lib.srml_csr_to_ell.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p,
+        ]
         lib.srml_num_threads.restype = ctypes.c_int
         _lib = lib
         get_logger("native").info(
@@ -96,6 +100,26 @@ def csr_to_dense(indptr: np.ndarray, indices: np.ndarray, data: np.ndarray,
     return np.asarray(
         sp.csr_matrix((data, indices, indptr), shape=(n, d)).todense(), dtype
     )
+
+
+def csr_to_ell(
+    indptr: np.ndarray, indices: np.ndarray, data: np.ndarray, n: int, r_max: int
+) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """Native CSR->ELL conversion (ops/sparse.py layout). Returns None when the
+    library is absent or dtypes need int64 (the numpy path handles those)."""
+    lib = _load()
+    if lib is None:
+        return None
+    indptr64 = np.ascontiguousarray(indptr, np.int64)
+    indices32 = np.ascontiguousarray(indices, np.int32)
+    data32 = np.ascontiguousarray(data, np.float32)
+    values = np.empty((n, r_max), np.float32)
+    cols = np.empty((n, r_max), np.int32)
+    lib.srml_csr_to_ell(
+        indptr64.ctypes.data, indices32.ctypes.data, data32.ctypes.data,
+        n, r_max, values.ctypes.data, cols.ctypes.data,
+    )
+    return values, cols
 
 
 def topk_merge(dists: np.ndarray, ids: np.ndarray, k: int) -> Tuple[np.ndarray, np.ndarray]:

@@ -49,6 +49,12 @@ def csr_to_ell(
     r = int(counts.max()) if n else 0
     r = max(r, 1)
     idx_dtype = np.int64 if (csr.nnz > INT32_LIMIT or n > INT32_LIMIT) else np.int32
+    if idx_dtype == np.int32 and dtype == np.float32 and csr.nnz:
+        from ..native import csr_to_ell as native_csr_to_ell
+
+        native = native_csr_to_ell(csr.indptr, csr.indices, csr.data, n, r)
+        if native is not None:  # OpenMP host kernel (native/src/srml_native.cpp)
+            return native
     values = np.zeros((n, r), dtype=dtype)
     indices = np.zeros((n, r), dtype=idx_dtype)
     if csr.nnz:
