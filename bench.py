@@ -126,6 +126,17 @@ def main() -> None:
     n_chips = jax.device_count()
     value = rows_per_sec / n_chips
 
+    # secondary metric: the fast-math variant (assignment distances at MXU bf16,
+    # model attributes still parity precision — config key fast_math)
+    fast_fit = functools.partial(lloyd_fit, fast_math=True)
+    centers_f, _, n_iter_f = fast_fit(Xd, w, init, 0.0, iters)
+    centers_f.block_until_ready()
+    t0 = time.perf_counter()
+    centers_f, _, n_iter_f = fast_fit(Xd, w, init, 0.0, iters)
+    centers_f.block_until_ready()
+    fast_time = time.perf_counter() - t0
+    fast_rows_per_sec_chip = n_rows * int(n_iter_f) / fast_time / n_chips
+
     # secondary metric: PCA covariance-fit throughput on the same matrix (the second
     # north-star algorithm; one warm + one timed pass, reported in the same line)
     from spark_rapids_ml_tpu.ops.linalg import weighted_covariance
@@ -168,6 +179,9 @@ def main() -> None:
                 "unit": "rows*iters/sec/chip",
                 "vs_baseline": round(vs_baseline, 4),
                 "secondary": {
+                    "kmeans_fast_math_rows_per_sec_per_chip": round(
+                        fast_rows_per_sec_chip, 1
+                    ),
                     "pca_cov_rows_per_sec_per_chip": round(pca_rows_per_sec_chip, 1),
                     "platform": platform,
                     "n_rows": n_rows,

@@ -39,6 +39,9 @@ _DEFAULTS: Dict[str, Any] = {
     # TPU hosts (spark/integration.py), "collect" materializes on the driver (local
     # mode / tiny data), "auto" picks barrier when a usable pyspark is importable
     "spark_fit_mode": "auto",
+    # fast_math=True lets ranking-only matmuls (KMeans assignment distances) run at
+    # MXU bf16 single-pass precision; model attributes stay parity-precision
+    "fast_math": False,
 }
 
 _ENV_KEYS: Dict[str, str] = {
@@ -50,6 +53,7 @@ _ENV_KEYS: Dict[str, str] = {
     "stream_threshold_bytes": "SRML_TPU_STREAM_THRESHOLD_BYTES",
     "stream_batch_rows": "SRML_TPU_STREAM_BATCH_ROWS",
     "spark_fit_mode": "SRML_TPU_SPARK_FIT_MODE",
+    "fast_math": "SRML_TPU_FAST_MATH",
 }
 
 _overrides: Dict[str, Any] = {}

@@ -30,15 +30,18 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ._precision import pdot
+from ._precision import FAST, pdot
 
 
-@functools.partial(jax.jit, static_argnames=())
-def _sq_dists(X: jax.Array, centers: jax.Array) -> jax.Array:
-    """(n, k) squared euclidean distances; the MXU hot loop."""
+@functools.partial(jax.jit, static_argnames=("fast",))
+def _sq_dists(X: jax.Array, centers: jax.Array, fast: bool = False) -> jax.Array:
+    """(n, k) squared euclidean distances; the MXU hot loop. `fast=True` runs the
+    cross-term matmul at MXU bf16 precision — valid for ASSIGNMENT (ranking) use;
+    anything feeding model attributes stays at parity precision."""
     x2 = jnp.sum(X * X, axis=1, keepdims=True)
     c2 = jnp.sum(centers * centers, axis=1)
-    d2 = x2 - 2.0 * pdot(X, centers.T) + c2
+    cross = jnp.matmul(X, centers.T, precision=FAST) if fast else pdot(X, centers.T)
+    d2 = x2 - 2.0 * cross + c2
     return jnp.maximum(d2, 0.0)
 
 
@@ -47,7 +50,7 @@ def _normalize_rows(X: jax.Array) -> jax.Array:
     return X / jnp.maximum(norms, 1e-12)
 
 
-@functools.partial(jax.jit, static_argnames=("max_iter", "cosine"))
+@functools.partial(jax.jit, static_argnames=("max_iter", "cosine", "fast_math"))
 def lloyd_fit(
     X: jax.Array,
     w: jax.Array,
@@ -55,6 +58,7 @@ def lloyd_fit(
     tol: float,
     max_iter: int,
     cosine: bool = False,
+    fast_math: bool = False,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Lloyd iterations until max center movement² <= tol² or max_iter.
 
@@ -64,15 +68,22 @@ def lloyd_fit(
 
     cosine=True runs spherical kmeans (Spark's distanceMeasure='cosine'): callers
     pass row-normalized X; centers are re-normalized every update and the cost is
-    Σ w·(1 - x̂·ĉ)."""
+    Σ w·(1 - x̂·ĉ).
+
+    fast_math=True runs the ASSIGNMENT distance matmul at MXU bf16 (single-pass)
+    precision — the centroid-update contraction and the final reported inertia stay
+    at parity precision, so model attributes remain fp32-exact while the hot loop's
+    dominant matmul runs at full MXU throughput (config key `fast_math`)."""
     k = init_centers.shape[0]
     if cosine:
         init_centers = _normalize_rows(init_centers)
 
-    def _dists(centers):
+    def _dists(centers, fast=False):
         if cosine:
+            if fast:
+                return 1.0 - jnp.matmul(X, centers.T, precision=FAST)
             return 1.0 - pdot(X, centers.T)
-        return _sq_dists(X, centers)
+        return _sq_dists(X, centers, fast=fast)
 
     def cond(state):
         _, _, it, shift2 = state
@@ -80,7 +91,7 @@ def lloyd_fit(
 
     def body(state):
         centers, _, it, _ = state
-        d2 = _dists(centers)
+        d2 = _dists(centers, fast=fast_math)
         assign = jnp.argmin(d2, axis=1)
         min_d2 = jnp.min(d2, axis=1)
         onehot = jax.nn.one_hot(assign, k, dtype=X.dtype) * w[:, None]
@@ -218,8 +229,11 @@ def kmeans_fit(
             )
         X = _normalize_rows(X)  # spherical kmeans operates on the unit sphere
     init_centers = jnp.asarray(kmeans_init(X, w, k, init, init_steps, seed))
+    from .. import config as _config
+
     centers, inertia, n_iter = lloyd_fit(
-        X, w, init_centers, float(tol), int(max_iter), cosine=cosine
+        X, w, init_centers, float(tol), int(max_iter), cosine=cosine,
+        fast_math=bool(_config.get("fast_math")),
     )
     return {
         "cluster_centers": np.asarray(centers),

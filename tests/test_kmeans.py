@@ -123,3 +123,30 @@ def test_kmeans_cosine_zero_vector_raises(n_devices):
     df = pd.DataFrame({"features": list(X)})
     with pytest.raises(ValueError, match="zero-length"):
         KMeans(k=2, distanceMeasure="cosine").fit(df)
+
+
+def test_fast_math_config_matches_parity_clusters(n_devices):
+    """fast_math runs assignment distances at MXU bf16: same clustering on
+    separated data, model attributes still parity-precision floats."""
+    from spark_rapids_ml_tpu import config
+    from spark_rapids_ml_tpu.clustering import KMeans
+
+    rng = np.random.default_rng(31)
+    X = np.concatenate(
+        [rng.normal(-5, 0.5, (60, 6)), rng.normal(5, 0.5, (60, 6))]
+    ).astype(np.float32)
+    df = pd.DataFrame({"features": list(X)})
+    parity = KMeans(k=2, seed=1, maxIter=25).fit(df)
+    config.set("fast_math", True)
+    try:
+        fast = KMeans(k=2, seed=1, maxIter=25).fit(df)
+    finally:
+        config.unset("fast_math")
+
+    def canon(c):
+        c = np.asarray(c)
+        return c[np.argsort(c[:, 0])]
+
+    np.testing.assert_allclose(
+        canon(parity.cluster_centers_), canon(fast.cluster_centers_), atol=1e-3
+    )
