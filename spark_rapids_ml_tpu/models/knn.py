@@ -34,6 +34,10 @@ from ..ops.knn import (
 )
 from ..utils import get_logger
 
+# query sets at/above this row count switch exact kNN from the all_gather merge to
+# the ring-permute path (queries stay sharded; ops/knn.exact_knn_ring)
+_RING_QUERY_THRESHOLD = 65536
+
 
 class _NNParams(HasInputCol, HasFeaturesCols, HasIDCol):
     k: Param[int] = Param(
@@ -138,7 +142,17 @@ class NearestNeighborsModel(_NearestNeighborsClass, _TpuModel, _NNParams):
         Xd = shard_array(Xp, mesh)
         vd = shard_array(valid, mesh)
         k = min(self.getK(), items.shape[0])
-        dists, gidx = exact_knn_distributed(mesh, Q, Xd, vd, k)
+        if len(Q) >= _RING_QUERY_THRESHOLD and mesh.devices.size > 1:
+            # large query sets shard over the mesh too and the item shards rotate
+            # around the ring (ops/knn.exact_knn_ring) — nothing global materializes
+            from ..ops.knn import exact_knn_ring
+
+            Qp, qvalid, _ = pad_rows(Q, mesh.devices.size)
+            Qd = shard_array(Qp, mesh)
+            dists, gidx = exact_knn_ring(mesh, Qd, Xd, vd, k)
+            dists, gidx = dists[: len(Q)], gidx[: len(Q)]
+        else:
+            dists, gidx = exact_knn_distributed(mesh, Q, Xd, vd, k)
         ids = item_ids[gidx]  # padded positions never win (inf distance)
 
         knn_df = pd.DataFrame(

@@ -466,3 +466,70 @@ def cagra_search(
     neg, pos = jax.lax.top_k(-d2, k_eff)
     out_ids = jnp.take_along_axis(ids, pos, axis=1)
     return jnp.sqrt(jnp.maximum(-neg, 0.0)), out_ids
+
+
+def exact_knn_ring(
+    mesh: Mesh,
+    Q_sharded: jax.Array,  # (nq_padded, d) row-sharded queries
+    X_sharded: jax.Array,  # (n_padded, d) row-sharded items
+    valid_sharded: jax.Array,  # (n_padded,) bool
+    k: int,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Ring-allreduce exact kNN: BOTH queries and items stay sharded. Each device
+    keeps its query block resident and the item shards rotate around the ring via
+    ppermute; a running top-k merges after every hop. Peak per-device memory is
+    one query block x one item shard — unlike the all_gather merge
+    (exact_knn_distributed), nothing global ever materializes, so this is the path
+    for query sets too large to replicate (the structural analog of cuML NN-MG's
+    UCX block exchange, reference knn.py:763-774, laid onto the ICI ring).
+
+    Returns host (distances, global item indices) for the real (unpadded) rows."""
+    n_total = X_sharded.shape[0]
+    n_dev = mesh.devices.size
+    shard_rows = n_total // n_dev
+    k_eff = min(k, n_total)
+
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS, None), P(DATA_AXIS)),
+        out_specs=(P(DATA_AXIS, None), P(DATA_AXIS, None)),
+    )
+    def _ring(q_local, x_local, valid_local):
+        rank = jax.lax.axis_index(DATA_AXIS)
+        nq_local = q_local.shape[0]
+        perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
+
+        def hop(h, state):
+            x_cur, valid_cur, best_d2, best_idx = state
+            # owner rank of the shard currently held: it started at `rank` and has
+            # moved h hops along the ring
+            owner = (rank - h) % n_dev
+            d2 = _block_sq_dists(q_local, x_cur)
+            d2 = jnp.where(valid_cur[None, :], d2, jnp.inf)
+            neg, idx = jax.lax.top_k(-d2, k_eff)
+            gidx = idx + owner * shard_rows
+            # merge the hop's candidates into the running top-k
+            cat_d2 = jnp.concatenate([best_d2, -neg], axis=1)
+            cat_idx = jnp.concatenate([best_idx, gidx], axis=1)
+            mneg, mpos = jax.lax.top_k(-cat_d2, k_eff)
+            best_d2 = -mneg
+            best_idx = jnp.take_along_axis(cat_idx, mpos, axis=1)
+            # rotate the item shard one hop along the ring
+            x_next = jax.lax.ppermute(x_cur, DATA_AXIS, perm)
+            valid_next = jax.lax.ppermute(valid_cur, DATA_AXIS, perm)
+            return x_next, valid_next, best_d2, best_idx
+
+        # the running top-k derives from axis_index (varying over the mesh axis);
+        # mark the literal init values varying too so the loop carry types agree
+        init = (
+            x_local,
+            valid_local,
+            jax.lax.pvary(jnp.full((nq_local, k_eff), jnp.inf, q_local.dtype), (DATA_AXIS,)),
+            jax.lax.pvary(jnp.full((nq_local, k_eff), -1, jnp.int32), (DATA_AXIS,)),
+        )
+        _, _, best_d2, best_idx = jax.lax.fori_loop(0, n_dev, hop, init)
+        return best_d2, best_idx
+
+    d2, gidx = _ring(Q_sharded, X_sharded, valid_sharded)
+    return np.sqrt(np.maximum(np.asarray(d2), 0.0)), np.asarray(gidx)
