@@ -523,6 +523,26 @@ class _TpuModel(_TpuClass, _TpuParams):
     def get_model_attributes(self) -> Dict[str, Any]:
         return self._model_attributes
 
+    @property
+    def n_cols(self) -> Optional[int]:
+        """Number of input features, inferred from the fitted attributes (the
+        reference stores n_cols on every model; here it derives from whichever
+        fitted array carries the feature dimension)."""
+        a = self._model_attributes
+        for key in (
+            "cluster_centers", "components", "coefficients", "mean", "raw_data",
+            "bin_edges", "item_features", "items",
+        ):
+            v = a.get(key)
+            if v is not None and hasattr(v, "shape") and len(v.shape) >= 1:
+                return int(v.shape[-1]) if len(v.shape) > 1 else int(v.shape[0])
+        return None
+
+    @property
+    def dtype(self) -> str:
+        """Training dtype (reference models expose cuML's dtype attribute)."""
+        return "float32" if self._float32_inputs else "float64"
+
     @classmethod
     def _from_row(cls, attrs: Dict[str, Any]) -> "_TpuModel":
         """Rebuild from an attribute dict (reference core.py:1389-1396)."""
