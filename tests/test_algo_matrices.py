@@ -339,3 +339,84 @@ def test_pca_multi_col_layout_and_full_rank(n_devices):
     d_orig = np.linalg.norm(X[0] - X[1])
     d_proj = np.linalg.norm(Z[0] - Z[1])
     assert d_proj == pytest.approx(d_orig, rel=1e-3)
+
+
+# ---------------------------------------------------------------------------
+# More reference edge axes: RF single-label, UMAP trustworthiness grid,
+# kNN feature layouts
+# ---------------------------------------------------------------------------
+
+
+def test_rf_missing_label_raises_with_guidance(n_devices):
+    """Reference parity: RF raises an actionable error when a class in 0..k-1 is
+    absent (reference tree.py:415-421); re-indexed labels then fit fine."""
+    rng = np.random.default_rng(23)
+    X = rng.normal(size=(60, 3)).astype(np.float32)
+    df = pd.DataFrame({"features": list(X), "label": np.ones(60)})
+    with pytest.raises(RuntimeError, match="missing from the dataset"):
+        RandomForestClassifier(numTrees=3, maxDepth=3, seed=1).fit(df)
+    # zero-indexed single class trains (one-class forest -> constant prediction)
+    df0 = pd.DataFrame({"features": list(X), "label": np.zeros(60)})
+    model = RandomForestClassifier(numTrees=3, maxDepth=3, seed=1).fit(df0)
+    assert (model.transform(df0)["prediction"].to_numpy() == 0.0).all()
+
+
+def test_logreg_single_label_inf_intercept(n_devices):
+    """Reference parity: one-label LogReg fits a degenerate +-inf-intercept model
+    (classification.py:1106-1121) instead of crashing."""
+    from spark_rapids_ml_tpu.classification import LogisticRegression
+
+    rng = np.random.default_rng(24)
+    X = rng.normal(size=(40, 3)).astype(np.float32)
+    df = pd.DataFrame({"features": list(X), "label": np.ones(40)})
+    model = LogisticRegression(maxIter=10).fit(df)
+    preds = model.transform(df)["prediction"].to_numpy()
+    assert (preds == 1.0).all()
+
+
+@pytest.mark.parametrize("n_neighbors,init", [(5, "random"), (15, "spectral")])
+def test_umap_trustworthiness_grid(n_neighbors, init, n_devices):
+    from sklearn.manifold import trustworthiness
+
+    from spark_rapids_ml_tpu.umap import UMAP
+
+    rng = np.random.default_rng(25)
+    X = np.concatenate(
+        [rng.normal(i * 4, 0.8, (50, 8)) for i in range(3)]
+    ).astype(np.float32)
+    df = pd.DataFrame({"features": list(X)})
+    model = UMAP(n_neighbors=n_neighbors, n_epochs=80, seed=4, init=init).fit(df)
+    emb = np.asarray(model.embedding_)
+    t = trustworthiness(X, emb, n_neighbors=10)
+    assert t > 0.8, (n_neighbors, init, t)
+
+
+def test_knn_multi_col_features(n_devices):
+    from sklearn.neighbors import NearestNeighbors as SkNN
+
+    from spark_rapids_ml_tpu.knn import NearestNeighbors
+
+    rng = np.random.default_rng(26)
+    items = rng.normal(size=(200, 3)).astype(np.float32)
+    queries = rng.normal(size=(20, 3)).astype(np.float32)
+    item_df = pd.DataFrame({f"f{j}": items[:, j] for j in range(3)})
+    query_df = pd.DataFrame({f"f{j}": queries[:, j] for j in range(3)})
+    est = NearestNeighbors(k=5, featuresCols=["f0", "f1", "f2"])
+    est.num_workers = n_devices
+    model = est.fit(item_df)
+    _, _, knn_df = model.kneighbors(query_df)
+    got = np.stack(knn_df["indices"].to_numpy())
+    _, sk_idx = SkNN(n_neighbors=5).fit(items).kneighbors(queries)
+    assert np.mean([len(set(g) & set(s)) / 5 for g, s in zip(got, sk_idx)]) == 1.0
+
+
+def test_model_n_cols_and_dtype(n_devices):
+    """Reference models expose n_cols/dtype; ours derive them from fitted arrays."""
+    X, y = _cls_data(n=60, d=5)
+    df = pd.DataFrame({"features": list(X), "label": y})
+    km = KMeans(k=2, seed=1, maxIter=10).fit(df[["features"]])
+    assert km.n_cols == 5 and km.dtype == "float32"
+    lr = LogisticRegression(maxIter=10).fit(df)
+    assert lr.n_cols == 5
+    pca = PCA(k=2, inputCol="features").fit(df[["features"]])
+    assert pca.n_cols == 5
