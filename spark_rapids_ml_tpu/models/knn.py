@@ -39,6 +39,21 @@ from ..utils import get_logger
 _RING_QUERY_THRESHOLD = 65536
 
 
+def _normalize_or_raise(X, w):
+    """Row-normalize for cosine metrics; zero-norm REAL rows raise (Spark/cuML
+    cosine semantics). Works on jax arrays; padding rows (w==0) are exempt."""
+    import jax.numpy as jnp
+
+    norms = jnp.linalg.norm(X, axis=1, keepdims=True)
+    min_norm = float(jnp.min(jnp.where(jnp.asarray(w)[:, None] > 0, norms, jnp.inf)))
+    if min_norm <= 0.0:
+        raise ValueError(
+            "Cosine distance is not defined for zero-length vectors; the input "
+            "contains an all-zero feature row."
+        )
+    return X / jnp.maximum(norms, 1e-30)
+
+
 class _NNParams(HasInputCol, HasFeaturesCols, HasIDCol):
     k: Param[int] = Param(
         "undefined", "k", "number of nearest neighbors to retrieve (> 0).",
@@ -199,9 +214,11 @@ class _ApproxNNClass(_TpuClass):
     def _param_value_mapping(cls):
         return {
             "algorithm": lambda x: x
-            if x in ("ivfflat", "ivf_flat", "ivfpq", "ivf_pq", "brute_force")
+            if x in ("ivfflat", "ivf_flat", "ivfpq", "ivf_pq", "cagra", "brute_force")
             else None,
-            "metric": lambda x: x if x in ("euclidean", "sqeuclidean", "l2") else None,
+            "metric": lambda x: x
+            if x in ("euclidean", "sqeuclidean", "l2", "cosine")
+            else None,
         }
 
     @classmethod
@@ -250,7 +267,14 @@ class ApproximateNearestNeighbors(_ApproxNNClass, _TpuEstimator, _NNParams):
         seed = int(algo_params.get("seed", 42))
         algo = self.getOrDefault("algorithm")
 
+        cosine = self.getOrDefault("metric") == "cosine"
+
         def _fit(inputs: FitInputs) -> Dict[str, Any]:
+            if cosine:
+                # cosine reduces to euclidean on the unit sphere (cuVS handles
+                # cosine the same way): normalize items at build; queries normalize
+                # at search and distances convert to 1 - cos = d^2/2
+                inputs.features = _normalize_or_raise(inputs.features, inputs.row_weight)
             if algo == "cagra":
                 # cuVS cagra param names (reference knn.py:1324-1404,1513-1524)
                 from ..ops.knn import cagra_build
@@ -293,7 +317,14 @@ class ApproximateNearestNeighbors(_ApproxNNClass, _TpuEstimator, _NNParams):
                 cell_ids=np.zeros((0, 0), np.int64),
                 cell_sizes=np.zeros((0,), np.int32),
             )
-            model._brute_items = np.asarray(fd.features)
+            items = np.asarray(fd.features)
+            if self.getOrDefault("metric") == "cosine":
+                import jax.numpy as jnp
+
+                items = np.asarray(
+                    _normalize_or_raise(jnp.asarray(items), jnp.ones(len(items)))
+                )
+            model._brute_items = items
         else:
             model = self._fit_internal(dataset, None)[0]
         model._item_row_ids = (
@@ -360,6 +391,12 @@ class ApproximateNearestNeighborsModel(_ApproxNNClass, _TpuModel, _NNParams):
             fd.row_id if fd.row_id is not None else np.arange(len(Q), dtype=np.int64)
         )
         k = self.getK()
+        cosine = self.getOrDefault("metric") == "cosine"
+        if cosine:
+            # the index holds unit vectors; normalize queries the same way
+            Q = np.asarray(
+                _normalize_or_raise(jnp.asarray(Q), jnp.ones(len(Q)))
+            )
 
         if self._brute_items is not None:
             from ..ops.knn import exact_knn_single
@@ -424,6 +461,9 @@ class ApproximateNearestNeighborsModel(_ApproxNNClass, _TpuModel, _NNParams):
             pos = np.asarray(ids_j)
 
         ids = np.where(pos >= 0, self._item_row_ids[np.maximum(pos, 0)], -1)
+        if cosine:
+            # searches ran euclidean on unit vectors: cosine distance = d^2 / 2
+            dists = np.where(np.isfinite(dists), (dists * dists) / 2.0, dists)
         knn_df = pd.DataFrame(
             {
                 f"query_{id_col}": query_ids,

@@ -104,8 +104,12 @@ def test_ann_recall(algorithm, n_devices):
 
 
 def test_ann_bad_algorithm_flags_fallback():
-    est = ApproximateNearestNeighbors(algorithm="cagra", inputCol="features")
-    assert est._use_cpu_fallback()  # cagra not yet TPU-implemented
+    # cagra is native since round 2; a genuinely unknown algorithm still flags
+    assert not ApproximateNearestNeighbors(
+        algorithm="cagra", inputCol="features"
+    )._use_cpu_fallback()
+    est = ApproximateNearestNeighbors(algorithm="hnswlib", inputCol="features")
+    assert est._use_cpu_fallback()
 
 
 def test_ann_join_filters_invalid(n_devices):
@@ -241,3 +245,47 @@ def test_ring_knn_matches_allgather_path(n_devices):
     sk = SkNN(n_neighbors=10).fit(items)
     sk_d, sk_idx = sk.kneighbors(queries)
     np.testing.assert_allclose(d_ring, sk_d, atol=1e-4)
+
+
+@pytest.mark.parametrize("algorithm", ["brute_force", "ivfflat", "cagra"])
+def test_ann_cosine_metric(algorithm, n_devices):
+    """Cosine ANN (round 2): matches sklearn cosine neighbors; distances are
+    1 - cos. Magnitude-varying directional data separates by angle, not norm."""
+    from sklearn.neighbors import NearestNeighbors as SkNN
+
+    rng = np.random.default_rng(33)
+    base = rng.normal(size=(400, 6)).astype(np.float32)
+    items = base * rng.uniform(0.1, 10.0, (400, 1)).astype(np.float32)
+    queries = rng.normal(size=(30, 6)).astype(np.float32)
+    est = ApproximateNearestNeighbors(
+        k=8,
+        inputCol="features",
+        algorithm=algorithm,
+        metric="cosine",
+        algoParams={"nlist": 8, "nprobe": 8, "graph_degree": 24, "itopk_size": 64},
+    )
+    est.num_workers = n_devices
+    assert not est._use_cpu_fallback()
+    model = est.fit(pd.DataFrame({"features": list(items)}))
+    _, _, knn_df = model.kneighbors(pd.DataFrame({"features": list(queries)}))
+
+    sk = SkNN(n_neighbors=8, metric="cosine").fit(items)
+    sk_d, sk_idx = sk.kneighbors(queries)
+    got = np.stack(knn_df["indices"].to_numpy())
+    recall = np.mean([len(set(g) & set(s)) / 8.0 for g, s in zip(got, sk_idx)])
+    floor = 1.0 if algorithm in ("brute_force", "ivfflat") else 0.85
+    assert recall >= floor, (algorithm, recall)
+    # distance values are cosine distances
+    got_d = np.stack(knn_df["distances"].to_numpy())
+    np.testing.assert_allclose(np.sort(got_d[0]), np.sort(sk_d[0]), atol=1e-3)
+
+
+def test_ann_cosine_zero_vector_raises(n_devices):
+    items = np.zeros((10, 3), np.float32)
+    items[1:] = np.random.default_rng(1).normal(size=(9, 3))
+    est = ApproximateNearestNeighbors(
+        k=2, inputCol="features", algorithm="brute_force", metric="cosine"
+    )
+    est.num_workers = n_devices
+    with pytest.raises(ValueError, match="zero-length"):
+        est.fit(pd.DataFrame({"features": list(items)}))
