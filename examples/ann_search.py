@@ -1,0 +1,29 @@
+#!/usr/bin/env python
+"""Approximate nearest neighbors: IVF-Flat, IVF-PQ and the CAGRA-class graph index."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import pandas as pd
+
+from spark_rapids_ml_tpu.knn import ApproximateNearestNeighbors
+
+rng = np.random.default_rng(0)
+items = rng.normal(size=(100_000, 64)).astype(np.float32)
+queries = rng.normal(size=(100, 64)).astype(np.float32)
+item_df = pd.DataFrame({"features": list(items)})
+query_df = pd.DataFrame({"features": list(queries)})
+
+for algo, params in [
+    ("ivfflat", {"nlist": 128, "nprobe": 16}),
+    ("ivfpq", {"nlist": 128, "nprobe": 16, "M": 8, "n_bits": 8}),
+    ("cagra", {"graph_degree": 32, "itopk_size": 96}),
+]:
+    model = ApproximateNearestNeighbors(
+        k=10, inputCol="features", algorithm=algo, algoParams=params
+    ).fit(item_df)
+    _, _, knn_df = model.kneighbors(query_df)
+    print(algo, "first query neighbors:", knn_df["indices"].iloc[0][:5])

@@ -1,0 +1,33 @@
+#!/usr/bin/env python
+"""CrossValidator: the whole param grid fits in ONE data pass per fold and the fold
+evaluates in ONE transform scan (P6 multi-model-in-one-pass)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import pandas as pd
+
+from spark_rapids_ml_tpu.classification import LogisticRegression
+from spark_rapids_ml_tpu.evaluation import MulticlassClassificationEvaluator
+from spark_rapids_ml_tpu.tuning import CrossValidator, ParamGridBuilder
+
+rng = np.random.default_rng(0)
+X = np.concatenate([rng.normal(-1, 1, (3000, 16)), rng.normal(1, 1, (3000, 16))])
+y = np.repeat([0.0, 1.0], 3000)
+df = pd.DataFrame({"features": list(X.astype(np.float32)), "label": y})
+
+lr = LogisticRegression(maxIter=50)
+grid = ParamGridBuilder().addGrid(lr.regParam, [0.0, 0.01, 0.1]).build()
+cv = CrossValidator(
+    estimator=lr,
+    estimatorParamMaps=grid,
+    evaluator=MulticlassClassificationEvaluator(metricName="accuracy"),
+    numFolds=3,
+    seed=7,
+)
+cv_model = cv.fit(df)
+print("avg metrics per grid point:", [round(m, 4) for m in cv_model.avgMetrics])
+print("best regParam:", cv_model.bestModel.getOrDefault("regParam"))

@@ -1,0 +1,34 @@
+#!/usr/bin/env python
+"""Quickstart: KMeans + PCA on the local device mesh.
+
+Run on CPU (simulated 8-chip mesh):
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/kmeans_pca_quickstart.py
+On a TPU host the same script uses every local chip automatically.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import pandas as pd
+
+from spark_rapids_ml_tpu.clustering import KMeans
+from spark_rapids_ml_tpu.feature import PCA
+
+rng = np.random.default_rng(0)
+X = np.concatenate(
+    [rng.normal(c, 1.0, (20_000, 32)).astype(np.float32) for c in (-5, 0, 5)]
+)
+df = pd.DataFrame({"features": list(X)})
+
+kmeans = KMeans(k=3, maxIter=30, seed=1).fit(df)
+print("centers (first dims):", np.asarray(kmeans.cluster_centers_)[:, 0])
+print("inertia:", kmeans.inertia_)
+
+pca = PCA(k=4, inputCol="features").fit(df)
+print("explained variance ratio:", np.asarray(pca.explainedVariance)[:4])
+out = pca.transform(df)
+print("projected shape:", np.stack(out["pca_features"].to_numpy()).shape)
