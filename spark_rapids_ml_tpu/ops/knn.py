@@ -308,7 +308,7 @@ def pq_refine(
     return jnp.where(ids >= 0, dists, jnp.inf), ids
 
 
-@functools.partial(jax.jit, static_argnames=("k", "nprobe"))
+@functools.partial(jax.jit, static_argnames=("k", "nprobe", "block"))
 def ivfflat_search(
     Q: jax.Array,
     centers: jax.Array,
@@ -316,28 +316,36 @@ def ivfflat_search(
     cell_ids: jax.Array,
     k: int,
     nprobe: int,
+    block: int = 64,
 ) -> Tuple[jax.Array, jax.Array]:
-    """Probe the nprobe nearest cells per query; masked scan + top-k.
+    """Probe the nprobe nearest cells per query; masked scan + top-k. Queries run in
+    fixed-size blocks (lax.map) so the probed-cell gather is (block, nprobe,
+    max_cell, d) — without blocking, a skewed cell layout at large nq is an HBM
+    blowup (the pre-fix path materialized the whole (nq, ...) gather at once).
     Returns (euclidean distances, item ids), id -1 where fewer than k found."""
     nlist, max_cell, d = cells.shape
-
-    cd2 = _block_sq_dists(Q, centers)  # (nq, nlist)
-    _, probe = jax.lax.top_k(-cd2, nprobe)  # (nq, nprobe)
-
-    probed_items = cells[probe]  # (nq, nprobe, max_cell, d)
-    probed_ids = cell_ids[probe]  # (nq, nprobe, max_cell)
     nq = Q.shape[0]
-    flat_items = probed_items.reshape(nq, nprobe * max_cell, d)
-    flat_ids = probed_ids.reshape(nq, nprobe * max_cell)
-
-    d2 = jnp.sum((flat_items - Q[:, None, :]) ** 2, axis=-1)
-    d2 = jnp.where(flat_ids >= 0, d2, jnp.inf)
     k_eff = min(k, nprobe * max_cell)
-    neg, pos = jax.lax.top_k(-d2, k_eff)
-    ids = jnp.take_along_axis(flat_ids, pos, axis=1)
-    dists = jnp.sqrt(jnp.maximum(-neg, 0.0))
-    dists = jnp.where(ids >= 0, dists, jnp.inf)
-    return dists, ids
+
+    def search_block(qb):
+        bq = qb.shape[0]
+        cd2 = _block_sq_dists(qb, centers)  # (bq, nlist)
+        _, probe = jax.lax.top_k(-cd2, nprobe)  # (bq, nprobe)
+        probed_items = cells[probe]  # (bq, nprobe, max_cell, d)
+        probed_ids = cell_ids[probe]
+        flat_items = probed_items.reshape(bq, nprobe * max_cell, d)
+        flat_ids = probed_ids.reshape(bq, nprobe * max_cell)
+        d2 = jnp.sum((flat_items - qb[:, None, :]) ** 2, axis=-1)
+        d2 = jnp.where(flat_ids >= 0, d2, jnp.inf)
+        neg, pos = jax.lax.top_k(-d2, k_eff)
+        ids = jnp.take_along_axis(flat_ids, pos, axis=1)
+        dists = jnp.sqrt(jnp.maximum(-neg, 0.0))
+        return jnp.where(ids >= 0, dists, jnp.inf), ids
+
+    pad = (-nq) % block
+    Qp = jnp.pad(Q, ((0, pad), (0, 0)))
+    db, ib = jax.lax.map(search_block, Qp.reshape(-1, block, d))
+    return db.reshape(-1, k_eff)[:nq], ib.reshape(-1, k_eff)[:nq]
 
 
 # ---------------------------------------------------------------------------
