@@ -404,10 +404,48 @@ def cagra_build(
     order = np.argsort(~not_self, axis=1, kind="stable")
     graph = np.take_along_axis(idx, order, axis=1)[:, :deg].astype(np.int32)
     graph = np.maximum(graph, 0)  # any -1 from an undersized IVF probe -> node 0
+    graph = _optimize_graph_reverse_edges(Xv, graph, deg)
     return {"items": Xv, "graph": graph}
 
 
-@functools.partial(jax.jit, static_argnames=("k", "itopk", "iterations"))
+def _optimize_graph_reverse_edges(
+    Xv: np.ndarray, graph: np.ndarray, deg: int
+) -> np.ndarray:
+    """Graph optimization (the role of cuVS cagra's optimize step): augment the
+    forward kNN edges with REVERSE edges, then keep each node's `deg` closest
+    distinct neighbors. Reverse edges give low-in-degree nodes entry points the
+    greedy beam can actually reach — pure-forward kNN graphs strand hub-adjacent
+    points. Fully vectorized: one lexsort over the doubled edge list."""
+    n = Xv.shape[0]
+    heads = np.repeat(np.arange(n, dtype=np.int64), graph.shape[1])
+    tails = graph.reshape(-1).astype(np.int64)
+    d = np.linalg.norm(Xv[heads] - Xv[tails], axis=1)
+    all_h = np.concatenate([heads, tails])
+    all_t = np.concatenate([tails, heads])
+    all_d = np.concatenate([d, d])
+    keep = all_h != all_t
+    all_h, all_t, all_d = all_h[keep], all_t[keep], all_d[keep]
+
+    # dedupe (h, t) pairs keeping the min distance, then rank per head by distance
+    key = all_h * n + all_t
+    o = np.lexsort((all_d, key))
+    key_s = key[o]
+    first = np.concatenate([[True], key_s[1:] != key_s[:-1]])
+    h2, t2, d2 = all_h[o][first], all_t[o][first], all_d[o][first]
+    o2 = np.lexsort((d2, h2))
+    h3, t3 = h2[o2], t2[o2]
+    counts = np.bincount(h3, minlength=n)
+    starts = np.concatenate([[0], np.cumsum(counts)[:-1]])
+    within = np.arange(len(h3)) - np.repeat(starts, counts)
+    sel = within < deg
+    out = graph.copy()  # nodes with < deg merged edges keep their forward fill
+    out[h3[sel], within[sel]] = t3[sel].astype(np.int32)
+    return out
+
+
+@functools.partial(
+    jax.jit, static_argnames=("k", "itopk", "iterations", "search_width")
+)
 def cagra_search(
     Q: jax.Array,
     items: jax.Array,  # (n, d)
@@ -415,8 +453,12 @@ def cagra_search(
     k: int,
     itopk: int = 64,
     iterations: int = 32,
+    search_width: int = 1,
 ) -> Tuple[jax.Array, jax.Array]:
-    """Greedy beam search over the neighbor graph.
+    """Greedy beam search over the neighbor graph. `search_width` (cuVS param of
+    the same name) expands the W best unvisited pool entries per iteration — the
+    gathers batch W*deg neighbors, so width converts iteration latency into MXU/
+    gather throughput at equal total expansions.
 
     Returns (euclidean distances, item ids), shapes (nq, min(k, itopk))."""
     n, d = items.shape
@@ -437,19 +479,25 @@ def cagra_search(
     d20 = dists_to(ids0)
     visited0 = jnp.zeros((nq, itopk_eff), bool)
 
+    width = max(1, min(search_width, itopk_eff))
+
     def body(_, state):
         ids, d2, visited = state
-        # expand the best unvisited pool entry
+        # expand the `width` best unvisited pool entries
         expand_key = jnp.where(visited, jnp.inf, d2)
-        best = jnp.argmin(expand_key, axis=1)  # (nq,)
-        visited = visited | jax.nn.one_hot(best, itopk_eff, dtype=bool)
-        best_id = jnp.take_along_axis(ids, best[:, None], axis=1)[:, 0]
-        nbrs = graph[best_id]  # (nq, deg)
+        _, best = jax.lax.top_k(-expand_key, width)  # (nq, width)
+        visited = visited | (
+            jnp.sum(jax.nn.one_hot(best, itopk_eff, dtype=jnp.int32), axis=1) > 0
+        )
+        best_ids = jnp.take_along_axis(ids, best, axis=1)  # (nq, width)
+        nbrs = graph[best_ids].reshape(nq, width * deg)
         nd2 = dists_to(nbrs)
 
         all_ids = jnp.concatenate([ids, nbrs], axis=1)
         all_d2 = jnp.concatenate([d2, nd2], axis=1)
-        all_vis = jnp.concatenate([visited, jnp.zeros((nq, deg), bool)], axis=1)
+        all_vis = jnp.concatenate(
+            [visited, jnp.zeros((nq, width * deg), bool)], axis=1
+        )
 
         # duplicate suppression: sort by id; any entry equal to its left neighbor is
         # a duplicate -> inf distance (never ranks) + visited (never re-expands).
