@@ -58,8 +58,29 @@ def save_instance(instance: Any, path: str, overwrite: bool = False) -> None:
 
     attrs: Optional[Dict[str, Any]] = getattr(instance, "_model_attributes", None)
     if attrs is not None:
-        arrays = {k: np.asarray(v) for k, v in attrs.items() if isinstance(v, np.ndarray)}
-        scalars = {k: v for k, v in attrs.items() if not isinstance(v, np.ndarray)}
+        try:
+            import scipy.sparse as sp
+        except ImportError:  # pragma: no cover
+            sp = None
+        arrays = {}
+        scalars = {}
+        sparse_keys = []
+        for k, v in attrs.items():
+            if sp is not None and sp.issparse(v):
+                # CSR attributes (sparse-fitted UMAP raw_data) store as their
+                # component arrays; reassembled at load
+                csr = v.tocsr()
+                arrays[f"__csr_data__{k}"] = csr.data
+                arrays[f"__csr_indices__{k}"] = csr.indices
+                arrays[f"__csr_indptr__{k}"] = csr.indptr
+                arrays[f"__csr_shape__{k}"] = np.asarray(csr.shape, np.int64)
+                sparse_keys.append(k)
+            elif isinstance(v, np.ndarray):
+                arrays[k] = np.asarray(v)
+            else:
+                scalars[k] = v
+        if sparse_keys:
+            scalars["__sparse_attr_keys__"] = sparse_keys
         if arrays:
             np.savez(os.path.join(path, "arrays.npz"), **arrays)
         with open(os.path.join(path, "attributes.json"), "w") as f:
@@ -97,6 +118,17 @@ def load_instance(path: str, expected_cls: Optional[Type] = None) -> Any:
         if os.path.exists(npz_file):
             with np.load(npz_file) as data:
                 attrs.update({k: data[k] for k in data.files})
+        for k in attrs.pop("__sparse_attr_keys__", []):
+            import scipy.sparse as sp
+
+            attrs[k] = sp.csr_matrix(
+                (
+                    attrs.pop(f"__csr_data__{k}"),
+                    attrs.pop(f"__csr_indices__{k}"),
+                    attrs.pop(f"__csr_indptr__{k}"),
+                ),
+                shape=tuple(attrs.pop(f"__csr_shape__{k}")),
+            )
         instance = cls._from_row(attrs)
     else:
         instance = cls()

@@ -236,3 +236,27 @@ def test_dbscan_cosine_zero_vector_raises(n_devices):
     est.num_workers = n_devices
     with pytest.raises(ValueError, match="zero-length"):
         est.fit(df).transform(df)
+
+
+def test_sparse_umap_persistence_roundtrip(tmp_path, n_devices):
+    """Sparse-fitted UMAP models save/load with raw_data staying CSR."""
+    import scipy.sparse as sp
+
+    from spark_rapids_ml_tpu.umap import UMAP, UMAPModel
+
+    X = sp.random(80, 30, density=0.15, format="csr", dtype=np.float32, random_state=1)
+    df = pd.DataFrame({"features": [X.getrow(i) for i in range(X.shape[0])]})
+    m = UMAP(n_epochs=20, seed=1).fit(df)
+    m.save(str(tmp_path / "m"))
+    m2 = UMAPModel.load(str(tmp_path / "m"))
+    assert sp.issparse(m2.rawData_)
+    np.testing.assert_allclose(
+        np.asarray(m2.embedding_), np.asarray(m.embedding_), atol=1e-6
+    )
+    out1 = m.transform(df.head(5))
+    out2 = m2.transform(df.head(5))
+    np.testing.assert_allclose(
+        np.stack(out1["embedding"].to_numpy()),
+        np.stack(out2["embedding"].to_numpy()),
+        atol=1e-5,
+    )
