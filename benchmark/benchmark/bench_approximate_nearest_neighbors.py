@@ -16,8 +16,15 @@ class BenchmarkApproximateNearestNeighbors(BenchmarkBase):
     def add_arguments(self, parser):
         parser.add_argument("--k", type=int, default=10)
         parser.add_argument("--num_queries", type=int, default=100)
+        parser.add_argument(
+            "--algorithm", default="ivfflat",
+            choices=["ivfflat", "ivfpq", "cagra", "brute_force"],
+        )
         parser.add_argument("--nlist", type=int, default=64)
         parser.add_argument("--nprobe", type=int, default=8)
+        parser.add_argument("--graph_degree", type=int, default=32)
+        parser.add_argument("--itopk_size", type=int, default=96)
+        parser.add_argument("--search_width", type=int, default=4)
 
     def run_tpu(self, df, args):
         from sklearn.neighbors import NearestNeighbors as SkNN
@@ -27,8 +34,12 @@ class BenchmarkApproximateNearestNeighbors(BenchmarkBase):
         X = np.stack(df["features"].to_numpy())
         qdf = pd.DataFrame({"features": list(X[: args.num_queries])})
         est = ApproximateNearestNeighbors(
-            k=args.k, inputCol="features",
-            algoParams={"nlist": args.nlist, "nprobe": args.nprobe},
+            k=args.k, inputCol="features", algorithm=args.algorithm,
+            algoParams={
+                "nlist": args.nlist, "nprobe": args.nprobe,
+                "graph_degree": args.graph_degree,
+                "itopk_size": args.itopk_size, "search_width": args.search_width,
+            },
         )
         if args.num_workers:
             est.num_workers = args.num_workers
