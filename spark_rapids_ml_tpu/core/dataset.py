@@ -72,11 +72,13 @@ class FeatureData:
 
 
 def _stack_feature_column(col: Any) -> np.ndarray:
-    """A pandas column whose cells are lists/arrays -> (n, d) float array
-    (reference's ArrayType/VectorUDT unwrap, core.py:496-527)."""
+    """A pandas column whose cells are lists/arrays/pyspark Vectors -> (n, d) float
+    array (reference's ArrayType/VectorUDT unwrap, core.py:496-527)."""
     first = col.iloc[0]
     if np.isscalar(first):
         return col.to_numpy().reshape(-1, 1)
+    if hasattr(first, "toArray"):  # pyspark.ml.linalg Dense/SparseVector cells
+        return np.stack([v.toArray() for v in col.to_numpy()])
     return np.stack([np.asarray(v) for v in col.to_numpy()])
 
 

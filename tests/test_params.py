@@ -67,3 +67,24 @@ def test_extract_param_map():
     pm = t.extractParamMap()
     assert pm[t.k] == 4
     assert pm[t.maxIter] == 10
+
+
+def test_vector_udt_style_cells(n_devices):
+    """pyspark.ml.linalg Vector cells (objects exposing toArray) unwrap like the
+    reference's VectorUDT path (core.py:496-527) — mocked, since pyspark is absent."""
+    import numpy as np
+    import pandas as pd
+
+    from spark_rapids_ml_tpu.core.dataset import extract_feature_data
+
+    class FakeDenseVector:
+        def __init__(self, values):
+            self._v = np.asarray(values, dtype=np.float64)
+
+        def toArray(self):
+            return self._v
+
+    X = np.random.default_rng(0).normal(size=(20, 4)).astype(np.float64)
+    pdf = pd.DataFrame({"features": [FakeDenseVector(r) for r in X]})
+    fd = extract_feature_data(pdf, input_col="features")
+    np.testing.assert_allclose(fd.features, X.astype(np.float32), atol=1e-6)
