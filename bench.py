@@ -37,7 +37,7 @@ def _probe_once(timeout_s: float) -> int:
         return -1
 
 
-def _device_init_watchdog(attempts: int = 3, timeout_s: float = 120.0) -> None:
+def _device_init_watchdog(attempts: int = 2, timeout_s: float = 90.0) -> None:
     """The axon TPU tunnel can wedge so hard that `import jax` hangs every process.
     Probe device init in a subprocess with retry+backoff (the tunnel can recover
     between probes); only after all probes fail, re-exec ourselves on the CPU
@@ -47,10 +47,12 @@ def _device_init_watchdog(attempts: int = 3, timeout_s: float = 120.0) -> None:
     marker = "/tmp/.srml_bench_device_ok"
     if os.path.exists(marker):
         return  # a prior healthy probe on this machine; skip the double init
+    # budget note: the whole probe sequence must leave room for the CPU-fallback
+    # compute inside a ~300 s driver timeout (2 x 90 s + 10 s backoff + ~60 s run)
     rc = -1
     for attempt in range(attempts):
         if attempt:
-            time.sleep(10.0 * attempt)  # linear backoff: 10s, 20s
+            time.sleep(10.0 * attempt)  # linear backoff
         rc = _probe_once(timeout_s)
         if rc == 0:
             break
