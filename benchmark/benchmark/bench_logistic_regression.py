@@ -15,8 +15,27 @@ class BenchmarkLogisticRegression(BenchmarkBase):
         parser.add_argument("--regParam", type=float, default=0.01)
         parser.add_argument("--maxIter", type=int, default=100)
         parser.add_argument("--num_classes", type=int, default=2)
+        parser.add_argument(
+            "--density", type=float, default=None,
+            help="generate sparse CSR input at this density (ELL kernel path, "
+            "reference's sparse LogReg benchmark axis)",
+        )
 
     def gen_dataframe(self, args):
+        if args.density is not None:
+            import pandas as pd
+            import scipy.sparse as sp
+
+            rng = np.random.default_rng(args.seed)
+            X = sp.random(
+                args.num_rows, args.num_cols, density=args.density, format="csr",
+                dtype=np.float32, random_state=args.seed,
+            )
+            coef = rng.normal(size=args.num_cols)
+            y = (np.asarray(X @ coef).ravel() > 0).astype(np.float64)
+            return pd.DataFrame(
+                {"features": [X.getrow(i) for i in range(X.shape[0])], "label": y}
+            )
         from ..gen_data import ClassificationDataGen
 
         return ClassificationDataGen(
@@ -40,7 +59,13 @@ class BenchmarkLogisticRegression(BenchmarkBase):
     def run_cpu(self, df, args):
         from sklearn.linear_model import LogisticRegression as SkLogReg
 
-        X = np.stack(df["features"].to_numpy())
+        first = df["features"].iloc[0]
+        if hasattr(first, "toarray"):  # sparse cells
+            import scipy.sparse as sp
+
+            X = sp.vstack(list(df["features"].to_numpy())).tocsr()
+        else:
+            X = np.stack(df["features"].to_numpy())
         y = df["label"].to_numpy()
         est = SkLogReg(C=1.0 / max(args.regParam * len(y), 1e-12), max_iter=args.maxIter)
         model, fit_time = with_benchmark("cpu fit", lambda: est.fit(X, y))
