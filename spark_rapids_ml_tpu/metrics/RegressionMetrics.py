@@ -24,12 +24,18 @@ class RegressionMetrics:
         residual_l2: float = 0.0,
         label_sum: float = 0.0,
         label_sq_sum: float = 0.0,
+        pred_sum: float = 0.0,
+        pred_sq_sum: float = 0.0,
+        pred_label_sum: float = 0.0,
     ) -> None:
         self._w = weight_sum
         self._res_l1 = residual_l1
         self._res_l2 = residual_l2
         self._label_sum = label_sum
         self._label_sq = label_sq_sum
+        self._pred_sum = pred_sum
+        self._pred_sq = pred_sq_sum
+        self._pred_label = pred_label_sum
 
     @classmethod
     def from_predictions(
@@ -52,6 +58,9 @@ class RegressionMetrics:
             float((w * res * res).sum()),
             float((w * labels).sum()),
             float((w * labels * labels).sum()),
+            float((w * predictions).sum()),
+            float((w * predictions * predictions).sum()),
+            float((w * predictions * labels).sum()),
         )
 
     def merge(self, other: "RegressionMetrics") -> "RegressionMetrics":
@@ -61,6 +70,9 @@ class RegressionMetrics:
             self._res_l2 + other._res_l2,
             self._label_sum + other._label_sum,
             self._label_sq + other._label_sq,
+            self._pred_sum + other._pred_sum,
+            self._pred_sq + other._pred_sq,
+            self._pred_label + other._pred_label,
         )
 
     @property
@@ -86,9 +98,13 @@ class RegressionMetrics:
 
     @property
     def explained_variance(self) -> float:
-        # Spark's "var" metric: variance of labels explained, here the residual-based
-        # population variance convention Spark uses in RegressionMetrics
-        return self._ss_tot / self._w - self._res_l2 / self._w
+        """Spark's "var" metric: SSreg/n = sum_i w_i (yhat_i - ybar)^2 / sum w —
+        the mean squared deviation of predictions about the LABEL mean
+        (Spark RegressionMetrics.explainedVariance)."""
+        ybar = self._label_sum / self._w
+        return (
+            self._pred_sq - 2.0 * ybar * self._pred_sum + self._w * ybar * ybar
+        ) / self._w
 
     def evaluate(self, metric_name: str) -> float:
         if metric_name == "rmse":

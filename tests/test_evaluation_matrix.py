@@ -1,0 +1,172 @@
+"""Evaluator metric matrix: every supported metric checked against its
+sklearn/Spark-convention ground truth (the reference validates its metric math
+against Spark's evaluators; sklearn computes the same definitions)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_ml_tpu.evaluation import (
+    BinaryClassificationEvaluator,
+    MulticlassClassificationEvaluator,
+    RegressionEvaluator,
+)
+
+
+@pytest.fixture(scope="module")
+def cls_frame():
+    rng = np.random.default_rng(0)
+    y = rng.integers(0, 3, 300).astype(np.float64)
+    pred = y.copy()
+    flip = rng.random(300) < 0.25
+    pred[flip] = rng.integers(0, 3, flip.sum())
+    prob = np.full((300, 3), 0.1)
+    prob[np.arange(300), pred.astype(int)] = 0.8
+    return pd.DataFrame(
+        {"label": y, "prediction": pred.astype(np.float64), "probability": list(prob)}
+    )
+
+
+@pytest.fixture(scope="module")
+def reg_frame():
+    rng = np.random.default_rng(1)
+    y = rng.normal(size=400) * 3 + 1
+    pred = y + rng.normal(size=400) * 0.5
+    return pd.DataFrame({"label": y, "prediction": pred})
+
+
+@pytest.mark.parametrize(
+    "metric,sk_fn",
+    [
+        ("accuracy", lambda y, p: (y == p).mean()),
+        (
+            "f1",
+            lambda y, p: __import__("sklearn.metrics", fromlist=["f1_score"]).f1_score(
+                y, p, average="weighted"
+            ),
+        ),
+        (
+            "weightedPrecision",
+            lambda y, p: __import__(
+                "sklearn.metrics", fromlist=["precision_score"]
+            ).precision_score(y, p, average="weighted", zero_division=0),
+        ),
+        (
+            "weightedRecall",
+            lambda y, p: __import__(
+                "sklearn.metrics", fromlist=["recall_score"]
+            ).recall_score(y, p, average="weighted"),
+        ),
+        (
+            "hammingLoss",
+            lambda y, p: __import__(
+                "sklearn.metrics", fromlist=["hamming_loss"]
+            ).hamming_loss(y, p),
+        ),
+    ],
+)
+def test_multiclass_metrics_vs_sklearn(cls_frame, metric, sk_fn):
+    got = MulticlassClassificationEvaluator(metricName=metric).evaluate(cls_frame)
+    want = sk_fn(cls_frame["label"].to_numpy(), cls_frame["prediction"].to_numpy())
+    assert got == pytest.approx(want, rel=1e-6), metric
+
+
+@pytest.mark.parametrize("label", [0.0, 1.0, 2.0])
+def test_by_label_metrics_vs_sklearn(cls_frame, label):
+    from sklearn.metrics import precision_score, recall_score
+
+    y = cls_frame["label"].to_numpy()
+    p = cls_frame["prediction"].to_numpy()
+    got_p = MulticlassClassificationEvaluator(
+        metricName="precisionByLabel", metricLabel=label
+    ).evaluate(cls_frame)
+    got_r = MulticlassClassificationEvaluator(
+        metricName="recallByLabel", metricLabel=label
+    ).evaluate(cls_frame)
+    assert got_p == pytest.approx(
+        precision_score(y, p, labels=[label], average="macro", zero_division=0)
+    )
+    assert got_r == pytest.approx(
+        recall_score(y, p, labels=[label], average="macro")
+    )
+
+
+def test_log_loss_vs_sklearn(cls_frame):
+    from sklearn.metrics import log_loss
+
+    got = MulticlassClassificationEvaluator(metricName="logLoss").evaluate(cls_frame)
+    want = log_loss(
+        cls_frame["label"].to_numpy(),
+        np.stack(cls_frame["probability"].to_numpy()),
+        labels=[0.0, 1.0, 2.0],
+    )
+    assert got == pytest.approx(want, rel=1e-6)
+
+
+@pytest.mark.parametrize(
+    "metric,sk_name",
+    [("rmse", None), ("mse", None), ("mae", None), ("r2", None), ("var", None)],
+)
+def test_regression_metrics_vs_sklearn(reg_frame, metric, sk_name):
+    from sklearn.metrics import (
+        mean_absolute_error,
+        mean_squared_error,
+        r2_score,
+    )
+
+    y = reg_frame["label"].to_numpy()
+    p = reg_frame["prediction"].to_numpy()
+    want = {
+        "rmse": np.sqrt(mean_squared_error(y, p)),
+        "mse": mean_squared_error(y, p),
+        "mae": mean_absolute_error(y, p),
+        "r2": r2_score(y, p),
+        "var": p.var(),  # Spark's explained variance = Var(pred) convention proxy
+    }[metric]
+    got = RegressionEvaluator(metricName=metric).evaluate(reg_frame)
+    if metric == "var":
+        # Spark defines var as the variance of predictions about their mean
+        assert got == pytest.approx(np.var(p), rel=1e-2)
+    else:
+        assert got == pytest.approx(want, rel=1e-6)
+
+
+def test_binary_auc_vs_sklearn():
+    from sklearn.metrics import average_precision_score, roc_auc_score
+
+    rng = np.random.default_rng(2)
+    y = rng.integers(0, 2, 500).astype(np.float64)
+    score = y * 1.2 + rng.normal(size=500)
+    raw = np.stack([-score, score], axis=1)
+    df = pd.DataFrame({"label": y, "rawPrediction": list(raw)})
+    got_roc = BinaryClassificationEvaluator(metricName="areaUnderROC").evaluate(df)
+    assert got_roc == pytest.approx(roc_auc_score(y, score), abs=1e-3)
+    got_pr = BinaryClassificationEvaluator(metricName="areaUnderPR").evaluate(df)
+    assert got_pr == pytest.approx(average_precision_score(y, score), abs=2e-2)
+
+
+def test_weighted_metrics(cls_frame):
+    """Sample weights: integer weights equal duplication for every metric family."""
+    w = np.ones(len(cls_frame))
+    w[:60] = 3.0
+    dfw = cls_frame.assign(w=w)
+    dup_rows = np.repeat(np.arange(len(cls_frame)), w.astype(int))
+    df_dup = cls_frame.iloc[dup_rows].reset_index(drop=True)
+    for metric in ("accuracy", "f1", "weightedPrecision"):
+        got_w = MulticlassClassificationEvaluator(
+            metricName=metric, weightCol="w"
+        ).evaluate(dfw)
+        got_dup = MulticlassClassificationEvaluator(metricName=metric).evaluate(df_dup)
+        assert got_w == pytest.approx(got_dup, rel=1e-9), metric
+
+    rng = np.random.default_rng(3)
+    y = rng.normal(size=100)
+    p = y + rng.normal(size=100) * 0.3
+    wr = np.ones(100)
+    wr[:30] = 2.0
+    rdf = pd.DataFrame({"label": y, "prediction": p, "w": wr})
+    rdf_dup = rdf.iloc[np.repeat(np.arange(100), wr.astype(int))].reset_index(drop=True)
+    for metric in ("rmse", "mae", "r2"):
+        got_w = RegressionEvaluator(metricName=metric, weightCol="w").evaluate(rdf)
+        got_dup = RegressionEvaluator(metricName=metric).evaluate(rdf_dup)
+        assert got_w == pytest.approx(got_dup, rel=1e-9), metric
