@@ -231,10 +231,31 @@ def kmeans_fit(
     init_centers = jnp.asarray(kmeans_init(X, w, k, init, init_steps, seed))
     from .. import config as _config
 
-    centers, inertia, n_iter = lloyd_fit(
-        X, w, init_centers, float(tol), int(max_iter), cosine=cosine,
-        fast_math=bool(_config.get("fast_math")),
+    use_fused = (
+        not cosine
+        and __import__("os").environ.get("SRML_TPU_PALLAS_KMEANS", "") == "1"
     )
+    if use_fused:
+        # fused pallas Lloyd: X streams HBM once per iteration (ops/pallas_kmeans.py);
+        # opt-in until profiled on live TPU hardware
+        from jax.sharding import NamedSharding
+
+        from .pallas_kmeans import lloyd_fit_pallas
+
+        mesh = (
+            X.sharding.mesh
+            if isinstance(getattr(X, "sharding", None), NamedSharding)
+            else None
+        )
+        centers, inertia, n_iter = lloyd_fit_pallas(
+            X, w, init_centers, float(tol), int(max_iter), mesh=mesh,
+            interpret=(jax.default_backend() != "tpu"),
+        )
+    else:
+        centers, inertia, n_iter = lloyd_fit(
+            X, w, init_centers, float(tol), int(max_iter), cosine=cosine,
+            fast_math=bool(_config.get("fast_math")),
+        )
     return {
         "cluster_centers": np.asarray(centers),
         "inertia": float(inertia),

@@ -1,0 +1,169 @@
+#
+# Pallas TPU kernel: fused Lloyd iteration (assignment + centroid accumulation).
+#
+# The XLA formulation of one Lloyd step reads X twice per iteration from HBM: once
+# for the (n, k) distance matmul and once for the one-hotT @ X centroid update —
+# plus it materializes the (n, k) distance/one-hot intermediates. This kernel fuses
+# the whole step per row block in VMEM:
+#     for each block of rows:  d2 = x2 - 2 Xb Ct + c2      (MXU)
+#                              assign = argmin d2
+#                              onehot = (iota == assign)    (VPU, never leaves VMEM)
+#                              sums   += onehotT @ Xb       (MXU)
+#                              counts += sum onehot
+#                              inertia+= sum w * min d2
+# so X streams through HBM exactly once per iteration and no (n, k) tensor exists.
+#
+# Single-device form (pallas_call has no GSPMD rule); the multi-device path wraps it
+# per-shard under shard_map with a psum merge, exactly like the histogram kernel
+# (ops/pallas_histogram.py). Off by default: enable with SRML_TPU_PALLAS_KMEANS=1
+# (a TPU-measured win should flip the default in a later round — this image has no
+# live TPU to profile).
+#
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+BLOCK_ROWS = 1024
+
+
+def _round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+def _lloyd_kernel(x_ref, w_ref, c_ref, c2_ref, sums_ref, counts_ref, inertia_ref):
+    """One row block: fused distances + argmin + weighted accumulation."""
+    b = pl.program_id(0)
+
+    @pl.when(b == 0)
+    def _():
+        sums_ref[...] = jnp.zeros_like(sums_ref)
+        counts_ref[...] = jnp.zeros_like(counts_ref)
+        inertia_ref[...] = jnp.zeros_like(inertia_ref)
+
+    Xb = x_ref[...]  # (B, d)
+    w = w_ref[...]  # (B, 1)
+    C = c_ref[...]  # (k, d)
+    c2 = c2_ref[...]  # (1, k)
+
+    cross = jax.lax.dot_general(
+        Xb, C, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )  # (B, k)
+    # x2 cancels in the argmin; only the inertia needs it
+    part = c2 - 2.0 * cross  # (B, k)
+    assign = jnp.argmin(part, axis=1)  # (B,)
+    k = C.shape[0]
+    cols = jax.lax.broadcasted_iota(jnp.int32, (Xb.shape[0], k), 1)
+    onehot = (cols == assign[:, None]).astype(jnp.float32) * w  # (B, k) weighted
+
+    sums_ref[...] += jax.lax.dot_general(
+        onehot, Xb, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )  # (k, d)
+    counts_ref[...] += jnp.sum(onehot, axis=0)[None, :]  # (1, k)
+    x2 = jnp.sum(Xb * Xb, axis=1, keepdims=True)  # (B, 1)
+    min_part = jnp.min(part, axis=1, keepdims=True)  # (B, 1)
+    d2min = jnp.maximum(x2 + min_part, 0.0)
+    inertia_ref[...] += jnp.sum(w * d2min)[None, None]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def lloyd_step_pallas(
+    X: jax.Array,  # (n, d) f32
+    w: jax.Array,  # (n,) f32 — 0 for padding rows
+    centers: jax.Array,  # (k, d) f32
+    interpret: bool = False,
+):
+    """One fused Lloyd accumulation pass. Returns (sums (k,d), counts (k,),
+    inertia scalar) — the caller forms new centers as sums/counts."""
+    n, d = X.shape
+    k = centers.shape[0]
+    pad = (-n) % BLOCK_ROWS
+    if pad:
+        X = jnp.pad(X, ((0, pad), (0, 0)))
+        w = jnp.pad(w, ((0, pad),))
+    c2 = jnp.sum(centers * centers, axis=1)[None, :]  # (1, k)
+
+    sums, counts, inertia = pl.pallas_call(
+        _lloyd_kernel,
+        grid=(X.shape[0] // BLOCK_ROWS,),
+        in_specs=[
+            pl.BlockSpec((BLOCK_ROWS, d), lambda b: (b, 0)),
+            pl.BlockSpec((BLOCK_ROWS, 1), lambda b: (b, 0)),
+            pl.BlockSpec((k, d), lambda b: (0, 0)),
+            pl.BlockSpec((1, k), lambda b: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((k, d), lambda b: (0, 0)),
+            pl.BlockSpec((1, k), lambda b: (0, 0)),
+            pl.BlockSpec((1, 1), lambda b: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((k, d), jnp.float32),
+            jax.ShapeDtypeStruct((1, k), jnp.float32),
+            jax.ShapeDtypeStruct((1, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(X, w[:, None], centers, c2)
+    return sums, counts[0], inertia[0, 0]
+
+
+def lloyd_fit_pallas(
+    X: jax.Array,
+    w: jax.Array,
+    init_centers: jax.Array,
+    tol: float,
+    max_iter: int,
+    mesh=None,
+    interpret: bool = False,
+):
+    """Full Lloyd loop over the fused kernel; identical convergence semantics to
+    ops/kmeans.lloyd_fit (movement^2 <= tol^2). With a multi-device mesh the kernel
+    runs per-shard under shard_map and the (sums, counts, inertia) partials psum."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import DATA_AXIS
+
+    if mesh is not None and mesh.devices.size > 1:
+        from jax import shard_map
+
+        @functools.partial(
+            shard_map,
+            mesh=mesh,
+            in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P()),
+            out_specs=(P(), P(), P()),
+            check_vma=False,
+        )
+        def _step(x_local, w_local, centers):
+            s, c, i = lloyd_step_pallas(x_local, w_local, centers, interpret=interpret)
+            return (
+                jax.lax.psum(s, DATA_AXIS),
+                jax.lax.psum(c, DATA_AXIS),
+                jax.lax.psum(i, DATA_AXIS),
+            )
+
+        step = _step
+    else:
+        step = functools.partial(lloyd_step_pallas, interpret=interpret)
+
+    centers = init_centers
+    inertia = np.inf
+    n_iter = 0
+    for it in range(max_iter):
+        sums, counts, inertia_j = step(X, w, centers)
+        new_centers = jnp.where(
+            counts[:, None] > 0,
+            sums / jnp.maximum(counts, 1.0)[:, None],
+            centers,
+        )
+        shift2 = float(jnp.max(jnp.sum((new_centers - centers) ** 2, axis=1)))
+        centers = new_centers
+        inertia = float(inertia_j)
+        n_iter = it + 1
+        if shift2 <= tol * tol:
+            break
+    return centers, inertia, n_iter

@@ -1,0 +1,88 @@
+"""Fused pallas Lloyd kernel (ops/pallas_kmeans.py): interpret-mode parity vs the
+XLA lloyd_fit, single-device and per-shard under shard_map."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_ml_tpu.ops.kmeans import lloyd_fit
+from spark_rapids_ml_tpu.ops.pallas_kmeans import lloyd_fit_pallas, lloyd_step_pallas
+
+
+def _blobs(n=600, d=16, k=4, seed=0):
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(0, 5, (k, d)).astype(np.float32)
+    X = (centers[rng.integers(0, k, n)] + rng.normal(0, 0.5, (n, d))).astype(np.float32)
+    init = centers + rng.normal(0, 0.3, centers.shape).astype(np.float32)
+    return X, init
+
+
+def test_fused_step_matches_xla_accumulation():
+    X, init = _blobs()
+    w = np.ones((len(X),), np.float32)
+    w[-40:] = 0.0  # padding rows contribute nothing
+    sums, counts, inertia = lloyd_step_pallas(
+        jnp.asarray(X), jnp.asarray(w), jnp.asarray(init), interpret=True
+    )
+    # reference accumulation
+    d2 = ((X[:, None, :] - init[None]) ** 2).sum(-1)
+    assign = d2.argmin(1)
+    onehot = np.eye(init.shape[0], dtype=np.float32)[assign] * w[:, None]
+    np.testing.assert_allclose(np.asarray(sums), onehot.T @ X, rtol=1e-4, atol=1e-2)
+    np.testing.assert_allclose(np.asarray(counts), onehot.sum(0), atol=1e-5)
+    assert float(inertia) == pytest.approx(float((w * d2.min(1)).sum()), rel=1e-5)
+
+
+def test_fused_fit_matches_lloyd_fit(n_devices):
+    X, init = _blobs(n=512)
+    w = np.ones((512,), np.float32)
+    c_ref, in_ref, it_ref = lloyd_fit(
+        jnp.asarray(X), jnp.asarray(w), jnp.asarray(init), 1e-6, 20
+    )
+    c_p, in_p, it_p = lloyd_fit_pallas(
+        jnp.asarray(X), jnp.asarray(w), jnp.asarray(init), 1e-6, 20, interpret=True
+    )
+    np.testing.assert_allclose(np.asarray(c_p), np.asarray(c_ref), rtol=1e-4, atol=1e-3)
+    assert in_p == pytest.approx(float(in_ref), rel=1e-4)
+    assert it_p == int(it_ref)
+
+
+def test_fused_fit_sharded(n_devices):
+    from spark_rapids_ml_tpu.parallel.mesh import get_mesh, shard_array
+
+    X, init = _blobs(n=1024, seed=3)
+    w = np.ones((1024,), np.float32)
+    mesh = get_mesh()
+    c_ref, in_ref, _ = lloyd_fit(
+        shard_array(X, mesh), shard_array(w, mesh), jnp.asarray(init), 1e-6, 15
+    )
+    c_p, in_p, _ = lloyd_fit_pallas(
+        shard_array(X, mesh), shard_array(w, mesh), jnp.asarray(init), 1e-6, 15,
+        mesh=mesh, interpret=True,
+    )
+    np.testing.assert_allclose(np.asarray(c_p), np.asarray(c_ref), rtol=1e-4, atol=1e-3)
+    assert in_p == pytest.approx(float(in_ref), rel=1e-4)
+
+
+def test_estimator_env_gate(monkeypatch, n_devices):
+    """SRML_TPU_PALLAS_KMEANS=1 routes KMeans.fit through the fused kernel with
+    matching clusters."""
+    import pandas as pd
+
+    from spark_rapids_ml_tpu.clustering import KMeans
+
+    X, _ = _blobs(n=240, d=6, k=2, seed=7)
+    df = pd.DataFrame({"features": list(X)})
+    base = KMeans(k=2, seed=1, maxIter=20).fit(df)
+    monkeypatch.setenv("SRML_TPU_PALLAS_KMEANS", "1")
+    fused = KMeans(k=2, seed=1, maxIter=20).fit(df)
+
+    def canon(c):
+        c = np.asarray(c)
+        return c[np.argsort(c[:, 0])]
+
+    np.testing.assert_allclose(
+        canon(base.cluster_centers_), canon(fused.cluster_centers_), atol=1e-3
+    )
