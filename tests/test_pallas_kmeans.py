@@ -4,7 +4,6 @@ XLA lloyd_fit, single-device and per-shard under shard_map."""
 import numpy as np
 import pytest
 
-import jax
 import jax.numpy as jnp
 
 from spark_rapids_ml_tpu.ops.kmeans import lloyd_fit
