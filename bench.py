@@ -89,9 +89,11 @@ def main() -> None:
     platform = jax.devices()[0].platform
     on_tpu = platform == "tpu"
 
-    # size to platform: ~2 GiB of f32 on TPU, small on CPU
+    # size to platform: HBM-filling on TPU (~6 GiB f32 design matrix per chip on a
+    # 16 GiB v5e, leaving headroom for the one-hot update and compiler scratch),
+    # small on CPU
     if on_tpu:
-        n_rows, n_cols, k, iters = 4_000_000, 128, 20, 10
+        n_rows, n_cols, k, iters = 12_000_000, 128, 20, 10
     else:
         n_rows, n_cols, k, iters = 100_000, 64, 8, 10
 
@@ -119,14 +121,24 @@ def main() -> None:
     centers, inertia, n_iter = lloyd_fit(Xd, w, init, 0.0, iters)
     centers.block_until_ready()
 
+    from spark_rapids_ml_tpu.profiling import trace as xplane_trace
+
+    trace_dir = "/tmp/srml_bench_xplane" if on_tpu else None
     t0 = time.perf_counter()
-    centers, inertia, n_iter = lloyd_fit(Xd, w, init, 0.0, iters)
-    centers.block_until_ready()
+    with xplane_trace(trace_dir):
+        centers, inertia, n_iter = lloyd_fit(Xd, w, init, 0.0, iters)
+        centers.block_until_ready()
     fit_time = time.perf_counter() - t0
 
     rows_per_sec = n_rows * int(n_iter) / fit_time
     n_chips = jax.device_count()
     value = rows_per_sec / n_chips
+
+    # estimated MFU: one Lloyd iteration is ~4*n*d*k matmul FLOPs (2ndk distance
+    # cross-term + 2nkd one-hot update); peak per chip assumes v5e f32 on MXU
+    flops = 4.0 * n_rows * n_cols * k * int(n_iter)
+    peak_f32 = 98e12  # v5e ~197 TFLOP/s bf16 -> ~98 TFLOP/s f32-equivalent
+    est_mfu = flops / fit_time / n_chips / peak_f32 if on_tpu else None
 
     # secondary metric: the fast-math variant (assignment distances at MXU bf16,
     # model attributes still parity precision — config key fast_math)
@@ -185,6 +197,8 @@ def main() -> None:
                         fast_rows_per_sec_chip, 1
                     ),
                     "pca_cov_rows_per_sec_per_chip": round(pca_rows_per_sec_chip, 1),
+                    "est_mfu": round(est_mfu, 4) if est_mfu is not None else None,
+                    "xplane_trace": trace_dir,
                     "platform": platform,
                     "n_rows": n_rows,
                     "n_cols": n_cols,
