@@ -22,7 +22,13 @@ from typing import Any, Dict
 
 _accelerated_attributes: Dict[str, Dict[str, str]] = {
     # pyspark module -> {class name -> spark_rapids_ml_tpu module}
-    "pyspark.ml.feature": {"PCA": "feature", "PCAModel": "feature"},
+    "pyspark.ml.feature": {
+        "PCA": "feature",
+        "PCAModel": "feature",
+        # standalone (pyspark-less) scripts need the assembler from the proxy too;
+        # with a real pyspark the Pipeline bypass makes it a no-op stage anyway
+        "VectorAssembler": "feature",
+    },
     "pyspark.ml.clustering": {
         "KMeans": "clustering",
         "KMeansModel": "clustering",
@@ -40,7 +46,13 @@ _accelerated_attributes: Dict[str, Dict[str, str]] = {
         "RandomForestRegressor": "regression",
         "RandomForestRegressionModel": "regression",
     },
-    "pyspark.ml.tuning": {"CrossValidator": "tuning", "CrossValidatorModel": "tuning"},
+    "pyspark.ml.tuning": {
+        "CrossValidator": "tuning",
+        "CrossValidatorModel": "tuning",
+        "TrainValidationSplit": "tuning",
+        "TrainValidationSplitModel": "tuning",
+        "ParamGridBuilder": "tuning",
+    },
     "pyspark.ml.evaluation": {
         "MulticlassClassificationEvaluator": "evaluation",
         "RegressionEvaluator": "evaluation",

@@ -57,3 +57,29 @@ def test_install_import_direct():
     from spark_rapids_ml_tpu.feature import PCA as direct
 
     assert cls is direct
+
+
+def test_interposer_tuning_and_assembler():
+    """ParamGridBuilder/TrainValidationSplit/VectorAssembler resolve through the
+    pyspark.ml proxies (standalone mode)."""
+    import subprocess
+    import sys
+
+    code = (
+        "import spark_rapids_ml_tpu.install\n"
+        "from pyspark.ml.tuning import ParamGridBuilder, TrainValidationSplit\n"
+        "from pyspark.ml.feature import VectorAssembler\n"
+        "import spark_rapids_ml_tpu.tuning as t\n"
+        "assert ParamGridBuilder is t.ParamGridBuilder\n"
+        "assert TrainValidationSplit is t.TrainValidationSplit\n"
+        "print('INTERPOSER_OK')\n"
+    )
+    out = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True,
+        text=True,
+        env={**__import__("os").environ, "JAX_PLATFORMS": "cpu",
+             "PALLAS_AXON_POOL_IPS": ""},
+        timeout=240,
+    )
+    assert "INTERPOSER_OK" in out.stdout, out.stdout + out.stderr
