@@ -143,3 +143,111 @@ def test_error_crosses_wire_not_raises():
     status, message = _roundtrip("KMeans", json.dumps({"k": -5}), "cls")
     assert status == "ERR"
     assert message
+
+
+def test_production_main_with_mocked_gateway(monkeypatch):
+    """connect_plugin.main(): the py4j session-rebuild wrapper, exercised with
+    mocked py4j/pyspark modules — validates the frame SEQUENCE the JVM half writes
+    (auth token, jsc key, then the serve() request) and that the resolver receives
+    the dataset key."""
+    import io
+    import sys
+    import types
+
+    from spark_rapids_ml_tpu import connect_plugin as cp
+
+    seen = {}
+
+    class FakeJavaObject:
+        def __init__(self, key, client):
+            seen.setdefault("java_objects", []).append(key)
+            self._key = key
+
+        def sc(self):
+            return self
+
+        def conf(self):
+            return self
+
+        def sparkSession(self):
+            return self
+
+    class FakeGateway:
+        def __init__(self, gateway_parameters=None):
+            seen["auth_token"] = gateway_parameters.auth_token
+            self._gateway_client = object()
+
+    class FakeGatewayParameters:
+        def __init__(self, auth_token=None, auto_convert=True):
+            self.auth_token = auth_token
+
+    py4j = types.ModuleType("py4j")
+    jg = types.ModuleType("py4j.java_gateway")
+    jg.JavaGateway = FakeGateway
+    jg.GatewayParameters = FakeGatewayParameters
+    jg.JavaObject = FakeJavaObject
+    py4j.java_gateway = jg
+
+    pyspark = types.ModuleType("pyspark")
+
+    class FakeSparkConf:
+        def __init__(self, _jconf=None):
+            pass
+
+    class FakeSparkContext:
+        def __init__(self, conf=None, gateway=None, jsc=None):
+            seen["sc_built"] = True
+
+    pyspark.SparkConf = FakeSparkConf
+    pyspark.SparkContext = FakeSparkContext
+    psql = types.ModuleType("pyspark.sql")
+
+    class FakeSession:
+        def __init__(self, sc, jsession):
+            pass
+
+    class FakeDataFrame:
+        def __init__(self, jdf, session):
+            seen["df_built"] = True
+            # stand-in dataset the dispatcher can actually fit
+            self._pdf = DATASETS["cls"]
+
+        def toPandas(self):
+            return self._pdf
+
+    # routed like a Spark frame (collect path; the fake pyspark has no spec so the
+    # barrier plane is not selected)
+    FakeDataFrame.__module__ = "pyspark.sql.fake"
+
+    psql.DataFrame = FakeDataFrame
+    psql.SparkSession = FakeSession
+    pyspark.sql = psql
+
+    monkeypatch.setitem(sys.modules, "py4j", py4j)
+    monkeypatch.setitem(sys.modules, "py4j.java_gateway", jg)
+    monkeypatch.setitem(sys.modules, "pyspark", pyspark)
+    monkeypatch.setitem(sys.modules, "pyspark.sql", psql)
+
+    buf_in = io.BytesIO()
+    for frame in (
+        "token-abc",          # auth token
+        "jsc-key-1",          # java spark context key
+        "KMeans",             # operator
+        json.dumps({"k": 2, "seed": 1, "maxIter": 10}),
+        "dataset-key-7",      # dataset py4j key
+    ):
+        write_framed_utf8(buf_in, frame)
+    buf_in.seek(0)
+    buf_out = io.BytesIO()
+
+    cp.main(buf_in, buf_out)
+
+    buf_out.seek(0)
+    status = read_framed_utf8(buf_out)
+    payload = read_framed_utf8(buf_out)
+    assert status == "OK", payload
+    attrs = decode_model_attributes(payload)
+    assert attrs["cluster_centers"].shape == (2, 4)
+    assert seen["auth_token"] == "token-abc"
+    assert seen["java_objects"] == ["jsc-key-1", "dataset-key-7"]
+    assert seen["sc_built"] and seen["df_built"]
