@@ -25,7 +25,7 @@ from ..core.params import (
     Param,
     TypeConverters,
 )
-from ..ops.pca import pca_fit, pca_transform
+from ..ops.pca import pca_transform
 
 
 class _PCAClass(_TpuClass):
@@ -98,15 +98,31 @@ class PCA(_PCAClass, _TpuEstimator, _PCAParams):
             "singular_values",
         ]
 
-    def _get_tpu_fit_func(self, extra_params: Optional[List[Dict[str, Any]]] = None):
-        k = self.getOrDefault("k")
+    def _enable_fit_multiple_in_single_pass(self) -> bool:
+        # the sharded covariance pass is shared; each param map re-does only the
+        # tiny replicated eigh (P6 pattern)
+        return True
 
-        def _fit(inputs: FitInputs) -> Dict[str, Any]:
-            if k > inputs.desc.n:
-                raise ValueError(
-                    f"k={k} exceeds the number of features {inputs.desc.n}"
-                )
-            return pca_fit(inputs.features, inputs.row_weight, k)
+    def _get_tpu_fit_func(self, extra_params: Optional[List[Dict[str, Any]]] = None):
+        base_k = self.getOrDefault("k")
+
+        def _fit(inputs: FitInputs):
+            from ..ops.linalg import weighted_covariance
+            from ..ops.pca import pca_attrs_from_cov
+
+            ks = (
+                [int(p.get("n_components", base_k)) for p in extra_params]
+                if extra_params is not None
+                else [base_k]
+            )
+            for k in ks:
+                if k > inputs.desc.n:
+                    raise ValueError(
+                        f"k={k} exceeds the number of features {inputs.desc.n}"
+                    )
+            cov, mean, wsum = weighted_covariance(inputs.features, inputs.row_weight)
+            results = [pca_attrs_from_cov(cov, mean, wsum, k) for k in ks]
+            return results if extra_params is not None else results[0]
 
         return _fit
 

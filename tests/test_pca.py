@@ -109,3 +109,21 @@ def test_pca_uneven_rows(n_devices):
     np.testing.assert_allclose(
         model.explained_variance_, sk.explained_variance_, rtol=2e-3
     )
+
+
+def test_pca_fit_multiple_single_pass(n_devices):
+    """PCA joins the single-pass fitMultiple family: one covariance pass serves
+    every k in the grid."""
+    rng = np.random.default_rng(41)
+    X = (rng.normal(size=(200, 8)) * np.linspace(1, 4, 8)).astype(np.float32)
+    df = pd.DataFrame({"features": list(X)})
+    est = PCA(inputCol="features", k=2)
+    assert est._enable_fit_multiple_in_single_pass()
+    maps = [{est.getParam("k"): 2}, {est.getParam("k"): 5}]
+    models = est.fit(df, maps)
+    assert np.asarray(models[0].components_).shape == (2, 8)
+    assert np.asarray(models[1].components_).shape == (5, 8)
+    single = PCA(inputCol="features", k=5).fit(df)
+    np.testing.assert_allclose(
+        np.asarray(models[1].components_), np.asarray(single.components_), atol=1e-5
+    )
