@@ -42,14 +42,14 @@ REPORT_DIR=benchmark/results
 mkdir -p "$REPORT_DIR"
 
 if [ "$BENCHES" = "all" ]; then
-  BENCHES="kmeans pca linear_regression logistic_regression random_forest_classifier random_forest_regressor knn approximate_knn umap dbscan"
+  BENCHES="kmeans pca linear_regression logistic_regression random_forest_classifier random_forest_regressor knn approximate_nearest_neighbors umap dbscan"
 fi
 
 # per-algorithm scaling rules (the quadratic/neighbor algorithms get smaller rows,
 # reference run_benchmark.sh:99-120)
 scaled_rows() {
   case "$1" in
-    knn|approximate_knn|umap|dbscan) echo $(( NUM_ROWS / 10 > 1000 ? NUM_ROWS / 10 : 1000 ));;
+    knn|approximate_nearest_neighbors|umap|dbscan) echo $(( NUM_ROWS / 10 > 1000 ? NUM_ROWS / 10 : 1000 ));;
     *) echo "$NUM_ROWS";;
   esac
 }
