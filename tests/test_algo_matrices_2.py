@@ -1,0 +1,187 @@
+"""Second parity-matrix tier: worker-count invariance (the SPMD contract — sharding
+must not change the math), solver grids (huber, elastic-net objective), DBSCAN eps/
+min_samples grids vs sklearn, KMeans init modes, single-feature guards."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_ml_tpu.classification import LogisticRegression
+from spark_rapids_ml_tpu.clustering import DBSCAN, KMeans
+from spark_rapids_ml_tpu.feature import PCA
+from spark_rapids_ml_tpu.regression import LinearRegression
+
+
+def _reg_df(n=150, d=6, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    y = X @ rng.normal(size=d) + 0.2 + rng.normal(0, 0.05, n)
+    return pd.DataFrame({"features": list(X), "label": y.astype(np.float64)}), X
+
+
+# ---------------------------------------------------------------------------
+# Worker-count invariance: the sharded program computes the SAME statistics
+# regardless of mesh width (the reference's results are also worker-count
+# invariant for the deterministic algorithms)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("workers", [1, 2, 8])
+def test_pca_worker_count_invariance(workers, n_devices):
+    df, X = _reg_df()
+    est = PCA(k=3, inputCol="features")
+    est.num_workers = workers
+    model = est.fit(df[["features"]])
+    base = PCA(k=3, inputCol="features")
+    base.num_workers = n_devices
+    ref = base.fit(df[["features"]])
+    np.testing.assert_allclose(
+        np.asarray(model.components_), np.asarray(ref.components_), atol=1e-4
+    )
+    np.testing.assert_allclose(
+        np.asarray(model.explained_variance_),
+        np.asarray(ref.explained_variance_),
+        rtol=1e-4,
+    )
+
+
+@pytest.mark.parametrize("workers", [1, 3, 8])
+def test_linreg_worker_count_invariance(workers, n_devices):
+    df, _ = _reg_df(seed=1)
+    est = LinearRegression(regParam=0.05)
+    est.num_workers = workers
+    m = est.fit(df)
+    ref = LinearRegression(regParam=0.05).fit(df)
+    np.testing.assert_allclose(
+        np.asarray(m.coefficients), np.asarray(ref.coefficients), atol=1e-4
+    )
+    assert m.intercept == pytest.approx(ref.intercept, abs=1e-4)
+
+
+def test_logreg_worker_count_invariance(n_devices):
+    rng = np.random.default_rng(2)
+    X = np.concatenate(
+        [rng.normal(-2, 1, (60, 4)), rng.normal(2, 1, (60, 4))]
+    ).astype(np.float32)
+    y = np.repeat([0.0, 1.0], 60)
+    df = pd.DataFrame({"features": list(X), "label": y})
+    est1 = LogisticRegression(regParam=0.01, maxIter=100, tol=1e-10)
+    est1.num_workers = 1
+    est8 = LogisticRegression(regParam=0.01, maxIter=100, tol=1e-10)
+    est8.num_workers = 8
+    m1, m8 = est1.fit(df), est8.fit(df)
+    np.testing.assert_allclose(m1.coefficients, m8.coefficients, rtol=1e-3, atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# Solver grids
+# ---------------------------------------------------------------------------
+
+
+def test_huber_loss_vs_sklearn(n_devices):
+    from sklearn.linear_model import HuberRegressor
+
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(200, 4)).astype(np.float32)
+    y = X @ np.array([2.0, -1.0, 0.5, 1.5]) + 0.3 + rng.normal(0, 0.1, 200)
+    y[:10] += 20  # outliers huber should shrug off
+    df = pd.DataFrame({"features": list(X), "label": y})
+    model = LinearRegression(loss="huber", epsilon=1.35).fit(df)
+    sk = HuberRegressor(epsilon=1.35, alpha=0.0).fit(X.astype(np.float64), y)
+    np.testing.assert_allclose(
+        np.asarray(model.coefficients), sk.coef_, rtol=0.1, atol=0.05
+    )
+    # robust: outliers moved the OLS fit much further than the huber fit
+    ols = LinearRegression().fit(df)
+    true_coef = np.array([2.0, -1.0, 0.5, 1.5])
+    assert np.abs(np.asarray(model.coefficients) - true_coef).max() < np.abs(
+        np.asarray(ols.coefficients) - true_coef
+    ).max()
+
+
+def test_elastic_net_objective_vs_sklearn(n_devices):
+    from sklearn.linear_model import ElasticNet
+
+    df, X = _reg_df(n=250, seed=4)
+    y = df["label"].to_numpy()
+    reg, l1r = 0.2, 0.5
+    model = LinearRegression(
+        regParam=reg, elasticNetParam=l1r, standardization=False,
+        maxIter=2000, tol=1e-10,
+    ).fit(df)
+    sk = ElasticNet(alpha=reg, l1_ratio=l1r, max_iter=50000, tol=1e-12).fit(
+        X.astype(np.float64), y
+    )
+
+    def objective(coef, icpt):
+        r = y - X.astype(np.float64) @ coef - icpt
+        return (
+            0.5 * np.mean(r * r)
+            + reg * (l1r * np.abs(coef).sum() + 0.5 * (1 - l1r) * (coef**2).sum())
+        )
+
+    ours = objective(np.asarray(model.coefficients, np.float64), model.intercept)
+    theirs = objective(sk.coef_, sk.intercept_)
+    assert ours <= theirs * 1.01 + 1e-8
+
+
+# ---------------------------------------------------------------------------
+# DBSCAN grids vs sklearn
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("eps,min_samples", [(0.3, 5), (0.5, 3), (0.8, 10)])
+def test_dbscan_grid_matches_sklearn(eps, min_samples, n_devices):
+    from sklearn.cluster import DBSCAN as SkDBSCAN
+    from sklearn.datasets import make_moons
+
+    X, _ = make_moons(n_samples=240, noise=0.06, random_state=5)
+    X = X.astype(np.float32)
+    df = pd.DataFrame({"features": list(X)})
+    est = DBSCAN(eps=eps, min_samples=min_samples)
+    est.num_workers = n_devices
+    got = est.fit(df).transform(df)["prediction"].to_numpy()
+    sk = SkDBSCAN(eps=eps, min_samples=min_samples).fit_predict(X.astype(np.float64))
+    # identical noise mask and identical partition structure
+    np.testing.assert_array_equal(got >= 0, sk >= 0)
+    # cluster label sets correspond 1:1
+    for lbl in set(sk[sk >= 0]):
+        ours = got[sk == lbl]
+        assert len(set(ours)) == 1, f"sklearn cluster {lbl} split"
+
+
+# ---------------------------------------------------------------------------
+# KMeans init modes / degenerate shapes
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("init_mode", ["random", "k-means||"])
+def test_kmeans_init_modes_converge(init_mode, n_devices):
+    rng = np.random.default_rng(6)
+    centers_true = np.array([[-6, 0], [6, 0], [0, 9]], np.float32)
+    X = np.concatenate(
+        [c + rng.normal(0, 0.4, (70, 2)).astype(np.float32) for c in centers_true]
+    )
+    df = pd.DataFrame({"features": list(X)})
+    model = KMeans(k=3, initMode=init_mode, maxIter=40, seed=2).fit(df)
+    got = np.sort(np.asarray(model.cluster_centers_), axis=0)
+    want = np.sort(centers_true, axis=0)
+    np.testing.assert_allclose(got, want, atol=0.3)
+
+
+def test_single_feature_regression(n_devices):
+    """d=1 end-to-end (the reference guards 1-feature fits, regression.py:499-505)."""
+    rng = np.random.default_rng(7)
+    X = rng.normal(size=(100, 1)).astype(np.float32)
+    y = 3.0 * X[:, 0] + 1.0 + rng.normal(0, 0.01, 100)
+    df = pd.DataFrame({"features": list(X), "label": y})
+    m = LinearRegression().fit(df)
+    assert np.asarray(m.coefficients)[0] == pytest.approx(3.0, abs=0.05)
+    assert m.intercept == pytest.approx(1.0, abs=0.05)
+
+
+def test_kmeans_more_clusters_than_points_raises(n_devices):
+    X = np.random.default_rng(8).normal(size=(5, 3)).astype(np.float32)
+    df = pd.DataFrame({"features": list(X)})
+    with pytest.raises(ValueError, match="exceeds the number of rows"):
+        KMeans(k=10, seed=1).fit(df)
