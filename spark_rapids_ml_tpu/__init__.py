@@ -6,7 +6,7 @@
 # this build follows.
 #
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"
 
 # Top-level modules mirror the reference's public layout
 # (reference python/src/spark_rapids_ml/__init__.py): feature, clustering,
