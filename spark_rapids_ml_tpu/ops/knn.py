@@ -544,6 +544,9 @@ def exact_knn_ring(
     n_dev = mesh.devices.size
     shard_rows = n_total // n_dev
     k_eff = min(k, n_total)
+    # a shard may hold fewer than k rows; per-hop candidates are capped at the
+    # shard size and the running pool still converges to the global top-k
+    k_hop = min(k_eff, shard_rows)
 
     @functools.partial(
         shard_map,
@@ -563,7 +566,7 @@ def exact_knn_ring(
             owner = (rank - h) % n_dev
             d2 = _block_sq_dists(q_local, x_cur)
             d2 = jnp.where(valid_cur[None, :], d2, jnp.inf)
-            neg, idx = jax.lax.top_k(-d2, k_eff)
+            neg, idx = jax.lax.top_k(-d2, k_hop)
             gidx = idx + owner * shard_rows
             # merge the hop's candidates into the running top-k
             cat_d2 = jnp.concatenate([best_d2, -neg], axis=1)

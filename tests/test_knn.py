@@ -289,3 +289,25 @@ def test_ann_cosine_zero_vector_raises(n_devices):
     est.num_workers = n_devices
     with pytest.raises(ValueError, match="zero-length"):
         est.fit(pd.DataFrame({"features": list(items)}))
+
+
+def test_ring_knn_k_exceeds_shard_size(n_devices):
+    """k larger than any single shard: per-hop candidates cap at the shard size and
+    the merged pool still reaches the exact global top-k."""
+    from spark_rapids_ml_tpu.ops.knn import exact_knn_ring
+    from spark_rapids_ml_tpu.parallel.mesh import get_mesh, shard_array
+    from spark_rapids_ml_tpu.parallel.partition import pad_rows
+
+    items, queries = _data(n_items=64, n_queries=16, d=4, seed=19)
+    mesh = get_mesh()  # 8 devices -> 8 rows per shard, k=20 > shard
+    Xp, valid, _ = pad_rows(items, mesh.devices.size)
+    Qp, _, _ = pad_rows(queries, mesh.devices.size)
+    d_ring, i_ring = exact_knn_ring(
+        mesh, shard_array(Qp, mesh), shard_array(Xp, mesh),
+        shard_array(valid > 0, mesh), k=20,
+    )
+    sk = SkNN(n_neighbors=20).fit(items)
+    sk_d, sk_idx = sk.kneighbors(queries)
+    np.testing.assert_allclose(d_ring[: len(queries)], sk_d, atol=1e-4)
+    # global indices must match too (catches owner-offset bugs that distances hide)
+    np.testing.assert_array_equal(i_ring[: len(queries)], sk_idx)
