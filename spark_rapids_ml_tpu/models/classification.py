@@ -474,6 +474,11 @@ class LogisticRegressionModel(
         X = np.asarray(value, dtype=np.float32).reshape(1, -1)
         return self._transform_arrays(X)[self.getOrDefault("probabilityCol")][0]
 
+    def predictRaw(self, value: np.ndarray) -> np.ndarray:
+        """Raw margin vector for one feature vector (pyspark model surface)."""
+        X = np.asarray(value, dtype=np.float32).reshape(1, -1)
+        return self._transform_arrays(X)[self.getOrDefault("rawPredictionCol")][0]
+
     def _combine(
         self, models: List["LogisticRegressionModel"]
     ) -> "LogisticRegressionModel":

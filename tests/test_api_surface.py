@@ -139,3 +139,84 @@ def test_model_cpu_twins(n_devices):
         rtol=1e-4,
         atol=1e-3,
     )
+
+
+def test_single_vector_predict_methods(n_devices):
+    """predict/predictProbability/predictRaw single-vector methods (pyspark model
+    surface the reference preserves)."""
+    import pandas as pd
+
+    from spark_rapids_ml_tpu.classification import LogisticRegression
+    from spark_rapids_ml_tpu.regression import LinearRegression
+
+    rng = np.random.default_rng(1)
+    X = np.concatenate(
+        [rng.normal(-2, 1, (50, 3)), rng.normal(2, 1, (50, 3))]
+    ).astype(np.float32)
+    y = np.repeat([0.0, 1.0], 50)
+    df = pd.DataFrame({"features": list(X), "label": y})
+    lr = LogisticRegression(maxIter=50).fit(df)
+    v = X[0]
+    assert lr.predict(v) == lr.transform(df)["prediction"].iloc[0]
+    p = lr.predictProbability(v)
+    assert p.shape == (2,) and p.sum() == pytest.approx(1.0, abs=1e-5)
+    raw = lr.predictRaw(v)
+    assert raw.shape == (2,)
+    np.testing.assert_allclose(
+        raw, np.stack(lr.transform(df)["rawPrediction"].to_numpy())[0], atol=1e-6
+    )
+
+    y_reg = (X @ np.array([1.0, 2.0, 3.0])).astype(np.float64)
+    df_reg = pd.DataFrame({"features": list(X), "label": y_reg})
+    lin = LinearRegression().fit(df_reg)
+    assert lin.predict(v) == pytest.approx(
+        lin.transform(df_reg)["prediction"].iloc[0], rel=1e-5
+    )
+
+
+def test_copy_isolates_params(n_devices):
+    from spark_rapids_ml_tpu.clustering import KMeans
+
+    est = KMeans(k=3, maxIter=10)
+    clone = est.copy({est.getParam("k"): 5})
+    assert est.getOrDefault("k") == 3
+    assert clone.getOrDefault("k") == 5
+    # backend dict follows the copy (public property, core/backend_params.py)
+    assert clone.tpu_params["n_clusters"] == 5
+    assert est.tpu_params["n_clusters"] == 3
+
+
+def test_explain_params_lists_every_param():
+    from spark_rapids_ml_tpu.classification import LogisticRegression
+
+    text = LogisticRegression().explainParams()
+    for name in ("regParam", "elasticNetParam", "maxIter", "tol", "standardization"):
+        assert name in text, name
+
+
+def test_cv_with_random_forest(n_devices):
+    """CrossValidator over RF param maps (single-pass fitMultiple + fused eval)."""
+    import pandas as pd
+
+    from spark_rapids_ml_tpu.classification import RandomForestClassifier
+    from spark_rapids_ml_tpu.evaluation import MulticlassClassificationEvaluator
+    from spark_rapids_ml_tpu.tuning import CrossValidator, ParamGridBuilder
+
+    rng = np.random.default_rng(2)
+    X = np.concatenate(
+        [rng.normal(-2, 1, (60, 4)), rng.normal(2, 1, (60, 4))]
+    ).astype(np.float32)
+    y = np.repeat([0.0, 1.0], 60)
+    df = pd.DataFrame({"features": list(X), "label": y})
+    rf = RandomForestClassifier(numTrees=3, seed=1)
+    grid = ParamGridBuilder().addGrid(rf.maxDepth, [2, 4]).build()
+    cv = CrossValidator(
+        estimator=rf,
+        estimatorParamMaps=grid,
+        evaluator=MulticlassClassificationEvaluator(metricName="accuracy"),
+        numFolds=2,
+        seed=3,
+    )
+    model = cv.fit(df)
+    assert len(model.avgMetrics) == 2
+    assert max(model.avgMetrics) > 0.85
