@@ -148,11 +148,19 @@ def _sample_by_d2(
     return X[idx]
 
 
-def _weighted_kmeans_pp(
+def _cand_sq_dists(candidates: np.ndarray, centers: np.ndarray) -> np.ndarray:
+    """(n_cand, k) squared distances via the matmul expansion — never materializes
+    the (n_cand, k, d) broadcast (IVF builds call this with k in the thousands)."""
+    c2 = np.sum(centers * centers, axis=1)
+    x2 = np.sum(candidates * candidates, axis=1)
+    return np.maximum(
+        x2[:, None] - 2.0 * (candidates @ centers.T) + c2[None, :], 0.0
+    )
+
+
+def _weighted_kmeans_pp_once(
     candidates: np.ndarray, weights: np.ndarray, k: int, rng: np.random.Generator
-) -> np.ndarray:
-    """Host-side weighted k-means++ over the small candidate set (the final reduce of
-    scalable k-means++)."""
+):
     n = candidates.shape[0]
     centers = np.empty((k, candidates.shape[1]), dtype=candidates.dtype)
     p = weights / weights.sum()
@@ -166,7 +174,52 @@ def _weighted_kmeans_pp(
         else:
             centers[i] = candidates[rng.choice(n, p=probs / s)]
         d2 = np.minimum(d2, np.sum((candidates - centers[i]) ** 2, axis=1))
-    return centers
+
+    # local weighted Lloyd refinement over the (tiny) candidate set — Spark's
+    # LocalKMeans runs the same after its ++ seeding; empty centers reseed at the
+    # worst-covered candidate
+    cost = np.inf
+    for _ in range(10):
+        d2_all = _cand_sq_dists(candidates, centers)  # (n_cand, k)
+        a = np.argmin(d2_all, axis=1)
+        sums = np.zeros_like(centers)
+        np.add.at(sums, a, candidates * weights[:, None])
+        cnts = np.zeros(k, dtype=weights.dtype)
+        np.add.at(cnts, a, weights)
+        for j in np.nonzero(cnts <= 0)[0]:
+            far = np.argmax(np.min(d2_all, axis=1))
+            centers[j] = candidates[far]
+            d2_all[far] = 0.0
+        ok = cnts > 0
+        centers[ok] = sums[ok] / cnts[ok, None]
+    # score the FINAL centers (the in-loop d2_all predates the last update)
+    cost = float(
+        np.sum(weights * np.min(_cand_sq_dists(candidates, centers), axis=1))
+    )
+    return centers, cost
+
+
+def _weighted_kmeans_pp(
+    candidates: np.ndarray,
+    weights: np.ndarray,
+    k: int,
+    rng: np.random.Generator,
+    restarts: int = 3,
+) -> np.ndarray:
+    """Host-side weighted k-means++ over the small candidate set (the final reduce
+    of scalable k-means++). A single ++ draw can seed two centers in one heavy
+    cluster and strand another in a local optimum the refinement cannot escape;
+    a few restarts scored by weighted candidate inertia make that mode vanishingly
+    unlikely at negligible cost."""
+    best = None
+    best_cost = np.inf
+    for _ in range(max(restarts, 1)):
+        centers, cost = _weighted_kmeans_pp_once(candidates, weights, k, rng)
+        # `best is None` guard: NaN costs (NaN features in the candidate set)
+        # compare false against everything and must not leave best unset
+        if best is None or cost < best_cost:
+            best, best_cost = centers, cost
+    return best
 
 
 def kmeans_init(
