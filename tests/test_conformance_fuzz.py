@@ -120,3 +120,57 @@ def test_sparse_logreg_random_configs(case, n_devices):
     np.testing.assert_allclose(
         m_s.coefficients, m_d.coefficients, rtol=2e-2, atol=2e-3
     )
+
+
+@pytest.mark.parametrize("case", range(8))
+def test_pca_random_configs(case, n_devices):
+    from sklearn.decomposition import PCA as SkPCA
+
+    from spark_rapids_ml_tpu.feature import PCA
+
+    rng = _case_rng(400 + case)
+    n = int(rng.integers(20, 500))
+    d = int(rng.integers(2, 40))
+    k = int(rng.integers(1, min(d, n) + 1))
+    X = (rng.normal(size=(n, d)) * rng.uniform(0.1, 8.0, d)).astype(np.float32)
+    df = pd.DataFrame({"features": list(X)})
+    model = PCA(k=k, inputCol="features").fit(df)
+    sk = SkPCA(n_components=k).fit(X.astype(np.float64))
+    np.testing.assert_allclose(
+        np.asarray(model.explained_variance_), sk.explained_variance_, rtol=2e-2
+    )
+    # component subspaces agree (up to sign)
+    np.testing.assert_allclose(
+        np.abs(np.asarray(model.components_)), np.abs(sk.components_),
+        atol=5e-2,
+    )
+
+
+@pytest.mark.parametrize("case", range(6))
+def test_rf_random_configs(case, n_devices):
+    from spark_rapids_ml_tpu.classification import RandomForestClassifier
+
+    rng = _case_rng(500 + case)
+    n = int(rng.integers(60, 300))
+    d = int(rng.integers(2, 12))
+    n_classes = int(rng.choice([2, 3]))
+    depth = int(rng.integers(2, 7))
+    trees = int(rng.integers(2, 10))
+    bins = int(rng.choice([4, 16, 64]))
+    centers = rng.normal(0, 3, (n_classes, d)).astype(np.float32)
+    labels = rng.integers(0, n_classes, n)
+    X = (centers[labels] + rng.normal(0, 0.8, (n, d))).astype(np.float32)
+    y = labels.astype(np.float64)
+    if len(np.unique(y)) < n_classes:
+        y[:n_classes] = np.arange(n_classes)
+    df = pd.DataFrame({"features": list(X), "label": y})
+    model = RandomForestClassifier(
+        numTrees=trees, maxDepth=depth, maxBins=bins,
+        seed=int(rng.integers(0, 99)),
+    ).fit(df)
+    out = model.transform(df)
+    prob = np.stack(out["probability"].to_numpy())
+    np.testing.assert_allclose(prob.sum(1), 1.0, atol=1e-4)
+    acc = (out["prediction"].to_numpy() == y).mean()
+    # separated gaussians: the forest must comfortably beat chance
+    assert acc > 0.6 + 0.3 / n_classes, (case, acc)
