@@ -174,3 +174,30 @@ def test_rf_random_configs(case, n_devices):
     acc = (out["prediction"].to_numpy() == y).mean()
     # separated gaussians: the forest must comfortably beat chance
     assert acc > 0.6 + 0.3 / n_classes, (case, acc)
+
+
+@pytest.mark.parametrize("case", range(8))
+def test_ann_random_configs(case, n_devices):
+    """IVF-Flat with every cell probed IS exact search — a sharp oracle across
+    random shapes, k, and nlist (catches layout/clamping bugs at odd sizes)."""
+    from sklearn.neighbors import NearestNeighbors as SkNN
+
+    from spark_rapids_ml_tpu.knn import ApproximateNearestNeighbors
+
+    rng = _case_rng(600 + case)
+    n = int(rng.integers(50, 800))
+    d = int(rng.integers(2, 40))
+    k = int(rng.integers(1, min(20, n)))
+    nlist = int(rng.integers(1, min(40, n)))
+    items = rng.normal(size=(n, d)).astype(np.float32)
+    queries = rng.normal(size=(int(rng.integers(1, 40)), d)).astype(np.float32)
+    est = ApproximateNearestNeighbors(
+        k=k, inputCol="features", algorithm="ivfflat",
+        algoParams={"nlist": nlist, "nprobe": nlist, "seed": int(rng.integers(0, 99))},
+    )
+    est.num_workers = n_devices
+    model = est.fit(pd.DataFrame({"features": list(items)}))
+    _, _, knn_df = model.kneighbors(pd.DataFrame({"features": list(queries)}))
+    got_d = np.stack(knn_df["distances"].to_numpy())
+    sk_d, _ = SkNN(n_neighbors=k).fit(items).kneighbors(queries)
+    np.testing.assert_allclose(got_d, sk_d, atol=1e-3, err_msg=str(case))
