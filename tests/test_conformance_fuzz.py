@@ -201,3 +201,34 @@ def test_ann_random_configs(case, n_devices):
     got_d = np.stack(knn_df["distances"].to_numpy())
     sk_d, _ = SkNN(n_neighbors=k).fit(items).kneighbors(queries)
     np.testing.assert_allclose(got_d, sk_d, atol=1e-3, err_msg=str(case))
+
+
+@pytest.mark.parametrize("case", range(6))
+def test_dbscan_random_configs(case, n_devices):
+    """Exact-algorithm oracle: our labels must induce the SAME partition (and noise
+    mask) as sklearn's DBSCAN for any eps/min_samples/shape draw."""
+    from sklearn.cluster import DBSCAN as SkDBSCAN
+
+    from spark_rapids_ml_tpu.clustering import DBSCAN
+
+    rng = _case_rng(700 + case)
+    n = int(rng.integers(40, 400))
+    d = int(rng.integers(2, 10))
+    n_blobs = int(rng.integers(1, 5))
+    centers = rng.normal(0, 5, (n_blobs, d)).astype(np.float32)
+    X = (centers[rng.integers(0, n_blobs, n)] + rng.normal(0, 0.5, (n, d))).astype(
+        np.float32
+    )
+    eps = float(rng.uniform(0.3, 1.5))
+    min_samples = int(rng.integers(2, 8))
+    df = pd.DataFrame({"features": list(X)})
+    est = DBSCAN(eps=eps, min_samples=min_samples)
+    est.num_workers = n_devices
+    got = est.fit(df).transform(df)["prediction"].to_numpy()
+    sk = SkDBSCAN(eps=eps, min_samples=min_samples).fit_predict(X.astype(np.float64))
+    np.testing.assert_array_equal(got >= 0, sk >= 0, err_msg=f"noise mask {case}")
+    # partitions correspond 1:1 both directions
+    for lbl in set(sk[sk >= 0]):
+        assert len(set(got[sk == lbl])) == 1, (case, "sk cluster split")
+    for lbl in set(got[got >= 0]):
+        assert len(set(sk[got == lbl])) == 1, (case, "our cluster merged")
