@@ -232,3 +232,90 @@ def test_dbscan_random_configs(case, n_devices):
         assert len(set(got[sk == lbl])) == 1, (case, "sk cluster split")
     for lbl in set(got[got >= 0]):
         assert len(set(sk[got == lbl])) == 1, (case, "our cluster merged")
+
+
+@pytest.mark.parametrize("case", range(6))
+def test_streaming_equals_incore_random_configs(case, n_devices):
+    """The streamed accumulation is algebraically identical to the in-core pass —
+    exact-match oracle across random shapes/batch sizes for PCA and LinReg."""
+    from spark_rapids_ml_tpu import config
+    from spark_rapids_ml_tpu.feature import PCA
+    from spark_rapids_ml_tpu.regression import LinearRegression
+
+    rng = _case_rng(800 + case)
+    n = int(rng.integers(100, 900))
+    d = int(rng.integers(2, 24))
+    batch = int(rng.integers(16, 256))
+    X = (rng.normal(size=(n, d)) * rng.uniform(0.2, 5.0, d)).astype(np.float32)
+    y = X @ rng.normal(size=d) + rng.normal(0, 0.05, n)
+    df = pd.DataFrame({"features": list(X), "label": y.astype(np.float64)})
+
+    incore_pca = PCA(k=min(3, d), inputCol="features").fit(df[["features"]])
+    incore_lin = LinearRegression(regParam=0.1).fit(df)
+    config.set("stream_threshold_bytes", 1)
+    config.set("stream_batch_rows", batch)
+    try:
+        streamed_pca = PCA(k=min(3, d), inputCol="features").fit(df[["features"]])
+        streamed_lin = LinearRegression(regParam=0.1).fit(df)
+    finally:
+        config.unset("stream_threshold_bytes")
+        config.unset("stream_batch_rows")
+    np.testing.assert_allclose(
+        np.asarray(streamed_pca.explained_variance_),
+        np.asarray(incore_pca.explained_variance_),
+        rtol=1e-3,
+    )
+    np.testing.assert_allclose(
+        np.asarray(streamed_lin.coefficients),
+        np.asarray(incore_lin.coefficients),
+        rtol=1e-3,
+        atol=1e-4,
+    )
+
+
+@pytest.mark.parametrize("case", range(6))
+def test_connect_codec_random_attrs(case):
+    """Tagged-JSON attribute codec roundtrips arbitrary nested dict/list/ndarray
+    structures bit-compatibly (dtype-preserving)."""
+    from spark_rapids_ml_tpu.connect_plugin import (
+        decode_model_attributes,
+        encode_model_attributes,
+    )
+
+    rng = _case_rng(900 + case)
+
+    def rand_value(depth=0):
+        choice = rng.integers(0, 6 if depth < 2 else 4)
+        if choice == 0:
+            return float(rng.normal())
+        if choice == 1:
+            return int(rng.integers(-1000, 1000))
+        if choice == 2:
+            dt = rng.choice([np.float32, np.float64, np.int32, np.int64])
+            shape = tuple(rng.integers(1, 5, size=int(rng.integers(1, 3))))
+            return (rng.normal(size=shape) * 10).astype(dt)
+        if choice == 3:
+            return "s" + str(rng.integers(0, 99))
+        if choice == 4:
+            return {f"k{j}": rand_value(depth + 1) for j in range(rng.integers(1, 4))}
+        return [rand_value(depth + 1) for _ in range(rng.integers(1, 4))]
+
+    attrs = {f"a{j}": rand_value() for j in range(5)}
+    back = decode_model_attributes(encode_model_attributes(attrs))
+
+    def check(a, b, path="root"):
+        if isinstance(a, np.ndarray):
+            assert b.dtype == a.dtype, (path, a.dtype, b.dtype)
+            np.testing.assert_allclose(b, a, rtol=1e-15)
+        elif isinstance(a, dict):
+            assert set(a) == set(b), path
+            for kk in a:
+                check(a[kk], b[kk], path + "." + kk)
+        elif isinstance(a, list):
+            assert len(a) == len(b), path
+            for i, (x, z) in enumerate(zip(a, b)):
+                check(x, z, f"{path}[{i}]")
+        else:
+            assert a == b or (a != a and b != b), (path, a, b)
+
+    check(attrs, back)
