@@ -319,3 +319,35 @@ def test_connect_codec_random_attrs(case):
             assert a == b or (a != a and b != b), (path, a, b)
 
     check(attrs, back)
+
+
+@pytest.mark.parametrize("case", range(4))
+def test_umap_random_configs(case, n_devices):
+    """UMAP invariants across random draws: finite embedding of the right shape and
+    a trustworthiness floor on clustered data."""
+    from sklearn.manifold import trustworthiness
+
+    from spark_rapids_ml_tpu.umap import UMAP
+
+    rng = _case_rng(1000 + case)
+    n_blobs = int(rng.integers(2, 5))
+    n = int(rng.integers(40, 90)) * n_blobs
+    d = int(rng.integers(4, 20))
+    n_comp = int(rng.choice([2, 3]))
+    centers = rng.normal(0, 5, (n_blobs, d)).astype(np.float32)
+    X = (centers[rng.integers(0, n_blobs, n)] + rng.normal(0, 0.6, (n, d))).astype(
+        np.float32
+    )
+    df = pd.DataFrame({"features": list(X)})
+    model = UMAP(
+        n_neighbors=int(rng.integers(5, 25)),
+        n_components=n_comp,
+        n_epochs=60,
+        seed=int(rng.integers(0, 99)),
+        init=str(rng.choice(["spectral", "random"])),
+    ).fit(df)
+    emb = np.asarray(model.embedding_)
+    assert emb.shape == (n, n_comp)
+    assert np.isfinite(emb).all()
+    t = trustworthiness(X, emb, n_neighbors=10)
+    assert t > 0.75, (case, t)
