@@ -151,6 +151,24 @@ def main() -> None:
     fast_time = time.perf_counter() - t0
     fast_rows_per_sec_chip = n_rows * int(n_iter_f) / fast_time / n_chips
 
+    # secondary metric (TPU only): the fused pallas Lloyd step — X streams HBM once
+    # per iteration (ops/pallas_kmeans.py); guarded so an unexpected Mosaic issue on
+    # new hardware can never kill the benchmark line
+    fused_rows_per_sec_chip = None
+    if on_tpu:
+        try:
+            from spark_rapids_ml_tpu.ops.pallas_kmeans import lloyd_fit_pallas
+
+            mesh_obj = getattr(getattr(Xd, "sharding", None), "mesh", None)
+            c_f, _, it_f = lloyd_fit_pallas(Xd, w, init, 0.0, iters, mesh=mesh_obj)
+            t0 = time.perf_counter()
+            c_f, _, it_f = lloyd_fit_pallas(Xd, w, init, 0.0, iters, mesh=mesh_obj)
+            np.asarray(c_f)
+            fused_time = time.perf_counter() - t0
+            fused_rows_per_sec_chip = n_rows * int(it_f) / fused_time / n_chips
+        except Exception as e:  # pragma: no cover
+            print(f"bench: fused pallas lloyd unavailable: {e}", file=sys.stderr)
+
     # secondary metric: PCA covariance-fit throughput on the same matrix (the second
     # north-star algorithm; one warm + one timed pass, reported in the same line)
     from spark_rapids_ml_tpu.ops.linalg import weighted_covariance
@@ -197,6 +215,11 @@ def main() -> None:
                         fast_rows_per_sec_chip, 1
                     ),
                     "pca_cov_rows_per_sec_per_chip": round(pca_rows_per_sec_chip, 1),
+                    "kmeans_fused_pallas_rows_per_sec_per_chip": (
+                        round(fused_rows_per_sec_chip, 1)
+                        if fused_rows_per_sec_chip is not None
+                        else None
+                    ),
                     "est_mfu": round(est_mfu, 4) if est_mfu is not None else None,
                     "xplane_trace": trace_dir,
                     "platform": platform,
