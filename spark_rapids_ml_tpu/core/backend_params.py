@@ -146,6 +146,27 @@ class _TpuClass:
         return None
 
 
+# Spark ParamValidators equivalents: (lo, hi) inclusive bounds, None = unbounded.
+# Checked at fit/compute time by _TpuParams._validate_param_bounds (the reference
+# validates through a throwaway pyspark estimator, core.py:579-602; pyspark is
+# optional here so the bounds live in the framework).
+_PARAM_BOUNDS: Dict[str, Any] = {
+    "k": (1, None),
+    "numTrees": (1, None),
+    "maxDepth": (0, None),
+    "maxBins": (2, None),
+    "maxIter": (0, None),
+    "regParam": (0.0, None),
+    "elasticNetParam": (0.0, 1.0),
+    "tol": (0.0, None),
+    "eps": (1e-30, None),
+    "min_samples": (1, None),
+    "n_neighbors": (1, None),
+    # numFolds lives on CrossValidator, which is not a _TpuParams subclass — its
+    # bound is enforced directly in tuning.CrossValidator._fit
+}
+
+
 class _TpuParams(HasVerboseParam):
     """Keeps a dict of backend params in sync with the pyspark.ml-style Params.
 
@@ -180,6 +201,23 @@ class _TpuParams(HasVerboseParam):
         """Backend kernel params for this estimator (reference `cuml_params`,
         params.py:330-335)."""
         return self._tpu_params
+
+    def _validate_param_bounds(self) -> None:
+        """Raise a clear ValueError when a numeric param is out of its Spark-valid
+        range (_PARAM_BOUNDS above) instead of failing deep in a kernel."""
+        for name, (lo, hi) in _PARAM_BOUNDS.items():
+            if not self.hasParam(name):
+                continue
+            try:
+                value = self.getOrDefault(name)
+            except KeyError:
+                continue
+            if value is None:
+                continue
+            if lo is not None and value < lo:
+                raise ValueError(f"Param {name}={value} must be >= {lo}.")
+            if hi is not None and value > hi:
+                raise ValueError(f"Param {name}={value} must be <= {hi}.")
 
     @property
     def num_workers(self) -> int:

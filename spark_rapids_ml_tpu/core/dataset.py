@@ -125,9 +125,20 @@ def extract_feature_data(
             )
         label = weight = row_id = None
         if input_cols:
+            missing = [c for c in input_cols if c not in dataset.columns]
+            if missing:
+                raise ValueError(
+                    f"feature columns {missing} not found in dataset columns "
+                    f"{list(dataset.columns)}"
+                )
             X = dataset[list(input_cols)].to_numpy(dtype=dtype)
             layout = "multi_cols"
         elif input_col:
+            if input_col not in dataset.columns:
+                raise ValueError(
+                    f"feature column '{input_col}' not found in dataset columns "
+                    f"{list(dataset.columns)}"
+                )
             cell = dataset[input_col].iloc[0]
             if _is_sparse(cell):
                 X = sp.vstack(list(dataset[input_col].to_numpy())).tocsr().astype(dtype)

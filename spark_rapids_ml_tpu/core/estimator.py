@@ -102,6 +102,9 @@ class _TpuCaller(_TpuClass, _TpuParams):
     # ---- data prep + execution ----
 
     def _pre_process_data(self, dataset: Any) -> FeatureData:
+        # Spark ParamValidators equivalent (core/backend_params.py); the reference
+        # validates through a throwaway pyspark estimator (core.py:579-602)
+        self._validate_param_bounds()
         input_col, input_cols = self._get_input_columns()
         label_col = (
             self.getOrDefault("labelCol")
@@ -401,6 +404,10 @@ class _TpuEstimator(_TpuCaller):
         return models
 
     def _fit(self, dataset: Any) -> "_TpuModel":
+        # validate on the DRIVER before any dispatch: a bad param must fail here,
+        # not inside a launched barrier stage (the _pre_process_data check still
+        # covers non-fit entry points)
+        self._validate_param_bounds()
         if self._use_cpu_fallback():
             return self._fallback_fit(dataset)
         if self._spark_fit_wanted(dataset):

@@ -141,6 +141,7 @@ class DBSCANModel(_DBSCANClass, _TpuModel, _DBSCANParams):
         self._use_sklearn = False
 
     def _transform_arrays(self, X: np.ndarray) -> Dict[str, np.ndarray]:
+        self._validate_param_bounds()  # DBSCAN defers compute to transform
         if self._use_sklearn:
             sk = self._fallback_class()(
                 eps=self.getOrDefault("eps"),

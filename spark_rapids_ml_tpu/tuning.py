@@ -175,6 +175,10 @@ class CrossValidator(_CrossValidatorParams):
                 "CrossValidator requires an estimator, a non-empty "
                 "estimatorParamMaps, and an evaluator."
             )
+        if self.getNumFolds() < 2:
+            raise ValueError(
+                f"Param numFolds={self.getNumFolds()} must be >= 2."
+            )
         n_models = len(maps)
         metrics = np.zeros((n_models,), dtype=np.float64)
         sub_models: Optional[List[List[Any]]] = (

@@ -88,3 +88,54 @@ def test_vector_udt_style_cells(n_devices):
     pdf = pd.DataFrame({"features": [FakeDenseVector(r) for r in X]})
     fd = extract_feature_data(pdf, input_col="features")
     np.testing.assert_allclose(fd.features, X.astype(np.float32), atol=1e-6)
+
+
+def test_param_bounds_validation(n_devices):
+    """Spark ParamValidators equivalents: out-of-range params raise clearly at fit
+    time instead of failing deep in a kernel (reference validates via a throwaway
+    pyspark estimator, core.py:579-602)."""
+    import numpy as np
+    import pandas as pd
+    import pytest
+
+    from spark_rapids_ml_tpu.classification import LogisticRegression
+    from spark_rapids_ml_tpu.clustering import DBSCAN, KMeans
+    from spark_rapids_ml_tpu.feature import PCA
+
+    X = np.random.default_rng(0).normal(size=(30, 4)).astype(np.float32)
+    df = pd.DataFrame({"features": list(X), "label": (X[:, 0] > 0).astype(float)})
+
+    with pytest.raises(ValueError, match="k=0 must be >= 1"):
+        KMeans(k=0).fit(df)
+    with pytest.raises(ValueError, match="k=0 must be >= 1"):
+        PCA(k=0, inputCol="features").fit(df)
+    with pytest.raises(ValueError, match="maxIter=-1 must be >= 0"):
+        LogisticRegression(maxIter=-1).fit(df)
+    with pytest.raises(ValueError, match="regParam=-1.0 must be >= 0"):
+        LogisticRegression(regParam=-1.0).fit(df)
+    with pytest.raises(ValueError, match="elasticNetParam=1.5 must be <= 1"):
+        LogisticRegression(regParam=0.1, elasticNetParam=1.5).fit(df)
+    with pytest.raises(ValueError, match="eps=-1.0 must be >="):
+        DBSCAN(eps=-1.0).fit(df).transform(df)
+    with pytest.raises(ValueError, match="feature column 'nope' not found"):
+        KMeans(featuresCol="nope").fit(df)
+    with pytest.raises(ValueError, match="feature columns \\['b'\\] not found"):
+        PCA(k=1, inputCols=["features", "b"]).fit(df)
+
+
+def test_cv_numfolds_bound():
+    import pytest
+
+    from spark_rapids_ml_tpu.classification import LogisticRegression
+    from spark_rapids_ml_tpu.evaluation import MulticlassClassificationEvaluator
+    from spark_rapids_ml_tpu.tuning import CrossValidator
+
+    lr = LogisticRegression()
+    cv = CrossValidator(
+        estimator=lr,
+        estimatorParamMaps=[{lr.getParam("regParam"): 0.0}],
+        evaluator=MulticlassClassificationEvaluator(),
+        numFolds=1,
+    )
+    with pytest.raises(ValueError, match="numFolds=1 must be >= 2"):
+        cv.fit(None)
