@@ -202,10 +202,15 @@ class _TpuParams(HasVerboseParam):
         params.py:330-335)."""
         return self._tpu_params
 
+    # per-estimator overrides/additions merged over _PARAM_BOUNDS (a single global
+    # table cannot express e.g. Spark's KMeans k>1 vs PCA k>=1, or the tree-depth
+    # ceiling that keeps the heap-layout forest from going depth-exponential)
+    _PARAM_BOUNDS_EXTRA: Dict[str, Any] = {}
+
     def _validate_param_bounds(self) -> None:
         """Raise a clear ValueError when a numeric param is out of its Spark-valid
-        range (_PARAM_BOUNDS above) instead of failing deep in a kernel."""
-        for name, (lo, hi) in _PARAM_BOUNDS.items():
+        range (_PARAM_BOUNDS + class extras) instead of failing deep in a kernel."""
+        for name, (lo, hi) in {**_PARAM_BOUNDS, **self._PARAM_BOUNDS_EXTRA}.items():
             if not self.hasParam(name):
                 continue
             try:

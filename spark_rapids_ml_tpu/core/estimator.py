@@ -405,8 +405,8 @@ class _TpuEstimator(_TpuCaller):
 
     def _fit(self, dataset: Any) -> "_TpuModel":
         # validate on the DRIVER before any dispatch: a bad param must fail here,
-        # not inside a launched barrier stage (the _pre_process_data check still
-        # covers non-fit entry points)
+        # not inside a launched barrier stage (_TpuModel.transform performs the
+        # same driver-side check for the transform plane)
         self._validate_param_bounds()
         if self._use_cpu_fallback():
             return self._fallback_fit(dataset)
@@ -568,6 +568,9 @@ class _TpuModel(_TpuClass, _TpuParams):
     def transform(self, dataset: Any, params: Optional[ParamMap] = None) -> Any:
         if params:
             return self.copy(params).transform(dataset)
+        # driver-side bounds check BEFORE any dispatch (covers transform(params=...)
+        # overrides and deferred-compute models like DBSCAN)
+        self._validate_param_bounds()
         from .dataset import _is_spark_df
 
         if _is_spark_df(dataset):

@@ -130,6 +130,8 @@ class _KMeansParams(
 
 
 class KMeans(_KMeansClass, _TpuEstimator, _KMeansParams):
+    # Spark's KMeans validator requires k > 1 (pyspark ParamValidators.gt(1))
+    _PARAM_BOUNDS_EXTRA = {"k": (2, None)}
     """KMeans on the TPU mesh: one jitted Lloyd loop, centroid psum over ICI.
 
     Drop-in for pyspark.ml.clustering.KMeans / reference

@@ -117,7 +117,9 @@ class DBSCAN(_DBSCANClass, _TpuEstimator, _DBSCANParams):
         return DBSCANModel()
 
     def _fit(self, dataset: Any) -> "DBSCANModel":
-        # no compute at fit (reference clustering.py:904-918)
+        # no compute at fit (reference clustering.py:904-918) — but bad params must
+        # still fail HERE on the driver, not inside the deferred transform stage
+        self._validate_param_bounds()
         if self._use_cpu_fallback():
             model = DBSCANModel()
             model._use_sklearn = True

@@ -165,6 +165,9 @@ class _RandomForestParams(
 
 class _RandomForestEstimator(_RandomForestClass, _TpuEstimatorSupervised, _RandomForestParams):
     _is_classification = False
+    # Spark caps tree depth at 30; the heap-layout forest (2^(depth+1) slots) makes
+    # an early clear error strictly better than a depth-exponential OOM
+    _PARAM_BOUNDS_EXTRA = {"maxDepth": (0, 30)}
 
     def __init__(self, **kwargs: Any) -> None:
         super().__init__()

@@ -79,8 +79,13 @@ class Pipeline(Params):
                     len(a.getOrDefault("inputCols")),
                     type(b).__name__,
                 )
+                # bypass on a COPY: mutating the user's estimator would corrupt its
+                # reuse outside this pipeline (pyspark's Pipeline.fit also never
+                # mutates the supplied stages)
+                b = b.copy()
                 b._set(featuresCols=a.getOrDefault("inputCols"))
                 b._clear(b.getParam("featuresCol"))
+                stages[i + 1] = b
                 stages[i] = NoOpTransformer()
 
         fitted: List[Any] = []

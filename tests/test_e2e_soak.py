@@ -1,0 +1,91 @@
+"""End-to-end soak: one flow threading the subsystems a real user would chain —
+sharded parquet generation -> dataset reload -> Pipeline (VectorAssembler bypass) ->
+CrossValidator grid -> best-model persistence roundtrip -> Spark Connect dispatch of
+the same model -> streaming transform plane. Complements the per-subsystem suites
+with the cross-subsystem seams."""
+
+
+import numpy as np
+import pandas as pd
+
+
+def test_full_workflow_classification(tmp_path, n_devices):
+    # 1. sharded parquet generation (benchmark/gen_data_distributed.py)
+    from benchmark.gen_data_distributed import generate_distributed, read_parquet_dataset
+
+    out = str(tmp_path / "data")
+    generate_distributed(
+        "classification", num_rows=1200, num_cols=8, output_dir=out,
+        num_shards=3, seed=11, max_workers=1, n_classes=2,
+    )
+    df = read_parquet_dataset(out)
+    assert len(df) == 1200 and "features" in df.columns
+
+    # 2. Pipeline with the VectorAssembler bypass (scalar cols fed directly)
+    from spark_rapids_ml_tpu.classification import LogisticRegression
+    from spark_rapids_ml_tpu.evaluation import MulticlassClassificationEvaluator
+    from spark_rapids_ml_tpu.pipeline import Pipeline
+    from spark_rapids_ml_tpu.models.feature import VectorAssembler
+
+    X = np.stack(df["features"].to_numpy())
+    scalar_df = pd.DataFrame({f"c{j}": X[:, j] for j in range(X.shape[1])})
+    scalar_df["label"] = df["label"].to_numpy()
+    assembler = VectorAssembler(
+        inputCols=[f"c{j}" for j in range(X.shape[1])], outputCol="features"
+    )
+    lr = LogisticRegression(maxIter=40)
+    pipe_model = Pipeline(stages=[assembler, lr]).fit(scalar_df)
+    pred = pipe_model.transform(scalar_df)
+    acc = (pred["prediction"].to_numpy() == scalar_df["label"].to_numpy()).mean()
+    assert acc > 0.8, acc
+
+    # 3. CrossValidator over a reg grid on the vector frame
+    from spark_rapids_ml_tpu.tuning import CrossValidator, ParamGridBuilder
+
+    vec_df = pd.DataFrame({"features": list(X.astype(np.float32)),
+                           "label": df["label"].to_numpy()})
+    grid = ParamGridBuilder().addGrid(lr.regParam, [0.0, 0.05]).build()
+    cv_model = CrossValidator(
+        estimator=lr, estimatorParamMaps=grid,
+        evaluator=MulticlassClassificationEvaluator(metricName="accuracy"),
+        numFolds=2, seed=5,
+    ).fit(vec_df)
+    assert len(cv_model.avgMetrics) == 2
+
+    # 4. persistence roundtrip of the best model
+    from spark_rapids_ml_tpu.classification import LogisticRegressionModel
+
+    path = str(tmp_path / "best")
+    cv_model.bestModel.save(path)
+    reloaded = LogisticRegressionModel.load(path)
+    np.testing.assert_allclose(
+        reloaded.coefficients, cv_model.bestModel.coefficients, atol=1e-7
+    )
+
+    # 5. connect-plugin dispatch reproduces the reloaded model's predictions
+    from spark_rapids_ml_tpu.connect_plugin import (
+        decode_model_attributes,
+        dispatch_fit,
+    )
+
+    attrs_json = dispatch_fit(
+        "LogisticRegression",
+        {"maxIter": 40, "regParam": float(cv_model.bestModel.getOrDefault("regParam"))},
+        vec_df,
+    )
+    rebuilt = LogisticRegressionModel._from_row(decode_model_attributes(attrs_json))
+    np.testing.assert_array_equal(
+        rebuilt.transform(vec_df)["prediction"].to_numpy(),
+        reloaded.transform(vec_df)["prediction"].to_numpy(),
+    )
+
+    # 6. streaming transform plane on a mock Spark frame
+    from tests.test_spark_transform import FakeSparkDF
+
+    sdf = FakeSparkDF(vec_df, n_partitions=4)
+    out_sdf = reloaded.transform(sdf)
+    assert sdf.full_collects == 0  # never collected; streamed via mapInPandas
+    np.testing.assert_array_equal(
+        out_sdf.toPandas()["prediction"].to_numpy(),
+        reloaded.transform(vec_df)["prediction"].to_numpy(),
+    )

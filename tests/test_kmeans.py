@@ -58,14 +58,18 @@ def test_kmeans_transform_and_predict(n_devices):
 
 
 def test_kmeans_weighted_fit(n_devices):
-    """Sample weights shift centers (weightCol support)."""
-    X = np.array([[0.0], [0.0], [10.0]], dtype=np.float32)
-    w = np.array([1.0, 1.0, 100.0], dtype=np.float32)
+    """Sample weights shift centers (weightCol support). Spark requires k > 1, so
+    the weighted-mean check uses a well-separated far cluster to isolate one
+    center's weighted mean."""
+    X = np.array([[0.0], [1.0], [1000.0]], dtype=np.float32)
+    w = np.array([1.0, 100.0, 1.0], dtype=np.float32)
     df = pd.DataFrame({"features": list(X), "w": w})
-    model = KMeans(k=1, weightCol="w", maxIter=10, initMode="random", seed=1).fit(df)
-    center = model.cluster_centers_[0, 0]
-    expected = (0 * 2 + 10 * 100) / 102
-    assert abs(center - expected) < 1e-3
+    model = KMeans(k=2, weightCol="w", maxIter=20, initMode="random", seed=1).fit(df)
+    centers = np.sort(np.asarray(model.cluster_centers_)[:, 0])
+    # cluster 0 = weighted mean of the two near points; cluster 1 = the far point
+    expected = (0.0 * 1 + 1.0 * 100) / 101
+    assert abs(centers[0] - expected) < 1e-3
+    assert abs(centers[1] - 1000.0) < 1e-2
 
 
 def test_kmeans_tol_zero_remap():

@@ -105,8 +105,8 @@ def test_param_bounds_validation(n_devices):
     X = np.random.default_rng(0).normal(size=(30, 4)).astype(np.float32)
     df = pd.DataFrame({"features": list(X), "label": (X[:, 0] > 0).astype(float)})
 
-    with pytest.raises(ValueError, match="k=0 must be >= 1"):
-        KMeans(k=0).fit(df)
+    with pytest.raises(ValueError, match="k=0 must be >= 2"):
+        KMeans(k=0).fit(df)  # KMeans overrides the k bound to Spark's k > 1
     with pytest.raises(ValueError, match="k=0 must be >= 1"):
         PCA(k=0, inputCol="features").fit(df)
     with pytest.raises(ValueError, match="maxIter=-1 must be >= 0"):
@@ -139,3 +139,49 @@ def test_cv_numfolds_bound():
     )
     with pytest.raises(ValueError, match="numFolds=1 must be >= 2"):
         cv.fit(None)
+
+
+def test_per_estimator_param_bounds(n_devices):
+    """Per-class bounds: Spark's KMeans k>1 and the tree-depth ceiling."""
+    import numpy as np
+    import pandas as pd
+    import pytest
+
+    from spark_rapids_ml_tpu.classification import RandomForestClassifier
+    from spark_rapids_ml_tpu.clustering import KMeans
+
+    X = np.random.default_rng(0).normal(size=(30, 3)).astype(np.float32)
+    df = pd.DataFrame({"features": list(X), "label": (X[:, 0] > 0).astype(float)})
+    with pytest.raises(ValueError, match="k=1 must be >= 2"):
+        KMeans(k=1).fit(df)
+    with pytest.raises(ValueError, match="maxDepth=50 must be <= 30"):
+        RandomForestClassifier(maxDepth=50).fit(df)
+
+
+def test_pipeline_bypass_does_not_mutate_user_estimator(n_devices):
+    """The VectorAssembler bypass fits a COPY: the caller's estimator keeps its
+    featuresCol and never gains featuresCols (pyspark Pipeline.fit semantics)."""
+    import numpy as np
+    import pandas as pd
+
+    from spark_rapids_ml_tpu.classification import LogisticRegression
+    from spark_rapids_ml_tpu.models.feature import VectorAssembler
+    from spark_rapids_ml_tpu.pipeline import Pipeline
+
+    rng = np.random.default_rng(1)
+    X = rng.normal(size=(60, 3)).astype(np.float32)
+    df = pd.DataFrame({f"c{j}": X[:, j] for j in range(3)})
+    df["label"] = (X[:, 0] > 0).astype(float)
+    lr = LogisticRegression(maxIter=10)
+    pipe = Pipeline(
+        stages=[
+            VectorAssembler(inputCols=["c0", "c1", "c2"], outputCol="features"),
+            lr,
+        ]
+    )
+    pipe.fit(df)
+    assert not lr.isDefined("featuresCols")
+    assert lr.getOrDefault("featuresCol") == "features"
+    # and the untouched estimator still fits vector frames directly
+    vec_df = pd.DataFrame({"features": list(X), "label": df["label"]})
+    lr.fit(vec_df)

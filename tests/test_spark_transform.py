@@ -13,12 +13,14 @@ from spark_rapids_ml_tpu.spark.transform import _WORKER_MODELS, infer_ddl_schema
 
 
 class FakeBroadcast:
-    _next_id = 0
-
     def __init__(self, value):
+        import uuid
+
         self.value = value
-        self.id = ("fake", FakeBroadcast._next_id)
-        FakeBroadcast._next_id += 1
+        # globally unique: pytest can import this module twice (as
+        # test_spark_transform and tests.test_spark_transform), and a class-level
+        # counter would then collide keys in the shared _WORKER_MODELS cache
+        self.id = ("fake", uuid.uuid4().hex)
         self.value_reads = 0
 
 
