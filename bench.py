@@ -117,9 +117,16 @@ def main() -> None:
     Xd.block_until_ready()
     w = shard_array(np.ones((n_rows,), dtype=np.float32), mesh)
 
+    def _sync(*arrays):
+        """Force completion by pulling the values to host. Under the axon remote
+        tunnel `block_until_ready` can acknowledge dispatch before the device has
+        finished executing (observed: a 4096^3 matmul "completing" in 0.02 ms);
+        a device->host transfer of the result cannot lie."""
+        return [np.asarray(a) for a in arrays]
+
     # compile warmup (excluded from timing)
     centers, inertia, n_iter = lloyd_fit(Xd, w, init, 0.0, iters)
-    centers.block_until_ready()
+    _sync(centers)
 
     from spark_rapids_ml_tpu.profiling import trace as xplane_trace
 
@@ -127,7 +134,7 @@ def main() -> None:
     t0 = time.perf_counter()
     with xplane_trace(trace_dir):
         centers, inertia, n_iter = lloyd_fit(Xd, w, init, 0.0, iters)
-        centers.block_until_ready()
+        _sync(centers)
     fit_time = time.perf_counter() - t0
 
     rows_per_sec = n_rows * int(n_iter) / fit_time
@@ -144,10 +151,10 @@ def main() -> None:
     # model attributes still parity precision — config key fast_math)
     fast_fit = functools.partial(lloyd_fit, fast_math=True)
     centers_f, _, n_iter_f = fast_fit(Xd, w, init, 0.0, iters)
-    centers_f.block_until_ready()
+    _sync(centers_f)
     t0 = time.perf_counter()
     centers_f, _, n_iter_f = fast_fit(Xd, w, init, 0.0, iters)
-    centers_f.block_until_ready()
+    _sync(centers_f)
     fast_time = time.perf_counter() - t0
     fast_rows_per_sec_chip = n_rows * int(n_iter_f) / fast_time / n_chips
 
@@ -161,9 +168,10 @@ def main() -> None:
 
             mesh_obj = getattr(getattr(Xd, "sharding", None), "mesh", None)
             c_f, _, it_f = lloyd_fit_pallas(Xd, w, init, 0.0, iters, mesh=mesh_obj)
+            _sync(c_f)
             t0 = time.perf_counter()
             c_f, _, it_f = lloyd_fit_pallas(Xd, w, init, 0.0, iters, mesh=mesh_obj)
-            np.asarray(c_f)
+            _sync(c_f)
             fused_time = time.perf_counter() - t0
             fused_rows_per_sec_chip = n_rows * int(it_f) / fused_time / n_chips
         except Exception as e:  # pragma: no cover
@@ -175,10 +183,10 @@ def main() -> None:
 
     cov_jit = jax.jit(weighted_covariance)
     cov, mean, wsum = cov_jit(Xd, w)
-    cov.block_until_ready()
+    _sync(cov)
     t0 = time.perf_counter()
     cov, mean, wsum = cov_jit(Xd, w)
-    cov.block_until_ready()
+    _sync(cov)
     pca_time = time.perf_counter() - t0
     pca_rows_per_sec_chip = n_rows / pca_time / n_chips
 
