@@ -45,8 +45,14 @@ def _device_init_watchdog(attempts: int = 2, timeout_s: float = 90.0) -> None:
     if os.environ.get("SRML_BENCH_NO_WATCHDOG") == "1":
         return
     marker = "/tmp/.srml_bench_device_ok"
-    if os.path.exists(marker):
-        return  # a prior healthy probe on this machine; skip the double init
+    try:
+        # only trust a recent healthy probe: the tunnel can wedge minutes after a
+        # good run (observed), and a stale marker would skip the probe and let the
+        # un-watchdogged jax import hang the whole benchmark
+        if os.path.exists(marker) and time.time() - os.path.getmtime(marker) < 600:
+            return
+    except OSError:
+        pass
     # budget note: the whole probe sequence must leave room for the CPU-fallback
     # compute inside a ~300 s driver timeout (2 x 90 s + 10 s backoff + ~60 s run)
     rc = -1
