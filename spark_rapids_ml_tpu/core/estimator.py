@@ -408,6 +408,15 @@ class _TpuEstimator(_TpuCaller):
         # not inside a launched barrier stage (_TpuModel.transform performs the
         # same driver-side check for the transform plane)
         self._validate_param_bounds()
+        armed = getattr(self, "_fallback_requested_params", set())
+        if armed and not self._fallback_enabled:
+            # silent wrong results are worse than a clear error: with fallback
+            # disabled, a param the TPU backend can't honor must stop the fit
+            # (reference raises in the same situation, core.py:1283-1297)
+            raise ValueError(
+                f"Params {sorted(armed)} are not supported by the TPU backend and "
+                f"CPU fallback is disabled (config fallback.enabled)."
+            )
         if self._use_cpu_fallback():
             return self._fallback_fit(dataset)
         if self._spark_fit_wanted(dataset):
@@ -442,12 +451,24 @@ class _TpuEstimator(_TpuCaller):
         except (ImportError, ValueError):
             return False
 
+    # params that neither the TPU backend nor the sklearn twin can honor — the
+    # reference's pyspark fallback CAN honor them (e.g. box constraints, leafCol),
+    # so silently dropping them here would return wrong results, not slower ones
+    _FALLBACK_CANNOT_HONOR: frozenset = frozenset()
+
     def _fallback_fit(self, dataset: Any) -> "_TpuModel":
         """CPU fallback via the sklearn twin (the reference falls back to pyspark.ml,
         core.py:1283-1297). Subclasses implement `_fit_fallback_model` to run the twin
         and translate its fitted attributes into this framework's model."""
         twin = self._fallback_class()
         reasons = getattr(self, "_fallback_requested_params", set())
+        dishonored = reasons & self._FALLBACK_CANNOT_HONOR
+        if dishonored:
+            raise ValueError(
+                f"Params {sorted(dishonored)} are not supported by the TPU backend, "
+                f"and the sklearn fallback cannot honor them either; use Spark ML "
+                f"directly for these."
+            )
         if twin is None:
             raise NotImplementedError(
                 f"{self.__class__.__name__} has unsupported params {reasons} "

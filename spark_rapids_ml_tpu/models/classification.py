@@ -30,6 +30,7 @@ from ..core.estimator import (
     _TpuModelWithPredictionCol,
 )
 from ..core.params import (
+    HasAggregationDepth,
     HasElasticNetParam,
     HasFeaturesCol,
     HasFitIntercept,
@@ -123,6 +124,7 @@ class _LogisticRegressionParams(
     HasStandardization,
     HasThresholds,
     HasWeightCol,
+    HasAggregationDepth,
 ):
     family: Param[str] = Param(
         "undefined",
@@ -136,6 +138,35 @@ class _LogisticRegressionParams(
         "threshold",
         "Threshold in binary classification prediction, in range [0, 1].",
         TypeConverters.toFloat,
+    )
+    # Spark LogisticRegression surface parity (reference classification.py:679-744):
+    # aggregationDepth/maxBlockSizeInMB are Spark-executor tuning knobs with no TPU
+    # meaning (accepted, ignored); the coefficient/intercept bounds select Spark's
+    # box-constrained optimizer, which the backend doesn't implement -> CPU fallback.
+    maxBlockSizeInMB: Param[float] = Param(
+        "undefined", "maxBlockSizeInMB",
+        "Maximum stacked-block memory in MB (Spark tuning knob; ignored).",
+        TypeConverters.toFloat,
+    )
+    lowerBoundsOnCoefficients: Param[Any] = Param(
+        "undefined", "lowerBoundsOnCoefficients",
+        "Lower-bound matrix for box-constrained fitting (unsupported -> fallback).",
+        TypeConverters.toList,
+    )
+    upperBoundsOnCoefficients: Param[Any] = Param(
+        "undefined", "upperBoundsOnCoefficients",
+        "Upper-bound matrix for box-constrained fitting (unsupported -> fallback).",
+        TypeConverters.toList,
+    )
+    lowerBoundsOnIntercepts: Param[Any] = Param(
+        "undefined", "lowerBoundsOnIntercepts",
+        "Lower-bound vector for intercepts (unsupported -> fallback).",
+        TypeConverters.toList,
+    )
+    upperBoundsOnIntercepts: Param[Any] = Param(
+        "undefined", "upperBoundsOnIntercepts",
+        "Upper-bound vector for intercepts (unsupported -> fallback).",
+        TypeConverters.toList,
     )
 
     def setFeaturesCol(self, value: str):
@@ -153,6 +184,17 @@ class LogisticRegression(
     reference spark_rapids_ml.classification.LogisticRegression
     (reference classification.py:747-1204)."""
 
+    # box constraints select Spark's constrained optimizer; sklearn's twin is
+    # unconstrained, so a fallback would silently drop the user's bounds
+    _FALLBACK_CANNOT_HONOR = frozenset(
+        {
+            "lowerBoundsOnCoefficients",
+            "upperBoundsOnCoefficients",
+            "lowerBoundsOnIntercepts",
+            "upperBoundsOnIntercepts",
+        }
+    )
+
     def __init__(self, **kwargs: Any) -> None:
         super().__init__()
         self._setDefault(
@@ -169,6 +211,8 @@ class LogisticRegression(
             tol=1e-6,
             family="auto",
             threshold=0.5,
+            aggregationDepth=2,
+            maxBlockSizeInMB=0.0,
         )
         self.initialize_tpu_params()
         self._set_params(**kwargs)

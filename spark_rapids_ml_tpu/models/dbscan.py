@@ -36,6 +36,10 @@ class _DBSCANClass(_TpuClass):
             "min_samples": "min_samples",
             "metric": "metric",
             "max_mbytes_per_batch": "max_mbytes_per_batch",
+            # cuML's 'algorithm' selects brute vs rbc neighbor search — both exact;
+            # the TPU backend always runs the blocked-matmul brute scan (reference
+            # clustering.py DBSCAN param surface)
+            "algorithm": "algorithm",
             "featuresCol": "",
             "featuresCols": "",
             "predictionCol": "",
@@ -44,7 +48,10 @@ class _DBSCANClass(_TpuClass):
 
     @classmethod
     def _param_value_mapping(cls):
-        return {"metric": lambda x: x if x in ("euclidean", "cosine") else None}
+        return {
+            "metric": lambda x: x if x in ("euclidean", "cosine") else None,
+            "algorithm": lambda x: x if x in ("brute", "rbc") else None,
+        }
 
     @classmethod
     def _get_tpu_params_default(cls) -> Dict[str, Any]:
@@ -53,6 +60,7 @@ class _DBSCANClass(_TpuClass):
             "min_samples": 5,
             "metric": "euclidean",
             "max_mbytes_per_batch": None,
+            "algorithm": "brute",
         }
 
     @classmethod
@@ -87,6 +95,12 @@ class _DBSCANParams(HasFeaturesCol, HasFeaturesCols, HasPredictionCol, HasIDCol)
         "Batch size cap for the pairwise-distance computation.",
         TypeConverters.toInt,
     )
+    algorithm: Param[str] = Param(
+        "undefined", "algorithm",
+        "Neighbor-search algorithm ('brute' or 'rbc'; both exact — the TPU backend "
+        "always runs the blocked brute scan).",
+        TypeConverters.toString,
+    )
 
     def setFeaturesCol(self, value: str):
         return self._set(featuresCol=value)
@@ -103,6 +117,7 @@ class DBSCAN(_DBSCANClass, _TpuEstimator, _DBSCANParams):
             eps=0.5,
             min_samples=5,
             metric="euclidean",
+            algorithm="brute",
         )
         self.initialize_tpu_params()
         self._set_params(**kwargs)
@@ -139,6 +154,7 @@ class DBSCANModel(_DBSCANClass, _TpuModel, _DBSCANParams):
             eps=0.5,
             min_samples=5,
             metric="euclidean",
+            algorithm="brute",
         )
         self._use_sklearn = False
 

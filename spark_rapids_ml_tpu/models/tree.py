@@ -149,6 +149,28 @@ class _RandomForestParams(
         "Minimum fraction of the weighted sample count each child must have.",
         TypeConverters.toFloat,
     )
+    # Spark executor-memory/caching knobs with no TPU meaning; accepted and ignored
+    # for drop-in compatibility (reference tree.py:103-156 maps them to "")
+    maxMemoryInMB: Param[int] = Param(
+        "undefined", "maxMemoryInMB",
+        "Maximum memory in MB allocated to histogram aggregation (ignored).",
+        TypeConverters.toInt,
+    )
+    cacheNodeIds: Param[bool] = Param(
+        "undefined", "cacheNodeIds",
+        "Whether to cache node IDs for each instance (ignored).",
+        TypeConverters.toBoolean,
+    )
+    checkpointInterval: Param[int] = Param(
+        "undefined", "checkpointInterval",
+        "Checkpoint interval for the node-id cache (ignored).",
+        TypeConverters.toInt,
+    )
+    leafCol: Param[str] = Param(
+        "undefined", "leafCol",
+        "Leaf-index output column (unsupported -> CPU fallback when set).",
+        TypeConverters.toString,
+    )
 
     def setFeaturesCol(self, value: str):
         return self._set(featuresCol=value)
@@ -168,6 +190,9 @@ class _RandomForestEstimator(_RandomForestClass, _TpuEstimatorSupervised, _Rando
     # Spark caps tree depth at 30; the heap-layout forest (2^(depth+1) slots) makes
     # an early clear error strictly better than a depth-exponential OOM
     _PARAM_BOUNDS_EXTRA = {"maxDepth": (0, 30)}
+    # sklearn forests produce no leaf-index column; a fallback would silently
+    # return a model missing the output the user asked for
+    _FALLBACK_CANNOT_HONOR = frozenset({"leafCol"})
 
     def __init__(self, **kwargs: Any) -> None:
         super().__init__()
@@ -185,6 +210,10 @@ class _RandomForestEstimator(_RandomForestClass, _TpuEstimatorSupervised, _Rando
             bootstrap=True,
             seed=0,
             minWeightFractionPerNode=0.0,
+            maxMemoryInMB=256,
+            cacheNodeIds=False,
+            checkpointInterval=10,
+            leafCol="",
         )
         self.initialize_tpu_params()
         self._set_params(**kwargs)

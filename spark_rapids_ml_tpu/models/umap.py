@@ -15,7 +15,7 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
-from ..core.backend_params import HasFeaturesCols, _TpuClass
+from ..core.backend_params import DictTypeConverters, HasFeaturesCols, _TpuClass
 from ..core.estimator import FitInputs, _TpuEstimator, _TpuModelWithColumns
 from ..core.params import (
     HasFeaturesCol,
@@ -41,6 +41,9 @@ class _UMAPClass(_TpuClass):
             "learning_rate": "learning_rate",
             "sample_fraction": "",
             "seed": "random_state",
+            # the reference exposes the cuML name `random_state` directly
+            # (umap.py:114-137); accept both spellings
+            "random_state": "random_state",
             "featuresCol": "",
             "featuresCols": "",
             # supervised UMAP: labelCol switches on the categorical simplicial-set
@@ -48,6 +51,19 @@ class _UMAPClass(_TpuClass):
             "labelCol": "",
             "init": "init",
             "outputCol": "",
+            # full cuML surface (reference umap.py:114-137)
+            "a": "a",
+            "b": "b",
+            "metric": "metric",
+            "metric_kwds": "metric_kwds",
+            "local_connectivity": "local_connectivity",
+            "repulsion_strength": "repulsion_strength",
+            "set_op_mix_ratio": "set_op_mix_ratio",
+            "build_algo": "build_algo",
+            "build_kwds": "build_kwds",
+            # exact transform search needs no queue-size tuning; accepted for
+            # drop-in compatibility (reference umap.py `transform_queue_size`)
+            "transform_queue_size": "",
         }
 
     @classmethod
@@ -63,6 +79,15 @@ class _UMAPClass(_TpuClass):
             "learning_rate": 1.0,
             "random_state": 42,
             "init": "spectral",
+            "a": None,
+            "b": None,
+            "metric": "euclidean",
+            "metric_kwds": None,
+            "local_connectivity": 1.0,
+            "repulsion_strength": 1.0,
+            "set_op_mix_ratio": 1.0,
+            "build_algo": "auto",
+            "build_kwds": None,
         }
 
     @classmethod
@@ -109,6 +134,65 @@ class _UMAPParams(HasFeaturesCol, HasFeaturesCols, HasLabelCol, HasOutputCol, Ha
         "cuML default) or 'random'.",
         TypeConverters.toString,
     )
+    a: Param[float] = Param(
+        "undefined", "a",
+        "output-kernel curve parameter; unset => derived from spread/min_dist.",
+        TypeConverters.toFloat,
+    )
+    b: Param[float] = Param(
+        "undefined", "b",
+        "output-kernel curve parameter; unset => derived from spread/min_dist.",
+        TypeConverters.toFloat,
+    )
+    metric: Param[str] = Param(
+        "undefined", "metric",
+        "kNN-graph distance metric (euclidean, sqeuclidean, cosine, manhattan, "
+        "minkowski).",
+        TypeConverters.toString,
+    )
+    metric_kwds: Param[Dict[str, Any]] = Param(
+        "undefined", "metric_kwds",
+        "metric keyword args (e.g. {'p': 3} for minkowski).",
+        DictTypeConverters._toDict,
+    )
+    local_connectivity: Param[float] = Param(
+        "undefined", "local_connectivity",
+        "number of nearest neighbors assumed locally connected (rho rank).",
+        TypeConverters.toFloat,
+    )
+    repulsion_strength: Param[float] = Param(
+        "undefined", "repulsion_strength",
+        "weight applied to negative (repulsive) samples in layout optimization.",
+        TypeConverters.toFloat,
+    )
+    set_op_mix_ratio: Param[float] = Param(
+        "undefined", "set_op_mix_ratio",
+        "blend between fuzzy union (1.0) and fuzzy intersection (0.0) when "
+        "symmetrizing the graph.",
+        TypeConverters.toFloat,
+    )
+    build_algo: Param[str] = Param(
+        "undefined", "build_algo",
+        "kNN graph build: 'auto'/'brute_force_knn' (exact) or 'nn_descent' "
+        "(approximate, IVF-backed).",
+        TypeConverters.toString,
+    )
+    build_kwds: Param[Dict[str, Any]] = Param(
+        "undefined", "build_kwds",
+        "graph-build keyword args (e.g. {'nlist': 256, 'nprobe': 32}).",
+        DictTypeConverters._toDict,
+    )
+    transform_queue_size: Param[float] = Param(
+        "undefined", "transform_queue_size",
+        "search-width multiplier for transform kNN (exact search here; accepted "
+        "for API compatibility).",
+        TypeConverters.toFloat,
+    )
+    random_state: Param[int] = Param(
+        "undefined", "random_state",
+        "random seed (cuML spelling; equivalent to seed).",
+        TypeConverters.toInt,
+    )
 
     def setFeaturesCol(self, value: str):
         return self._set(featuresCol=value)
@@ -120,6 +204,38 @@ class _UMAPParams(HasFeaturesCol, HasFeaturesCols, HasLabelCol, HasOutputCol, Ha
 class UMAP(_UMAPClass, _TpuEstimator, _UMAPParams):
     """UMAP: single-device fit on (sampled) data, broadcastable model for transform
     (reference umap.py:838-1304)."""
+
+    _PARAM_BOUNDS_EXTRA = {
+        "n_components": (1, None),
+        "n_epochs": (1, None),
+        "min_dist": (0.0, None),
+        "spread": (0.0, None),
+        "negative_sample_rate": (0, None),
+        "local_connectivity": (1.0, None),
+        "repulsion_strength": (0.0, None),
+        "set_op_mix_ratio": (0.0, 1.0),
+        "transform_queue_size": (0.0, None),
+    }
+
+    def _validate_param_bounds(self) -> None:
+        # string enums validated on the DRIVER before any dispatch, like the
+        # numeric bounds (a bad metric must not fail inside a barrier stage)
+        super()._validate_param_bounds()
+        from ..ops.umap_ops import UMAP_METRICS
+
+        metric = self._tpu_params.get("metric", "euclidean")
+        if metric not in UMAP_METRICS:
+            raise ValueError(
+                f"Unsupported UMAP metric '{metric}'; supported: {UMAP_METRICS}"
+            )
+        build_algo = self._tpu_params.get("build_algo", "auto")
+        if build_algo not in ("auto", "brute_force_knn", "nn_descent"):
+            raise ValueError(
+                "build_algo must be one of 'auto', 'brute_force_knn', 'nn_descent'"
+            )
+        init = self._tpu_params.get("init", "spectral")
+        if init not in ("spectral", "random"):
+            raise ValueError("init must be 'spectral' or 'random'")
 
     def __init__(self, **kwargs: Any) -> None:
         super().__init__()
@@ -136,12 +252,19 @@ class UMAP(_UMAPClass, _TpuEstimator, _UMAPParams):
             seed=42,
             sample_fraction=1.0,
             init="spectral",
+            metric="euclidean",
+            local_connectivity=1.0,
+            repulsion_strength=1.0,
+            set_op_mix_ratio=1.0,
+            build_algo="auto",
+            transform_queue_size=4.0,
         )
         self.initialize_tpu_params()
         self._set_params(**kwargs)
 
     def _out_schema(self) -> List[str]:
-        return ["embedding", "raw_data", "a", "b", "n_neighbors"]
+        return ["embedding", "raw_data", "a", "b", "n_neighbors", "metric",
+                "metric_kwds", "local_connectivity"]
 
     def _use_label(self) -> bool:
         # supervised UMAP when a labelCol is explicitly set (reference umap.py)
@@ -197,6 +320,15 @@ class UMAP(_UMAPClass, _TpuEstimator, _UMAPParams):
                 mesh=inputs.mesh,
                 y=y,
                 init=str(p.get("init", "spectral")),
+                metric=str(p.get("metric", "euclidean")),
+                metric_kwds=p.get("metric_kwds"),
+                a=p.get("a"),
+                b=p.get("b"),
+                local_connectivity=float(p.get("local_connectivity", 1.0)),
+                set_op_mix_ratio=float(p.get("set_op_mix_ratio", 1.0)),
+                repulsion_strength=float(p.get("repulsion_strength", 1.0)),
+                build_algo=str(p.get("build_algo", "auto")),
+                build_kwds=p.get("build_kwds"),
             )
 
         return _fit
@@ -213,6 +345,9 @@ class UMAPModel(_UMAPClass, _TpuModelWithColumns, _UMAPParams):
         a: float,
         b: float,
         n_neighbors: int,
+        metric: str = "euclidean",
+        metric_kwds: Optional[Dict[str, Any]] = None,
+        local_connectivity: float = 1.0,
     ) -> None:
         from ..core.dataset import _is_sparse
 
@@ -222,6 +357,9 @@ class UMAPModel(_UMAPClass, _TpuModelWithColumns, _UMAPParams):
             a=float(a),
             b=float(b),
             n_neighbors=int(n_neighbors),
+            metric=str(metric),
+            metric_kwds=dict(metric_kwds) if metric_kwds else {},
+            local_connectivity=float(local_connectivity),
         )
         self._setDefault(featuresCol="features", outputCol="embedding", n_neighbors=15)
 
@@ -239,5 +377,10 @@ class UMAPModel(_UMAPClass, _TpuModelWithColumns, _UMAPParams):
             self._model_attributes["raw_data"],
             self._model_attributes["embedding"],
             self._model_attributes["n_neighbors"],
+            metric=str(self._model_attributes.get("metric", "euclidean")),
+            metric_kwds=self._model_attributes.get("metric_kwds") or None,
+            local_connectivity=float(
+                self._model_attributes.get("local_connectivity", 1.0)
+            ),
         )
         return {self.getOrDefault("outputCol"): out}

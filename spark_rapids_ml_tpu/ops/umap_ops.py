@@ -46,15 +46,31 @@ def find_ab_params(spread: float = 1.0, min_dist: float = 0.1) -> Tuple[float, f
     return float(params[0]), float(params[1])
 
 
-@jax.jit
-def smooth_knn(knn_dists: jax.Array) -> Tuple[jax.Array, jax.Array]:
-    """Per-point (rho, sigma): rho = nearest nonzero neighbor distance; sigma solves
+@functools.partial(jax.jit, static_argnames=("local_connectivity",))
+def smooth_knn(
+    knn_dists: jax.Array, local_connectivity: float = 1.0
+) -> Tuple[jax.Array, jax.Array]:
+    """Per-point (rho, sigma): rho = distance to the local_connectivity-th nearest
+    nonzero neighbor (fractional values interpolate between the surrounding ranks —
+    the standard UMAP local-connectivity semantics; the reference exposes it as the
+    cuML param `local_connectivity`, umap.py:114-137); sigma solves
     Σⱼ exp(-(dⱼ-rho)/σ) = log2(k) by bisection (64 steps, vectorized)."""
     k = knn_dists.shape[1]
     target = jnp.log2(jnp.array(float(k)))
     nonzero = jnp.where(knn_dists > 0, knn_dists, jnp.inf)
-    rho = jnp.min(nonzero, axis=1)
-    rho = jnp.where(jnp.isfinite(rho), rho, 0.0)
+    sorted_nz = jnp.sort(nonzero, axis=1)  # ascending, inf-padded
+    n_nz = jnp.sum(jnp.isfinite(sorted_nz), axis=1)
+    lc = max(float(local_connectivity), 1.0)
+    lo_rank = int(np.floor(lc)) - 1  # 0-based rank of the lower surrounding rank
+    frac = lc - np.floor(lc)
+    lo_idx = jnp.minimum(lo_rank, jnp.maximum(n_nz - 1, 0))
+    hi_idx = jnp.minimum(lo_rank + 1, jnp.maximum(n_nz - 1, 0))
+    d_lo = jnp.take_along_axis(sorted_nz, lo_idx[:, None], axis=1)[:, 0]
+    d_hi = jnp.take_along_axis(sorted_nz, hi_idx[:, None], axis=1)[:, 0]
+    rho = d_lo + frac * (d_hi - d_lo)
+    # fewer nonzero neighbors than requested -> farthest nonzero; none -> 0
+    rho = jnp.where(n_nz > lo_rank, rho, d_lo)
+    rho = jnp.where((n_nz > 0) & jnp.isfinite(rho), rho, 0.0)
 
     def psum_of(sigma):
         d = jnp.maximum(knn_dists - rho[:, None], 0.0)
@@ -75,13 +91,23 @@ def smooth_knn(knn_dists: jax.Array) -> Tuple[jax.Array, jax.Array]:
 
 
 def fuzzy_simplicial_set(
-    knn_ids: np.ndarray, knn_dists: np.ndarray
+    knn_ids: np.ndarray,
+    knn_dists: np.ndarray,
+    set_op_mix_ratio: float = 1.0,
+    local_connectivity: float = 1.0,
 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
-    """Symmetrized edge list (heads, tails, weights) of the fuzzy graph."""
+    """Symmetrized edge list (heads, tails, weights) of the fuzzy graph.
+
+    set_op_mix_ratio blends the probabilistic t-conorm (fuzzy union, 1.0) with the
+    product t-norm (fuzzy intersection, 0.0):
+        W = mix·(P + Pᵀ - P∘Pᵀ) + (1-mix)·(P∘Pᵀ)
+    (cuML/umap-learn semantics; reference surfaces it as `set_op_mix_ratio`)."""
     import scipy.sparse as sp
 
     n, k = knn_ids.shape
-    rho, sigma = smooth_knn(jnp.asarray(knn_dists))
+    rho, sigma = smooth_knn(
+        jnp.asarray(knn_dists), local_connectivity=float(local_connectivity)
+    )
     rho_h, sigma_h = np.asarray(rho), np.asarray(sigma)
     d = np.maximum(knn_dists - rho_h[:, None], 0.0)
     w = np.exp(-d / sigma_h[:, None])
@@ -91,7 +117,9 @@ def fuzzy_simplicial_set(
     P = sp.coo_matrix(
         (w.reshape(-1)[keep], (rows[keep], cols[keep])), shape=(n, n)
     ).tocsr()
-    W = P + P.T - P.multiply(P.T)
+    prod = P.multiply(P.T)
+    mix = float(np.clip(set_op_mix_ratio, 0.0, 1.0))
+    W = (P + P.T - prod) * mix + prod * (1.0 - mix)
     W = W.tocoo()
     return (
         W.row.astype(np.int32),
@@ -115,6 +143,7 @@ def optimize_layout(
     n_vertices: int,
     neg_samples: int = 5,
     initial_lr: float = 1.0,
+    gamma: float = 1.0,
 ) -> jax.Array:
     E = heads.shape[0]
     wsum_per_vertex = jax.ops.segment_sum(weights, heads, num_segments=n_vertices)
@@ -139,7 +168,8 @@ def optimize_layout(
         yn = emb[neg]  # (E, S, dim)
         diff_n = yh[:, None, :] - yn
         d2n = jnp.sum(diff_n * diff_n, axis=-1)
-        g_rep = (2.0 * b) / ((0.001 + d2n) * (1.0 + a * d2n**b))
+        # gamma = repulsion_strength scales the negative-sample force (cuML param)
+        g_rep = (2.0 * gamma * b) / ((0.001 + d2n) * (1.0 + a * d2n**b))
         f_rep = jnp.clip(g_rep[..., None] * diff_n, -4.0, 4.0) * weights[:, None, None]
 
         grad_h = f_att + jnp.sum(f_rep, axis=1) / neg_samples
@@ -236,6 +266,121 @@ def sparse_knn_graph(
     return ids, dists
 
 
+UMAP_METRICS = (
+    "euclidean", "l2", "sqeuclidean", "cosine", "manhattan", "l1", "taxicab",
+    "minkowski",
+)
+
+
+@functools.partial(jax.jit, static_argnames=("k", "p", "qblock", "xblock"))
+def _minkowski_knn(
+    Q: jax.Array, X: jax.Array, k: int, p: float, qblock: int = 256,
+    xblock: int = 2048,
+) -> Tuple[jax.Array, jax.Array]:
+    """Exact kNN under the Minkowski-p metric (p=1 manhattan). No matmul expansion
+    exists for p≠2, so this is a doubly-blocked elementwise scan with a running
+    top-k merge — VPU-bound, used only when the user asks for a non-dot-product
+    metric (cuML brute-force kNN does the same on GPU)."""
+    nq, d = Q.shape
+    nx = X.shape[0]
+    Qp = jnp.pad(Q, ((0, (-nq) % qblock), (0, 0)))
+    Xp = jnp.pad(X, ((0, (-nx) % xblock), (0, 0)))
+    n_xb = Xp.shape[0] // xblock
+    x_chunks = Xp.reshape(n_xb, xblock, d)
+    base_ids = jnp.arange(Xp.shape[0]).reshape(n_xb, xblock)
+    valid = base_ids < nx
+
+    def per_qblock(qb):
+        def scan_chunk(carry, chunk):
+            best_d, best_i = carry
+            xc, ids_c, valid_c = chunk
+            diff = jnp.abs(qb[:, None, :] - xc[None, :, :])  # (qblock, xblock, d)
+            dist = jnp.sum(diff if p == 1.0 else diff**p, axis=-1)
+            dist = jnp.where(valid_c[None, :], dist, jnp.inf)
+            cat_d = jnp.concatenate([best_d, dist], axis=1)
+            cat_i = jnp.concatenate(
+                [best_i, jnp.broadcast_to(ids_c[None, :], dist.shape)], axis=1
+            )
+            neg, pos = jax.lax.top_k(-cat_d, k)
+            return (-neg, jnp.take_along_axis(cat_i, pos, axis=1)), None
+
+        init = (
+            jnp.full((qb.shape[0], k), jnp.inf),
+            jnp.zeros((qb.shape[0], k), jnp.int32),
+        )
+        (bd, bi), _ = jax.lax.scan(
+            scan_chunk, init, (x_chunks, base_ids, valid)
+        )
+        return bd, bi
+
+    db, ib = jax.lax.map(per_qblock, Qp.reshape(-1, qblock, d))
+    dists = db.reshape(-1, k)[:nq]
+    if p != 1.0:
+        dists = dists ** (1.0 / p)
+    return dists, ib.reshape(-1, k)[:nq]
+
+
+def _dense_knn_graph(
+    Xj, k: int, metric: str, metric_kwds, build_algo: str, build_kwds, seed: int
+) -> Tuple[np.ndarray, np.ndarray]:
+    """kNN graph of a dense matrix under the requested metric. Euclidean-family and
+    cosine ride the MXU matmul path; manhattan/minkowski use the blocked VPU scan.
+    build_algo='nn_descent' (cuML's approximate graph build) maps to the IVF-Flat
+    approximate index — same role: an approximate kNN graph much faster than brute
+    force at large n (reference umap.py:114-137 `build_algo`/`build_kwds`)."""
+    from .knn import exact_knn_single, ivfflat_build, ivfflat_search
+    import jax.numpy as jnp
+
+    n = Xj.shape[0]
+    valid = jnp.ones((n,), bool)
+    if build_algo == "nn_descent" and metric not in (
+        "euclidean", "l2", "sqeuclidean"
+    ):
+        from ..utils import get_logger
+
+        get_logger("umap").warning(
+            "build_algo='nn_descent' (IVF-backed approximate graph) supports only "
+            "euclidean-family metrics; using the exact scan for metric '%s'.",
+            metric,
+        )
+    if metric == "cosine":
+        norms = jnp.linalg.norm(Xj, axis=1, keepdims=True)
+        Xn = Xj / jnp.maximum(norms, 1e-12)
+        d2, ids = exact_knn_single(Xn, Xn, valid, k)
+        # unit vectors: d2 = 2(1 - cos)  =>  cosine distance = d2 / 2
+        return np.asarray(ids), (np.asarray(d2) / 2.0).astype(np.float32)
+    if metric in ("manhattan", "l1", "taxicab", "minkowski"):
+        p = 1.0 if metric != "minkowski" else float((metric_kwds or {}).get("p", 2.0))
+        dists, ids = _minkowski_knn(Xj, Xj, k, p)
+        return np.asarray(ids), np.asarray(dists).astype(np.float32)
+    # euclidean family
+    if build_algo == "nn_descent" and n > 4 * k:
+        kw = dict(build_kwds or {})
+        nlist = int(kw.get("nlist", max(int(np.sqrt(n)), 8)))
+        nprobe = int(kw.get("nprobe", max(nlist // 8, 2)))
+        idx = ivfflat_build(
+            Xj, jnp.ones((n,), jnp.float32), nlist=min(nlist, n), max_iter=8,
+            seed=seed,
+        )
+        d, ids = ivfflat_search(
+            Xj, jnp.asarray(idx["centers"]), jnp.asarray(idx["cells"]),
+            jnp.asarray(idx["cell_ids"]), k=k, nprobe=min(nprobe, nlist),
+        )
+        dists = np.asarray(d).astype(np.float32)
+        ids_h = np.asarray(ids)
+        # unfilled slots (-1 ids) -> self-loops with 0 distance (dropped later)
+        rows = np.arange(n)[:, None]
+        ids_h = np.where(ids_h < 0, rows, ids_h)
+        dists = np.where(ids_h == rows, 0.0, dists)
+        if metric == "sqeuclidean":
+            dists = dists**2
+        return ids_h, dists
+    d2, ids = exact_knn_single(Xj, Xj, valid, k)
+    d2_h = np.asarray(d2)
+    dists = d2_h if metric == "sqeuclidean" else np.sqrt(d2_h)
+    return np.asarray(ids), dists.astype(np.float32)
+
+
 def umap_fit(
     X,
     n_neighbors: int,
@@ -249,12 +394,32 @@ def umap_fit(
     mesh=None,
     y: "np.ndarray | None" = None,
     init: str = "spectral",
+    metric: str = "euclidean",
+    metric_kwds: "Dict | None" = None,
+    a: "float | None" = None,
+    b: "float | None" = None,
+    local_connectivity: float = 1.0,
+    set_op_mix_ratio: float = 1.0,
+    repulsion_strength: float = 1.0,
+    build_algo: str = "auto",
+    build_kwds: "Dict | None" = None,
 ) -> Dict[str, np.ndarray]:
     """Full UMAP fit; X may be dense (n, d) or scipy CSR (sparse stays sparse
     end-to-end: sparse kNN graph + device SGD on the edge list). `y` switches on the
-    supervised categorical intersection; `init` is 'spectral' or 'random'."""
-    from .knn import exact_knn_single
+    supervised categorical intersection; `init` is 'spectral' or 'random'. The cuML
+    surface params (metric/metric_kwds, a/b override, local_connectivity,
+    set_op_mix_ratio, repulsion_strength, build_algo/build_kwds — reference
+    umap.py:114-137) are honored natively."""
     import jax.numpy as jnp
+
+    if metric not in UMAP_METRICS:
+        raise ValueError(
+            f"Unsupported UMAP metric '{metric}'; supported: {UMAP_METRICS}"
+        )
+    if build_algo not in ("auto", "brute_force_knn", "nn_descent"):
+        raise ValueError(
+            "build_algo must be one of 'auto', 'brute_force_knn', 'nn_descent'"
+        )
 
     try:
         import scipy.sparse as sp
@@ -266,18 +431,40 @@ def umap_fit(
     n = X.shape[0]
     k = min(n_neighbors + 1, n)
     if is_sparse:
-        knn_ids, knn_dists = sparse_knn_graph(X.tocsr(), k)
+        Xs = X.tocsr()
+        if metric == "cosine":
+            # row-normalize the CSR (cheap, host): euclidean kNN of unit rows
+            # yields d^2 = 2(1-cos)
+            norms = np.sqrt(np.asarray(Xs.multiply(Xs).sum(axis=1))).ravel()
+            inv = 1.0 / np.maximum(norms, 1e-12)
+            Xs = sp.diags(inv) @ Xs
+            knn_ids, knn_d = sparse_knn_graph(Xs, k)
+            knn_dists = (knn_d**2) / 2.0
+        elif metric in ("euclidean", "l2", "sqeuclidean"):
+            knn_ids, knn_dists = sparse_knn_graph(Xs, k)
+            if metric == "sqeuclidean":
+                knn_dists = knn_dists**2
+        else:
+            raise ValueError(
+                f"Sparse UMAP fit supports euclidean/sqeuclidean/cosine, got "
+                f"'{metric}'"
+            )
     else:
-        d2, ids = exact_knn_single(
-            jnp.asarray(X), jnp.asarray(X), jnp.ones((n,), bool), k
+        knn_ids, knn_dists = _dense_knn_graph(
+            jnp.asarray(X), k, metric, metric_kwds, build_algo, build_kwds, seed
         )
-        knn_dists = np.sqrt(np.asarray(d2))
-        knn_ids = np.asarray(ids)
 
-    heads, tails, weights = fuzzy_simplicial_set(knn_ids, knn_dists)
+    heads, tails, weights = fuzzy_simplicial_set(
+        knn_ids, knn_dists,
+        set_op_mix_ratio=set_op_mix_ratio,
+        local_connectivity=local_connectivity,
+    )
     if y is not None:
         weights = categorical_intersection(heads, tails, weights, np.asarray(y))
-    a, b = find_ab_params(spread, min_dist)
+    if a is None or b is None:
+        a, b = find_ab_params(spread, min_dist)
+    else:
+        a, b = float(a), float(b)
 
     rng = np.random.default_rng(seed & 0x7FFFFFFF)
     if init == "spectral":
@@ -297,6 +484,7 @@ def umap_fit(
         n_vertices=n,
         neg_samples=int(negative_sample_rate),
         initial_lr=float(learning_rate),
+        gamma=float(repulsion_strength),
     )
     return {
         "embedding": np.asarray(emb),
@@ -304,15 +492,24 @@ def umap_fit(
         "a": a,
         "b": b,
         "n_neighbors": n_neighbors,
+        "metric": metric,
+        "metric_kwds": dict(metric_kwds) if metric_kwds else {},
+        "local_connectivity": float(local_connectivity),
     }
 
 
 def umap_transform(
-    Q: np.ndarray, raw_data, embedding: np.ndarray, n_neighbors: int
+    Q: np.ndarray,
+    raw_data,
+    embedding: np.ndarray,
+    n_neighbors: int,
+    metric: str = "euclidean",
+    metric_kwds: "Dict | None" = None,
+    local_connectivity: float = 1.0,
 ) -> np.ndarray:
     """Embed new points at the fuzzy-weighted mean of their neighbors' embeddings.
     `raw_data` may be dense or CSR (sparse-fitted models transform without ever
-    densifying the training data)."""
+    densifying the training data). Distances use the fit-time metric."""
     from .knn import exact_knn_single
     import jax.numpy as jnp
 
@@ -327,22 +524,51 @@ def umap_transform(
     k = min(n_neighbors, n)
     if rd_sparse:
         Qs = Q if sp.issparse(Q) else sp.csr_matrix(np.asarray(Q))
-        x2 = np.asarray(raw_data.multiply(raw_data).sum(axis=1)).ravel()
+        Xs = raw_data
+        if metric == "cosine":
+            qn = np.sqrt(np.asarray(Qs.multiply(Qs).sum(axis=1))).ravel()
+            xn = np.sqrt(np.asarray(Xs.multiply(Xs).sum(axis=1))).ravel()
+            Qs = sp.diags(1.0 / np.maximum(qn, 1e-12)) @ Qs
+            Xs = sp.diags(1.0 / np.maximum(xn, 1e-12)) @ Xs
+        x2 = np.asarray(Xs.multiply(Xs).sum(axis=1)).ravel()
         q2 = np.asarray(Qs.multiply(Qs).sum(axis=1)).ravel()
-        cross = np.asarray((Qs @ raw_data.T).todense())
+        cross = np.asarray((Qs @ Xs.T).todense())
         d2_full = np.maximum(q2[:, None] - 2.0 * cross + x2[None, :], 0.0)
         part = np.argpartition(d2_full, k - 1, axis=1)[:, :k]
         pd2 = np.take_along_axis(d2_full, part, axis=1)
         order = np.argsort(pd2, axis=1, kind="stable")
         ids_h = np.take_along_axis(part, order, axis=1)
-        dists = np.sqrt(np.take_along_axis(pd2, order, axis=1)).astype(np.float32)
+        if metric == "cosine":
+            dists = (np.take_along_axis(pd2, order, axis=1) / 2.0).astype(np.float32)
+        elif metric == "sqeuclidean":
+            dists = np.take_along_axis(pd2, order, axis=1).astype(np.float32)
+        else:
+            dists = np.sqrt(np.take_along_axis(pd2, order, axis=1)).astype(np.float32)
+    elif metric in ("manhattan", "l1", "taxicab", "minkowski"):
+        p = 1.0 if metric != "minkowski" else float((metric_kwds or {}).get("p", 2.0))
+        d_j, ids = _minkowski_knn(jnp.asarray(Q), jnp.asarray(raw_data), k, p)
+        dists = np.asarray(d_j).astype(np.float32)
+        ids_h = np.asarray(ids)
+    elif metric == "cosine":
+        Qj = jnp.asarray(Q)
+        Xj = jnp.asarray(raw_data)
+        Qj = Qj / jnp.maximum(jnp.linalg.norm(Qj, axis=1, keepdims=True), 1e-12)
+        Xj = Xj / jnp.maximum(jnp.linalg.norm(Xj, axis=1, keepdims=True), 1e-12)
+        d2, ids = exact_knn_single(Qj, Xj, jnp.ones((n,), bool), k)
+        dists = (np.asarray(d2) / 2.0).astype(np.float32)
+        ids_h = np.asarray(ids)
     else:
         d2, ids = exact_knn_single(
             jnp.asarray(Q), jnp.asarray(raw_data), jnp.ones((n,), bool), k
         )
-        dists = np.sqrt(np.asarray(d2))
+        d2_h = np.asarray(d2)
+        dists = d2_h if metric == "sqeuclidean" else np.sqrt(d2_h)
         ids_h = np.asarray(ids)
-    rho, sigma = smooth_knn(jnp.asarray(dists))
+    # membership strengths must use the same local-connectivity kernel the
+    # embedding was trained with
+    rho, sigma = smooth_knn(
+        jnp.asarray(dists), local_connectivity=float(local_connectivity)
+    )
     w = np.exp(
         -np.maximum(dists - np.asarray(rho)[:, None], 0.0)
         / np.asarray(sigma)[:, None]

@@ -220,3 +220,112 @@ def test_cv_with_random_forest(n_devices):
     model = cv.fit(df)
     assert len(model.avgMetrics) == 2
     assert max(model.avgMetrics) > 0.85
+
+
+def test_reference_param_surface_accepted():
+    """Every constructor kwarg the reference accepts must be accepted here too — a
+    reference user's code must not hard-fail on construction (reference param
+    surfaces: classification.py:679-744, tree.py:103-156, clustering.py DBSCAN,
+    umap.py:114-137)."""
+    # accepted-and-ignored Spark tuning knobs
+    lr = LogisticRegression(aggregationDepth=3, maxBlockSizeInMB=1.0)
+    assert lr.getOrDefault("aggregationDepth") == 3
+    rf = RandomForestClassifier(
+        maxMemoryInMB=512, cacheNodeIds=True, checkpointInterval=5
+    )
+    assert rf.getOrDefault("maxMemoryInMB") == 512
+    db = DBSCAN(algorithm="rbc")  # exact-result variant: runs the brute scan
+    assert db.getOrDefault("algorithm") == "rbc"
+    # full cuML UMAP surface
+    u = UMAP(
+        a=1.2, b=0.9, metric="cosine", metric_kwds={}, local_connectivity=2.0,
+        repulsion_strength=1.5, set_op_mix_ratio=0.7, build_algo="nn_descent",
+        build_kwds={"nlist": 16}, transform_queue_size=2.0, random_state=11,
+    )
+    assert u._tpu_params["random_state"] == 11
+    assert u._tpu_params["metric"] == "cosine"
+
+
+def test_unsupported_reference_params_arm_fallback():
+    """Box-constraint params exist on the surface but select an optimizer the TPU
+    backend doesn't implement -> they arm CPU fallback instead of raising
+    (reference maps them to None, classification.py:694-698)."""
+    lr = LogisticRegression(lowerBoundsOnCoefficients=[[0.0, 0.0]])
+    assert lr._use_cpu_fallback() or not lr._fallback_enabled
+    rf = RandomForestClassifier(leafCol="leaf")
+    assert rf._use_cpu_fallback() or not rf._fallback_enabled
+
+
+def test_umap_param_semantics(n_devices):
+    """The new UMAP params change the result in the documented direction."""
+    rng = np.random.default_rng(5)
+    X = np.vstack(
+        [rng.normal(0, 1, (50, 6)), rng.normal(8, 1, (50, 6))]
+    ).astype(np.float32)
+    df = pd.DataFrame({"features": list(X)})
+    base = UMAP(n_epochs=30, random_state=3, init="random").fit(df)
+    # a/b override is recorded verbatim in the model
+    ab = UMAP(n_epochs=5, a=1.75, b=0.85, init="random").fit(df)
+    assert ab._model_attributes["a"] == pytest.approx(1.75)
+    assert ab._model_attributes["b"] == pytest.approx(0.85)
+    # intersection-only symmetrization keeps fewer/weaker edges than union: both
+    # still embed finitely
+    inter = UMAP(
+        n_epochs=30, set_op_mix_ratio=0.0, random_state=3, init="random"
+    ).fit(df)
+    assert np.isfinite(inter.embedding_).all()
+    # random_state is the seed alias: same seed => same embedding
+    again = UMAP(n_epochs=30, random_state=3, init="random").fit(df)
+    np.testing.assert_allclose(base.embedding_, again.embedding_, rtol=1e-5)
+    # cosine-metric model transforms with the fit-time metric
+    cm = UMAP(n_epochs=20, metric="cosine", init="random").fit(df)
+    out = cm.transform(df)
+    emb = np.vstack(out["embedding"].to_numpy())
+    assert np.isfinite(emb).all()
+
+
+def test_fallback_cannot_honor_raises(n_devices):
+    """Bounds/leafCol select behavior neither the TPU backend nor the sklearn twin
+    implements -> clear error at fit, never a silently-unconstrained model."""
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(40, 3)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float64)
+    df = pd.DataFrame({"features": list(X), "label": y})
+    lr = LogisticRegression(lowerBoundsOnCoefficients=[[0.0] * 3])
+    with pytest.raises((ValueError, NotImplementedError)):
+        lr.fit(df)
+    rf = RandomForestClassifier(numTrees=2, leafCol="leaf")
+    with pytest.raises((ValueError, NotImplementedError)):
+        rf.fit(df)
+
+
+def test_umap_driver_side_validation():
+    """Bad metric/build_algo/init fail on the driver, before any dispatch."""
+    df = pd.DataFrame({"features": [np.zeros(3, np.float32)] * 4})
+    for bad in (
+        UMAP(metric="hamming"),
+        UMAP(build_algo="kgraph"),
+        UMAP(init="pca"),
+    ):
+        with pytest.raises(ValueError):
+            bad.fit(df)
+
+
+def test_umap_local_connectivity_persists(n_devices):
+    """local_connectivity is a model attribute and survives save/load; transform
+    uses the fit-time value."""
+    import os, tempfile
+
+    from spark_rapids_ml_tpu.umap import UMAPModel
+
+    rng = np.random.default_rng(1)
+    X = rng.normal(size=(60, 5)).astype(np.float32)
+    df = pd.DataFrame({"features": list(X)})
+    m = UMAP(n_epochs=10, local_connectivity=2.5, init="random").fit(df)
+    assert m._model_attributes["local_connectivity"] == pytest.approx(2.5)
+    with tempfile.TemporaryDirectory() as td:
+        m.save(os.path.join(td, "m"))
+        m2 = UMAPModel.load(os.path.join(td, "m"))
+        assert m2._model_attributes["local_connectivity"] == pytest.approx(2.5)
+        out = m2.transform(df)
+        assert np.isfinite(np.vstack(out["embedding"].to_numpy())).all()
