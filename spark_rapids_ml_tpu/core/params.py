@@ -183,12 +183,20 @@ class Params:
 
     @property
     def params(self) -> List[Param]:
-        """Returns all params ordered by name."""
+        """Returns all params ordered by name. Properties are skipped before
+        access (pyspark does the same): a model property that raises by contract
+        (e.g. `summary` when hasSummary is False) must not break introspection."""
         if self._params is None:
             self._params = list(
                 filter(
                     lambda attr: isinstance(attr, Param),
-                    [getattr(self, x) for x in dir(self) if x != "params" and not x.startswith("_")],
+                    [
+                        getattr(self, x)
+                        for x in dir(self)
+                        if x != "params"
+                        and not x.startswith("_")
+                        and not isinstance(getattr(type(self), x, None), property)
+                    ],
                 )
             )
             self._params.sort(key=lambda p: p.name)

@@ -425,6 +425,19 @@ class LogisticRegressionModel(
     def interceptVector(self) -> np.ndarray:
         return self._model_attributes["intercepts"]
 
+    @property
+    def hasSummary(self) -> bool:
+        """No training summary is produced (reference classification.py:1575-1581)."""
+        return False
+
+    @property
+    def summary(self):
+        """Spark raises when hasSummary is False; match it
+        (reference classification.py:1583-1591)."""
+        raise RuntimeError(
+            f"No training summary available for this {self.__class__.__name__}"
+        )
+
     def _margins(self, X: np.ndarray) -> np.ndarray:
         coef = self._model_attributes["coefficients"].astype(np.float32)
         icpt = self._model_attributes["intercepts"].astype(np.float32)

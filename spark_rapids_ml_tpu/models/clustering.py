@@ -284,6 +284,18 @@ class KMeansModel(_KMeansClass, _TpuModelWithPredictionCol, _KMeansParams):
         """Spark MLlib KMeansModel surface."""
         return list(self._model_attributes["cluster_centers"])
 
+    @property
+    def hasSummary(self) -> bool:
+        """No training summary is produced (reference clustering.py:549-553)."""
+        return False
+
+    @property
+    def summary(self):
+        """Spark raises when hasSummary is False; match it."""
+        raise RuntimeError(
+            f"No training summary available for this {self.__class__.__name__}"
+        )
+
     def cpu(self):
         """CPU twin of this model (the reference's model.cpu() builds the pyspark
         twin via py4j, clustering.py:524-544; pyspark is optional here so the twin

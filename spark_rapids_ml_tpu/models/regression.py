@@ -180,7 +180,8 @@ class LinearRegression(_LinearRegressionClass, _TpuEstimatorSupervised, _LinearR
         return self._set_params(elasticNetParam=value)  # type: ignore[return-value]
 
     def _out_schema(self) -> List[str]:
-        return ["coefficients", "intercept", "n_iter"]
+        # scale present on huber (fallback) fits only; model defaults it to 1.0
+        return ["coefficients", "intercept", "n_iter", "scale"]
 
     def _enable_fit_multiple_in_single_pass(self) -> bool:
         # the sufficient-statistics pass is shared across all param maps
@@ -266,6 +267,13 @@ class LinearRegression(_LinearRegressionClass, _TpuEstimatorSupervised, _LinearR
                 alpha=self.getOrDefault("regParam"),
                 fit_intercept=fit_intercept,
             ).fit(X64, fd.label, sample_weight=fd.weight)
+            return {
+                "coefficients": sk.coef_.astype(np.float32),
+                "intercept": float(sk.intercept_),
+                "n_iter": int(getattr(sk, "n_iter_", 1) or 1),
+                # huber sigma — Spark's LinearRegressionModel.scale
+                "scale": float(sk.scale_),
+            }
         else:
             reg = self.getOrDefault("regParam")
             l1r = self.getOrDefault("elasticNetParam")
@@ -296,11 +304,18 @@ class LinearRegressionModel(
 ):
     """Fitted linear regression model (reference regression.py:700-863)."""
 
-    def __init__(self, coefficients: np.ndarray, intercept: float, n_iter: int) -> None:
+    def __init__(
+        self,
+        coefficients: np.ndarray,
+        intercept: float,
+        n_iter: int,
+        scale: float = 1.0,
+    ) -> None:
         super().__init__(
             coefficients=np.asarray(coefficients),
             intercept=float(intercept),
             n_iter=int(n_iter),
+            scale=float(scale),
         )
         self._setDefault(featuresCol="features", labelCol="label", predictionCol="prediction")
 
@@ -315,6 +330,26 @@ class LinearRegressionModel(
     @property
     def numFeatures(self) -> int:
         return int(self._model_attributes["coefficients"].shape[0])
+
+    @property
+    def scale(self) -> float:
+        """Huber scale sigma for huber fits; 1.0 for squared-error fits. (The
+        reference hardcodes 1.0 because cuML has no huber, regression.py:760-763;
+        here the huber path fits sklearn's HuberRegressor and its sigma is part of
+        the model state.)"""
+        return float(self._model_attributes.get("scale", 1.0))
+
+    @property
+    def hasSummary(self) -> bool:
+        """No training summary is produced (reference regression.py:745-750)."""
+        return False
+
+    @property
+    def summary(self):
+        """Spark raises when hasSummary is False; match it."""
+        raise RuntimeError(
+            f"No training summary available for this {self.__class__.__name__}"
+        )
 
     def cpu(self):
         """sklearn LinearRegression twin with the fitted state installed."""

@@ -219,7 +219,8 @@ class _RandomForestEstimator(_RandomForestClass, _TpuEstimatorSupervised, _Rando
         self._set_params(**kwargs)
 
     def _out_schema(self) -> List[str]:
-        return ["feature", "threshold", "is_leaf", "value", "bin_edges", "num_classes"]
+        return ["feature", "threshold", "is_leaf", "value", "gain", "node_weight",
+                "bin_edges", "num_classes"]
 
     def _row_stats(self, inputs: FitInputs) -> np.ndarray:
         raise NotImplementedError
@@ -294,6 +295,8 @@ def _sk_forest_to_heap(sk_model, is_classification: bool, n_features: int) -> Di
     threshold = np.zeros((n_trees, n_slots), np.float32)
     is_leaf = np.zeros((n_trees, n_slots), bool)
     value = np.zeros((n_trees, n_slots, v_dim), np.float32)
+    gain = np.zeros((n_trees, n_slots), np.float32)
+    node_weight = np.zeros((n_trees, n_slots), np.float32)
 
     for ti, est in enumerate(estimators):
         t = est.tree_
@@ -306,19 +309,34 @@ def _sk_forest_to_heap(sk_model, is_classification: bool, n_features: int) -> Di
                 value[ti, pos] = val / s if s > 0 else val
             else:
                 value[ti, pos] = val[:1]
+            w = float(t.weighted_n_node_samples[nid])
+            node_weight[ti, pos] = w
             if t.children_left[nid] == -1:
                 is_leaf[ti, pos] = True
             else:
                 feature[ti, pos] = t.feature[nid]
                 threshold[ti, pos] = t.threshold[nid]
-                stack.append((t.children_left[nid], 2 * pos))
-                stack.append((t.children_right[nid], 2 * pos + 1))
+                # per-unit-weight impurity decrease (same scale the TPU builder
+                # records) so featureImportances works identically on fallback fits
+                left, right = t.children_left[nid], t.children_right[nid]
+                wl = float(t.weighted_n_node_samples[left])
+                wr = float(t.weighted_n_node_samples[right])
+                gain[ti, pos] = max(
+                    float(t.impurity[nid])
+                    - (wl / w) * float(t.impurity[left])
+                    - (wr / w) * float(t.impurity[right]),
+                    0.0,
+                )
+                stack.append((left, 2 * pos))
+                stack.append((right, 2 * pos + 1))
 
     return {
         "feature": feature,
         "threshold": threshold,
         "is_leaf": is_leaf,
         "value": value,
+        "gain": gain,
+        "node_weight": node_weight,
         "bin_edges": np.zeros((n_features, 1), np.float32),
         "num_classes": sk_model.n_classes_ if is_classification else 0,
     }
@@ -451,14 +469,29 @@ class _RandomForestModel(_RandomForestClass, _TpuModelWithPredictionCol, _Random
         value: np.ndarray,
         bin_edges: np.ndarray,
         num_classes: int,
+        gain: "np.ndarray | None" = None,
+        node_weight: "np.ndarray | None" = None,
     ) -> None:
+        feature = np.asarray(feature)
+        # gain/node_weight absent on JSON-imported forests (the dump carries
+        # structure, not training statistics) -> importances are all-zero there
         super().__init__(
-            feature=np.asarray(feature),
+            feature=feature,
             threshold=np.asarray(threshold),
             is_leaf=np.asarray(is_leaf),
             value=np.asarray(value),
             bin_edges=np.asarray(bin_edges),
             num_classes=int(num_classes),
+            gain=(
+                np.zeros(feature.shape, np.float32)
+                if gain is None
+                else np.asarray(gain)
+            ),
+            node_weight=(
+                np.zeros(feature.shape, np.float32)
+                if node_weight is None
+                else np.asarray(node_weight)
+            ),
         )
         self._setDefault(
             featuresCol="features", labelCol="label", predictionCol="prediction",
@@ -481,6 +514,98 @@ class _RandomForestModel(_RandomForestClass, _TpuModelWithPredictionCol, _Random
         import math
 
         return int(math.log2(self._model_attributes["feature"].shape[1])) - 1
+
+    def _reachable_slots(self, tree_idx: int) -> List[int]:
+        """Heap slots actually present in tree `tree_idx` (walk from root slot 1;
+        children of leaves are padding)."""
+        a = self._model_attributes
+        feat = a["feature"][tree_idx]
+        leaf = a["is_leaf"][tree_idx]
+        n_slots = feat.shape[0]
+        out: List[int] = []
+        stack = [1]
+        while stack:
+            p = stack.pop()
+            if p >= n_slots:
+                continue
+            out.append(p)
+            if not leaf[p] and feat[p] >= 0:
+                stack.extend((2 * p, 2 * p + 1))
+        return out
+
+    @property
+    def totalNumNodes(self) -> int:
+        """Total number of nodes, summed over all trees (Spark
+        TreeEnsembleModel.totalNumNodes)."""
+        return sum(len(self._reachable_slots(i)) for i in range(self.getNumTrees()))
+
+    @property
+    def featureImportances(self) -> np.ndarray:
+        """Impurity-based feature importances (Spark TreeEnsembleModel semantics:
+        per tree, each internal node contributes gain x node weight to its split
+        feature; trees are normalized to sum 1, averaged, and renormalized). The
+        reference cannot compute this without a Spark conversion and raises
+        (reference tree.py:567-572); here the builder records per-node gain and
+        weight, so importances come straight from the heap arrays."""
+        a = self._model_attributes
+        d = self.numFeatures
+        total = np.zeros(d, np.float64)
+        for i in range(self.getNumTrees()):
+            imp = np.zeros(d, np.float64)
+            feat = a["feature"][i]
+            contrib = a["gain"][i] * a["node_weight"][i]
+            for p in self._reachable_slots(i):
+                if feat[p] >= 0 and not a["is_leaf"][i][p]:
+                    imp[feat[p]] += contrib[p]
+            s = imp.sum()
+            if s > 0:
+                total += imp / s
+        s = total.sum()
+        return (total / s if s > 0 else total).astype(np.float64)
+
+    def _tree_debug_string(self, tree_idx: int) -> str:
+        a = self._model_attributes
+        feat = a["feature"][tree_idx]
+        thr = a["threshold"][tree_idx]
+        leaf = a["is_leaf"][tree_idx]
+        value = a["value"][tree_idx]
+        lines: List[str] = []
+
+        def walk(p: int, depth: int) -> None:
+            pad = "  " * depth
+            if leaf[p] or feat[p] < 0:
+                v = value[p]
+                pred = float(np.argmax(v)) if self._is_classification else float(v[0])
+                lines.append(f"{pad}Predict: {pred}")
+                return
+            lines.append(f"{pad}If (feature {int(feat[p])} <= {float(thr[p])})")
+            walk(2 * p, depth + 1)
+            lines.append(f"{pad}Else (feature {int(feat[p])} > {float(thr[p])})")
+            walk(2 * p + 1, depth + 1)
+
+        walk(1, 1)
+        return "\n".join(lines)
+
+    @property
+    def toDebugString(self) -> str:
+        """Full text description of the forest (Spark toDebugString shape)."""
+        n = self.getNumTrees()
+        head = (
+            f"{self.__class__.__name__} with {n} trees, "
+            f"{self.totalNumNodes} total nodes\n"
+        )
+        parts = []
+        for i in range(n):
+            n_nodes = len(self._reachable_slots(i))
+            parts.append(f"  Tree {i} ({n_nodes} nodes):\n{self._tree_debug_string(i)}")
+        return head + "\n".join(parts)
+
+    @property
+    def trees(self) -> List["_DecisionTreeView"]:
+        """Per-tree views (Spark returns DecisionTreeModels; without a JVM these are
+        lightweight standalone equivalents with numNodes/depth/toDebugString/
+        predict)."""
+        return [_DecisionTreeView(self, i) for i in range(self.getNumTrees())]
 
     def _forest_outputs(self, X: np.ndarray) -> np.ndarray:
         a = self._model_attributes
@@ -512,6 +637,48 @@ class _RandomForestModel(_RandomForestClass, _TpuModelWithPredictionCol, _Random
         attrs = forest_from_json(trees_json, n_features, cls._is_classification)
         attrs["num_classes"] = int(num_classes)
         return cls(**attrs)
+
+
+class _DecisionTreeView:
+    """One tree of a fitted forest: the standalone stand-in for Spark's
+    DecisionTree{Classification,Regression}Model returned by `model.trees`."""
+
+    def __init__(self, forest: "_RandomForestModel", tree_idx: int) -> None:
+        self._forest = forest
+        self._idx = int(tree_idx)
+
+    @property
+    def numNodes(self) -> int:
+        return len(self._forest._reachable_slots(self._idx))
+
+    @property
+    def depth(self) -> int:
+        # floor(log2(slot)) is the node's level (root slot 1 -> level 0)
+        slots = self._forest._reachable_slots(self._idx)
+        return max(int(np.floor(np.log2(p))) for p in slots) if slots else 0
+
+    @property
+    def toDebugString(self) -> str:
+        return (
+            f"DecisionTreeModel ({self.numNodes} nodes)\n"
+            + self._forest._tree_debug_string(self._idx)
+        )
+
+    def predict(self, value: np.ndarray) -> float:
+        """Route one sample through this single tree."""
+        a = self._forest._model_attributes
+        x = np.asarray(value, np.float32).ravel()
+        feat = a["feature"][self._idx]
+        thr = a["threshold"][self._idx]
+        leaf = a["is_leaf"][self._idx]
+        val = a["value"][self._idx]
+        p = 1
+        while not leaf[p] and feat[p] >= 0:
+            p = 2 * p + int(x[feat[p]] > thr[p])
+        v = val[p]
+        return (
+            float(np.argmax(v)) if self._forest._is_classification else float(v[0])
+        )
 
 
 class RandomForestRegressionModel(_RandomForestModel):

@@ -164,6 +164,10 @@ def build_tree(
     thr_arr = jnp.zeros((n_slots,), jnp.float32)
     leaf_arr = jnp.zeros((n_slots,), bool)
     val_arr = jnp.zeros((n_slots, v_dim), jnp.float32)
+    # per-node split gain and weighted row count — the inputs to impurity-based
+    # featureImportances (Spark TreeEnsembleModel semantics)
+    gain_arr = jnp.zeros((n_slots,), jnp.float32)
+    wgt_arr = jnp.zeros((n_slots,), jnp.float32)
 
     node_id = jnp.zeros((n,), jnp.int32)
     T = jnp.sum(values, axis=0)[None, :]  # (1, s) root stats
@@ -204,6 +208,10 @@ def build_tree(
         thr_arr = thr_arr.at[slots].set(edges[best_feat, best_bin])
         leaf_arr = leaf_arr.at[slots].set(is_leaf_t)
         val_arr = val_arr.at[slots].set(_leaf_value(T, impurity))
+        gain_arr = gain_arr.at[slots].set(
+            jnp.where(is_leaf_t, 0.0, jnp.maximum(best_gain, 0.0))
+        )
+        wgt_arr = wgt_arr.at[slots].set(wT)
 
         # route rows; leaf rows stay in the left child slot (unreachable at predict)
         f = best_feat[node_id]
@@ -223,11 +231,14 @@ def build_tree(
     slots = width + jnp.arange(width)
     leaf_arr = leaf_arr.at[slots].set(True)
     val_arr = val_arr.at[slots].set(_leaf_value(T, impurity))
+    wgt_arr = wgt_arr.at[slots].set(_stat_weight(T, impurity))
     return {
         "feature": feat_arr,
         "threshold": thr_arr,
         "is_leaf": leaf_arr,
         "value": val_arr,
+        "gain": gain_arr,
+        "node_weight": wgt_arr,
     }
 
 
@@ -355,6 +366,8 @@ def forest_fit(
         "threshold": np.stack([t["threshold"] for t in trees]),
         "is_leaf": np.stack([t["is_leaf"] for t in trees]),
         "value": np.stack([t["value"] for t in trees]),
+        "gain": np.stack([t["gain"] for t in trees]),
+        "node_weight": np.stack([t["node_weight"] for t in trees]),
         "bin_edges": edges,
     }
 

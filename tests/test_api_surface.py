@@ -329,3 +329,94 @@ def test_umap_local_connectivity_persists(n_devices):
         assert m2._model_attributes["local_connectivity"] == pytest.approx(2.5)
         out = m2.transform(df)
         assert np.isfinite(np.vstack(out["embedding"].to_numpy())).all()
+
+
+def test_model_attribute_parity(n_devices):
+    """Reference model-surface attributes exist and behave (reference
+    clustering.py:549, classification.py:1575-1591, regression.py:745-763,
+    tree.py:567-607 — featureImportances is computed natively here where the
+    reference raises)."""
+    rng = np.random.default_rng(7)
+    X = np.vstack([rng.normal(-2, 1, (60, 5)), rng.normal(2, 1, (60, 5))]).astype(
+        np.float32
+    )
+    # only feature 0 separates the classes once the rest is noise
+    X[:, 1:] = rng.normal(0, 1, (120, 4)).astype(np.float32)
+    X[:60, 0] = rng.normal(-3, 0.5, 60)
+    X[60:, 0] = rng.normal(3, 0.5, 60)
+    y = np.repeat([0.0, 1.0], 60)
+    df = pd.DataFrame({"features": list(X), "label": y})
+
+    km = KMeans(k=2, seed=0).fit(df)
+    assert km.hasSummary is False
+
+    lrm = LogisticRegression(maxIter=20).fit(df)
+    assert lrm.hasSummary is False
+    with pytest.raises(RuntimeError):
+        _ = lrm.summary
+
+    lin = LinearRegression().fit(df)
+    assert lin.hasSummary is False
+    assert lin.scale == 1.0
+
+    rf = RandomForestClassifier(numTrees=5, maxDepth=4, seed=3).fit(df)
+    imp = rf.featureImportances
+    assert imp.shape == (5,)
+    assert imp.sum() == pytest.approx(1.0)
+    assert imp[0] == imp.max()  # the separating feature dominates
+    assert rf.totalNumNodes >= 3 * 5  # separable data: every tree splits at least once
+    assert len(rf.trees) == 5
+    t0 = rf.trees[0]
+    assert t0.numNodes >= 1 and "Predict:" in t0.toDebugString
+    # single-tree predict routes to a sensible class
+    assert t0.predict(X[0]) in (0.0, 1.0)
+    dbg = rf.toDebugString
+    assert "trees" in dbg and "If (feature" in dbg
+    assert rf.treeWeights == [1.0] * 5
+
+    # importances survive persistence
+    import os, tempfile
+
+    from spark_rapids_ml_tpu.classification import RandomForestClassificationModel
+
+    with tempfile.TemporaryDirectory() as td:
+        rf.save(os.path.join(td, "rf"))
+        rf2 = RandomForestClassificationModel.load(os.path.join(td, "rf"))
+        np.testing.assert_allclose(rf2.featureImportances, imp, rtol=1e-6)
+
+    # JSON-imported forests have structure but no training stats
+    imported = RandomForestClassificationModel.fromJSON(
+        rf.toJSON(), n_features=5, num_classes=2
+    )
+    assert imported.featureImportances.sum() == 0.0
+
+
+def test_huber_scale_and_fallback_importances(n_devices):
+    """Huber fits persist sigma as model.scale (better than the reference's
+    constant 1.0); sklearn-fallback forests still produce real importances."""
+    rng = np.random.default_rng(9)
+    X = rng.normal(size=(200, 3)).astype(np.float32)
+    y = X @ np.array([2.0, -1.0, 0.5]) + 0.1 * rng.normal(size=200)
+    df = pd.DataFrame({"features": list(X), "label": y})
+    hub = LinearRegression(loss="huber", epsilon=1.35).fit(df)
+    assert hub.scale > 0.0 and hub.scale != 1.0
+    with pytest.raises(RuntimeError):
+        _ = hub.summary
+    sq = LinearRegression().fit(df)
+    assert sq.scale == 1.0
+
+    km = KMeans(k=2, seed=0).fit(df)
+    with pytest.raises(RuntimeError):
+        _ = km.summary
+
+    # fallback forest path: force it by arming an unsupported-but-honorable param
+    rf = RandomForestClassifier(numTrees=3, maxDepth=3, seed=0)
+    ydisc = (X[:, 0] > 0).astype(np.float64)
+    df2 = pd.DataFrame({"features": list(X), "label": ydisc})
+    rf._fallback_requested_params = {"minWeightFractionPerNode"}
+    m = rf.fit(df2)
+    imp = m.featureImportances
+    assert imp.sum() == pytest.approx(1.0)
+    assert imp[0] == imp.max()
+    # tree views are consistent on fallback models too
+    assert m.trees[0].depth >= 1
