@@ -39,6 +39,15 @@ from ..utils import get_logger
 _RING_QUERY_THRESHOLD = 65536
 
 
+def _ap(algo_params: Dict[str, Any], *names: str, default: Any) -> Any:
+    """First present key among the accepted spellings — cuML and cuVS names are both
+    honored, like the reference's translation table (knn.py:1324-1404)."""
+    for n in names:
+        if n in algo_params:
+            return algo_params[n]
+    return default
+
+
 def _normalize_or_raise(X, w):
     """Row-normalize for cosine metrics; zero-norm REAL rows raise (Spark/cuML
     cosine semantics). Works on jax arrays; padding rows (w==0) are exempt."""
@@ -263,7 +272,9 @@ class ApproximateNearestNeighbors(_ApproxNNClass, _TpuEstimator, _NNParams):
 
     def _get_tpu_fit_func(self, extra_params: Optional[List[Dict[str, Any]]] = None):
         algo_params = self.getOrDefault("algoParams") or {}
-        nlist = int(algo_params.get("nlist", 64))
+        # both cuML and cuVS spellings are accepted, like the reference's
+        # translation table (knn.py:1370-1380: nlist/n_lists, nprobe/n_probes)
+        nlist = int(_ap(algo_params, "nlist", "n_lists", default=64))
         seed = int(algo_params.get("seed", 42))
         algo = self.getOrDefault("algorithm")
 
@@ -282,8 +293,13 @@ class ApproximateNearestNeighbors(_ApproxNNClass, _TpuEstimator, _NNParams):
                 return cagra_build(
                     inputs.features,
                     inputs.row_weight,
-                    graph_degree=int(algo_params.get("graph_degree", 32)),
-                    nlist=int(algo_params.get("nlist", 0)),
+                    graph_degree=int(
+                        _ap(
+                            algo_params, "graph_degree",
+                            "intermediate_graph_degree", default=32,
+                        )
+                    ),
+                    nlist=int(_ap(algo_params, "nlist", "n_lists", default=0)),
                     seed=seed,
                 )
             if algo in ("ivfpq", "ivf_pq"):
@@ -292,8 +308,8 @@ class ApproximateNearestNeighbors(_ApproxNNClass, _TpuEstimator, _NNParams):
                     inputs.features,
                     inputs.row_weight,
                     nlist=min(nlist, inputs.desc.m),
-                    m_subvectors=int(algo_params.get("M", algo_params.get("pq_dim", 4))),
-                    n_bits=int(algo_params.get("n_bits", algo_params.get("pq_bits", 8))),
+                    m_subvectors=int(_ap(algo_params, "M", "pq_dim", default=4)),
+                    n_bits=int(_ap(algo_params, "n_bits", "pq_bits", default=8)),
                     max_iter=20,
                     seed=seed,
                 )
@@ -428,7 +444,9 @@ class ApproximateNearestNeighborsModel(_ApproxNNClass, _TpuModel, _NNParams):
         else:
             algo_params = self.getOrDefault("algoParams") or {}
             nlist = self._model_attributes["centers"].shape[0]
-            nprobe = int(algo_params.get("nprobe", max(1, nlist // 8)))
+            nprobe = int(
+                _ap(algo_params, "nprobe", "n_probes", default=max(1, nlist // 8))
+            )
             if "codebooks" in self._model_attributes:
                 from ..ops.knn import pq_refine
 

@@ -59,7 +59,9 @@ class _LinearRegressionClass(_TpuClass):
             "tol": "tol",
             "loss": "loss",
             "solver": "solver",
-            "epsilon": None,  # huber knob: unsupported
+            # huber is NATIVE here (ops/linear.huber_fit) — the reference cannot
+            # run it on device at all (cuML lacks huber; regression.py:183-215)
+            "epsilon": "epsilon",
             "aggregationDepth": "",
             "maxBlockSizeInMB": "",
             "featuresCol": "",
@@ -71,7 +73,11 @@ class _LinearRegressionClass(_TpuClass):
     @classmethod
     def _param_value_mapping(cls):
         return {
-            "loss": lambda x: {"squaredError": "squared_loss", "squared_loss": "squared_loss"}.get(x),
+            "loss": lambda x: {
+                "squaredError": "squared_loss",
+                "squared_loss": "squared_loss",
+                "huber": "huber",
+            }.get(x),
             "solver": lambda x: {"auto": "eig", "normal": "eig", "eig": "eig", "l-bfgs": "eig"}.get(x),
         }
 
@@ -86,6 +92,7 @@ class _LinearRegressionClass(_TpuClass):
             "tol": 1e-6,
             "loss": "squared_loss",
             "solver": "eig",
+            "epsilon": 1.35,
         }
 
     @classmethod
@@ -145,12 +152,17 @@ class _LinearRegressionParams(
 
 
 class LinearRegression(_LinearRegressionClass, _TpuEstimatorSupervised, _LinearRegressionParams):
-    """LinearRegression (OLS/Ridge/Lasso/ElasticNet) on the TPU mesh.
+    """LinearRegression (OLS/Ridge/Lasso/ElasticNet/huber) on the TPU mesh.
 
-    One sharded pass accumulates (XᵀWX, XᵀWy) with the psum over ICI; the d×d solve is
-    replicated. Drop-in for pyspark.ml.regression.LinearRegression / reference
+    Squared loss: one sharded pass accumulates (XᵀWX, XᵀWy) with the psum over ICI;
+    the d×d solve is replicated. Huber loss: native concomitant-scale L-BFGS
+    (ops/linear.huber_fit — the reference has no device huber at all). Drop-in for
+    pyspark.ml.regression.LinearRegression / reference
     spark_rapids_ml.regression.LinearRegression (reference regression.py:312-660).
     """
+
+    # Spark ParamValidators.gt(1.0) for the huber shape parameter
+    _PARAM_BOUNDS_EXTRA = {"epsilon": (1.0 + 1e-12, None)}
 
     def __init__(self, **kwargs: Any) -> None:
         super().__init__()
@@ -195,30 +207,71 @@ class LinearRegression(_LinearRegressionClass, _TpuEstimatorSupervised, _LinearR
         p = dict(self._tpu_params)
 
         def _fit(inputs: FitInputs):
-            common = dict(
-                reg=float(p["alpha"]),
-                l1_ratio=float(p["l1_ratio"]),
-                fit_intercept=bool(p["fit_intercept"]),
-                standardize=bool(p["normalize"]),
-                max_iter=int(p["max_iter"]),
-                tol=float(p["tol"]),
-                extra_param_sets=extra_params,
-            )
-            if inputs.sparse_values is not None:
-                from ..ops.sparse import sparse_linreg_fit
+            # dispatch PER PARAM SET on its own loss — a fitMultiple map may flip
+            # between squared and huber (each extra set is a full backend dict)
+            sets = extra_params if extra_params is not None else [p]
+            results: List[Optional[Dict[str, Any]]] = [None] * len(sets)
+            hb = [
+                i for i, s in enumerate(sets)
+                if s.get("loss", "squared_loss") == "huber"
+            ]
+            sq = [i for i in range(len(sets)) if i not in set(hb)]
 
-                results = sparse_linreg_fit(
-                    inputs.sparse_values,
-                    inputs.sparse_indices,
-                    inputs.desc.n,
-                    inputs.label,
-                    inputs.row_weight,
-                    **common,
+            if hb:
+                from ..ops.linear import huber_fit
+
+                if inputs.sparse_values is not None:
+                    raise ValueError(
+                        "loss='huber' requires dense features "
+                        "(disable enable_sparse_data_optim)."
+                    )
+                for i in hb:
+                    if float(sets[i].get("l1_ratio", 0.0)) != 0.0:
+                        # Spark: huber supports only L2 regularization
+                        raise ValueError(
+                            "loss='huber' supports only L2 regularization "
+                            "(elasticNetParam must be 0.0)."
+                        )
+                hres = huber_fit(
+                    inputs.features, inputs.label, inputs.row_weight,
+                    epsilon=float(p.get("epsilon", 1.35)),
+                    reg=float(p["alpha"]),
+                    fit_intercept=bool(p["fit_intercept"]),
+                    standardize=bool(p["normalize"]),
+                    max_iter=int(p["max_iter"]),
+                    tol=float(p["tol"]),
+                    extra_param_sets=[sets[i] for i in hb],
                 )
-            else:
-                results = linreg_fit(
-                    inputs.features, inputs.label, inputs.row_weight, **common
+                for j, i in enumerate(hb):
+                    results[i] = hres[j]
+
+            if sq:
+                common = dict(
+                    reg=float(p["alpha"]),
+                    l1_ratio=float(p["l1_ratio"]),
+                    fit_intercept=bool(p["fit_intercept"]),
+                    standardize=bool(p["normalize"]),
+                    max_iter=int(p["max_iter"]),
+                    tol=float(p["tol"]),
+                    extra_param_sets=[sets[i] for i in sq],
                 )
+                if inputs.sparse_values is not None:
+                    from ..ops.sparse import sparse_linreg_fit
+
+                    sqres = sparse_linreg_fit(
+                        inputs.sparse_values,
+                        inputs.sparse_indices,
+                        inputs.desc.n,
+                        inputs.label,
+                        inputs.row_weight,
+                        **common,
+                    )
+                else:
+                    sqres = linreg_fit(
+                        inputs.features, inputs.label, inputs.row_weight, **common
+                    )
+                for j, i in enumerate(sq):
+                    results[i] = sqres[j]
             return results if extra_params is not None else results[0]
 
         return _fit
@@ -236,6 +289,15 @@ class LinearRegression(_LinearRegressionClass, _TpuEstimatorSupervised, _LinearR
         from ..parallel.mesh import get_mesh
 
         p = self._tpu_params
+        if p.get("loss", "squared_loss") == "huber":
+            # huber has no sufficient-statistics form; fit in-core (the robust loss
+            # needs the residuals every iteration)
+            self.logger.warning(
+                "loss='huber' has no streamed sufficient-statistics form; "
+                "fitting in-core despite stream_threshold_bytes."
+            )
+            inputs = self._build_fit_inputs(fd)
+            return self._get_tpu_fit_func(None)(inputs)
         mesh = get_mesh(self.num_workers)
         A, b, xbar, ybar, sw = streaming_linreg_stats(
             _densify(fd.features, self._float32_inputs),
@@ -262,9 +324,14 @@ class LinearRegression(_LinearRegressionClass, _TpuEstimatorSupervised, _LinearR
         if self.getOrDefault("loss") == "huber":
             from sklearn.linear_model import HuberRegressor
 
+            # sklearn's objective SUMS the data term; the native path (and Spark)
+            # use the mean + lambda/2 penalty — rescale alpha for equivalence
+            n_eff = float(np.sum(fd.weight)) if fd.weight is not None else float(
+                fd.n_rows
+            )
             sk = HuberRegressor(
                 epsilon=max(self.getOrDefault("epsilon"), 1.0),
-                alpha=self.getOrDefault("regParam"),
+                alpha=0.5 * self.getOrDefault("regParam") * n_eff,
                 fit_intercept=fit_intercept,
             ).fit(X64, fd.label, sample_weight=fd.weight)
             return {

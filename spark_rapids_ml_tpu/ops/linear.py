@@ -215,3 +215,113 @@ def solve_from_stats(
 @jax.jit
 def linreg_predict(X: jax.Array, coef: jax.Array, intercept: jax.Array) -> jax.Array:
     return pdot(X, coef) + intercept
+
+
+# ---------------------------------------------------------------------------
+# Huber regression (robust loss) — NATIVE on the mesh.
+#
+# The reference has no device path at all for loss='huber' (cuML lacks it; the
+# reference falls back to Spark, regression.py:183-215 maps loss to squared only).
+# Here the jointly-convex concomitant-scale formulation (Huber 1981, the same
+# objective sklearn's HuberRegressor and Spark's HuberAggregator optimize)
+#     L(beta, b, sigma) = sum_i w_i [ sigma + H_eps((y_i - x_i.beta - b)/sigma) sigma ]
+#                         + reg * ||beta_s||^2
+# is minimized by the shared optax L-BFGS loop (ops/logistic._run_lbfgs): the
+# residual matvec over the sharded row axis is where XLA inserts the psum.
+# sigma is parameterized as exp(s) for positivity; `standardize` applies the
+# penalty to sigma-scaled coefficients like the squared-loss path.
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(
+    jax.jit, static_argnames=("fit_intercept", "standardize", "max_iter")
+)
+def _huber_qn(
+    X: jax.Array,
+    y: jax.Array,
+    w: jax.Array,
+    epsilon: jax.Array,
+    reg: jax.Array,
+    fit_intercept: bool,
+    standardize: bool,
+    max_iter: int,
+    tol: jax.Array,
+):
+    from .linalg import weighted_moments
+    from .logistic import _run_lbfgs
+
+    d = X.shape[1]
+    wsum = jnp.sum(w)
+    if standardize:
+        _, var, _ = weighted_moments(X, w)
+        scale = jnp.sqrt(jnp.maximum(var, 0.0))
+        # zero-variance columns pass through unscaled (solve_from_stats convention)
+        scale = jnp.where(scale <= 0.0, 1.0, scale)
+    else:
+        scale = jnp.ones((d,), X.dtype)
+
+    ybar = jnp.sum(w * y) / wsum
+    b0 = jnp.where(fit_intercept, ybar, 0.0)
+    resid0 = y - b0
+    sigma0 = jnp.sqrt(jnp.sum(w * resid0 * resid0) / wsum) + 1e-6
+    params0 = jnp.concatenate(
+        [jnp.zeros((d,), X.dtype), jnp.array([b0, jnp.log(sigma0)], X.dtype)]
+    )
+
+    def loss(params):
+        coef_s, b, s = params[:d], params[d], params[d + 1]
+        sigma = jnp.exp(s)
+        r = y - pdot(X, coef_s / scale) - jnp.where(fit_intercept, b, 0.0)
+        z = r / sigma
+        az = jnp.abs(z)
+        Hz = jnp.where(az <= epsilon, z * z, 2.0 * epsilon * az - epsilon * epsilon)
+        # Spark HuberCostFun convention: mean data term + (lambda/2)||beta_s||^2
+        # (same regParam meaning as the squared-loss path's A/n + reg*I)
+        return jnp.sum(w * (sigma + Hz * sigma)) / wsum + 0.5 * reg * jnp.sum(
+            coef_s * coef_s
+        )
+
+    params, n_iter = _run_lbfgs(loss, params0, max_iter, tol)
+    coef = params[:d] / scale
+    return coef, params[d], jnp.exp(params[d + 1]), n_iter
+
+
+def huber_fit(
+    X: jax.Array,
+    y: jax.Array,
+    w: jax.Array,
+    epsilon: float,
+    reg: float,
+    fit_intercept: bool,
+    standardize: bool,
+    max_iter: int,
+    tol: float,
+    extra_param_sets: Optional[List[Dict[str, Any]]] = None,
+) -> List[Dict[str, Any]]:
+    """Native huber fits — one result per param set, the solve_from_stats
+    convention (extra sets are full backend-param dicts; None => one base fit).
+    No sufficient-statistics shortcut exists for the robust loss, but the jitted
+    program is compiled once and reused across maps."""
+    param_sets = (
+        extra_param_sets if extra_param_sets is not None else [{}]
+    )
+    results = []
+    for ps in param_sets:
+        coef, b, sigma, n_iter = _huber_qn(
+            X, y, w,
+            jnp.asarray(float(ps.get("epsilon", epsilon)), X.dtype),
+            jnp.asarray(float(ps.get("alpha", reg)), X.dtype),
+            fit_intercept=bool(ps.get("fit_intercept", fit_intercept)),
+            standardize=bool(ps.get("normalize", standardize)),
+            max_iter=int(ps.get("max_iter", max_iter)),
+            tol=jnp.asarray(float(ps.get("tol", tol)), X.dtype),
+        )
+        results.append(
+            {
+                "coefficients": np.asarray(coef, np.float32),
+                "intercept": float(b),
+                "n_iter": int(n_iter),
+                "scale": float(sigma),
+            }
+        )
+    return results

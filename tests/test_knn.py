@@ -311,3 +311,31 @@ def test_ring_knn_k_exceeds_shard_size(n_devices):
     np.testing.assert_allclose(d_ring[: len(queries)], sk_d, atol=1e-4)
     # global indices must match too (catches owner-offset bugs that distances hide)
     np.testing.assert_array_equal(i_ring[: len(queries)], sk_idx)
+
+
+def test_ann_algo_params_cuvs_spellings(n_devices):
+    """cuVS spellings (n_lists/n_probes/pq_dim/pq_bits/intermediate_graph_degree)
+    are accepted interchangeably with the cuML ones, like the reference's
+    translation table (knn.py:1324-1404)."""
+    import pandas as pd
+
+    from spark_rapids_ml_tpu.knn import ApproximateNearestNeighbors
+
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(300, 16)).astype(np.float32)
+    df = pd.DataFrame({"features": list(X), "id": np.arange(300)})
+    qdf = pd.DataFrame({"features": list(X[:10]), "id": np.arange(10)})
+
+    for algo, params in [
+        ("ivfflat", {"n_lists": 8, "n_probes": 8}),
+        ("ivfpq", {"n_lists": 8, "n_probes": 8, "pq_dim": 4, "pq_bits": 8}),
+        ("cagra", {"intermediate_graph_degree": 16}),
+    ]:
+        ann = ApproximateNearestNeighbors(
+            k=4, algorithm=algo, algoParams=params, idCol="id", inputCol="features"
+        )
+        model = ann.fit(df)
+        _, _, knn = model.kneighbors(qdf)
+        ids = np.stack(knn["indices"].to_numpy())
+        # self is its own nearest neighbor for all-probes exact-ish settings
+        assert (ids[:, 0] == np.arange(10)).mean() >= 0.8, algo

@@ -154,10 +154,90 @@ def test_linreg_persistence(tmp_path, n_devices):
     assert loaded.getOrDefault("regParam") == 0.1
 
 
-def test_huber_falls_back():
+def test_huber_is_native():
+    """huber no longer arms CPU fallback — it runs on the device path
+    (ops/linear.huber_fit)."""
     X, y, _ = _data(n=50, d=3)
     df = pd.DataFrame({"features": list(X), "label": y})
     est = LinearRegression(loss="huber", epsilon=2.0)
-    assert est._use_cpu_fallback()
-    model = est.fit(df)  # sklearn twin fallback
+    assert not est._use_cpu_fallback()
+    model = est.fit(df)
     assert model.coefficients.shape == (3,)
+    assert model.scale > 0.0
+
+
+def test_huber_native_vs_sklearn(n_devices):
+    """Native huber (concomitant-scale L-BFGS, ops/linear.huber_fit) matches
+    sklearn's HuberRegressor and resists outliers; the reference has no device
+    huber at all (cuML lacks it, reference regression.py:183-215)."""
+    from sklearn.linear_model import HuberRegressor
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(400, 5)).astype(np.float32)
+    beta = np.array([2.0, -1.0, 0.5, 0.0, 1.5])
+    y = X @ beta + 0.1 * rng.normal(size=400)
+    y[::20] += 15.0  # gross outliers
+    df = pd.DataFrame({"features": list(X), "label": y})
+
+    m = LinearRegression(
+        loss="huber", epsilon=1.35, regParam=0.0, maxIter=200, standardization=False
+    ).fit(df)
+    sk = HuberRegressor(epsilon=1.35, alpha=0.0, max_iter=500).fit(
+        X.astype(np.float64), y
+    )
+    np.testing.assert_allclose(m.coefficients, sk.coef_, atol=2e-2)
+    assert m.intercept == pytest.approx(float(sk.intercept_), abs=2e-2)
+    assert m.scale == pytest.approx(float(sk.scale_), rel=0.1)
+    # robustness: huber beats OLS under contamination
+    ols = LinearRegression(standardization=False).fit(df)
+    assert np.linalg.norm(m.coefficients - beta) < 0.5 * np.linalg.norm(
+        ols.coefficients - beta
+    )
+    # transform uses the huber coefficients
+    pred = m.transform(df)["prediction"].to_numpy()
+    clean = ~(np.arange(400) % 20 == 0)
+    assert np.corrcoef(pred[clean], y[clean])[0, 1] > 0.99
+
+
+def test_huber_guards(n_devices):
+    df = pd.DataFrame(
+        {"features": [np.ones(2, np.float32)] * 8, "label": [1.0] * 8}
+    )
+    with pytest.raises(ValueError):
+        LinearRegression(loss="huber", epsilon=0.9).fit(df)
+    with pytest.raises(ValueError):
+        LinearRegression(loss="huber", elasticNetParam=0.3).fit(df)
+
+
+def test_fitmultiple_mixed_loss_maps(n_devices):
+    """Param maps that flip loss between squared and huber fit each map with ITS
+    OWN loss in single-pass fitMultiple (dispatch is per param set)."""
+    rng = np.random.default_rng(4)
+    X = rng.normal(size=(300, 4)).astype(np.float32)
+    beta = np.array([1.0, -2.0, 0.5, 3.0])
+    y = X @ beta + 0.05 * rng.normal(size=300)
+    y[::15] += 25.0  # outliers
+    df = pd.DataFrame({"features": list(X), "label": y})
+
+    est = LinearRegression(standardization=False, maxIter=200)
+    maps = [
+        {est.getParam("loss"): "squaredError"},
+        {est.getParam("loss"): "huber"},
+    ]
+    models = [m for _, m in est.fitMultiple(df, maps)]
+    sq_m, hb_m = models[0], models[1]
+    # huber model resists the outliers; squared model is pulled by them
+    assert np.linalg.norm(hb_m.coefficients - beta) < 0.5 * np.linalg.norm(
+        sq_m.coefficients - beta
+    )
+    assert hb_m.scale > 0.0 and sq_m.scale == 1.0
+    # varying fitIntercept inside huber maps is honored too
+    maps2 = [
+        {est.getParam("loss"): "huber", est.getParam("fitIntercept"): False},
+        {est.getParam("loss"): "huber", est.getParam("fitIntercept"): True},
+    ]
+    y2 = y + 10.0
+    df2 = pd.DataFrame({"features": list(X), "label": y2})
+    m_no, m_yes = [m for _, m in est.fitMultiple(df2, maps2)]
+    assert abs(m_yes.intercept - 10.0) < 1.0
+    assert m_no.intercept == 0.0
