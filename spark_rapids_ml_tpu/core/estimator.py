@@ -378,8 +378,19 @@ class _TpuEstimator(_TpuCaller):
         """Fit for each param map; in single-pass mode all models come from one sweep
         over the (already device-resident) data (reference core.py:1177-1228)."""
         per_map_estimators = [self.copy(m) for m in paramMaps]
-        if self._enable_fit_multiple_in_single_pass() and not any(
-            est._use_cpu_fallback() for est in per_map_estimators
+        # single-pass mode ships each map as a backend-param dict; a map touching a
+        # param with no backend mapping ("" or None — e.g. coefficient bounds,
+        # column names) cannot be represented there and must fit per map
+        mapping = self._param_mapping() if isinstance(self, _TpuClass) else {}
+        maps_backend_repr = all(
+            mapping.get(param.name) not in ("", None)
+            for m in paramMaps
+            for param in m
+        )
+        if (
+            maps_backend_repr
+            and self._enable_fit_multiple_in_single_pass()
+            and not any(est._use_cpu_fallback() for est in per_map_estimators)
         ):
             extra = [dict(est._tpu_params) for est in per_map_estimators]
             models = self.copy()._fit_internal(dataset, extra)

@@ -77,10 +77,14 @@ class _LogisticRegressionClass(_TpuClass):
             # (core/dataset.py densify); gather-based true-sparse device kernels are
             # a round-2 item (reference sparse path: classification.py:1002-1055)
             "enable_sparse_data_optim": "",
-            "lowerBoundsOnCoefficients": None,
-            "upperBoundsOnCoefficients": None,
-            "lowerBoundsOnIntercepts": None,
-            "upperBoundsOnIntercepts": None,
+            # box constraints run NATIVELY via the projected fit
+            # (ops/logistic._projected_fit) — the reference maps these to None and
+            # falls back to Spark (classification.py:694-698); values stay on the
+            # Spark side (matrices don't belong in the backend kernel dict)
+            "lowerBoundsOnCoefficients": "",
+            "upperBoundsOnCoefficients": "",
+            "lowerBoundsOnIntercepts": "",
+            "upperBoundsOnIntercepts": "",
         }
 
     @classmethod
@@ -184,16 +188,37 @@ class LogisticRegression(
     reference spark_rapids_ml.classification.LogisticRegression
     (reference classification.py:747-1204)."""
 
-    # box constraints select Spark's constrained optimizer; sklearn's twin is
-    # unconstrained, so a fallback would silently drop the user's bounds
-    _FALLBACK_CANNOT_HONOR = frozenset(
-        {
-            "lowerBoundsOnCoefficients",
-            "upperBoundsOnCoefficients",
-            "lowerBoundsOnIntercepts",
-            "upperBoundsOnIntercepts",
-        }
-    )
+    def _validate_param_bounds(self) -> None:
+        # bounds incompatibilities fail on the DRIVER before any dispatch, like the
+        # numeric bounds (the worker-side checks remain as backstops)
+        super()._validate_param_bounds()
+        bound_names = (
+            "lowerBoundsOnCoefficients", "upperBoundsOnCoefficients",
+            "lowerBoundsOnIntercepts", "upperBoundsOnIntercepts",
+        )
+        any_bounds = any(self.isDefined(n) for n in bound_names)
+        if not any_bounds:
+            return
+        if self.getOrDefault("elasticNetParam") != 0.0:
+            raise ValueError(
+                "Coefficient bounds support only L2 regularization "
+                "(elasticNetParam must be 0.0), matching Spark."
+            )
+        icpt_bounded = self.isDefined("lowerBoundsOnIntercepts") or self.isDefined(
+            "upperBoundsOnIntercepts"
+        )
+        if icpt_bounded and not self.getOrDefault("fitIntercept"):
+            raise ValueError(
+                "Intercept bounds require fitIntercept=True (an unbounded, "
+                "unfitted intercept cannot honor them)."
+            )
+        if self.hasParam("enable_sparse_data_optim") and self.isDefined(
+            "enable_sparse_data_optim"
+        ) and self.getOrDefault("enable_sparse_data_optim"):
+            raise ValueError(
+                "Coefficient bounds require dense features "
+                "(disable enable_sparse_data_optim)."
+            )
 
     def __init__(self, **kwargs: Any) -> None:
         super().__init__()
@@ -238,6 +263,16 @@ class LogisticRegression(
 
     def _get_tpu_fit_func(self, extra_params: Optional[List[Dict[str, Any]]] = None):
         base = dict(self._tpu_params)
+        bounds = None
+        bound_vals = [
+            self.getOrDefault(name) if self.isDefined(name) else None
+            for name in (
+                "lowerBoundsOnCoefficients", "upperBoundsOnCoefficients",
+                "lowerBoundsOnIntercepts", "upperBoundsOnIntercepts",
+            )
+        ]
+        if any(v is not None for v in bound_vals):
+            bounds = tuple(bound_vals)
 
         def _fit(inputs: FitInputs):
             y_host = inputs.host_label
@@ -288,6 +323,17 @@ class LogisticRegression(
                         intercept = np.array(
                             [np.inf if only == 1 else -np.inf], np.float32
                         )
+                    if bounds is not None:
+                        # the degenerate model must still live inside the user's box
+                        lb_c, ub_c, lb_i, ub_i = bounds
+                        if lb_c is not None or ub_c is not None:
+                            lo = -np.inf if lb_c is None else np.asarray(lb_c, np.float32)
+                            hi = np.inf if ub_c is None else np.asarray(ub_c, np.float32)
+                            coef = np.clip(coef, lo, hi)
+                        if lb_i is not None or ub_i is not None:
+                            lo = -np.inf if lb_i is None else np.asarray(lb_i, np.float32)
+                            hi = np.inf if ub_i is None else np.asarray(ub_i, np.float32)
+                            intercept = np.clip(intercept, lo, hi)
                     results.append(
                         {
                             "coefficients": coef,
@@ -311,6 +357,11 @@ class LogisticRegression(
                 if inputs.sparse_values is not None:
                     from ..ops.sparse import sparse_logreg_fit
 
+                    if bounds is not None:
+                        raise ValueError(
+                            "Coefficient bounds require dense features "
+                            "(disable enable_sparse_data_optim)."
+                        )
                     attrs = sparse_logreg_fit(
                         inputs.sparse_values,
                         inputs.sparse_indices,
@@ -321,7 +372,8 @@ class LogisticRegression(
                     )
                 else:
                     attrs = logreg_fit(
-                        inputs.features, inputs.label, inputs.row_weight, **common
+                        inputs.features, inputs.label, inputs.row_weight,
+                        bounds=bounds, **common,
                     )
                 attrs["num_classes"] = n_classes
                 results.append(attrs)

@@ -25,7 +25,7 @@
 from __future__ import annotations
 
 import functools
-from typing import Any, Dict
+from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -119,6 +119,30 @@ def _qn_fit(
     return params, n_iter, loss(params)
 
 
+def _accelerated_prox_loop(smooth, prox, params0, step, max_iter: int, tol):
+    """The shared FISTA/projected-gradient machinery: Nesterov-accelerated
+    proximal steps with relative-movement stopping. `prox` is the soft-threshold
+    for elastic net and the box clip for bound constraints."""
+    grad_fn = jax.grad(smooth)
+
+    def cond(state):
+        _, _, _, it, delta = state
+        return jnp.logical_and(it < max_iter, delta > tol)
+
+    def body(state):
+        pk, zk, tk, it, _ = state
+        p_next = prox(zk - step * grad_fn(zk))
+        t_next = 0.5 * (1.0 + jnp.sqrt(1.0 + 4.0 * tk * tk))
+        z_next = p_next + ((tk - 1.0) / t_next) * (p_next - pk)
+        delta = jnp.max(jnp.abs(p_next - pk)) / (jnp.max(jnp.abs(p_next)) + 1e-12)
+        return p_next, z_next, t_next, it + 1, delta
+
+    dtype = params0.dtype
+    state0 = (params0, params0, jnp.array(1.0, dtype), 0, jnp.array(jnp.inf, dtype))
+    params, _, _, n_iter, _ = jax.lax.while_loop(cond, body, state0)
+    return params, n_iter
+
+
 @functools.partial(jax.jit, static_argnames=("fit_intercept", "max_iter", "multinomial"))
 def _fista_fit(
     X, y_enc, w, scale, reg_l1, reg_l2, lipschitz, fit_intercept: bool, max_iter: int,
@@ -138,28 +162,46 @@ def _fista_fit(
             [jnp.ones((X.shape[1],)), jnp.zeros((1,))]
         ).astype(X.dtype)
 
-    grad_fn = jax.grad(smooth)
     step = 1.0 / lipschitz
 
     def prox(p):
         soft = jnp.sign(p) * jnp.maximum(jnp.abs(p) - step * reg_l1, 0.0)
         return jnp.where(coef_mask > 0, soft, p)
 
-    def cond(state):
-        _, _, _, it, delta = state
-        return jnp.logical_and(it < max_iter, delta > tol)
-
-    def body(state):
-        pk, zk, tk, it, _ = state
-        p_next = prox(zk - step * grad_fn(zk))
-        t_next = 0.5 * (1.0 + jnp.sqrt(1.0 + 4.0 * tk * tk))
-        z_next = p_next + ((tk - 1.0) / t_next) * (p_next - pk)
-        delta = jnp.max(jnp.abs(p_next - pk)) / (jnp.max(jnp.abs(p_next)) + 1e-12)
-        return p_next, z_next, t_next, it + 1, delta
-
-    state0 = (params0, params0, jnp.array(1.0, X.dtype), 0, jnp.array(jnp.inf, X.dtype))
-    params, _, _, n_iter, _ = jax.lax.while_loop(cond, body, state0)
+    params, n_iter = _accelerated_prox_loop(smooth, prox, params0, step, max_iter, tol)
     return params, n_iter, smooth(params) + reg_l1 * jnp.sum(jnp.abs(params * coef_mask))
+
+
+@functools.partial(
+    jax.jit, static_argnames=("fit_intercept", "max_iter", "multinomial")
+)
+def _projected_fit(
+    X, y_enc, w, scale, reg_l2, lipschitz, fit_intercept: bool, max_iter: int,
+    tol, multinomial: bool, lb, ub,
+):
+    """Box-constrained fit: accelerated projected gradient (the same loop as
+    _fista_fit with the prox of the box indicator = clip). `lb`/`ub` are full
+    params-shaped bounds in the STANDARDIZED space (coef entries pre-multiplied by
+    sigma; intercept entries unscaled; +-inf where unbounded). Spark exposes this
+    as lowerBounds/upperBoundsOnCoefficients/Intercepts and solves it with
+    L-BFGS-B — projection onto the box is the TPU-friendly route to the same
+    optimum."""
+    if multinomial:
+        smooth = _multinomial_loss_fn(X, y_enc, w, scale, reg_l2, fit_intercept)
+        params0 = jnp.zeros((y_enc.shape[1], X.shape[1] + 1), X.dtype)
+    else:
+        smooth = _binomial_loss_fn(X, y_enc, w, scale, reg_l2, fit_intercept)
+        params0 = jnp.zeros((X.shape[1] + 1,), X.dtype)
+
+    step = 1.0 / lipschitz
+
+    def proj(p):
+        return jnp.clip(p, lb, ub)
+
+    params, n_iter = _accelerated_prox_loop(
+        smooth, proj, proj(params0), step, max_iter, tol
+    )
+    return params, n_iter, smooth(params)
 
 
 @jax.jit
@@ -183,9 +225,15 @@ def logreg_fit(
     max_iter: int,
     tol: float,
     multinomial: bool,
+    bounds: "Optional[Tuple[Any, Any, Any, Any]]" = None,
 ) -> Dict[str, Any]:
     """Full fit; returns Spark-layout model attributes:
-    coefficients (k_rows, d) and intercepts (k_rows,) with k_rows = 1 for binomial."""
+    coefficients (k_rows, d) and intercepts (k_rows,) with k_rows = 1 for binomial.
+
+    `bounds` = (lb_coef, ub_coef, lb_icpt, ub_icpt) in ORIGINAL coefficient space
+    ((k_rows, d) matrices / (k_rows,) vectors, None where unbounded) switches on the
+    box-constrained projected fit — the reference maps these Spark params to None
+    (unsupported, classification.py:694-698); here they run natively."""
     d = X.shape[1]
     if standardize:
         _, var, _ = weighted_moments(X, w)
@@ -204,7 +252,69 @@ def logreg_fit(
     else:
         y_enc = y
 
-    if reg_l1 > 0.0:
+    icpt_bounded = False
+    if bounds is not None:
+        if reg_l1 > 0.0:
+            raise ValueError(
+                "Coefficient bounds support only L2 regularization "
+                "(elasticNetParam must be 0.0), matching Spark."
+            )
+        lb_c, ub_c, lb_i, ub_i = bounds
+        k_rows = n_classes if multinomial else 1
+        inf = jnp.inf
+
+        def _mat(v, fill, name):
+            if v is None:
+                return jnp.full((k_rows, d), fill, X.dtype)
+            arr = np.asarray(v, np.float32)
+            if arr.ndim == 1 and k_rows == 1:
+                arr = arr.reshape(1, -1)
+            if arr.shape != (k_rows, d):
+                raise ValueError(
+                    f"{name} must have shape ({k_rows}, {d}) "
+                    f"(numCoefficientSets x numFeatures), got {arr.shape}."
+                )
+            return jnp.asarray(arr)
+
+        def _vec(v, fill, name):
+            if v is None:
+                return jnp.full((k_rows,), fill, X.dtype)
+            arr = np.asarray(v, np.float32).reshape(-1)
+            if arr.shape != (k_rows,):
+                raise ValueError(
+                    f"{name} must have length {k_rows} (numCoefficientSets), "
+                    f"got {arr.shape[0]}."
+                )
+            return jnp.asarray(arr)
+
+        lbm_raw = _mat(lb_c, -inf, "lowerBoundsOnCoefficients")
+        ubm_raw = _mat(ub_c, inf, "upperBoundsOnCoefficients")
+        lbi = _vec(lb_i, -inf, "lowerBoundsOnIntercepts")
+        ubi = _vec(ub_i, inf, "upperBoundsOnIntercepts")
+        if bool(jnp.any(lbm_raw > ubm_raw)) or bool(jnp.any(lbi > ubi)):
+            raise ValueError(
+                "Each lower bound must be <= the matching upper bound."
+            )
+        # constraint l <= coef <= u in original space <=> l*sigma <= coef_s <= u*sigma
+        lbm = lbm_raw * scale[None, :]
+        ubm = ubm_raw * scale[None, :]
+        icpt_bounded = lb_i is not None or ub_i is not None
+        if icpt_bounded and not fit_intercept:
+            raise ValueError(
+                "Intercept bounds require fitIntercept=True (an unbounded, "
+                "unfitted intercept cannot honor them)."
+            )
+        lb_full = jnp.concatenate([lbm, lbi[:, None]], axis=1)
+        ub_full = jnp.concatenate([ubm, ubi[:, None]], axis=1)
+        if not multinomial:
+            lb_full, ub_full = lb_full[0], ub_full[0]
+        lmax = _gram_lmax(X, w, scale)
+        lipschitz = (0.5 if multinomial else 0.25) * lmax + reg_l2 + 1e-12
+        params, n_iter, obj = _projected_fit(
+            X, y_enc, w, scale, reg_l2, lipschitz, bool(fit_intercept),
+            int(max_iter), float(tol), bool(multinomial), lb_full, ub_full,
+        )
+    elif reg_l1 > 0.0:
         lmax = _gram_lmax(X, w, scale)
         lipschitz = (0.5 if multinomial else 0.25) * lmax + reg_l2 + 1e-12
         params, n_iter, obj = _fista_fit(
@@ -223,7 +333,8 @@ def logreg_fit(
         coef = params[:, :-1] / scale_h
         intercept = params[:, -1]
         # Spark centers multinomial intercepts (reference classification.py:1135-1147)
-        if fit_intercept:
+        # — but never when the user bounded them (centering would break the box)
+        if fit_intercept and not icpt_bounded:
             intercept = intercept - intercept.mean()
     else:
         coef = (params[:-1] / scale_h).reshape(1, -1)

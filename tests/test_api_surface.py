@@ -247,11 +247,11 @@ def test_reference_param_surface_accepted():
 
 
 def test_unsupported_reference_params_arm_fallback():
-    """Box-constraint params exist on the surface but select an optimizer the TPU
-    backend doesn't implement -> they arm CPU fallback instead of raising
-    (reference maps them to None, classification.py:694-698)."""
+    """leafCol selects behavior the TPU backend doesn't implement -> arms CPU
+    fallback (reference maps it to None). Box constraints are NATIVE now
+    (ops/logistic._projected_fit) and must NOT arm fallback."""
     lr = LogisticRegression(lowerBoundsOnCoefficients=[[0.0, 0.0]])
-    assert lr._use_cpu_fallback() or not lr._fallback_enabled
+    assert not lr._use_cpu_fallback()
     rf = RandomForestClassifier(leafCol="leaf")
     assert rf._use_cpu_fallback() or not rf._fallback_enabled
 
@@ -285,18 +285,66 @@ def test_umap_param_semantics(n_devices):
 
 
 def test_fallback_cannot_honor_raises(n_devices):
-    """Bounds/leafCol select behavior neither the TPU backend nor the sklearn twin
-    implements -> clear error at fit, never a silently-unconstrained model."""
+    """leafCol selects behavior neither the TPU backend nor the sklearn twin
+    implements -> clear error at fit, never a silently-wrong model."""
     rng = np.random.default_rng(0)
     X = rng.normal(size=(40, 3)).astype(np.float32)
     y = (X[:, 0] > 0).astype(np.float64)
     df = pd.DataFrame({"features": list(X), "label": y})
-    lr = LogisticRegression(lowerBoundsOnCoefficients=[[0.0] * 3])
-    with pytest.raises((ValueError, NotImplementedError)):
-        lr.fit(df)
     rf = RandomForestClassifier(numTrees=2, leafCol="leaf")
     with pytest.raises((ValueError, NotImplementedError)):
         rf.fit(df)
+
+
+def test_logreg_box_constraints_native(n_devices):
+    """Box-constrained LogisticRegression runs natively (projected accelerated
+    gradient) and matches scipy L-BFGS-B on the identical objective — the
+    reference falls back to Spark for these params (classification.py:694-698)."""
+    from scipy.optimize import minimize
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(300, 4)).astype(np.float32)
+    beta = np.array([2.0, -1.5, 0.8, -0.3])
+    logit = X @ beta + 0.5
+    y = (rng.random(300) < 1 / (1 + np.exp(-logit))).astype(np.float64)
+    df = pd.DataFrame({"features": list(X), "label": y})
+
+    m = LogisticRegression(
+        maxIter=500, tol=1e-8, standardization=False, regParam=0.01,
+        lowerBoundsOnCoefficients=[[0.0] * 4],
+    ).fit(df)
+    assert (m.coefficients >= -1e-6).all()
+
+    def obj(p):
+        c, b = p[:4], p[4]
+        z = X @ c + b
+        ll = np.logaddexp(0, z) - y * z
+        return ll.mean() + 0.5 * 0.01 * np.sum(c * c)
+
+    res = minimize(
+        obj, np.zeros(5), method="L-BFGS-B",
+        bounds=[(0, None)] * 4 + [(None, None)],
+    )
+    np.testing.assert_allclose(m.coefficients, res.x[:4], atol=5e-3)
+    assert m.intercept == pytest.approx(res.x[4], abs=5e-3)
+
+    # intercept bounds honored; multinomial upper bounds honored
+    m2 = LogisticRegression(
+        maxIter=300, standardization=False, lowerBoundsOnIntercepts=[1.0]
+    ).fit(df)
+    assert m2.intercept >= 1.0 - 1e-6
+    y3 = rng.integers(0, 3, 300).astype(np.float64)
+    df3 = pd.DataFrame({"features": list(X[:, :3]), "label": y3})
+    m3 = LogisticRegression(
+        family="multinomial", maxIter=200,
+        upperBoundsOnCoefficients=[[0.5] * 3] * 3,
+    ).fit(df3)
+    assert (m3.coefficientMatrix <= 0.5 + 1e-6).all()
+    with pytest.raises(ValueError):
+        LogisticRegression(
+            elasticNetParam=0.5, regParam=0.1,
+            lowerBoundsOnCoefficients=[[0.0] * 4],
+        ).fit(df)
 
 
 def test_umap_driver_side_validation():
@@ -420,3 +468,41 @@ def test_huber_scale_and_fallback_importances(n_devices):
     assert imp[0] == imp.max()
     # tree views are consistent on fallback models too
     assert m.trees[0].depth >= 1
+
+
+def test_logreg_bounds_edge_cases(n_devices):
+    """Review-driven edge cases: per-map bounds force per-map fits, bad shapes and
+    inverted bounds fail clearly, fitIntercept=False + intercept bounds fails on
+    the driver, single-label fits are clamped into the box."""
+    rng = np.random.default_rng(2)
+    X = rng.normal(size=(60, 3)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float64)
+    df = pd.DataFrame({"features": list(X), "label": y})
+
+    # per-param-map bounds: single-pass cannot represent them; per-map path honors
+    # (map 0 forces all coefs <= -0.5; the unconstrained optimum has coef0 >> 0)
+    est = LogisticRegression(maxIter=100, standardization=False)
+    maps = [
+        {est.getParam("upperBoundsOnCoefficients"): [[-0.5] * 3]},
+        {},
+    ]
+    models = [m for _, m in est.fitMultiple(df, maps)]
+    assert (models[0].coefficients <= -0.5 + 1e-6).all()
+    assert models[1].coefficients[0] > 0.5  # unconstrained separator
+
+    with pytest.raises(ValueError):
+        LogisticRegression(lowerBoundsOnCoefficients=[[0.0, 0.0]]).fit(df)  # bad shape
+    with pytest.raises(ValueError):
+        LogisticRegression(
+            lowerBoundsOnCoefficients=[[1.0] * 3],
+            upperBoundsOnCoefficients=[[0.0] * 3],
+        ).fit(df)  # inverted
+    with pytest.raises(ValueError):
+        LogisticRegression(
+            fitIntercept=False, lowerBoundsOnIntercepts=[1.0]
+        ).fit(df)  # driver-side
+
+    # single-label degenerate fit clamps into the box
+    df1 = pd.DataFrame({"features": list(X), "label": np.ones(60)})
+    m1 = LogisticRegression(upperBoundsOnIntercepts=[5.0]).fit(df1)
+    assert m1.intercept == 5.0
