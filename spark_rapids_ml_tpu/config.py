@@ -42,6 +42,11 @@ _DEFAULTS: Dict[str, Any] = {
     # fast_math=True lets ranking-only matmuls (KMeans assignment distances) run at
     # MXU bf16 single-pass precision; model attributes stay parity-precision
     "fast_math": False,
+    # precision of PARITY matmuls (the ones feeding model attributes):
+    #   highest = 6-pass bf16 (full f32, the default)
+    #   high    = 3-pass bf16 (~2x faster on MXU, error ~2^-22 vs ~2^-24)
+    # a TPU-measured accuracy/throughput tradeoff knob; tests pin highest
+    "parity_precision": "highest",
 }
 
 _ENV_KEYS: Dict[str, str] = {
@@ -54,6 +59,7 @@ _ENV_KEYS: Dict[str, str] = {
     "stream_batch_rows": "SRML_TPU_STREAM_BATCH_ROWS",
     "spark_fit_mode": "SRML_TPU_SPARK_FIT_MODE",
     "fast_math": "SRML_TPU_FAST_MATH",
+    "parity_precision": "SRML_TPU_PARITY_PRECISION",
 }
 
 _overrides: Dict[str, Any] = {}

@@ -14,6 +14,7 @@ from typing import Any, Optional
 import numpy as np
 
 from .core.params import (
+    HasFeaturesCol,
     HasLabelCol,
     HasPredictionCol,
     HasProbabilityCol,
@@ -214,3 +215,106 @@ class BinaryClassificationEvaluator(
         recall = tps / P
         precision = np.where(tps + fps > 0, tps / np.maximum(tps + fps, 1e-30), 1.0)
         return float(np.trapezoid(precision, recall))
+
+
+class ClusteringEvaluator(Evaluator, HasFeaturesCol, HasPredictionCol, HasWeightCol):
+    """Silhouette evaluator (pyspark.ml.evaluation.ClusteringEvaluator surface).
+
+    Spark's silhouette for squaredEuclidean/cosine avoids the O(n^2) pairwise
+    matrix with per-cluster sufficient statistics: the mean squared distance from a
+    point to a cluster is ||x||^2 - 2 x.mu_C + mean||y||^2_C, so the whole
+    computation is one (n, k) matmul against the cluster means — the MXU-shaped
+    formulation of the same metric."""
+
+    metricName: Param[str] = Param(
+        "undefined", "metricName", "metric name in evaluation (silhouette)",
+        TypeConverters.toString,
+    )
+    distanceMeasure: Param[str] = Param(
+        "undefined", "distanceMeasure",
+        "distance measure: squaredEuclidean or cosine",
+        TypeConverters.toString,
+    )
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__()
+        self._setDefault(
+            metricName="silhouette",
+            distanceMeasure="squaredEuclidean",
+            featuresCol="features",
+            predictionCol="prediction",
+        )
+        self._set(**kwargs)
+
+    def getMetricName(self) -> str:
+        return self.getOrDefault("metricName")
+
+    def getDistanceMeasure(self) -> str:
+        return self.getOrDefault("distanceMeasure")
+
+    def setFeaturesCol(self, value: str) -> "ClusteringEvaluator":
+        return self._set(featuresCol=value)
+
+    def setPredictionCol(self, value: str) -> "ClusteringEvaluator":
+        return self._set(predictionCol=value)
+
+    def _evaluate(self, dataset: Any) -> float:
+        if self.getMetricName() != "silhouette":
+            raise ValueError(
+                f"Unsupported metric '{self.getMetricName()}'; only 'silhouette'."
+            )
+        measure = self.getDistanceMeasure()
+        if measure not in ("squaredEuclidean", "cosine"):
+            raise ValueError(
+                "distanceMeasure must be 'squaredEuclidean' or 'cosine', got "
+                f"'{measure}'."
+            )
+        X = np.asarray(_col(dataset, self.getOrDefault("featuresCol")), np.float64)
+        labels = np.asarray(
+            _col(dataset, self.getOrDefault("predictionCol"))
+        ).astype(np.int64)
+        w = (
+            np.asarray(_col(dataset, self.getOrDefault("weightCol")), np.float64)
+            if self.isDefined("weightCol")
+            else np.ones(len(labels), np.float64)
+        )
+        uniq, inv = np.unique(labels, return_inverse=True)
+        k = len(uniq)
+        if k < 2:
+            raise ValueError("Silhouette requires at least 2 clusters.")
+        if measure == "cosine":
+            norms = np.linalg.norm(X, axis=1, keepdims=True)
+            if np.any(norms == 0):
+                raise ValueError("Cosine distance is undefined for zero vectors.")
+            X = X / norms
+
+        # weighted per-cluster stats: count, mean vector, mean squared norm
+        Wc = np.zeros(k)
+        np.add.at(Wc, inv, w)
+        mu = np.zeros((k, X.shape[1]))
+        np.add.at(mu, inv, X * w[:, None])
+        mu /= Wc[:, None]
+        x2 = np.sum(X * X, axis=1)
+
+        if measure == "squaredEuclidean":
+            m2 = np.zeros(k)
+            np.add.at(m2, inv, w * x2)
+            m2 /= Wc
+            # meanSq[i, C] = ||x_i||^2 - 2 x_i.mu_C + mean||y||^2_C  (includes self
+            # for C = own cluster; the self term contributes 0 to the sum)
+            mean_d = x2[:, None] - 2.0 * (X @ mu.T) + m2[None, :]
+        else:
+            # mean cosine distance to cluster C = 1 - x_hat . psi_C
+            mean_d = 1.0 - X @ mu.T
+        mean_d = np.maximum(mean_d, 0.0)
+
+        own = mean_d[np.arange(len(labels)), inv]
+        Wown = Wc[inv]
+        # exclude self from the own-cluster mean (self distance is 0)
+        with np.errstate(divide="ignore", invalid="ignore"):
+            a = np.where(Wown > w, Wown * own / np.maximum(Wown - w, 1e-300), 0.0)
+        other = mean_d.copy()
+        other[np.arange(len(labels)), inv] = np.inf
+        b = other.min(axis=1)
+        s = np.where(Wown > w, (b - a) / np.maximum(np.maximum(a, b), 1e-300), 0.0)
+        return float(np.average(s, weights=w))

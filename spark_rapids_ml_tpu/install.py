@@ -57,6 +57,7 @@ _accelerated_attributes: Dict[str, Dict[str, str]] = {
         "MulticlassClassificationEvaluator": "evaluation",
         "RegressionEvaluator": "evaluation",
         "BinaryClassificationEvaluator": "evaluation",
+        "ClusteringEvaluator": "evaluation",
     },
     "pyspark.ml": {"Pipeline": "pipeline", "PipelineModel": "pipeline"},
 }

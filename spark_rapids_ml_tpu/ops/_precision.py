@@ -4,20 +4,36 @@
 # On TPU the MXU's DEFAULT precision computes f32 dots via bfloat16 passes — fast, but
 # off by ~2^-8, which breaks parity with the reference's fp32/fp64 cuML results (and
 # this build's XLA CPU backend shows the same behavior). Statistics that feed model
-# attributes (covariance, Gram, gradients, projections) therefore pin
-# Precision.HIGHEST (6-pass bf16 ≙ full f32 on MXU). Ops where throughput matters more
+# attributes (covariance, Gram, gradients, projections) therefore run at the
+# config-selected parity precision (`parity_precision`: HIGHEST by default, HIGH as
+# a measured 2x opt-in — read at first trace). Ops where throughput matters more
 # than the last bits (distance matrices in kNN/KMeans assignment) may choose lower
 # precision explicitly.
 #
 
 import jax
 
-PARITY = jax.lax.Precision.HIGHEST
 FAST = jax.lax.Precision.DEFAULT
+
+
+def parity_precision() -> jax.lax.Precision:
+    """The precision for model-attribute matmuls, from the process config
+    (`parity_precision`): HIGHEST (6-pass bf16 ≙ full f32) by default; HIGH
+    (3-pass, ~2x faster on MXU at ~2^-22 error) as a measured opt-in."""
+    from .. import config as _config
+
+    value = str(_config.get("parity_precision")).lower()
+    if value == "high":
+        return jax.lax.Precision.HIGH
+    if value == "highest":
+        return jax.lax.Precision.HIGHEST
+    raise ValueError(
+        f"parity_precision must be 'highest' or 'high', got '{value}'."
+    )
 
 
 def pdot(a, b):
     """Parity-precision matmul."""
     import jax.numpy as jnp
 
-    return jnp.matmul(a, b, precision=PARITY)
+    return jnp.matmul(a, b, precision=parity_precision())

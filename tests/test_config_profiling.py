@@ -81,3 +81,24 @@ def test_train_validation_split_empty_grid():
     tvs = TrainValidationSplit()
     with pytest.raises(ValueError, match="non-empty"):
         tvs.fit(pd.DataFrame({"features": []}))
+
+
+def test_parity_precision_knob(n_devices):
+    """parity_precision config: 'high' selects 3-pass MXU matmuls for model-attr
+    math (measured TPU tradeoff); default stays 'highest'. On the CPU backend both
+    are exact — this pins the plumbing, not the numerics."""
+    import jax
+
+    from spark_rapids_ml_tpu import config
+    from spark_rapids_ml_tpu.ops._precision import parity_precision
+
+    assert parity_precision() == jax.lax.Precision.HIGHEST
+    config.set("parity_precision", "high")
+    try:
+        assert parity_precision() == jax.lax.Precision.HIGH
+        config.set("parity_precision", "hgih")
+        with pytest.raises(ValueError):
+            parity_precision()
+    finally:
+        config.unset("parity_precision")
+    assert parity_precision() == jax.lax.Precision.HIGHEST

@@ -170,3 +170,54 @@ def test_weighted_metrics(cls_frame):
         got_w = RegressionEvaluator(metricName=metric, weightCol="w").evaluate(rdf)
         got_dup = RegressionEvaluator(metricName=metric).evaluate(rdf_dup)
         assert got_w == pytest.approx(got_dup, rel=1e-9), metric
+
+
+def test_clustering_evaluator_silhouette(n_devices):
+    """ClusteringEvaluator (Spark surface): squaredEuclidean silhouette matches a
+    brute-force O(n^2) oracle of the same definition; cosine runs; degenerate
+    inputs raise."""
+    import pandas as pd
+
+    from spark_rapids_ml_tpu.evaluation import ClusteringEvaluator
+
+    rng = np.random.default_rng(3)
+    X = np.vstack(
+        [rng.normal(0, 1, (60, 4)), rng.normal(7, 1, (60, 4))]
+    ).astype(np.float64)
+    labels = np.repeat([0.0, 1.0], 60)
+    df = pd.DataFrame({"features": list(X), "prediction": labels})
+    ours = ClusteringEvaluator().evaluate(df)
+
+    D = ((X[:, None, :] - X[None, :, :]) ** 2).sum(-1)
+    s = np.zeros(len(labels))
+    for i in range(len(labels)):
+        own = labels == labels[i]
+        a = D[i][own].sum() / (own.sum() - 1)
+        b = min(D[i][labels == c].mean() for c in set(labels) if c != labels[i])
+        s[i] = (b - a) / max(a, b)
+    assert ours == pytest.approx(s.mean(), abs=1e-9)
+    assert ours > 0.8
+
+    # cosine needs direction-separated clusters (the origin-centered blob has
+    # random directions, so its cosine silhouette is legitimately low)
+    Xdir = np.vstack(
+        [rng.normal([5, 0, 0, 0], 0.3, (40, 4)), rng.normal([0, 5, 0, 0], 0.3, (40, 4))]
+    )
+    dfdir = pd.DataFrame(
+        {"features": list(Xdir), "prediction": np.repeat([0.0, 1.0], 40)}
+    )
+    assert ClusteringEvaluator(distanceMeasure="cosine").evaluate(dfdir) > 0.9
+    # weighted variant downweights half the points without crashing
+    dfw = df.assign(w=np.where(np.arange(120) % 2 == 0, 1.0, 0.2))
+    assert ClusteringEvaluator(weightCol="w").evaluate(dfw) > 0.8
+    with pytest.raises(ValueError):
+        ClusteringEvaluator(distanceMeasure="manhattan").evaluate(df)
+    one = pd.DataFrame({"features": list(X[:10]), "prediction": [0.0] * 10})
+    with pytest.raises(ValueError):
+        ClusteringEvaluator().evaluate(one)
+    # KMeans end-to-end: evaluator consumes a transform frame directly
+    from spark_rapids_ml_tpu.clustering import KMeans
+
+    km = KMeans(k=2, seed=0).fit(df[["features"]])
+    out = km.transform(df[["features"]])
+    assert ClusteringEvaluator().evaluate(out) > 0.8
