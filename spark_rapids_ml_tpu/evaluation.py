@@ -194,27 +194,24 @@ class BinaryClassificationEvaluator(
         return self.getOrDefault("metricName")
 
     def _evaluate(self, dataset: Any) -> float:
+        from .metrics.utils import (
+            area_under_pr,
+            area_under_roc,
+            binary_classification_sweep,
+        )
+
         raw = _col(dataset, self.getOrDefault("rawPredictionCol"))
         score = raw[:, 1] if raw.ndim == 2 else raw
         y = _col(dataset, self.getOrDefault("labelCol")).astype(np.float64)
         w = (
             _col(dataset, self.getOrDefault("weightCol")).astype(np.float64)
             if self.isDefined("weightCol")
-            else np.ones_like(y)
+            else None
         )
-        order = np.argsort(-score, kind="stable")
-        y, w = y[order], w[order]
-        tps = np.cumsum(w * y)
-        fps = np.cumsum(w * (1.0 - y))
-        tps = np.concatenate([[0.0], tps])
-        fps = np.concatenate([[0.0], fps])
-        P, N = tps[-1], fps[-1]
+        tps, fps = binary_classification_sweep(score, y, w)
         if self.getMetricName() == "areaUnderROC":
-            return float(np.trapezoid(tps / P, fps / N))
-        # areaUnderPR
-        recall = tps / P
-        precision = np.where(tps + fps > 0, tps / np.maximum(tps + fps, 1e-30), 1.0)
-        return float(np.trapezoid(precision, recall))
+            return area_under_roc(tps, fps)
+        return area_under_pr(tps, fps)
 
 
 class ClusteringEvaluator(Evaluator, HasFeaturesCol, HasPredictionCol, HasWeightCol):

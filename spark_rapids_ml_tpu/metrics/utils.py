@@ -71,3 +71,42 @@ def logistic_regression_objective(
         0.5 * (1.0 - l1r) * float(np.sum(coef**2)) + l1r * float(np.sum(np.abs(coef)))
     )
     return log_loss + penalty
+
+
+def binary_classification_sweep(score, y, w=None):
+    """Score-sorted cumulative (tps, fps) staircase for ROC/PR curves, with tied
+    scores GROUPED into single sweep points (Spark BinaryClassificationMetrics /
+    sklearn semantics — without grouping, AUC on tied scores depends on input row
+    order). Returns (tps, fps) arrays with a leading 0 point."""
+    import numpy as np
+
+    score = np.asarray(score, np.float64)
+    y = np.asarray(y, np.float64)
+    w = np.ones_like(y) if w is None else np.asarray(w, np.float64)
+    order = np.argsort(-score, kind="stable")
+    s, y, w = score[order], y[order], w[order]
+    tps = np.cumsum(w * y)
+    fps = np.cumsum(w * (1.0 - y))
+    # keep only the LAST index of each tied-score run (the threshold boundary)
+    keep = np.nonzero(np.diff(s))[0]
+    keep = np.concatenate([keep, [len(s) - 1]]) if len(s) else np.array([], int)
+    tps, fps = tps[keep], fps[keep]
+    return np.concatenate([[0.0], tps]), np.concatenate([[0.0], fps])
+
+
+def area_under_roc(tps, fps) -> float:
+    import numpy as np
+
+    P, N = tps[-1], fps[-1]
+    return float(np.trapezoid(tps / P, fps / N))
+
+
+def area_under_pr(tps, fps) -> float:
+    import numpy as np
+
+    P = tps[-1]
+    recall = tps / P
+    precision = np.where(
+        tps + fps > 0, tps / np.maximum(tps + fps, 1e-300), 1.0
+    )
+    return float(np.trapezoid(precision, recall))

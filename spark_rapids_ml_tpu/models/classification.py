@@ -145,8 +145,8 @@ class _LogisticRegressionParams(
     )
     # Spark LogisticRegression surface parity (reference classification.py:679-744):
     # aggregationDepth/maxBlockSizeInMB are Spark-executor tuning knobs with no TPU
-    # meaning (accepted, ignored); the coefficient/intercept bounds select Spark's
-    # box-constrained optimizer, which the backend doesn't implement -> CPU fallback.
+    # meaning (accepted, ignored); the coefficient/intercept bounds run NATIVELY
+    # via the projected fit (ops/logistic._projected_fit).
     maxBlockSizeInMB: Param[float] = Param(
         "undefined", "maxBlockSizeInMB",
         "Maximum stacked-block memory in MB (Spark tuning knob; ignored).",
@@ -154,22 +154,24 @@ class _LogisticRegressionParams(
     )
     lowerBoundsOnCoefficients: Param[Any] = Param(
         "undefined", "lowerBoundsOnCoefficients",
-        "Lower-bound matrix for box-constrained fitting (unsupported -> fallback).",
+        "Lower-bound matrix ((numCoefficientSets, numFeatures)) for the "
+        "box-constrained fit.",
         TypeConverters.toList,
     )
     upperBoundsOnCoefficients: Param[Any] = Param(
         "undefined", "upperBoundsOnCoefficients",
-        "Upper-bound matrix for box-constrained fitting (unsupported -> fallback).",
+        "Upper-bound matrix ((numCoefficientSets, numFeatures)) for the "
+        "box-constrained fit.",
         TypeConverters.toList,
     )
     lowerBoundsOnIntercepts: Param[Any] = Param(
         "undefined", "lowerBoundsOnIntercepts",
-        "Lower-bound vector for intercepts (unsupported -> fallback).",
+        "Lower-bound vector (numCoefficientSets) for the box-constrained fit.",
         TypeConverters.toList,
     )
     upperBoundsOnIntercepts: Param[Any] = Param(
         "undefined", "upperBoundsOnIntercepts",
-        "Upper-bound vector for intercepts (unsupported -> fallback).",
+        "Upper-bound vector (numCoefficientSets) for the box-constrained fit.",
         TypeConverters.toList,
     )
 
@@ -588,6 +590,29 @@ class LogisticRegressionModel(
         X = np.asarray(value, dtype=np.float32).reshape(1, -1)
         return self._transform_arrays(X)[self.getOrDefault("rawPredictionCol")][0]
 
+    def evaluate(self, dataset: Any) -> "LogisticRegressionSummary":
+        """Evaluate on a labeled dataset, returning the Spark summary surface —
+        computed natively (the reference converts to a pyspark model and
+        delegates, classification.py:1597-1601)."""
+        from ..core.dataset import _is_spark_df
+
+        out = self.transform(dataset)
+        if _is_spark_df(out):
+            out = out.toPandas()
+        label = np.asarray(out[self.getOrDefault("labelCol")], np.float64)
+        pred = np.asarray(out[self.getOrDefault("predictionCol")], np.float64)
+        weight = None
+        if self.hasParam("weightCol") and self.isDefined("weightCol"):
+            # a defined weightCol missing from the frame is an error, not a
+            # silent unweighted evaluation (Spark raises too)
+            weight = np.asarray(out[self.getOrDefault("weightCol")], np.float64)
+        if self.numClasses == 2:
+            prob = np.stack(out[self.getOrDefault("probabilityCol")].to_numpy())
+            return BinaryLogisticRegressionSummary(
+                out, label, pred, prob[:, 1], weight
+            )
+        return LogisticRegressionSummary(out, label, pred, weight)
+
     def _combine(
         self, models: List["LogisticRegressionModel"]
     ) -> "LogisticRegressionModel":
@@ -596,3 +621,114 @@ class LogisticRegressionModel(
         first = models[0]
         first._combined_models = models
         return first
+
+
+class LogisticRegressionSummary:
+    """Evaluation summary over a predictions frame — the surface of
+    pyspark.ml.classification.LogisticRegressionSummary, computed natively on the
+    metrics/ reduction classes (the reference's model.evaluate() converts to a
+    pyspark model and delegates, classification.py:1597-1601)."""
+
+    def __init__(
+        self,
+        predictions,
+        label: np.ndarray,
+        pred: np.ndarray,
+        weight: Optional[np.ndarray] = None,
+    ) -> None:
+        from ..metrics.MulticlassMetrics import MulticlassMetrics
+
+        self.predictions = predictions
+        self._m = MulticlassMetrics.from_predictions(label, pred, weight)
+        self._labels = sorted(set(np.asarray(label, np.float64).tolist()))
+
+    @property
+    def labels(self) -> List[float]:
+        return list(self._labels)
+
+    @property
+    def accuracy(self) -> float:
+        return self._m.accuracy()
+
+    @property
+    def weightedPrecision(self) -> float:
+        return self._m.weighted_precision()
+
+    @property
+    def weightedRecall(self) -> float:
+        return self._m.weighted_recall()
+
+    def weightedFMeasure(self, beta: float = 1.0) -> float:
+        return self._m.weighted_f_measure(beta)
+
+    @property
+    def weightedTruePositiveRate(self) -> float:
+        return self._m.weighted_recall()
+
+    @property
+    def weightedFalsePositiveRate(self) -> float:
+        return self._m.weighted_false_positive_rate()
+
+    @property
+    def precisionByLabel(self) -> List[float]:
+        return [self._m._precision(l) for l in self._labels]
+
+    @property
+    def recallByLabel(self) -> List[float]:
+        return [self._m._recall(l) for l in self._labels]
+
+    def fMeasureByLabel(self, beta: float = 1.0) -> List[float]:
+        return [self._m._f_measure(l, beta) for l in self._labels]
+
+    @property
+    def truePositiveRateByLabel(self) -> List[float]:
+        return self.recallByLabel
+
+    @property
+    def falsePositiveRateByLabel(self) -> List[float]:
+        return [self._m._false_positive_rate(l) for l in self._labels]
+
+
+class BinaryLogisticRegressionSummary(LogisticRegressionSummary):
+    """Adds the threshold-sweep metrics (areaUnderROC, roc/pr curves) for binary
+    models — pyspark.ml.classification.BinaryLogisticRegressionSummary surface."""
+
+    def __init__(
+        self,
+        predictions,
+        label: np.ndarray,
+        pred: np.ndarray,
+        score: np.ndarray,
+        weight: Optional[np.ndarray] = None,
+    ) -> None:
+        from ..metrics.utils import binary_classification_sweep
+
+        super().__init__(predictions, label, pred, weight)
+        self._tps, self._fps = binary_classification_sweep(score, label, weight)
+        self._P, self._N = self._tps[-1], self._fps[-1]
+
+    @property
+    def areaUnderROC(self) -> float:
+        from ..metrics.utils import area_under_roc
+
+        return area_under_roc(self._tps, self._fps)
+
+    @property
+    def roc(self):
+        import pandas as pd
+
+        return pd.DataFrame(
+            {"FPR": self._fps / self._N, "TPR": self._tps / self._P}
+        )
+
+    @property
+    def pr(self):
+        import pandas as pd
+
+        recall = self._tps / self._P
+        precision = np.where(
+            self._tps + self._fps > 0,
+            self._tps / np.maximum(self._tps + self._fps, 1e-300),
+            1.0,
+        )
+        return pd.DataFrame({"recall": recall, "precision": precision})

@@ -418,6 +418,28 @@ class LinearRegressionModel(
             f"No training summary available for this {self.__class__.__name__}"
         )
 
+    def evaluate(self, dataset: Any) -> "LinearRegressionSummary":
+        """Evaluate on a labeled dataset, returning the Spark summary surface —
+        computed natively (the reference exposes no evaluate/summary for
+        regression at all)."""
+        from ..core.dataset import _is_spark_df
+
+        out = self.transform(dataset)
+        if _is_spark_df(out):
+            out = out.toPandas()
+        label = np.asarray(out[self.getOrDefault("labelCol")], np.float64)
+        pred = np.asarray(out[self.getOrDefault("predictionCol")], np.float64)
+        weight = None
+        if self.hasParam("weightCol") and self.isDefined("weightCol"):
+            # a defined weightCol missing from the frame is an error, not a
+            # silent unweighted evaluation (Spark raises too)
+            weight = np.asarray(out[self.getOrDefault("weightCol")], np.float64)
+        return LinearRegressionSummary(
+            out, label, pred, weight,
+            num_features=self.numFeatures,
+            fit_intercept=bool(self.getOrDefault("fitIntercept")),
+        )
+
     def cpu(self):
         """sklearn LinearRegression twin with the fitted state installed."""
         from sklearn.linear_model import LinearRegression as SkLinReg
@@ -464,3 +486,53 @@ class LinearRegressionModel(
             + self.intercept
         )
         return {self.getOrDefault("predictionCol"): pred}
+
+
+class LinearRegressionSummary:
+    """Evaluation summary over a predictions frame — the surface of
+    pyspark.ml.regression.LinearRegressionSummary, computed natively on the
+    metrics/ reduction classes (the reference exposes no summary at all)."""
+
+    def __init__(
+        self,
+        predictions,
+        label: np.ndarray,
+        pred: np.ndarray,
+        weight: "np.ndarray | None" = None,
+        num_features: int = 0,
+        fit_intercept: bool = True,
+    ) -> None:
+        from ..metrics.RegressionMetrics import RegressionMetrics
+
+        self.predictions = predictions
+        self._m = RegressionMetrics.from_predictions(label, pred, weight)
+        self._n = len(np.asarray(label))
+        self._dof = max(self._n - num_features - (1 if fit_intercept else 0), 0)
+
+    @property
+    def rootMeanSquaredError(self) -> float:
+        return self._m.root_mean_squared_error
+
+    @property
+    def meanSquaredError(self) -> float:
+        return self._m.mean_squared_error
+
+    @property
+    def meanAbsoluteError(self) -> float:
+        return self._m.mean_absolute_error
+
+    @property
+    def r2(self) -> float:
+        return self._m.r2
+
+    @property
+    def explainedVariance(self) -> float:
+        return self._m.explained_variance
+
+    @property
+    def numInstances(self) -> int:
+        return self._n
+
+    @property
+    def degreesOfFreedom(self) -> int:
+        return self._dof

@@ -506,3 +506,49 @@ def test_logreg_bounds_edge_cases(n_devices):
     df1 = pd.DataFrame({"features": list(X), "label": np.ones(60)})
     m1 = LogisticRegression(upperBoundsOnIntercepts=[5.0]).fit(df1)
     assert m1.intercept == 5.0
+
+
+def test_model_evaluate_summaries(n_devices):
+    """model.evaluate(df) returns native Spark-surface summaries (the reference
+    delegates to pyspark via cpu() for LogReg and has nothing for LinReg)."""
+    from sklearn.metrics import roc_auc_score
+
+    rng = np.random.default_rng(11)
+    X = np.vstack([rng.normal(-1.5, 1, (80, 4)), rng.normal(1.5, 1, (80, 4))]).astype(
+        np.float32
+    )
+    y = np.repeat([0.0, 1.0], 80)
+    df = pd.DataFrame({"features": list(X), "label": y})
+
+    lr = LogisticRegression(maxIter=100).fit(df)
+    s = lr.evaluate(df)
+    assert 0.9 < s.accuracy <= 1.0
+    assert len(s.precisionByLabel) == 2 and len(s.recallByLabel) == 2
+    assert s.weightedFMeasure() == pytest.approx(
+        s.weightedFMeasure(1.0)
+    )
+    # binary summary: AUC agrees with sklearn on the same scores
+    prob = np.stack(lr.transform(df)["probability"].to_numpy())[:, 1]
+    assert s.areaUnderROC == pytest.approx(roc_auc_score(y, prob), abs=1e-6)
+    roc = s.roc
+    assert roc["FPR"].iloc[0] == 0.0 and roc["TPR"].iloc[-1] == 1.0
+    assert s.pr.shape[1] == 2
+
+    # multinomial summary has no ROC, but per-label metrics exist
+    y3 = rng.integers(0, 3, 160).astype(np.float64)
+    df3 = pd.DataFrame({"features": list(X), "label": y3})
+    s3 = LogisticRegression(family="multinomial", maxIter=50).fit(df3).evaluate(df3)
+    assert len(s3.labels) == 3
+    assert not hasattr(s3, "areaUnderROC")
+
+    # regression summary
+    yr = (X @ np.array([1.0, -2.0, 0.5, 3.0]) + 1.0).astype(np.float64)
+    dfr = pd.DataFrame({"features": list(X), "label": yr})
+    lin = LinearRegression().fit(dfr)
+    sr = lin.evaluate(dfr)
+    assert sr.r2 > 0.99
+    assert sr.rootMeanSquaredError == pytest.approx(
+        np.sqrt(sr.meanSquaredError)
+    )
+    assert sr.numInstances == 160
+    assert sr.degreesOfFreedom == 160 - 4 - 1

@@ -221,3 +221,27 @@ def test_clustering_evaluator_silhouette(n_devices):
     km = KMeans(k=2, seed=0).fit(df[["features"]])
     out = km.transform(df[["features"]])
     assert ClusteringEvaluator().evaluate(out) > 0.8
+
+
+def test_binary_sweep_tie_handling():
+    """Tied scores collapse to one sweep point: AUC on all-equal scores is exactly
+    0.5 regardless of row order (Spark/sklearn semantics; order-dependent before)."""
+    from spark_rapids_ml_tpu.metrics.utils import (
+        area_under_roc,
+        binary_classification_sweep,
+    )
+
+    y = np.array([1.0] * 10 + [0.0] * 10)  # positives first — the adversarial order
+    score = np.full(20, 0.5)
+    tps, fps = binary_classification_sweep(score, y)
+    assert area_under_roc(tps, fps) == pytest.approx(0.5)
+    # and agrees with sklearn on data WITH ties
+    from sklearn.metrics import roc_auc_score
+
+    rng = np.random.default_rng(0)
+    s = np.round(rng.random(300), 1)  # heavy ties
+    yy = (rng.random(300) < s).astype(np.float64)
+    tps, fps = binary_classification_sweep(s, yy)
+    assert area_under_roc(tps, fps) == pytest.approx(
+        roc_auc_score(yy, s), abs=1e-9
+    )
