@@ -410,6 +410,9 @@ class _TpuEstimator(_TpuCaller):
             model = self._create_pyspark_model(attrs)
             model._num_workers = self._num_workers
             model._float32_inputs = self._float32_inputs
+            # freshly-fit marker: training summaries exist only on fit() results,
+            # never after save/load (Spark semantics)
+            model._has_training_summary = True
             self._copyValues(model)
             models.append(model)
         return models

@@ -169,7 +169,9 @@ class KMeans(_KMeansClass, _TpuEstimator, _KMeansParams):
         return self._set_params(maxIter=value)  # type: ignore[return-value]
 
     def _out_schema(self) -> List[str]:
-        return ["cluster_centers", "inertia", "n_iter"]
+        # cluster_sizes feeds the training summary (absent on streamed/fallback
+        # fits; the model tolerates it)
+        return ["cluster_centers", "inertia", "n_iter", "cluster_sizes"]
 
     def _enable_fit_multiple_in_single_pass(self) -> bool:
         # the sharded design matrix is staged on the mesh ONCE and every param map's
@@ -191,19 +193,38 @@ class KMeans(_KMeansClass, _TpuEstimator, _KMeansParams):
                         f"{inputs.desc.m}; initialization would select padding rows "
                         "as centers."
                     )
-                results.append(
-                    kmeans_fit(
-                        inputs.features,
-                        inputs.row_weight,
-                        k=int(p["n_clusters"]),
-                        max_iter=int(p["max_iter"]),
-                        tol=float(p["tol"]),
-                        init=str(p["init"]),
-                        init_steps=int(p["init_steps"]),
-                        seed=int(p["random_state"]) if p["random_state"] is not None else 1,
-                        metric=str(p.get("metric", "euclidean")),
-                    )
+                res = kmeans_fit(
+                    inputs.features,
+                    inputs.row_weight,
+                    k=int(p["n_clusters"]),
+                    max_iter=int(p["max_iter"]),
+                    tol=float(p["tol"]),
+                    init=str(p["init"]),
+                    init_steps=int(p["init_steps"]),
+                    seed=int(p["random_state"]) if p["random_state"] is not None else 1,
+                    metric=str(p.get("metric", "euclidean")),
                 )
+                # one assignment pass for the training summary's clusterSizes
+                # (Spark KMeansSummary; the reference produces no summary). Done
+                # HERE — not inside kmeans_fit — so the IVF index builds that call
+                # the op directly never pay it. Counts ALL real rows (padding is
+                # positional: rows beyond desc.m), including user weight-0 rows,
+                # matching Spark's groupBy(prediction).count().
+                import jax.numpy as _jnp
+
+                from ..ops.kmeans import kmeans_predict
+
+                assign = np.asarray(
+                    kmeans_predict(
+                        inputs.features,
+                        _jnp.asarray(res["cluster_centers"]),
+                        cosine=str(p.get("metric", "euclidean")) == "cosine",
+                    )
+                )[: inputs.desc.m]
+                res["cluster_sizes"] = np.bincount(
+                    assign, minlength=int(p["n_clusters"])
+                ).astype(np.int64)
+                results.append(res)
             return results if extra_params is not None else results[0]
 
         return _fit
@@ -263,22 +284,44 @@ class KMeans(_KMeansClass, _TpuEstimator, _KMeansParams):
         }
 
 
+class KMeansSummary:
+    """Training summary surface of pyspark.ml.clustering.KMeansSummary."""
+
+    def __init__(
+        self, k: int, cluster_sizes: np.ndarray, training_cost: float, num_iter: int
+    ) -> None:
+        self.k = int(k)
+        self.clusterSizes = [int(s) for s in cluster_sizes]
+        self.trainingCost = float(training_cost)
+        self.numIter = int(num_iter)
+
+
 class KMeansModel(_KMeansClass, _TpuModelWithPredictionCol, _KMeansParams):
     """Fitted KMeans model (reference clustering.py:459-604)."""
 
     def __init__(
-        self, cluster_centers: np.ndarray, inertia: float, n_iter: int
+        self,
+        cluster_centers: np.ndarray,
+        inertia: float,
+        n_iter: int,
+        cluster_sizes: "np.ndarray | None" = None,
     ) -> None:
         super().__init__(
             cluster_centers=np.asarray(cluster_centers),
             inertia=float(inertia),
             n_iter=int(n_iter),
+            cluster_sizes=(
+                np.asarray(cluster_sizes) if cluster_sizes is not None else None
+            ),
         )
         self._setDefault(
             featuresCol="features",
             predictionCol="prediction",
             distanceMeasure="euclidean",
         )
+        # Spark semantics: a summary exists on a freshly-fit model only; loaded
+        # models have hasSummary=False. The estimator sets this flag after fit.
+        self._has_training_summary = False
 
     def clusterCenters(self) -> List[np.ndarray]:
         """Spark MLlib KMeansModel surface."""
@@ -286,14 +329,28 @@ class KMeansModel(_KMeansClass, _TpuModelWithPredictionCol, _KMeansParams):
 
     @property
     def hasSummary(self) -> bool:
-        """No training summary is produced (reference clustering.py:549-553)."""
-        return False
+        """True on a freshly-fit model (the reference always returns False,
+        clustering.py:549-553 — the TPU fit records the sizes at no extra cost
+        beyond one assignment pass)."""
+        return (
+            self._has_training_summary
+            and self._model_attributes.get("cluster_sizes") is not None
+        )
 
     @property
-    def summary(self):
-        """Spark raises when hasSummary is False; match it."""
-        raise RuntimeError(
-            f"No training summary available for this {self.__class__.__name__}"
+    def summary(self) -> KMeansSummary:
+        """KMeansSummary (k, clusterSizes, trainingCost, numIter); raises after
+        save/load like Spark."""
+        if not self.hasSummary:
+            raise RuntimeError(
+                f"No training summary available for this {self.__class__.__name__}"
+            )
+        a = self._model_attributes
+        return KMeansSummary(
+            k=a["cluster_centers"].shape[0],
+            cluster_sizes=a["cluster_sizes"],
+            training_cost=a["inertia"],
+            num_iter=a["n_iter"],
         )
 
     def cpu(self):

@@ -308,6 +308,9 @@ def fit_on_spark(estimator: Any, spark_df: Any, num_hosts: int) -> Any:
     model = estimator._create_pyspark_model(attrs)
     model._num_workers = estimator._num_workers
     model._float32_inputs = estimator._float32_inputs
+    # freshly-fit marker (same semantics as _fit_internal): training summaries
+    # exist on fit() results regardless of the data plane
+    model._has_training_summary = True
     estimator._copyValues(model)
     logger.info("fit_on_spark complete: %s", type(model).__name__)
     return model

@@ -154,3 +154,32 @@ def test_fast_math_config_matches_parity_clusters(n_devices):
     np.testing.assert_allclose(
         canon(parity.cluster_centers_), canon(fast.cluster_centers_), atol=1e-3
     )
+
+
+def test_kmeans_training_summary(n_devices):
+    """Freshly-fit models expose a KMeansSummary (clusterSizes/trainingCost/
+    numIter); loaded models do not — Spark semantics. The reference produces no
+    summary at all (clustering.py:549-553)."""
+    import os
+    import tempfile
+
+    rng = np.random.default_rng(4)
+    X = np.vstack(
+        [rng.normal(-4, 0.5, (70, 3)), rng.normal(4, 0.5, (30, 3))]
+    ).astype(np.float32)
+    df = pd.DataFrame({"features": list(X)})
+    m = KMeans(k=2, seed=1, maxIter=20).fit(df)
+    assert m.hasSummary
+    s = m.summary
+    assert s.k == 2
+    assert sorted(s.clusterSizes) == [30, 70]
+    assert s.trainingCost == pytest.approx(
+        m._model_attributes["inertia"]
+    )
+    assert s.numIter >= 1
+    with tempfile.TemporaryDirectory() as td:
+        m.save(os.path.join(td, "m"))
+        m2 = KMeansModel.load(os.path.join(td, "m"))
+        assert not m2.hasSummary
+        with pytest.raises(RuntimeError):
+            _ = m2.summary
