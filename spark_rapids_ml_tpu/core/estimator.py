@@ -734,3 +734,20 @@ class _TpuModelWithPredictionCol(_TpuModelWithColumns):
 
     def _out_schema(self) -> List[str]:
         return [self.getOrDefault("predictionCol")]
+
+
+def extract_eval_columns(model: "_TpuModel", dataset: Any):
+    """Shared plumbing for model.evaluate(): transform, land on pandas, and pull
+    (predictions_frame, label, prediction, weight). A defined weightCol missing
+    from the frame raises (Spark raises too, never silently unweights)."""
+    from .dataset import _is_spark_df
+
+    out = model.transform(dataset)
+    if _is_spark_df(out):
+        out = out.toPandas()
+    label = np.asarray(out[model.getOrDefault("labelCol")], np.float64)
+    pred = np.asarray(out[model.getOrDefault("predictionCol")], np.float64)
+    weight = None
+    if model.hasParam("weightCol") and model.isDefined("weightCol"):
+        weight = np.asarray(out[model.getOrDefault("weightCol")], np.float64)
+    return out, label, pred, weight

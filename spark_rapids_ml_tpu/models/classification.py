@@ -594,18 +594,9 @@ class LogisticRegressionModel(
         """Evaluate on a labeled dataset, returning the Spark summary surface —
         computed natively (the reference converts to a pyspark model and
         delegates, classification.py:1597-1601)."""
-        from ..core.dataset import _is_spark_df
+        from ..core.estimator import extract_eval_columns
 
-        out = self.transform(dataset)
-        if _is_spark_df(out):
-            out = out.toPandas()
-        label = np.asarray(out[self.getOrDefault("labelCol")], np.float64)
-        pred = np.asarray(out[self.getOrDefault("predictionCol")], np.float64)
-        weight = None
-        if self.hasParam("weightCol") and self.isDefined("weightCol"):
-            # a defined weightCol missing from the frame is an error, not a
-            # silent unweighted evaluation (Spark raises too)
-            weight = np.asarray(out[self.getOrDefault("weightCol")], np.float64)
+        out, label, pred, weight = extract_eval_columns(self, dataset)
         if self.numClasses == 2:
             prob = np.stack(out[self.getOrDefault("probabilityCol")].to_numpy())
             return BinaryLogisticRegressionSummary(

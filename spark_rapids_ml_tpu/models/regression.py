@@ -422,18 +422,9 @@ class LinearRegressionModel(
         """Evaluate on a labeled dataset, returning the Spark summary surface —
         computed natively (the reference exposes no evaluate/summary for
         regression at all)."""
-        from ..core.dataset import _is_spark_df
+        from ..core.estimator import extract_eval_columns
 
-        out = self.transform(dataset)
-        if _is_spark_df(out):
-            out = out.toPandas()
-        label = np.asarray(out[self.getOrDefault("labelCol")], np.float64)
-        pred = np.asarray(out[self.getOrDefault("predictionCol")], np.float64)
-        weight = None
-        if self.hasParam("weightCol") and self.isDefined("weightCol"):
-            # a defined weightCol missing from the frame is an error, not a
-            # silent unweighted evaluation (Spark raises too)
-            weight = np.asarray(out[self.getOrDefault("weightCol")], np.float64)
+        out, label, pred, weight = extract_eval_columns(self, dataset)
         return LinearRegressionSummary(
             out, label, pred, weight,
             num_features=self.numFeatures,

@@ -689,6 +689,18 @@ class RandomForestRegressionModel(_RandomForestModel):
     def _transform_arrays(self, X: np.ndarray) -> Dict[str, np.ndarray]:
         return {self.getOrDefault("predictionCol"): self._forest_outputs(X)[:, 0]}
 
+    def evaluate(self, dataset: Any):
+        """Regression summary on a labeled dataset (Spark model surface; computed
+        natively — the reference exposes no evaluate for forests)."""
+        from ..core.estimator import extract_eval_columns
+        from .regression import LinearRegressionSummary
+
+        out, label, pred, weight = extract_eval_columns(self, dataset)
+        return LinearRegressionSummary(
+            out, label, pred, weight, num_features=self.numFeatures,
+            fit_intercept=False,
+        )
+
 
 class RandomForestClassificationModel(
     _RandomForestModel, HasProbabilityCol, HasRawPredictionCol
@@ -720,3 +732,20 @@ class RandomForestClassificationModel(
             self.getOrDefault("probabilityCol"): prob,
             self.getOrDefault("rawPredictionCol"): prob * self.getNumTrees(),
         }
+
+    def evaluate(self, dataset: Any):
+        """Classification summary on a labeled dataset (Spark 3.1+
+        RandomForestClassificationSummary surface; binary models additionally get
+        the ROC/PR sweep). Computed natively — the reference exposes no evaluate
+        for forests."""
+        from ..core.estimator import extract_eval_columns
+        from .classification import (
+            BinaryLogisticRegressionSummary,
+            LogisticRegressionSummary,
+        )
+
+        out, label, pred, weight = extract_eval_columns(self, dataset)
+        if self.numClasses == 2:
+            prob = np.stack(out[self.getOrDefault("probabilityCol")].to_numpy())
+            return BinaryLogisticRegressionSummary(out, label, pred, prob[:, 1], weight)
+        return LogisticRegressionSummary(out, label, pred, weight)

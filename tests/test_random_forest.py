@@ -210,3 +210,25 @@ def test_forest_json_roundtrip(n_devices):
         rebuilt_r.transform(df_reg)["prediction"].to_numpy(),
         atol=1e-6,
     )
+
+
+def test_rf_evaluate_summaries(n_devices):
+    """RF models expose evaluate(df) -> native classification/regression
+    summaries (the reference has no forest evaluate at all)."""
+    rng = np.random.default_rng(6)
+    X = np.vstack([rng.normal(-2, 1, (60, 4)), rng.normal(2, 1, (60, 4))]).astype(
+        np.float32
+    )
+    y = np.repeat([0.0, 1.0], 60)
+    df = pd.DataFrame({"features": list(X), "label": y})
+    rfc = RandomForestClassifier(numTrees=5, maxDepth=4, seed=0).fit(df)
+    s = rfc.evaluate(df)
+    assert s.accuracy > 0.9
+    assert s.areaUnderROC > 0.9  # binary summary carries the sweep
+
+    yr = (X @ np.array([1.0, 2.0, -0.5, 0.3])).astype(np.float64)
+    dfr = pd.DataFrame({"features": list(X), "label": yr})
+    rfr = RandomForestRegressor(numTrees=10, maxDepth=6, seed=0).fit(dfr)
+    sr = rfr.evaluate(dfr)
+    assert sr.r2 > 0.8
+    assert sr.numInstances == 120
