@@ -351,3 +351,107 @@ def test_umap_random_configs(case, n_devices):
     assert np.isfinite(emb).all()
     t = trustworthiness(X, emb, n_neighbors=10)
     assert t > 0.75, (case, t)
+
+
+@pytest.mark.parametrize("case", range(8))
+def test_huber_random_configs(case, n_devices):
+    """Native huber vs sklearn HuberRegressor over random shapes/epsilon/intercept
+    (reg=0 where the objectives coincide exactly)."""
+    from sklearn.linear_model import HuberRegressor
+
+    from spark_rapids_ml_tpu.regression import LinearRegression
+
+    rng = _case_rng(3000 + case)
+    n = int(rng.integers(60, 400))
+    d = int(rng.integers(1, 12))
+    eps = float(rng.uniform(1.05, 2.5))
+    fit_intercept = bool(rng.integers(0, 2))
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    y = X @ rng.normal(size=d) + 0.05 * rng.normal(size=n)
+    out = rng.random(n) < 0.05
+    y[out] += rng.choice([-1, 1], out.sum()) * rng.uniform(5, 20, out.sum())
+    df = pd.DataFrame({"features": list(X), "label": y.astype(np.float64)})
+
+    m = LinearRegression(
+        loss="huber", epsilon=eps, fitIntercept=fit_intercept,
+        standardization=False, maxIter=300, tol=1e-9,
+    ).fit(df)
+    sk = HuberRegressor(
+        epsilon=eps, alpha=0.0, fit_intercept=fit_intercept, max_iter=1000
+    ).fit(X.astype(np.float64), y)
+    np.testing.assert_allclose(m.coefficients, sk.coef_, atol=5e-2, rtol=5e-2)
+    assert m.scale == pytest.approx(float(sk.scale_), rel=0.25, abs=1e-3)
+
+
+@pytest.mark.parametrize("case", range(6))
+def test_bounded_logreg_random_configs(case, n_devices):
+    """Native box-constrained LogReg vs scipy L-BFGS-B on the identical objective
+    over random bound patterns."""
+    from scipy.optimize import minimize
+
+    from spark_rapids_ml_tpu.classification import LogisticRegression
+
+    rng = _case_rng(4000 + case)
+    n = int(rng.integers(100, 400))
+    d = int(rng.integers(2, 8))
+    reg = float(rng.choice([0.0, 0.01, 0.1]))
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    beta = rng.normal(size=d) * 2
+    yprob = 1 / (1 + np.exp(-(X @ beta)))
+    y = (rng.random(n) < yprob).astype(np.float64)
+    if len(set(y)) < 2:
+        pytest.skip("degenerate draw")
+    # random box: each coef gets a lower bound 0 OR an upper bound 0 OR free
+    kind = rng.integers(0, 3, d)
+    lb = np.where(kind == 0, 0.0, -np.inf)
+    ub = np.where(kind == 1, 0.0, np.inf)
+    df = pd.DataFrame({"features": list(X), "label": y})
+    m = LogisticRegression(
+        maxIter=600, tol=1e-9, standardization=False, regParam=reg,
+        lowerBoundsOnCoefficients=[list(np.where(np.isfinite(lb), lb, -1e30))],
+        upperBoundsOnCoefficients=[list(np.where(np.isfinite(ub), ub, 1e30))],
+    ).fit(df)
+
+    def obj(p):
+        c, b = p[:d], p[d]
+        z = X @ c + b
+        return (np.logaddexp(0, z) - y * z).mean() + 0.5 * reg * np.sum(c * c)
+
+    res = minimize(
+        obj, np.zeros(d + 1), method="L-BFGS-B",
+        bounds=[(l if np.isfinite(l) else None, u if np.isfinite(u) else None)
+                for l, u in zip(lb, ub)] + [(None, None)],
+    )
+    np.testing.assert_allclose(m.coefficients, res.x[:d], atol=2e-2)
+
+
+@pytest.mark.parametrize("case", range(6))
+def test_silhouette_random_configs(case, n_devices):
+    """ClusteringEvaluator vs the O(n^2) brute-force silhouette across random
+    cluster counts/shapes/weights."""
+    from spark_rapids_ml_tpu.evaluation import ClusteringEvaluator
+
+    rng = _case_rng(5000 + case)
+    k = int(rng.integers(2, 6))
+    n = int(rng.integers(40, 200))
+    d = int(rng.integers(2, 10))
+    centers = rng.normal(size=(k, d)) * 4
+    labels = rng.integers(0, k, n)
+    X = centers[labels] + rng.normal(size=(n, d))
+    if len(set(labels.tolist())) < 2:
+        pytest.skip("degenerate draw")
+    df = pd.DataFrame(
+        {"features": list(X), "prediction": labels.astype(np.float64)}
+    )
+    ours = ClusteringEvaluator().evaluate(df)
+    D = ((X[:, None, :] - X[None, :, :]) ** 2).sum(-1)
+    s = np.zeros(n)
+    for i in range(n):
+        own = labels == labels[i]
+        if own.sum() == 1:
+            s[i] = 0.0
+            continue
+        a = D[i][own].sum() / (own.sum() - 1)
+        b = min(D[i][labels == c].mean() for c in set(labels.tolist()) if c != labels[i])
+        s[i] = (b - a) / max(a, b) if max(a, b) > 0 else 0.0
+    assert ours == pytest.approx(s.mean(), abs=1e-8)
