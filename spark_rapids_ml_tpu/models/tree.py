@@ -638,6 +638,26 @@ class _RandomForestModel(_RandomForestClass, _TpuModelWithPredictionCol, _Random
         attrs["num_classes"] = int(num_classes)
         return cls(**attrs)
 
+    @classmethod
+    def fromTreeliteJSON(
+        cls,
+        model_json: Any,
+        n_features: int | None = None,
+        num_classes: int = 0,
+    ) -> "_RandomForestModel":
+        """Import a treelite JSON dump — the format cuML forests serialize to and
+        the reference's models carry (reference tree.py:534-559 `dump_as_json`,
+        utils.py:700-809 node schema). Accepts the full model dict (with `trees` +
+        `num_feature`) or a bare list of tree dicts plus n_features. Classification
+        leaves may be `leaf_vector` class probabilities or scalar votes."""
+        from ..ops.trees import forest_from_treelite_json
+
+        attrs = forest_from_treelite_json(
+            model_json, cls._is_classification, n_features
+        )
+        attrs["num_classes"] = int(num_classes)
+        return cls(**attrs)
+
 
 class _DecisionTreeView:
     """One tree of a fitted forest: the standalone stand-in for Spark's

@@ -29,15 +29,37 @@ import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
 
-BLOCK_ROWS = 1024
+BLOCK_ROWS = 0  # 0 = adaptive (see _block_rows); tests may pin a fixed size
+
+
+def _block_rows(d: int) -> int:
+    """Row-block size targeting ~2 MiB of X per block: big enough to amortize DMA
+    issue latency (TPU-measured: 1024-row blocks pay ~10% over 4096 at d=128),
+    small enough that double-buffered blocks + the (B, 128-lane-padded) distance/
+    one-hot intermediates stay inside the 16 MiB scoped-VMEM budget at any d
+    (a lax.cond variant at 4096x512 was observed to blow exactly that limit)."""
+    if BLOCK_ROWS:
+        return BLOCK_ROWS
+    target = 2 * 1024 * 1024 // (max(d, 1) * 4)
+    return int(min(8192, max(512, 1 << (target.bit_length() - 1))))
 
 
 def _round_up(x: int, m: int) -> int:
     return ((x + m - 1) // m) * m
 
 
-def _lloyd_kernel(x_ref, w_ref, c_ref, c2_ref, sums_ref, counts_ref, inertia_ref):
-    """One row block: fused distances + argmin + weighted accumulation."""
+def _lloyd_kernel(
+    n_rows, x_ref, w_ref, c_ref, c2_ref, sums_ref, counts_ref, inertia_ref
+):
+    """One row block: fused distances + argmin + weighted accumulation.
+
+    The grid covers ceil(n / BLOCK_ROWS) blocks with NO host-side padding of X —
+    padding would copy the whole design matrix inside the jit, doubling HBM at
+    exactly the HBM-filling sizes this kernel exists for (observed OOM at 12M x 128
+    on a 16 GiB v5e). The ragged tail block is masked here instead: rows past
+    n_rows load unspecified values from the edge block, so both X and w are zeroed
+    before any arithmetic can propagate them (0 * garbage stays finite only when
+    the garbage never reaches a matmul — hence masking X itself, not just w)."""
     b = pl.program_id(0)
 
     @pl.when(b == 0)
@@ -50,6 +72,14 @@ def _lloyd_kernel(x_ref, w_ref, c_ref, c2_ref, sums_ref, counts_ref, inertia_ref
     w = w_ref[...]  # (B, 1)
     C = c_ref[...]  # (k, d)
     c2 = c2_ref[...]  # (1, k)
+
+    row0 = b * Xb.shape[0]
+    rows = row0 + jax.lax.broadcasted_iota(jnp.int32, (Xb.shape[0], 1), 0)
+    valid = rows < n_rows  # (B, 1) bool
+    # select, don't multiply: the edge block's unspecified region can be NaN
+    # (interpret mode fills it so) and 0 * NaN is NaN
+    Xb = jnp.where(valid, Xb, 0.0)
+    w = jnp.where(valid, w, 0.0)
 
     cross = jax.lax.dot_general(
         Xb, C, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
@@ -71,29 +101,42 @@ def _lloyd_kernel(x_ref, w_ref, c_ref, c2_ref, sums_ref, counts_ref, inertia_ref
     inertia_ref[...] += jnp.sum(w * d2min)[None, None]
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
 def lloyd_step_pallas(
     X: jax.Array,  # (n, d) f32
     w: jax.Array,  # (n,) f32 — 0 for padding rows
     centers: jax.Array,  # (k, d) f32
     interpret: bool = False,
+    blk: int | None = None,
 ):
     """One fused Lloyd accumulation pass. Returns (sums (k,d), counts (k,),
-    inertia scalar) — the caller forms new centers as sums/counts."""
+    inertia scalar) — the caller forms new centers as sums/counts.
+
+    blk resolves OUTSIDE the jitted inner so a test pinning the module-level
+    BLOCK_ROWS actually takes effect — the jit cache is keyed on the static blk,
+    never on the module global."""
+    return _lloyd_step_jit(
+        X, w, centers, interpret, blk if blk else _block_rows(X.shape[1])
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("interpret", "blk"))
+def _lloyd_step_jit(
+    X: jax.Array,
+    w: jax.Array,
+    centers: jax.Array,
+    interpret: bool,
+    blk: int,
+):
     n, d = X.shape
     k = centers.shape[0]
-    pad = (-n) % BLOCK_ROWS
-    if pad:
-        X = jnp.pad(X, ((0, pad), (0, 0)))
-        w = jnp.pad(w, ((0, pad),))
     c2 = jnp.sum(centers * centers, axis=1)[None, :]  # (1, k)
 
     sums, counts, inertia = pl.pallas_call(
-        _lloyd_kernel,
-        grid=(X.shape[0] // BLOCK_ROWS,),
+        functools.partial(_lloyd_kernel, n),
+        grid=((n + blk - 1) // blk,),
         in_specs=[
-            pl.BlockSpec((BLOCK_ROWS, d), lambda b: (b, 0)),
-            pl.BlockSpec((BLOCK_ROWS, 1), lambda b: (b, 0)),
+            pl.BlockSpec((blk, d), lambda b: (b, 0)),
+            pl.BlockSpec((blk, 1), lambda b: (b, 0)),
             pl.BlockSpec((k, d), lambda b: (0, 0)),
             pl.BlockSpec((1, k), lambda b: (0, 0)),
         ],
@@ -112,6 +155,81 @@ def lloyd_step_pallas(
     return sums, counts[0], inertia[0, 0]
 
 
+@functools.lru_cache(maxsize=None)
+def _fit_fn(mesh, interpret: bool, blk: int):
+    """Build (and cache) the jitted full-loop fit for a mesh/interpret/blk combo.
+
+    The whole Lloyd loop runs ON DEVICE as a lax.while_loop around the fused step —
+    a host-driven loop costs one host<->device round trip per iteration, which under
+    a remote-relay tunnel dominates everything (measured: 0.2 s/iter host-driven vs
+    the ~40 ms/iter kernel). One dispatch for the whole fit, like ops/kmeans.lloyd_fit.
+
+    The REPORTED inertia is recomputed against the final centers at parity
+    precision (pdot) outside the kernel — the kernel's own inertia accumulator
+    (default-precision matmul) only steers the convergence loop. This keeps the
+    fast_math contract from ops/kmeans.lloyd_fit: ranking-class matmuls may run
+    at bf16, anything reported as a model attribute stays parity-precision."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import DATA_AXIS
+    from ._precision import pdot
+
+    if mesh is not None and mesh.devices.size > 1:
+        from jax import shard_map
+
+        @functools.partial(
+            shard_map,
+            mesh=mesh,
+            in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P()),
+            out_specs=(P(), P(), P()),
+            check_vma=False,
+        )
+        def step(x_local, w_local, centers):
+            s, c, i = lloyd_step_pallas(
+                x_local, w_local, centers, interpret=interpret, blk=blk
+            )
+            return (
+                jax.lax.psum(s, DATA_AXIS),
+                jax.lax.psum(c, DATA_AXIS),
+                jax.lax.psum(i, DATA_AXIS),
+            )
+
+    else:
+        step = functools.partial(lloyd_step_pallas, interpret=interpret, blk=blk)
+
+    def fit(X, w, init_centers, tol, max_iter):
+        def cond(state):
+            _, _, it, shift2 = state
+            return jnp.logical_and(it < max_iter, shift2 > tol * tol)
+
+        def body(state):
+            centers, _, it, _ = state
+            sums, counts, inertia = step(X, w, centers)
+            new_centers = jnp.where(
+                counts[:, None] > 0,
+                sums / jnp.maximum(counts, 1.0)[:, None],
+                centers,
+            )
+            shift2 = jnp.max(jnp.sum((new_centers - centers) ** 2, axis=1))
+            return new_centers, inertia, it + 1, shift2
+
+        state = (
+            init_centers,
+            jnp.array(0.0, X.dtype),
+            jnp.array(0, jnp.int32),
+            jnp.array(jnp.inf, X.dtype),
+        )
+        centers, _, n_iter, _ = jax.lax.while_loop(cond, body, state)
+        # reported inertia: final centers, PARITY precision (see docstring)
+        x2 = jnp.sum(X * X, axis=1)
+        c2 = jnp.sum(centers * centers, axis=1)
+        d2 = x2[:, None] - 2.0 * pdot(X, centers.T) + c2[None, :]
+        inertia = jnp.sum(w * jnp.maximum(jnp.min(d2, axis=1), 0.0))
+        return centers, inertia, n_iter
+
+    return jax.jit(fit, static_argnames=("max_iter",))
+
+
 def lloyd_fit_pallas(
     X: jax.Array,
     w: jax.Array,
@@ -124,46 +242,7 @@ def lloyd_fit_pallas(
     """Full Lloyd loop over the fused kernel; identical convergence semantics to
     ops/kmeans.lloyd_fit (movement^2 <= tol^2). With a multi-device mesh the kernel
     runs per-shard under shard_map and the (sums, counts, inertia) partials psum."""
-    from jax.sharding import PartitionSpec as P
-
-    from ..parallel.mesh import DATA_AXIS
-
-    if mesh is not None and mesh.devices.size > 1:
-        from jax import shard_map
-
-        @functools.partial(
-            shard_map,
-            mesh=mesh,
-            in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P()),
-            out_specs=(P(), P(), P()),
-            check_vma=False,
-        )
-        def _step(x_local, w_local, centers):
-            s, c, i = lloyd_step_pallas(x_local, w_local, centers, interpret=interpret)
-            return (
-                jax.lax.psum(s, DATA_AXIS),
-                jax.lax.psum(c, DATA_AXIS),
-                jax.lax.psum(i, DATA_AXIS),
-            )
-
-        step = _step
-    else:
-        step = functools.partial(lloyd_step_pallas, interpret=interpret)
-
-    centers = init_centers
-    inertia = np.inf
-    n_iter = 0
-    for it in range(max_iter):
-        sums, counts, inertia_j = step(X, w, centers)
-        new_centers = jnp.where(
-            counts[:, None] > 0,
-            sums / jnp.maximum(counts, 1.0)[:, None],
-            centers,
-        )
-        shift2 = float(jnp.max(jnp.sum((new_centers - centers) ** 2, axis=1)))
-        centers = new_centers
-        inertia = float(inertia_j)
-        n_iter = it + 1
-        if shift2 <= tol * tol:
-            break
-    return centers, inertia, n_iter
+    centers, inertia, n_iter = _fit_fn(mesh, interpret, _block_rows(X.shape[1]))(
+        X, w, init_centers, jnp.asarray(tol, X.dtype), max_iter
+    )
+    return centers, float(inertia), int(n_iter)

@@ -420,6 +420,14 @@ def forest_from_json(
         raise ValueError("empty forest JSON")
     roots = [t["root"] for t in trees_json]
     max_depth = max(depth_of(r) for r in roots)
+    if max_depth > 20:
+        # the heap layout allocates 2^(depth+1) slots per tree: one depth-25
+        # branch in an imported (e.g. cuML-trained) forest would inflate every
+        # array by 2^26 slots — fail with the number instead of a MemoryError
+        raise ValueError(
+            f"forest depth {max_depth} exceeds the dense-heap import limit (20); "
+            f"re-train/dump with a bounded max_depth to import"
+        )
     v_dims = set()
 
     def leaf_dim(node: Dict) -> None:
@@ -464,3 +472,103 @@ def forest_from_json(
         "value": value,
         "bin_edges": np.zeros((n_features, 1), np.float32),
     }
+
+
+def _prev_f32_ftz(t: float) -> float:
+    """Largest float32 strictly below t UNDER XLA's flush-to-zero semantics.
+
+    nextafter(0.0, -inf) is a denormal, and XLA flushes denormals to +-0.0 — the
+    nudge silently vanishes and equality routes the wrong way (caught by driving
+    a '<' split at threshold 0.0). Denormal results are therefore snapped to the
+    nearest FTZ-representable neighbor: -tiny below zero, 0.0 for positive
+    denormals (consistent with denormal INPUTS also flushing to zero)."""
+    p = np.nextafter(np.float32(t), np.float32(-np.inf))
+    tiny = np.finfo(np.float32).tiny
+    if p != 0.0 and abs(p) < tiny:
+        p = np.float32(-tiny) if p < 0 else np.float32(0.0)
+    return float(p)
+
+
+def _treelite_tree_to_nested(tree: Dict, is_classification: bool) -> Dict:
+    """One treelite-JSON tree (flat `nodes` list keyed by node_id — the schema the
+    reference translates at utils.py:700-809) -> this module's nested dict.
+
+    Routing semantics: this framework's predict goes LEFT iff x[f] <= threshold.
+    Treelite records a comparison_op per split; for "<" the equality case must go
+    right, so the threshold is nudged to the previous float32 (x <= prev(t) iff
+    x < t for float32 inputs). "<=" imports unchanged.
+
+    Missing values: predict routes NaN LEFT (NaN > t is false), which matches
+    treelite's default_left=True. Nodes dumped with default_left=False would
+    misroute NaN features — flagged with a warning on import since this engine
+    has no per-node missing-direction bit.
+    """
+    nodes = {n["node_id"]: n for n in tree["nodes"]}
+    leaf_key = "leaf_class_probs" if is_classification else "leaf_value"
+    if any(
+        n.get("default_left") is False
+        for n in tree["nodes"]
+        if "left_child" in n
+    ):
+        import warnings
+
+        warnings.warn(
+            "treelite dump contains default_left=False splits; this engine "
+            "routes NaN/missing features LEFT, so predictions on rows with "
+            "missing values may differ from the source model",
+            stacklevel=3,
+        )
+
+    def conv(node_id: int) -> Dict:
+        n = nodes[node_id]
+        if "leaf_value" in n or "leaf_vector" in n:
+            v = n.get("leaf_vector", n.get("leaf_value"))
+            payload = list(v) if isinstance(v, (list, tuple)) else [float(v)]
+            if is_classification and len(payload) < 2:
+                raise ValueError(
+                    "classification import needs per-class leaf_vector "
+                    "probabilities (cuML RF dumps these); scalar leaves are "
+                    "margin/regression outputs"
+                )
+            return {leaf_key: payload}
+        op = n.get("comparison_op", "<=")
+        thr = float(n["threshold"])
+        if op == "<":
+            thr = _prev_f32_ftz(thr)
+        elif op != "<=":
+            raise ValueError(f"unsupported treelite comparison_op {op!r}")
+        return {
+            "split_feature": int(n["split_feature_id"]),
+            "threshold": thr,
+            "default_left": bool(n.get("default_left", True)),
+            "left_child": conv(n["left_child"]),
+            "right_child": conv(n["right_child"]),
+        }
+
+    return {"root": conv(int(tree.get("root_id", 0)))}
+
+
+def forest_from_treelite_json(
+    model_json: Dict | List[Dict],
+    is_classification: bool,
+    n_features: int | None = None,
+) -> Dict[str, np.ndarray]:
+    """Import a treelite JSON dump (cuML `dump_as_json`, what the reference's
+    models carry as `treelite_model` JSON, reference tree.py:534-559) into the
+    heap-layout forest arrays. Accepts either the full model dict (with `trees`
+    and `num_feature`) or a bare list of tree dicts (then n_features is required)."""
+    if isinstance(model_json, dict):
+        trees = model_json["trees"]
+        if n_features is None:
+            n_features = int(model_json.get("num_feature", 0)) or None
+    else:
+        trees = model_json
+    if n_features is None:
+        raise ValueError(
+            "n_features is required when the dump carries no num_feature"
+        )
+    nested = [
+        {"tree_id": i, **_treelite_tree_to_nested(t, is_classification)}
+        for i, t in enumerate(trees)
+    ]
+    return forest_from_json(nested, int(n_features), is_classification)

@@ -212,6 +212,127 @@ def test_forest_json_roundtrip(n_devices):
     )
 
 
+def test_forest_from_treelite_json(n_devices):
+    """Import of treelite-format JSON (cuML `dump_as_json` node schema, reference
+    utils.py:700-809): flat node lists with node_id/split_feature_id/threshold/
+    comparison_op/left_child/right_child, leaf_value or leaf_vector leaves.
+    Predictions are checked against hand-routing the same trees."""
+    from spark_rapids_ml_tpu.classification import RandomForestClassificationModel
+    from spark_rapids_ml_tpu.regression import RandomForestRegressionModel
+
+    # regression: one "<" tree (equality goes right) + one "<=" tree
+    reg_trees = [
+        {
+            "num_nodes": 5,
+            "nodes": [
+                {
+                    "node_id": 0, "split_feature_id": 0, "default_left": True,
+                    "node_type": "numerical_test_node", "comparison_op": "<",
+                    "threshold": 5.0, "left_child": 1, "right_child": 2,
+                },
+                {
+                    "node_id": 1, "split_feature_id": 2, "default_left": False,
+                    "node_type": "numerical_test_node", "comparison_op": "<",
+                    "threshold": -3.0, "left_child": 3, "right_child": 4,
+                },
+                {"node_id": 2, "leaf_value": 0.6},
+                {"node_id": 3, "leaf_value": -0.4},
+                {"node_id": 4, "leaf_value": 1.2},
+            ],
+        },
+        {
+            "num_nodes": 3,
+            "nodes": [
+                {
+                    "node_id": 0, "split_feature_id": 1,
+                    "comparison_op": "<=", "threshold": 0.0,
+                    "left_child": 1, "right_child": 2,
+                },
+                {"node_id": 1, "leaf_value": -1.0},
+                {"node_id": 2, "leaf_value": 2.0},
+            ],
+        },
+    ]
+    model = RandomForestRegressionModel.fromTreeliteJSON(
+        {"num_feature": 3, "trees": reg_trees}
+    )
+
+    def route(x):
+        t0 = 0.6 if x[0] >= 5.0 else (-0.4 if x[2] < -3.0 else 1.2)
+        t1 = -1.0 if x[1] <= 0.0 else 2.0
+        return (t0 + t1) / 2.0
+
+    probe = np.array(
+        [
+            [4.9, 0.0, -3.1],
+            [5.0, 0.1, -3.0],  # x0 == threshold with "<" must go RIGHT
+            [6.0, -2.0, 0.0],
+            [0.0, 5.0, 7.0],
+        ],
+        np.float32,
+    )
+    got = [model.predict(p) for p in probe]
+    want = [route(p) for p in probe]
+    np.testing.assert_allclose(got, want, atol=1e-6)
+
+    # "<" at threshold 0.0: the nudged threshold is a DENORMAL, which XLA
+    # flushes to zero — equality must still go right (regression: FTZ ate the
+    # nudge and routed left)
+    zero_tree = [
+        {
+            "num_nodes": 3,
+            "nodes": [
+                {
+                    "node_id": 0, "split_feature_id": 0,
+                    "comparison_op": "<", "threshold": 0.0,
+                    "left_child": 1, "right_child": 2,
+                },
+                {"node_id": 1, "leaf_value": -1.0},
+                {"node_id": 2, "leaf_value": 1.0},
+            ],
+        }
+    ]
+    zm = RandomForestRegressionModel.fromTreeliteJSON(
+        {"num_feature": 1, "trees": zero_tree}
+    )
+    df0 = pd.DataFrame(
+        {"features": list(np.array([[0.0], [-1e-39], [-1.0]], np.float32))}
+    )
+    # -1e-39 is a true f32 denormal: FTZ backends flush it to -0.0 (routes
+    # right), and on denormal-honoring backends it still exceeds the -tiny
+    # threshold (routes right) — consistent either way
+    np.testing.assert_allclose(
+        zm.transform(df0)["prediction"].to_numpy(), [1.0, 1.0, -1.0]
+    )
+
+    # classification: leaf_vector class probabilities
+    cls_trees = [
+        {
+            "num_nodes": 3,
+            "nodes": [
+                {
+                    "node_id": 0, "split_feature_id": 0,
+                    "comparison_op": "<=", "threshold": 1.5,
+                    "left_child": 1, "right_child": 2,
+                },
+                {"node_id": 1, "leaf_vector": [0.9, 0.1]},
+                {"node_id": 2, "leaf_vector": [0.2, 0.8]},
+            ],
+        }
+    ]
+    cm = RandomForestClassificationModel.fromTreeliteJSON(
+        {"num_feature": 2, "trees": cls_trees}, num_classes=2
+    )
+    assert cm.predict(np.array([1.0, 0.0])) == 0.0
+    assert cm.predict(np.array([2.0, 0.0])) == 1.0
+
+    # scalar leaves in a classification import are rejected with guidance
+    with pytest.raises(ValueError, match="leaf_vector"):
+        RandomForestClassificationModel.fromTreeliteJSON(
+            {"num_feature": 2, "trees": reg_trees[1:]}, num_classes=2
+        )
+
+
 def test_rf_evaluate_summaries(n_devices):
     """RF models expose evaluate(df) -> native classification/regression
     summaries (the reference has no forest evaluate at all)."""
