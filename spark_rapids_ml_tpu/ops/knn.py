@@ -81,6 +81,16 @@ def exact_knn_distributed(
     # (n_dev * k_local >= min(k_eff, n_total)) still covers the global top-k
     k_local = min(k_eff, shard_rows)
 
+    merge = _knn_local_then_merge_fn(mesh, shard_rows, k_local, k_eff)
+    d2, gidx = merge(jnp.asarray(Q), X_sharded, valid_sharded)
+    return np.sqrt(np.asarray(d2)), np.asarray(gidx)
+
+
+def _knn_local_then_merge_fn(mesh: Mesh, shard_rows: int, k_local: int, k_eff: int):
+    """The shard-mapped local-topk + all_gather merge step, exposed so tests can
+    lower it and assert the compiled collective structure (one gather batch, no
+    quadratic exchange)."""
+
     @functools.partial(
         shard_map,
         mesh=mesh,
@@ -101,8 +111,7 @@ def exact_knn_distributed(
         neg, pos = jax.lax.top_k(-d2_all, k_eff)
         return -neg, jnp.take_along_axis(gidx_all, pos, axis=1)
 
-    d2, gidx = _local_then_merge(jnp.asarray(Q), X_sharded, valid_sharded)
-    return np.sqrt(np.asarray(d2)), np.asarray(gidx)
+    return _local_then_merge
 
 
 # ---------------------------------------------------------------------------

@@ -113,17 +113,20 @@ def _histogram(
     use_pallas: bool = False,
     mesh=None,
 ) -> jax.Array:
-    """(n_nodes, d, nbins, s) histogram. On TPU this runs the pallas one-hot-matmul
-    kernel (ops/pallas_histogram.py — MXU contraction instead of XLA scatter):
-    single-device as a plain pallas_call, multi-device per-shard under shard_map
-    with a psum merge. The segment_sum fallback's replicated output makes XLA psum
-    partial histograms the same way."""
-    from .pallas_histogram import segment_histogram
+    """(n_nodes, d, nbins, s) histogram. On TPU this runs the FACTORED pallas
+    node x bin one-hot-matmul kernel (ops/pallas_histogram.py
+    node_bin_histogram_pallas — one MXU contraction per feature per row block,
+    cost independent of the flattened segment count): single-device as a plain
+    pallas_call, multi-device per-shard under shard_map with a psum merge. The
+    segment_sum fallback's replicated output makes XLA psum partial histograms
+    the same way — but note that XLA's scatter lowering has been observed to
+    crash the TPU compiler outright at >=1M rows, so on TPU the pallas path is
+    the production path, not an optimization."""
+    from .pallas_histogram import node_bin_histogram
 
-    seg_ids = node_id[:, None] * nbins + Xb  # (n, d)
-    hist = segment_histogram(seg_ids, values, n_nodes * nbins, use_pallas, mesh=mesh)
-    d = Xb.shape[1]
-    return hist.reshape(d, n_nodes, nbins, values.shape[1]).transpose(1, 0, 2, 3)
+    return node_bin_histogram(
+        Xb, node_id, values, n_nodes, nbins, use_pallas, mesh=mesh
+    )
 
 
 @functools.partial(

@@ -38,6 +38,55 @@ def test_pallas_zero_value_rows_ignored():
     np.testing.assert_allclose(np.asarray(got[0, :, 0]), [2.0, 3.0, 0.0, 0.0])
 
 
+@pytest.mark.parametrize(
+    "n,d,s,width,nbins",
+    [
+        (700, 4, 3, 8, 16),      # ragged rows (mask path), small level
+        (1024, 2, 5, 256, 8),    # full w_tile
+        (300, 9, 1, 300, 32),    # width > w_tile -> c-tiling; odd d -> d padding
+        (50, 3, 2, 1, 4),        # root level
+    ],
+)
+def test_node_bin_hist_matches_segment_sum(n, d, s, width, nbins):
+    """The factored node x bin kernel (v2) must match the flattened segment_sum
+    oracle for every tiling regime."""
+    from spark_rapids_ml_tpu.ops.pallas_histogram import node_bin_histogram_pallas
+
+    rng = np.random.default_rng(3)
+    Xb = jnp.asarray(rng.integers(0, nbins, size=(n, d)).astype(np.int32))
+    node = jnp.asarray(rng.integers(0, width, size=(n,)).astype(np.int32))
+    vals = jnp.asarray(rng.normal(size=(n, s)).astype(np.float32))
+
+    got = node_bin_histogram_pallas(Xb, node, vals, width, nbins, interpret=True)
+    seg = node[:, None] * nbins + Xb
+    ref = _ref_hist(seg, vals, width * nbins).reshape(d, width, nbins, s)
+    ref = jnp.transpose(ref, (1, 0, 2, 3))
+    assert got.shape == (width, d, nbins, s)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+
+def test_node_bin_hist_sharded_matches(n_devices):
+    """v2 kernel under shard_map+psum == global oracle."""
+    from spark_rapids_ml_tpu.ops.pallas_histogram import node_bin_histogram
+    from spark_rapids_ml_tpu.parallel.mesh import get_mesh, shard_array
+
+    rng = np.random.default_rng(4)
+    n, d, s, width, nbins = 1024, 5, 3, 16, 8
+    Xb = rng.integers(0, nbins, size=(n, d)).astype(np.int32)
+    node = rng.integers(0, width, size=(n,)).astype(np.int32)
+    vals = rng.normal(size=(n, s)).astype(np.float32)
+
+    mesh = get_mesh()
+    got = node_bin_histogram(
+        shard_array(Xb, mesh), shard_array(node, mesh), shard_array(vals, mesh),
+        width, nbins, use_pallas=True, mesh=mesh,
+    )
+    seg = jnp.asarray(node[:, None] * nbins + Xb)
+    ref = _ref_hist(seg, jnp.asarray(vals), width * nbins).reshape(d, width, nbins, s)
+    ref = jnp.transpose(ref, (1, 0, 2, 3))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+
 def test_forest_with_pallas_forced(n_devices, monkeypatch):
     """RF fit with the pallas histogram forced (interpret mode on CPU) must match
     the segment_sum path bit-for-bit."""
