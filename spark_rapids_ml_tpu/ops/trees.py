@@ -216,12 +216,34 @@ def build_tree(
         )
         wgt_arr = wgt_arr.at[slots].set(wT)
 
-        # route rows; leaf rows stay in the left child slot (unreachable at predict)
-        f = best_feat[node_id]
-        bsplit = best_bin[node_id]
-        go_right = (jnp.take_along_axis(Xb, f[:, None], axis=1)[:, 0] > bsplit) & ~(
-            is_leaf_t[node_id]
-        )
+        # route rows; leaf rows stay in the left child slot (unreachable at predict).
+        # The naive per-row lane gather (take_along_axis by best_feat[node]) is the
+        # slowest op class on TPU — measured 164 ms/level at 4M x 64, w=256. Two
+        # gather-free formulations (both bit-identical to the gather on hardware):
+        #  - matmul route: G=onehot(node) bf16, picked = rowsum((G @ onehot(feat)) * X)
+        #    (23.8 ms measured) — exact while the per-row one-hot sums and the bin
+        #    ids stay <= 256 (bf16 integer range) and G (n x width) fits HBM;
+        #  - row-gather route: A[node] for A=(width,d) one-hot + mask-sum (77 ms) —
+        #    no (n, width) intermediate, used for deep/wide levels.
+        leaf_f = is_leaf_t.astype(jnp.float32)
+        # n * width bound: G is a materialized (n, width) bf16 array — cap it at
+        # ~2.5 GiB so flagship-scale fits (12M rows) fall back to the row-gather
+        # route at deep levels instead of OOMing HBM
+        if width <= 256 and nbins <= 256 and n * width * 2 <= 2_500_000_000:
+            G = jax.nn.one_hot(node_id, width, dtype=jnp.bfloat16)
+            A = jax.nn.one_hot(best_feat, d, dtype=jnp.bfloat16)
+            picked = jnp.sum(
+                jnp.matmul(G, A).astype(jnp.float32) * Xb.astype(jnp.float32), axis=1
+            )
+            thr_r = jnp.matmul(G, best_bin.astype(jnp.bfloat16)[:, None])[:, 0]
+            leaf_r = jnp.matmul(G, leaf_f.astype(jnp.bfloat16)[:, None])[:, 0] > 0.5
+            go_right = (picked > thr_r.astype(jnp.float32)) & ~leaf_r
+        else:
+            A = jax.nn.one_hot(best_feat, d, dtype=jnp.float32)
+            picked = jnp.sum(A[node_id] * Xb.astype(jnp.float32), axis=1)
+            go_right = (picked > best_bin[node_id].astype(jnp.float32)) & ~(
+                is_leaf_t[node_id]
+            )
         node_id = node_id * 2 + go_right.astype(jnp.int32)
 
         # children stats carried from the winning split
