@@ -278,12 +278,33 @@ def predict_forest(
 ) -> jax.Array:
     """Average of per-tree leaf payloads, (n, v)."""
 
+    d = X.shape[1]
+    n_slots = feature.shape[1]
+    # the mask-sum route builds a (n_slots, d) one-hot table per tree — fine for
+    # trained forests (depth <= 12ish) but a vmapped OOM for deep imported
+    # forests (depth-20 heap = 2M slots); those keep the lane gather
+    use_mask_sum = n_slots * d <= (1 << 22)
+
     def one_tree(feat_t, thr_t, leaf_t, val_t):
+        # feature one-hot table rows instead of a per-row lane gather on X
+        # (same rewrite as build_tree routing: the lane gather is 2x slower
+        # than the table-row + mask-sum form on TPU). SELECT, don't multiply:
+        # 0 * NaN = NaN would let a NaN in any UNTESTED feature poison the
+        # picked value; with where() only the tested feature's value flows
+        # through, so NaN-in-tested-feature still compares False and routes
+        # LEFT — the documented treelite default_left=True contract.
+        if use_mask_sum:
+            A = jax.nn.one_hot(jnp.maximum(feat_t, 0), d, dtype=X.dtype) > 0
+
         def walk(carry, _):
             p = carry
             stop = leaf_t[p]
-            f = jnp.maximum(feat_t[p], 0)
-            go_right = jnp.take_along_axis(X, f[:, None], axis=1)[:, 0] > thr_t[p]
+            if use_mask_sum:
+                picked = jnp.sum(jnp.where(A[p], X, 0.0), axis=1)
+            else:
+                f = jnp.maximum(feat_t[p], 0)
+                picked = jnp.take_along_axis(X, f[:, None], axis=1)[:, 0]
+            go_right = picked > thr_t[p]
             p_next = p * 2 + go_right.astype(jnp.int32)
             return jnp.where(stop, p, p_next), None
 

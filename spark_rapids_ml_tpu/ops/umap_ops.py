@@ -226,18 +226,41 @@ def spectral_init(
         dinv = 1.0 / np.sqrt(np.maximum(deg, 1e-12))
         L = sp.identity(n) - sp.diags(dinv) @ W @ sp.diags(dinv)
         k_eig = n_components + 1
-        # shift-invert around 0 finds the smallest eigenvalues fast on kNN graphs
-        vals, vecs = spla.eigsh(
-            L, k=k_eig, sigma=0.0, which="LM",
-            v0=rng.normal(size=n), maxiter=2000, tol=1e-4,
-        )
+        # Smallest-eigenvector solve, sized to n. Shift-invert (sigma=0) is
+        # instant below ~10k but its sparse-LU fill-in takes MINUTES at n>=20k
+        # (observed hang at 20k/50k). Above that: the Laplacian spectrum lives
+        # in [0, 2], so the largest-algebraic eigenvectors of 2I - L are the
+        # smallest of L and Lanczos needs only cheap spmv products — with a
+        # widened Krylov basis (ncv), because a k-cluster graph has ~k
+        # near-degenerate eigenvalues at 0 and the default ncv=20 can stall
+        # exactly on the clustered datasets spectral init matters for.
+        if n < 10_000:
+            vals, vecs = spla.eigsh(
+                L, k=k_eig, sigma=0.0, which="LM",
+                v0=rng.normal(size=n), maxiter=2000, tol=1e-4,
+            )
+        else:
+            B = 2.0 * sp.identity(n) - L
+            vals_b, vecs = spla.eigsh(
+                B, k=k_eig, which="LA",
+                v0=rng.normal(size=n), maxiter=n,
+                ncv=min(n, max(6 * k_eig, 64)), tol=1e-4,
+            )
+            vals = 2.0 - vals_b
         order = np.argsort(vals)
         emb = vecs[:, order[1 : n_components + 1]]  # drop the trivial eigenvector
         # scale to the +-10 box the SGD expects
         emb = emb / np.maximum(np.abs(emb).max(axis=0, keepdims=True), 1e-12) * 10.0
         noise = rng.normal(0, 1e-4, size=emb.shape)
         return (emb + noise).astype(np.float32)
-    except Exception:
+    except Exception as e:
+        import warnings
+
+        warnings.warn(
+            f"UMAP spectral init failed ({type(e).__name__}: {e}); falling back "
+            f"to random init — embedding quality may degrade",
+            stacklevel=2,
+        )
         return rng.uniform(-10, 10, size=(n, n_components)).astype(np.float32)
 
 

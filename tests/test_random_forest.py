@@ -333,6 +333,44 @@ def test_forest_from_treelite_json(n_devices):
         )
 
 
+def test_predict_routes_nan_left(n_devices):
+    """NaN in the TESTED feature routes LEFT (treelite default_left=True
+    contract, documented on the importer); NaN in an UNTESTED feature must not
+    poison the picked value (regression: the mask-sum routing multiplied
+    0 * NaN = NaN and misrouted every such row)."""
+    from spark_rapids_ml_tpu.regression import RandomForestRegressionModel
+
+    trees = [
+        {
+            "num_nodes": 3,
+            "nodes": [
+                {
+                    "node_id": 0, "split_feature_id": 0,
+                    "comparison_op": "<=", "threshold": 0.0,
+                    "left_child": 1, "right_child": 2,
+                },
+                {"node_id": 1, "leaf_value": -1.0},
+                {"node_id": 2, "leaf_value": 1.0},
+            ],
+        }
+    ]
+    m = RandomForestRegressionModel.fromTreeliteJSON(
+        {"num_feature": 2, "trees": trees}
+    )
+    X = np.array(
+        [
+            [np.nan, 0.0],   # NaN in tested feature -> LEFT (-1)
+            [1.0, np.nan],   # NaN in untested feature -> ignore it, RIGHT (+1)
+            [-1.0, np.inf],  # inf untested -> ignore, LEFT
+        ],
+        np.float32,
+    )
+    df = pd.DataFrame({"features": list(X)})
+    np.testing.assert_allclose(
+        m.transform(df)["prediction"].to_numpy(), [-1.0, 1.0, -1.0]
+    )
+
+
 def test_rf_evaluate_summaries(n_devices):
     """RF models expose evaluate(df) -> native classification/regression
     summaries (the reference has no forest evaluate at all)."""
