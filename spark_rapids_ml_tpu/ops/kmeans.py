@@ -148,6 +148,31 @@ def _sample_by_d2(
     return X[idx]
 
 
+@functools.partial(jax.jit, static_argnames=("l", "steps"))
+def _oversample_rounds(
+    X: jax.Array, w: jax.Array, first: jax.Array, key: jax.Array, l: int, steps: int
+) -> jax.Array:
+    """All k-means|| oversampling rounds in ONE dispatch: the former host loop
+    synced candidates to host every round (2 relay round trips per step) and
+    recomputed distances against the WHOLE candidate set each time; here the
+    min-distance vector updates incrementally against only the new candidates
+    (O(steps·l·n·d) instead of O(steps²·l·n·d)). Returns (1 + steps·l, d)
+    candidates; already-chosen rows get d²=0 so they are ~never re-drawn, same
+    as the host version's behavior."""
+    n_c = 1 + steps * l
+    buf = jnp.zeros((n_c, X.shape[1]), X.dtype).at[0].set(first)
+    d2 = jnp.sum((X - first[None, :]) ** 2, axis=1)
+    for r in range(steps):
+        key, sub = jax.random.split(key)
+        logits = jnp.where(w > 0, jnp.log(d2 + 1e-30), -jnp.inf)
+        g = jax.random.gumbel(sub, logits.shape, dtype=X.dtype)
+        _, idx = jax.lax.top_k(logits + g, l)
+        newc = X[idx]
+        buf = jax.lax.dynamic_update_slice(buf, newc, (1 + r * l, 0))
+        d2 = jnp.minimum(d2, jnp.min(_sq_dists(X, newc), axis=1))
+    return buf
+
+
 def _cand_sq_dists(candidates: np.ndarray, centers: np.ndarray) -> np.ndarray:
     """(n_cand, k) squared distances via the matmul expansion — never materializes
     the (n_cand, k, d) broadcast (IVF builds call this with k in the thousands)."""
@@ -243,12 +268,11 @@ def kmeans_init(
     n_real = int(jnp.sum(w > 0))
     l = max(2, min(2 * k, n_real))  # never oversample past the real rows (padding)
     key, sub = jax.random.split(key)
-    cand = [np.asarray(_random_real_rows(X, w, 1, sub))]
-    for _ in range(max(init_steps, 1)):
-        key, sub = jax.random.split(key)
-        current = jnp.asarray(np.concatenate(cand, axis=0))
-        cand.append(np.asarray(_sample_by_d2(X, w, current, l, sub)))
-    candidates = np.concatenate(cand, axis=0)
+    first = _random_real_rows(X, w, 1, sub)[0]
+    key, sub = jax.random.split(key)
+    candidates = np.asarray(
+        _oversample_rounds(X, w, first, sub, l, max(init_steps, 1))
+    )
     # weight candidates by how many points they attract (one cheap pass)
     assign = np.asarray(kmeans_predict(X, jnp.asarray(candidates)))
     wh = np.asarray(w)
