@@ -191,19 +191,31 @@ def _weighted_kmeans_pp_once(
     p = weights / weights.sum()
     centers[0] = candidates[rng.choice(n, p=p)]
     d2 = np.sum((candidates - centers[0]) ** 2, axis=1)
+    # greedy k-means++ (sklearn-style): draw several d²-weighted trials per step
+    # and keep the one that minimizes the resulting potential — a single
+    # non-greedy draw can seed two centers in one heavy cluster and the local
+    # refinement below cannot always escape that basin
+    n_local_trials = 2 + int(np.log(k))
     for i in range(1, k):
         probs = weights * d2
         s = probs.sum()
         if s <= 0:
             centers[i] = candidates[rng.integers(n)]
-        else:
-            centers[i] = candidates[rng.choice(n, p=probs / s)]
-        d2 = np.minimum(d2, np.sum((candidates - centers[i]) ** 2, axis=1))
+            d2 = np.minimum(
+                d2, np.sum((candidates - centers[i]) ** 2, axis=1)
+            )
+            continue
+        trial_ids = rng.choice(n, size=n_local_trials, p=probs / s)
+        trial_d2 = _cand_sq_dists(candidates, candidates[trial_ids])  # (n, t)
+        new_d2 = np.minimum(d2[:, None], trial_d2)
+        potentials = (weights[:, None] * new_d2).sum(axis=0)
+        best_t = int(np.argmin(potentials))
+        centers[i] = candidates[trial_ids[best_t]]
+        d2 = new_d2[:, best_t]
 
     # local weighted Lloyd refinement over the (tiny) candidate set — Spark's
     # LocalKMeans runs the same after its ++ seeding; empty centers reseed at the
     # worst-covered candidate
-    cost = np.inf
     for _ in range(10):
         d2_all = _cand_sq_dists(candidates, centers)  # (n_cand, k)
         a = np.argmin(d2_all, axis=1)
@@ -229,13 +241,18 @@ def _weighted_kmeans_pp(
     weights: np.ndarray,
     k: int,
     rng: np.random.Generator,
-    restarts: int = 3,
+    restarts: int = 8,
 ) -> np.ndarray:
     """Host-side weighted k-means++ over the small candidate set (the final reduce
-    of scalable k-means++). A single ++ draw can seed two centers in one heavy
-    cluster and strand another in a local optimum the refinement cannot escape;
-    a few restarts scored by weighted candidate inertia make that mode vanishingly
-    unlikely at negligible cost."""
+    of scalable k-means++). Even the greedy ++ draw can land a poor basin the
+    refinement cannot escape; restarts scored by weighted candidate inertia make
+    that mode vanishingly unlikely at negligible cost (the candidate set is
+    ~(1 + steps·2k) rows). Large k (IVF coarse quantizers call this with
+    k=nlist in the thousands, candidates ~4k) caps restarts at 2: the greedy
+    trials already remove most of the need for restarts, and the per-restart
+    cost there is O(k²·t·d) host work."""
+    if k > 64:
+        restarts = min(restarts, 2)
     best = None
     best_cost = np.inf
     for _ in range(max(restarts, 1)):

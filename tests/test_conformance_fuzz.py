@@ -75,7 +75,7 @@ def test_logreg_random_configs(case, n_devices):
     assert acc > 1.5 / n_classes, (case, acc)
 
 
-@pytest.mark.parametrize("case", range(8))
+@pytest.mark.parametrize("case", range(16))
 def test_kmeans_random_configs(case, n_devices):
     from sklearn.cluster import KMeans as SkKMeans
 
@@ -92,7 +92,7 @@ def test_kmeans_random_configs(case, n_devices):
     sk = SkKMeans(n_clusters=k, n_init=5, random_state=0).fit(X.astype(np.float64))
     # Spark parity forces n_init=1 (reference clustering.py:317-319), so a single
     # draw can land a worse basin than sklearn's best-of-5; bound the gap
-    assert model.inertia_ <= sk.inertia_ * 1.25, (case, model.inertia_, sk.inertia_)
+    assert model.inertia_ <= sk.inertia_ * 1.15, (case, model.inertia_, sk.inertia_)
 
 
 @pytest.mark.parametrize("case", range(6))
