@@ -325,25 +325,20 @@ def kmeans_fit(
     init_centers = jnp.asarray(kmeans_init(X, w, k, init, init_steps, seed))
     from .. import config as _config
 
-    # the fused pallas Lloyd shares fast_math's numerics (bf16-class assignment
-    # matmul, model attributes still f32-accumulated) and is TPU-measured 1.5x
-    # faster than the XLA fast_math path (40 vs 60 ms/iter at 12M x 128, k=20) —
-    # so fast_math on a real TPU routes through it. SRML_TPU_PALLAS_KMEANS=1/0
-    # force-enables/disables regardless.
+    # The fused pallas Lloyd is an explicit opt-in (SRML_TPU_PALLAS_KMEANS=1), NOT
+    # the default and NOT tied to fast_math: steady-state TPU measurement at the
+    # bench shape (12M x 128, k=20, v5e) puts the XLA path at 18.7 ms/iter (~87%
+    # of the two-X-reads HBM roofline) vs 26.3/37.5 ms/iter for the fused kernel
+    # at 1-pass/6-pass precision — at small k both fused matmuls pad k to the
+    # 128-lane MXU width and the per-block argmin/one-hot VPU work dominates, so
+    # streaming X once does not pay. The kernel may still win at large k (less
+    # lane padding, and XLA's (n, k) intermediates grow); hence the escape hatch.
     _pallas_env = __import__("os").environ.get("SRML_TPU_PALLAS_KMEANS", "")
-    use_fused = not cosine and (
-        _pallas_env == "1"
-        or (
-            _pallas_env != "0"
-            and bool(_config.get("fast_math"))
-            and jax.default_backend() == "tpu"
-        )
-    )
+    use_fused = not cosine and _pallas_env == "1"
     if use_fused:
-        # fused pallas Lloyd: X streams HBM once per iteration (ops/pallas_kmeans.py);
-        # opt-in until profiled on live TPU hardware
         from jax.sharding import NamedSharding
 
+        from ._precision import parity_precision
         from .pallas_kmeans import lloyd_fit_pallas
 
         mesh = (
@@ -351,9 +346,15 @@ def kmeans_fit(
             if isinstance(getattr(X, "sharding", None), NamedSharding)
             else None
         )
+        prec = (
+            jax.lax.Precision.DEFAULT
+            if bool(_config.get("fast_math"))
+            else parity_precision()
+        )
         centers, inertia, n_iter = lloyd_fit_pallas(
             X, w, init_centers, float(tol), int(max_iter), mesh=mesh,
             interpret=(jax.default_backend() != "tpu"),
+            precision=prec,
         )
     else:
         centers, inertia, n_iter = lloyd_fit(

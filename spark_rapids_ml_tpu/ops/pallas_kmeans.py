@@ -15,9 +15,16 @@
 #
 # Single-device form (pallas_call has no GSPMD rule); the multi-device path wraps it
 # per-shard under shard_map with a psum merge, exactly like the histogram kernel
-# (ops/pallas_histogram.py). Off by default: enable with SRML_TPU_PALLAS_KMEANS=1
-# (a TPU-measured win should flip the default in a later round — this image has no
-# live TPU to profile).
+# (ops/pallas_histogram.py).
+#
+# MEASURED (v5e, 12M x 128, k=20, steady-state marginal per-iteration): XLA
+# lloyd_fit 18.7 ms/iter (~87% of its two-X-reads HBM roofline) vs this kernel at
+# 26.3 (1-pass) / 37.5 (6-pass parity) ms/iter. At small k the two MXU matmuls pad
+# k to the 128-lane width, so halving HBM traffic buys nothing — the kernel is
+# VPU/MXU-bound, not DMA-bound. It therefore stays an explicit opt-in
+# (SRML_TPU_PALLAS_KMEANS=1); the expected win region is large k (k >~ 128), where
+# lane padding vanishes and XLA's (n, k) distance/one-hot intermediates approach
+# the size of X itself.
 #
 
 from __future__ import annotations
@@ -31,25 +38,75 @@ from jax.experimental import pallas as pl
 
 BLOCK_ROWS = 0  # 0 = adaptive (see _block_rows); tests may pin a fixed size
 
+# MXU passes emulating each f32 precision tier via bf16 splitting (_dot_multipass)
+_N_SPLIT = {
+    jax.lax.Precision.DEFAULT: 1,
+    jax.lax.Precision.HIGH: 2,
+    jax.lax.Precision.HIGHEST: 3,
+}
 
-def _block_rows(d: int) -> int:
+
+def _block_rows(d: int, n_split: int = 1) -> int:
     """Row-block size targeting ~2 MiB of X per block: big enough to amortize DMA
     issue latency (TPU-measured: 1024-row blocks pay ~10% over 4096 at d=128),
     small enough that double-buffered blocks + the (B, 128-lane-padded) distance/
     one-hot intermediates stay inside the 16 MiB scoped-VMEM budget at any d
-    (a lax.cond variant at 4096x512 was observed to blow exactly that limit)."""
+    (a lax.cond variant at 4096x512 was observed to blow exactly that limit).
+    Multipass precision (n_split>1) materializes n_split bf16 copies of the X
+    block and the one-hot, so the block shrinks with it (3-split at 4096x128
+    was observed 2.56 MiB over the scoped-vmem limit)."""
     if BLOCK_ROWS:
         return BLOCK_ROWS
     target = 2 * 1024 * 1024 // (max(d, 1) * 4)
-    return int(min(8192, max(512, 1 << (target.bit_length() - 1))))
+    blk = int(min(8192, max(512, 1 << (target.bit_length() - 1))))
+    if n_split > 1:
+        blk = max(512, blk // 2)
+    return blk
 
 
 def _round_up(x: int, m: int) -> int:
     return ((x + m - 1) // m) * m
 
 
+def _split_bf16(x, n_split: int):
+    """Decompose f32 into n_split bf16 terms (x ≈ Σ parts): the classic
+    hi/lo residual split behind XLA's HIGH/HIGHEST f32 matmul emulation."""
+    parts = []
+    r = x
+    for _ in range(n_split):
+        p = r.astype(jnp.bfloat16)
+        parts.append(p)
+        r = r - p.astype(jnp.float32)
+    return parts
+
+
+def _dot_multipass(a, b, dims, n_split: int):
+    """dot_general with f32 operands emulated at higher precision via bf16
+    splitting: n_split=1 → single-pass MXU (DEFAULT numerics), 2 → 3 passes
+    (≙ Precision.HIGH), 3 → 6 passes (≙ Precision.HIGHEST ≈ full f32).
+    Mosaic rejects precision=HIGH/HIGHEST on this toolchain (NotImplementedError /
+    compile-helper crash, observed on v5e), so the decomposition is done by hand;
+    each pass is a native bf16×bf16→f32 MXU matmul."""
+    if n_split <= 1:
+        return jax.lax.dot_general(
+            a, b, dims, preferred_element_type=jnp.float32
+        )
+    pa = _split_bf16(a, n_split)
+    pb = _split_bf16(b, n_split)
+    acc = None
+    # terms ordered smallest-magnitude first so the f32 accumulation loses the
+    # least; skip terms whose combined order i+j >= n_split (below f32 ulp)
+    for i in range(n_split - 1, -1, -1):
+        for j in range(n_split - 1 - i, -1, -1):
+            t = jax.lax.dot_general(
+                pa[i], pb[j], dims, preferred_element_type=jnp.float32
+            )
+            acc = t if acc is None else acc + t
+    return acc
+
+
 def _lloyd_kernel(
-    n_rows, x_ref, w_ref, c_ref, c2_ref, sums_ref, counts_ref, inertia_ref
+    n_rows, n_split, x_ref, w_ref, c_ref, c2_ref, sums_ref, counts_ref, inertia_ref
 ):
     """One row block: fused distances + argmin + weighted accumulation.
 
@@ -81,9 +138,7 @@ def _lloyd_kernel(
     Xb = jnp.where(valid, Xb, 0.0)
     w = jnp.where(valid, w, 0.0)
 
-    cross = jax.lax.dot_general(
-        Xb, C, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    )  # (B, k)
+    cross = _dot_multipass(Xb, C, (((1,), (1,)), ((), ())), n_split)  # (B, k)
     # x2 cancels in the argmin; only the inertia needs it
     part = c2 - 2.0 * cross  # (B, k)
     assign = jnp.argmin(part, axis=1)  # (B,)
@@ -91,8 +146,8 @@ def _lloyd_kernel(
     cols = jax.lax.broadcasted_iota(jnp.int32, (Xb.shape[0], k), 1)
     onehot = (cols == assign[:, None]).astype(jnp.float32) * w  # (B, k) weighted
 
-    sums_ref[...] += jax.lax.dot_general(
-        onehot, Xb, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    sums_ref[...] += _dot_multipass(
+        onehot, Xb, (((0,), (0,)), ((), ())), n_split
     )  # (k, d)
     counts_ref[...] += jnp.sum(onehot, axis=0)[None, :]  # (1, k)
     x2 = jnp.sum(Xb * Xb, axis=1, keepdims=True)  # (B, 1)
@@ -107,32 +162,43 @@ def lloyd_step_pallas(
     centers: jax.Array,  # (k, d) f32
     interpret: bool = False,
     blk: int | None = None,
+    precision: jax.lax.Precision = jax.lax.Precision.DEFAULT,
 ):
     """One fused Lloyd accumulation pass. Returns (sums (k,d), counts (k,),
     inertia scalar) — the caller forms new centers as sums/counts.
 
     blk resolves OUTSIDE the jitted inner so a test pinning the module-level
     BLOCK_ROWS actually takes effect — the jit cache is keyed on the static blk,
-    never on the module global."""
+    never on the module global.
+
+    precision sets both MXU matmuls (assignment cross-term and one-hot update):
+    DEFAULT = single-pass bf16 class (fast_math numerics), HIGH = 3-pass,
+    HIGHEST = 6-pass f32 parity (emulated in-kernel via bf16 splitting — Mosaic
+    rejects the precision attribute itself on this toolchain). The kernel is
+    HBM-streaming-bound at the shapes it exists for, so the extra parity passes
+    ride mostly under the DMA floor."""
+    n_split = _N_SPLIT[precision]
     return _lloyd_step_jit(
-        X, w, centers, interpret, blk if blk else _block_rows(X.shape[1])
+        X, w, centers, interpret,
+        blk if blk else _block_rows(X.shape[1], n_split), n_split,
     )
 
 
-@functools.partial(jax.jit, static_argnames=("interpret", "blk"))
+@functools.partial(jax.jit, static_argnames=("interpret", "blk", "n_split"))
 def _lloyd_step_jit(
     X: jax.Array,
     w: jax.Array,
     centers: jax.Array,
     interpret: bool,
     blk: int,
+    n_split: int,
 ):
     n, d = X.shape
     k = centers.shape[0]
     c2 = jnp.sum(centers * centers, axis=1)[None, :]  # (1, k)
 
     sums, counts, inertia = pl.pallas_call(
-        functools.partial(_lloyd_kernel, n),
+        functools.partial(_lloyd_kernel, n, n_split),
         grid=((n + blk - 1) // blk,),
         in_specs=[
             pl.BlockSpec((blk, d), lambda b: (b, 0)),
@@ -156,7 +222,7 @@ def _lloyd_step_jit(
 
 
 @functools.lru_cache(maxsize=None)
-def _fit_fn(mesh, interpret: bool, blk: int):
+def _fit_fn(mesh, interpret: bool, blk: int, precision=jax.lax.Precision.DEFAULT):
     """Build (and cache) the jitted full-loop fit for a mesh/interpret/blk combo.
 
     The whole Lloyd loop runs ON DEVICE as a lax.while_loop around the fused step —
@@ -186,7 +252,8 @@ def _fit_fn(mesh, interpret: bool, blk: int):
         )
         def step(x_local, w_local, centers):
             s, c, i = lloyd_step_pallas(
-                x_local, w_local, centers, interpret=interpret, blk=blk
+                x_local, w_local, centers, interpret=interpret, blk=blk,
+                precision=precision,
             )
             return (
                 jax.lax.psum(s, DATA_AXIS),
@@ -195,7 +262,9 @@ def _fit_fn(mesh, interpret: bool, blk: int):
             )
 
     else:
-        step = functools.partial(lloyd_step_pallas, interpret=interpret, blk=blk)
+        step = functools.partial(
+            lloyd_step_pallas, interpret=interpret, blk=blk, precision=precision
+        )
 
     def fit(X, w, init_centers, tol, max_iter):
         def cond(state):
@@ -238,11 +307,16 @@ def lloyd_fit_pallas(
     max_iter: int,
     mesh=None,
     interpret: bool = False,
+    precision: jax.lax.Precision = jax.lax.Precision.DEFAULT,
 ):
     """Full Lloyd loop over the fused kernel; identical convergence semantics to
     ops/kmeans.lloyd_fit (movement^2 <= tol^2). With a multi-device mesh the kernel
-    runs per-shard under shard_map and the (sums, counts, inertia) partials psum."""
-    centers, inertia, n_iter = _fit_fn(mesh, interpret, _block_rows(X.shape[1]))(
-        X, w, init_centers, jnp.asarray(tol, X.dtype), max_iter
-    )
+    runs per-shard under shard_map and the (sums, counts, inertia) partials psum.
+
+    precision=HIGHEST makes the in-loop numerics match lloyd_fit's parity path
+    (f32 assignment + f32 update accumulation); DEFAULT matches fast_math."""
+    n_split = _N_SPLIT[precision]
+    centers, inertia, n_iter = _fit_fn(
+        mesh, interpret, _block_rows(X.shape[1], n_split), precision
+    )(X, w, init_centers, jnp.asarray(tol, X.dtype), max_iter)
     return centers, float(inertia), int(n_iter)

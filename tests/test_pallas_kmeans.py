@@ -34,18 +34,52 @@ def test_fused_step_matches_xla_accumulation():
     assert float(inertia) == pytest.approx(float((w * d2.min(1)).sum()), rel=1e-5)
 
 
-def test_fused_fit_matches_lloyd_fit(n_devices):
+@pytest.mark.parametrize("precision", ["DEFAULT", "HIGH", "HIGHEST"])
+def test_fused_fit_matches_lloyd_fit(n_devices, precision):
+    """Parity gate for the fused kernel at every precision tier: same centers,
+    inertia AND effective iteration count as the XLA parity path. On the CPU
+    interpret backend the DEFAULT tier is f32-exact too, so all three tiers must
+    match exactly; on real TPU the HIGHEST (6-pass) tier is the parity claim —
+    bench.py asserts the same live (fused_parity_ok)."""
+    import jax
+
     X, init = _blobs(n=512)
     w = np.ones((512,), np.float32)
     c_ref, in_ref, it_ref = lloyd_fit(
         jnp.asarray(X), jnp.asarray(w), jnp.asarray(init), 1e-6, 20
     )
     c_p, in_p, it_p = lloyd_fit_pallas(
-        jnp.asarray(X), jnp.asarray(w), jnp.asarray(init), 1e-6, 20, interpret=True
+        jnp.asarray(X), jnp.asarray(w), jnp.asarray(init), 1e-6, 20, interpret=True,
+        precision=getattr(jax.lax.Precision, precision),
     )
     np.testing.assert_allclose(np.asarray(c_p), np.asarray(c_ref), rtol=1e-4, atol=1e-3)
     assert in_p == pytest.approx(float(in_ref), rel=1e-4)
     assert it_p == int(it_ref)
+
+
+def test_multipass_dot_tightens_precision():
+    """The bf16-split emulation must actually add precision: 3-split (HIGHEST)
+    reproduces the f64 reference where 1-split (single MXU pass numerics on TPU)
+    would not. Interpret mode executes the same split arithmetic, so the
+    decomposition identity is checkable on CPU."""
+    from spark_rapids_ml_tpu.ops.pallas_kmeans import _dot_multipass
+
+    rng = np.random.default_rng(0)
+    a = (rng.normal(size=(64, 96)) * rng.uniform(0.1, 100, 96)).astype(np.float32)
+    b = rng.normal(size=(96, 32)).astype(np.float32)
+    ref = a.astype(np.float64) @ b.astype(np.float64)
+    dims = (((1,), (0,)), ((), ()))
+    # what a single bf16 MXU pass would produce (CPU dot is f32-exact, so the
+    # bf16 input rounding is simulated explicitly)
+    a16 = np.asarray(jnp.asarray(a).astype(jnp.bfloat16).astype(jnp.float32))
+    b16 = np.asarray(jnp.asarray(b).astype(jnp.bfloat16).astype(jnp.float32))
+    err_1pass = np.abs(a16 @ b16 - ref).max()
+    err3 = np.abs(
+        np.asarray(_dot_multipass(jnp.asarray(a), jnp.asarray(b), dims, 3)) - ref
+    ).max()
+    scale = np.abs(ref).max()
+    assert err3 <= 1e-6 * scale
+    assert err3 < err_1pass / 100  # decisively tighter than one bf16 pass
 
 
 def test_fused_fit_sharded(n_devices):
