@@ -47,8 +47,14 @@ class Evaluator(Params):
         from .core.dataset import _is_spark_df
 
         if _is_spark_df(dataset):
-            # columnar collect of just the evaluator's columns; the distributed
-            # evaluate path is the fused transform_evaluate_multi (core/estimator.py)
+            if self.supportsPartialAggregation():
+                # per-partition partials merged on the driver; the frame is
+                # never collected (reference core.py:1572-1693 executor scan)
+                from .spark.evaluate import evaluate_on_spark
+
+                return evaluate_on_spark(self, dataset)
+            # non-decomposable metric (AUC sweep, silhouette): collect just the
+            # evaluator's columns
             dataset = dataset.toPandas()
         return self._evaluate(dataset)
 
@@ -57,6 +63,34 @@ class Evaluator(Params):
 
     def isLargerBetter(self) -> bool:
         return True
+
+    # ---- mergeable partial aggregation (the executor/driver split behind the
+    # distributed one-pass transform+evaluate; reference computes the partials
+    # executor-side at classification.py:117-159 / regression.py:149-178 and
+    # merges on the driver) ----
+
+    def supportsPartialAggregation(self) -> bool:
+        """Whether this evaluator's metric decomposes into mergeable per-partition
+        partials. Evaluators without it (AUC sweeps, silhouette) force a
+        driver-side collect in the distributed evaluate path."""
+        return False
+
+    def _partial(self, dataset: Any) -> Any:
+        """Compute this partition's mergeable partial from a minimal pandas frame
+        of the evaluator's columns."""
+        raise NotImplementedError
+
+    def _evaluate_partials(self, partials: Any) -> float:
+        """Merge partition partials and finish the metric."""
+        import functools
+
+        return self._finish_partial(
+            functools.reduce(lambda a, b: a.merge(b), partials)
+        )
+
+    def _finish_partial(self, merged: Any) -> float:
+        """Turn the fully-merged partial into the metric value."""
+        raise NotImplementedError
 
 
 class RegressionEvaluator(Evaluator, HasLabelCol, HasPredictionCol, HasWeightCol):
@@ -86,17 +120,25 @@ class RegressionEvaluator(Evaluator, HasLabelCol, HasPredictionCol, HasWeightCol
         return self.getMetricName() in ("r2", "var")
 
     def _evaluate(self, dataset: Any) -> float:
+        return self._partial(dataset).evaluate(self.getMetricName())
+
+    def supportsPartialAggregation(self) -> bool:
+        return True
+
+    def _partial(self, dataset: Any) -> RegressionMetrics:
         w = (
             _col(dataset, self.getOrDefault("weightCol"))
             if self.isDefined("weightCol")
             else None
         )
-        metrics = RegressionMetrics.from_predictions(
+        return RegressionMetrics.from_predictions(
             _col(dataset, self.getOrDefault("labelCol")),
             _col(dataset, self.getOrDefault("predictionCol")),
             w,
         )
-        return metrics.evaluate(self.getMetricName())
+
+    def _finish_partial(self, merged: RegressionMetrics) -> float:
+        return merged.evaluate(self.getMetricName())
 
 
 class MulticlassClassificationEvaluator(
@@ -149,24 +191,33 @@ class MulticlassClassificationEvaluator(
         return self.getMetricName() not in ("logLoss", "hammingLoss")
 
     def _evaluate(self, dataset: Any) -> float:
-        name = self.getMetricName()
+        return self._evaluate_partials([self._partial(dataset)])
+
+    def supportsPartialAggregation(self) -> bool:
+        return True
+
+    def _partial(self, dataset: Any) -> MulticlassMetrics:
         probs = None
-        if name == "logLoss":
+        if self.getMetricName() == "logLoss":
             probs = _col(dataset, self.getOrDefault("probabilityCol"))
         w = (
             _col(dataset, self.getOrDefault("weightCol"))
             if self.isDefined("weightCol")
             else None
         )
-        metrics = MulticlassMetrics.from_predictions(
+        return MulticlassMetrics.from_predictions(
             _col(dataset, self.getOrDefault("labelCol")),
             _col(dataset, self.getOrDefault("predictionCol")),
             w,
             probs,
             eps=self.getOrDefault("eps"),
         )
-        return metrics.evaluate(
-            name, self.getOrDefault("metricLabel"), self.getOrDefault("beta")
+
+    def _finish_partial(self, merged: MulticlassMetrics) -> float:
+        return merged.evaluate(
+            self.getMetricName(),
+            self.getOrDefault("metricLabel"),
+            self.getOrDefault("beta"),
         )
 
 

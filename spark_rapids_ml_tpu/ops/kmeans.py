@@ -327,7 +327,7 @@ def kmeans_fit(
 
     # The fused pallas Lloyd is an explicit opt-in (SRML_TPU_PALLAS_KMEANS=1), NOT
     # the default and NOT tied to fast_math: steady-state TPU measurement at the
-    # bench shape (12M x 128, k=20, v5e) puts the XLA path at 18.7 ms/iter (~87%
+    # bench shape (12M x 128, k=20, v5e) puts the XLA path at 18.7 ms/iter (~92%
     # of the two-X-reads HBM roofline) vs 26.3/37.5 ms/iter for the fused kernel
     # at 1-pass/6-pass precision — at small k both fused matmuls pad k to the
     # 128-lane MXU width and the per-block argmin/one-hot VPU work dominates, so

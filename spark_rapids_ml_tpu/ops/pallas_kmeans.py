@@ -18,7 +18,7 @@
 # (ops/pallas_histogram.py).
 #
 # MEASURED (v5e, 12M x 128, k=20, steady-state marginal per-iteration): XLA
-# lloyd_fit 18.7 ms/iter (~87% of its two-X-reads HBM roofline) vs this kernel at
+# lloyd_fit 18.7 ms/iter (~92% of its two-X-reads HBM roofline) vs this kernel at
 # 26.3 (1-pass) / 37.5 (6-pass parity) ms/iter. At small k the two MXU matmuls pad
 # k to the 128-lane width, so halving HBM traffic buys nothing — the kernel is
 # VPU/MXU-bound, not DMA-bound. It therefore stays an explicit opt-in

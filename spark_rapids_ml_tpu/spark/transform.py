@@ -26,8 +26,12 @@ from ..utils import get_logger
 
 # per-python-worker-process model cache: one deserialization per broadcast, not per
 # batch/partition (the reference caches via `_construct_cuml_object` once per task,
-# core.py:1868-1878; caching per process is strictly better)
+# core.py:1868-1878; caching per process is strictly better). FIFO-bounded: a
+# CrossValidator broadcasts a fresh payload per fold, and an unbounded dict would
+# pin every fold's deserialized model list in executor memory for the process
+# lifetime.
 _WORKER_MODELS: Dict[Any, Any] = {}
+_WORKER_MODELS_MAX = 4
 
 # Spark torrent broadcast caps a single value at 8 GiB; large models (UMAP holds
 # embedding + raw data) ship as multiple chunked broadcasts the worker reassembles
@@ -49,6 +53,8 @@ def _worker_model(bcasts: list) -> Any:
         import pickle
 
         model = pickle.loads(b"".join(bytes(b.value) for b in bcasts))
+        while len(_WORKER_MODELS) >= _WORKER_MODELS_MAX:
+            _WORKER_MODELS.pop(next(iter(_WORKER_MODELS)))
         _WORKER_MODELS[key] = model
     return model
 

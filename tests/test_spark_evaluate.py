@@ -1,0 +1,176 @@
+"""Distributed one-pass transform+evaluate (spark/evaluate.py): partial metrics
+computed per partition inside mapInPandas, merged on the driver — the fold is never
+collected (reference core.py:1572-1693). Exercised against the same Spark-DataFrame
+protocol mock as the transform plane (pyspark is not installed in this image)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from tests.test_spark_transform import FakeSparkDF
+
+
+def _labeled_pdf(n=80, d=4, seed=0, n_classes=2):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    y = (X @ rng.normal(size=d) > 0).astype(np.float64)
+    if n_classes > 2:
+        y = (np.abs(X @ rng.normal(size=d)) * n_classes / 3).astype(int) % n_classes
+        y = y.astype(np.float64)
+    return pd.DataFrame({"features": list(X), "label": y})
+
+
+def test_multiclass_evaluate_never_collects_fold():
+    from spark_rapids_ml_tpu.classification import LogisticRegression
+    from spark_rapids_ml_tpu.core.estimator import transform_evaluate_multi
+    from spark_rapids_ml_tpu.evaluation import MulticlassClassificationEvaluator
+
+    pdf = _labeled_pdf(n_classes=3)
+    model = LogisticRegression(maxIter=40).fit(pdf)
+    ev = MulticlassClassificationEvaluator(metricName="f1")
+    expected = transform_evaluate_multi([model], pdf, ev)
+
+    sdf = FakeSparkDF(pdf, n_partitions=4)
+    got = transform_evaluate_multi([model], sdf, ev)
+    assert sdf.full_collects == 0  # the fold itself was NEVER collected
+    assert len(sdf.map_in_pandas_calls) == 1
+    assert sdf.map_in_pandas_calls[0] == "model_index bigint, partial binary"
+    np.testing.assert_allclose(got, expected, rtol=1e-12)
+
+
+@pytest.mark.parametrize("metric", ["rmse", "r2", "mae"])
+def test_regression_evaluate_partials_match_local(metric):
+    from spark_rapids_ml_tpu.core.estimator import transform_evaluate_multi
+    from spark_rapids_ml_tpu.evaluation import RegressionEvaluator
+    from spark_rapids_ml_tpu.regression import LinearRegression
+
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(90, 5)).astype(np.float32)
+    y = X @ rng.normal(size=5) + rng.normal(0, 0.1, 90)
+    pdf = pd.DataFrame({"features": list(X), "label": y})
+    model = LinearRegression().fit(pdf)
+    ev = RegressionEvaluator(metricName=metric)
+    expected = transform_evaluate_multi([model], pdf, ev)
+
+    sdf = FakeSparkDF(pdf, n_partitions=3)
+    got = transform_evaluate_multi([model], sdf, ev)
+    assert sdf.full_collects == 0
+    np.testing.assert_allclose(got, expected, rtol=1e-9)
+
+
+def test_multimodel_single_scan():
+    """All models of a fitMultiple grid evaluate in ONE mapInPandas scan."""
+    from spark_rapids_ml_tpu.classification import LogisticRegression
+    from spark_rapids_ml_tpu.core.estimator import transform_evaluate_multi
+    from spark_rapids_ml_tpu.evaluation import MulticlassClassificationEvaluator
+
+    pdf = _labeled_pdf(n=100)
+    models = [
+        LogisticRegression(maxIter=30, regParam=r).fit(pdf) for r in (0.0, 0.1, 1.0)
+    ]
+    ev = MulticlassClassificationEvaluator(metricName="accuracy")
+    expected = transform_evaluate_multi(models, pdf, ev)
+
+    sdf = FakeSparkDF(pdf, n_partitions=3)
+    got = transform_evaluate_multi(models, sdf, ev)
+    assert len(sdf.map_in_pandas_calls) == 1  # one scan for all 3 models
+    assert sdf.full_collects == 0
+    np.testing.assert_allclose(got, expected, rtol=1e-12)
+    assert got[0] != got[2]  # regularization actually changed the model
+
+
+def test_weighted_logloss_partials():
+    from spark_rapids_ml_tpu.classification import LogisticRegression
+    from spark_rapids_ml_tpu.core.estimator import transform_evaluate_multi
+    from spark_rapids_ml_tpu.evaluation import MulticlassClassificationEvaluator
+
+    pdf = _labeled_pdf(n=70)
+    pdf["w"] = np.random.default_rng(1).uniform(0.5, 2.0, len(pdf))
+    model = LogisticRegression(maxIter=40).fit(pdf)
+    ev = MulticlassClassificationEvaluator(metricName="logLoss", weightCol="w")
+    expected = transform_evaluate_multi([model], pdf, ev)
+    sdf = FakeSparkDF(pdf, n_partitions=4)
+    got = transform_evaluate_multi([model], sdf, ev)
+    assert sdf.full_collects == 0
+    np.testing.assert_allclose(got, expected, rtol=1e-12)
+
+
+def test_non_decomposable_evaluator_falls_back_to_collect():
+    """AUC does not decompose into mergeable partials; Spark input collects
+    (matching the reference's fallback for unsupported evaluators) and still
+    produces the right score."""
+    from spark_rapids_ml_tpu.classification import LogisticRegression
+    from spark_rapids_ml_tpu.core.estimator import transform_evaluate_multi
+    from spark_rapids_ml_tpu.evaluation import BinaryClassificationEvaluator
+
+    pdf = _labeled_pdf(n=60)
+    model = LogisticRegression(maxIter=30).fit(pdf)
+    ev = BinaryClassificationEvaluator()
+    assert not ev.supportsPartialAggregation()
+    expected = transform_evaluate_multi([model], pdf, ev)
+    sdf = FakeSparkDF(pdf, n_partitions=2)
+    got = transform_evaluate_multi([model], sdf, ev)
+    assert sdf.full_collects == 1  # collect fallback, by design
+    np.testing.assert_allclose(got, expected, rtol=1e-12)
+
+
+def test_plain_evaluator_on_spark_df_distributes():
+    """evaluator.evaluate(spark_df) on an already-transformed frame also runs the
+    partial scan instead of collecting."""
+    from spark_rapids_ml_tpu.evaluation import MulticlassClassificationEvaluator
+
+    rng = np.random.default_rng(5)
+    frame = pd.DataFrame(
+        {
+            "label": rng.integers(0, 2, 50).astype(np.float64),
+            "prediction": rng.integers(0, 2, 50).astype(np.float64),
+        }
+    )
+    ev = MulticlassClassificationEvaluator(metricName="accuracy")
+    expected = ev.evaluate(frame)
+    sdf = FakeSparkDF(frame, n_partitions=3)
+    got = ev.evaluate(sdf)
+    assert sdf.full_collects == 0
+    assert sdf.map_in_pandas_calls == ["partial binary"]
+    np.testing.assert_allclose(got, expected, rtol=1e-12)
+
+
+def test_partial_merge_associativity():
+    """Metric from merged partition partials == metric from the whole frame, for
+    every supported metric name (the merge is the correctness load-bearing step)."""
+    from spark_rapids_ml_tpu.evaluation import (
+        MulticlassClassificationEvaluator,
+        RegressionEvaluator,
+    )
+
+    rng = np.random.default_rng(7)
+    n = 101  # deliberately not divisible by the chunk count
+    labels = rng.integers(0, 3, n).astype(np.float64)
+    preds = rng.integers(0, 3, n).astype(np.float64)
+    probs = rng.dirichlet(np.ones(3), n)
+    w = rng.uniform(0.1, 3.0, n)
+    frame = pd.DataFrame(
+        {
+            "label": labels,
+            "prediction": preds,
+            "probability": list(probs),
+            "w": w,
+        }
+    )
+    chunks = np.array_split(np.arange(n), 4)
+    for name in ("f1", "accuracy", "weightedPrecision", "logLoss", "hammingLoss"):
+        ev = MulticlassClassificationEvaluator(metricName=name, weightCol="w")
+        whole = ev.evaluate(frame)
+        partials = [ev._partial(frame.iloc[c].reset_index(drop=True)) for c in chunks]
+        merged = ev._evaluate_partials(partials)
+        np.testing.assert_allclose(merged, whole, rtol=1e-12, err_msg=name)
+
+    y = rng.normal(size=n)
+    p = y + rng.normal(0, 0.3, n)
+    rframe = pd.DataFrame({"label": y, "prediction": p, "w": w})
+    for name in ("rmse", "mse", "r2", "mae", "var"):
+        ev = RegressionEvaluator(metricName=name, weightCol="w")
+        whole = ev.evaluate(rframe)
+        partials = [ev._partial(rframe.iloc[c].reset_index(drop=True)) for c in chunks]
+        merged = ev._evaluate_partials(partials)
+        np.testing.assert_allclose(merged, whole, rtol=1e-12, err_msg=name)
