@@ -666,11 +666,12 @@ class _TpuModel(_TpuClass, _TpuParams):
 
 def model_eval_frames(
     models: Sequence["_TpuModel"], pdf: Any, evaluator: Any
-) -> List[Any]:
+) -> Iterator[Any]:
     """One feature extraction over `pdf`, then per model a MINIMAL pandas frame of
-    exactly the evaluator's columns (predictions + label + weight). Shared by the
-    local one-pass evaluate and the per-partition executor scan of the distributed
-    plane (spark/evaluate.py)."""
+    exactly the evaluator's columns (predictions + label + weight), yielded one at
+    a time so only one model's frame is ever alive. Shared by the local one-pass
+    evaluate and the per-partition executor scan of the distributed plane
+    (spark/evaluate.py)."""
     import pandas as pd
 
     m0 = models[0]
@@ -696,7 +697,6 @@ def model_eval_frames(
     def _colify(v):
         return v if np.ndim(v) == 1 else list(v)
 
-    frames = []
     for m in models:
         outputs = m._transform_arrays(X)
         cols: Dict[str, Any] = {name: _colify(v) for name, v in outputs.items()}
@@ -704,8 +704,7 @@ def model_eval_frames(
             cols[label_col] = fd.label
         if weight_col is not None and fd.weight is not None:
             cols[weight_col] = fd.weight
-        frames.append(pd.DataFrame(cols))
-    return frames
+        yield pd.DataFrame(cols)
 
 
 def transform_evaluate_multi(

@@ -348,6 +348,11 @@ class UMAPModel(_UMAPClass, _TpuModelWithColumns, _UMAPParams):
         metric: str = "euclidean",
         metric_kwds: Optional[Dict[str, Any]] = None,
         local_connectivity: float = 1.0,
+        n_epochs: int = 200,
+        negative_sample_rate: int = 5,
+        learning_rate: float = 1.0,
+        repulsion_strength: float = 1.0,
+        random_state: int = 42,
     ) -> None:
         from ..core.dataset import _is_sparse
 
@@ -360,6 +365,11 @@ class UMAPModel(_UMAPClass, _TpuModelWithColumns, _UMAPParams):
             metric=str(metric),
             metric_kwds=dict(metric_kwds) if metric_kwds else {},
             local_connectivity=float(local_connectivity),
+            n_epochs=int(n_epochs),
+            negative_sample_rate=int(negative_sample_rate),
+            learning_rate=float(learning_rate),
+            repulsion_strength=float(repulsion_strength),
+            random_state=int(random_state),
         )
         self._setDefault(featuresCol="features", outputCol="embedding", n_neighbors=15)
 
@@ -372,15 +382,24 @@ class UMAPModel(_UMAPClass, _TpuModelWithColumns, _UMAPParams):
         return self._model_attributes["raw_data"]
 
     def _transform_arrays(self, X: np.ndarray) -> Dict[str, np.ndarray]:
+        attrs = self._model_attributes
+        # cuML/umap-learn transform refines new points for fit_epochs // 3 SGD
+        # epochs against the frozen reference embedding
+        fit_epochs = int(attrs.get("n_epochs", 200))
         out = umap_transform(
             X,
-            self._model_attributes["raw_data"],
-            self._model_attributes["embedding"],
-            self._model_attributes["n_neighbors"],
-            metric=str(self._model_attributes.get("metric", "euclidean")),
-            metric_kwds=self._model_attributes.get("metric_kwds") or None,
-            local_connectivity=float(
-                self._model_attributes.get("local_connectivity", 1.0)
-            ),
+            attrs["raw_data"],
+            attrs["embedding"],
+            attrs["n_neighbors"],
+            metric=str(attrs.get("metric", "euclidean")),
+            metric_kwds=attrs.get("metric_kwds") or None,
+            local_connectivity=float(attrs.get("local_connectivity", 1.0)),
+            a=attrs.get("a"),
+            b=attrs.get("b"),
+            n_epochs=max(fit_epochs // 3, 1),
+            negative_sample_rate=int(attrs.get("negative_sample_rate", 5)),
+            learning_rate=float(attrs.get("learning_rate", 1.0)),
+            repulsion_strength=float(attrs.get("repulsion_strength", 1.0)),
+            seed=int(attrs.get("random_state", 42)),
         )
         return {self.getOrDefault("outputCol"): out}

@@ -182,6 +182,66 @@ def optimize_layout(
     return emb
 
 
+@functools.partial(jax.jit, static_argnames=("n_epochs", "neg_samples"))
+def optimize_transform_layout(
+    q_emb0: jax.Array,  # (nq, dim) init (fuzzy-weighted mean)
+    ref_emb: jax.Array,  # (n_ref, dim) FROZEN reference embedding
+    ids: jax.Array,  # (nq, k) neighbor indices into ref_emb
+    w: jax.Array,  # (nq, k) membership strengths (unnormalized)
+    key: jax.Array,
+    a: float,
+    b: float,
+    n_epochs: int,
+    neg_samples: int = 5,
+    initial_lr: float = 1.0,
+    gamma: float = 1.0,
+) -> jax.Array:
+    """SGD refinement of NEW points against a fixed reference embedding — the
+    transform-side optimization cuML's UMAP.transform runs after the weighted-mean
+    init (reference umap.py:1368-1446 broadcasts embedding+raw to feed it). Only
+    the query embeddings move: attraction along the (query → ref neighbor) edges,
+    repulsion against uniform negative samples from the reference vertices. Same
+    cross-entropy gradients and linear lr decay as the fit-side optimize_layout."""
+    nq, k = ids.shape
+    n_ref = ref_emb.shape[0]
+    heads = jnp.repeat(jnp.arange(nq, dtype=jnp.int32), k)  # (E,)
+    tails = ids.reshape(-1)
+    weights = w.reshape(-1)
+    deg_norm = 1.0 / jnp.maximum(jnp.sum(w, axis=1), 1e-6)  # (nq,)
+
+    def epoch(e, state):
+        qe, key = state
+        lr = initial_lr * (1.0 - e / n_epochs)
+
+        yh = qe[heads]
+        yt = ref_emb[tails]
+        diff = yh - yt
+        d2 = jnp.sum(diff * diff, axis=1)
+        g_att = (-2.0 * a * b * d2 ** jnp.maximum(b - 1.0, 0.0)) / (
+            1.0 + a * d2**b
+        )
+        f_att = jnp.clip(g_att[:, None] * diff, -4.0, 4.0) * weights[:, None]
+
+        key, sub = jax.random.split(key)
+        neg = jax.random.randint(sub, (heads.shape[0], neg_samples), 0, n_ref)
+        yn = ref_emb[neg]  # (E, S, dim)
+        diff_n = yh[:, None, :] - yn
+        d2n = jnp.sum(diff_n * diff_n, axis=-1)
+        g_rep = (2.0 * gamma * b) / ((0.001 + d2n) * (1.0 + a * d2n**b))
+        f_rep = (
+            jnp.clip(g_rep[..., None] * diff_n, -4.0, 4.0) * weights[:, None, None]
+        )
+
+        grad_h = f_att + jnp.sum(f_rep, axis=1) / neg_samples
+        upd = jnp.zeros_like(qe).at[heads].add(
+            grad_h * deg_norm[heads][:, None]
+        )
+        return qe + lr * upd, key
+
+    qe, _ = jax.lax.fori_loop(0, n_epochs, epoch, (q_emb0, key))
+    return qe
+
+
 def categorical_intersection(
     heads: np.ndarray,
     tails: np.ndarray,
@@ -518,6 +578,13 @@ def umap_fit(
         "metric": metric,
         "metric_kwds": dict(metric_kwds) if metric_kwds else {},
         "local_connectivity": float(local_connectivity),
+        # transform-side SGD refinement settings (cuML transform optimizes new
+        # points with the fit hyperparameters; epochs = fit epochs // 3)
+        "n_epochs": int(n_epochs),
+        "negative_sample_rate": int(negative_sample_rate),
+        "learning_rate": float(learning_rate),
+        "repulsion_strength": float(repulsion_strength),
+        "random_state": int(seed),
     }
 
 
@@ -529,10 +596,20 @@ def umap_transform(
     metric: str = "euclidean",
     metric_kwds: "Dict | None" = None,
     local_connectivity: float = 1.0,
+    a: "float | None" = None,
+    b: "float | None" = None,
+    n_epochs: int = 0,
+    negative_sample_rate: int = 5,
+    learning_rate: float = 1.0,
+    repulsion_strength: float = 1.0,
+    seed: int = 42,
 ) -> np.ndarray:
-    """Embed new points at the fuzzy-weighted mean of their neighbors' embeddings.
-    `raw_data` may be dense or CSR (sparse-fitted models transform without ever
-    densifying the training data). Distances use the fit-time metric."""
+    """Embed new points: fuzzy-weighted-mean init at their neighbors' embeddings,
+    then (n_epochs > 0) SGD refinement against the FROZEN reference embedding —
+    cuML's UMAP.transform optimizes new points the same way (the reference
+    broadcasts embedding+raw data to feed it, umap.py:1368-1446). `raw_data` may
+    be dense or CSR (sparse-fitted models transform without ever densifying the
+    training data). Distances use the fit-time metric."""
     from .knn import exact_knn_single
     import jax.numpy as jnp
 
@@ -596,5 +673,25 @@ def umap_transform(
         -np.maximum(dists - np.asarray(rho)[:, None], 0.0)
         / np.asarray(sigma)[:, None]
     )
-    w = w / np.maximum(w.sum(axis=1, keepdims=True), 1e-12)
-    return np.einsum("qk,qkd->qd", w, embedding[ids_h]).astype(np.float32)
+    w_norm = w / np.maximum(w.sum(axis=1, keepdims=True), 1e-12)
+    emb0 = np.einsum("qk,qkd->qd", w_norm, embedding[ids_h]).astype(np.float32)
+    if n_epochs <= 0:
+        return emb0
+    if a is None or b is None:
+        # callers always pass the fit-time (a, b); this is a permissive fallback
+        # for direct op users with the find_ab_params defaults
+        a, b = find_ab_params()
+    refined = optimize_transform_layout(
+        jnp.asarray(emb0),
+        jnp.asarray(embedding, dtype=np.float32),
+        jnp.asarray(ids_h, dtype=np.int32),
+        jnp.asarray(w, dtype=np.float32),  # raw membership strengths drive SGD
+        jax.random.PRNGKey(seed & 0x7FFFFFFF),
+        a=float(a),
+        b=float(b),
+        n_epochs=int(n_epochs),
+        neg_samples=int(negative_sample_rate),
+        initial_lr=float(learning_rate),
+        gamma=float(repulsion_strength),
+    )
+    return np.asarray(refined).astype(np.float32)

@@ -120,11 +120,9 @@ def transform_evaluate_on_spark(
         )
     scores: List[float] = []
     for i in range(n_models):
+        # every non-empty partition emits a partial for ALL models, so the outer
+        # emptiness guard above already covers the no-partials case
         blobs = out[out["model_index"] == i]["partial"]
-        if len(blobs) == 0:
-            raise RuntimeError(
-                "Distributed evaluate produced no partials (empty input?)."
-            )
         scores.append(
             evaluator._evaluate_partials([pickle.loads(bytes(b)) for b in blobs])
         )

@@ -46,8 +46,20 @@ def _broadcast_chunked(sc: Any, payload: bytes) -> list:
     ]
 
 
+def _broadcast_key(b: Any) -> Any:
+    """Stable per-broadcast cache key. Spark broadcast ids start at 0, so an
+    `or`-style falsy fallback would silently key the FIRST broadcast of a context
+    by Python object identity — which differs per task (the closure re-deserializes
+    the Broadcast wrapper), defeating the cache and churning the FIFO."""
+    for attr in ("id", "_bid"):
+        v = getattr(b, attr, None)
+        if v is not None:
+            return ("bid", v)
+    return ("obj", id(b))  # no stable id exposed: no cross-task caching
+
+
 def _worker_model(bcasts: list) -> Any:
-    key = tuple(getattr(b, "id", None) or id(b) for b in bcasts)
+    key = tuple(_broadcast_key(b) for b in bcasts)
     model = _WORKER_MODELS.get(key)
     if model is None:
         import pickle

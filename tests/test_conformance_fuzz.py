@@ -203,10 +203,13 @@ def test_ann_random_configs(case, n_devices):
     np.testing.assert_allclose(got_d, sk_d, atol=1e-3, err_msg=str(case))
 
 
+@pytest.mark.parametrize("metric", ["euclidean", "cosine"])
 @pytest.mark.parametrize("case", range(6))
-def test_dbscan_random_configs(case, n_devices):
+def test_dbscan_random_configs(case, metric, n_devices):
     """Exact-algorithm oracle: our labels must induce the SAME partition (and noise
-    mask) as sklearn's DBSCAN for any eps/min_samples/shape draw."""
+    mask) as sklearn's DBSCAN for any eps/min_samples/shape/metric draw. Cosine
+    runs NATIVELY (row-normalized euclidean scan with the 2*eps threshold map),
+    so it faces the same oracle as euclidean."""
     from sklearn.cluster import DBSCAN as SkDBSCAN
 
     from spark_rapids_ml_tpu.clustering import DBSCAN
@@ -219,13 +222,23 @@ def test_dbscan_random_configs(case, n_devices):
     X = (centers[rng.integers(0, n_blobs, n)] + rng.normal(0, 0.5, (n, d))).astype(
         np.float32
     )
-    eps = float(rng.uniform(0.3, 1.5))
+    if metric == "cosine":
+        # cosine eps lives in [0, 2]; keep draws in the separating range and
+        # shift any zero-norm row off the origin (cosine undefined there)
+        eps = float(rng.uniform(0.05, 0.5))
+        norms = np.linalg.norm(X, axis=1)
+        X[norms == 0] += 1.0
+    else:
+        eps = float(rng.uniform(0.3, 1.5))
     min_samples = int(rng.integers(2, 8))
     df = pd.DataFrame({"features": list(X)})
-    est = DBSCAN(eps=eps, min_samples=min_samples)
+    est = DBSCAN(eps=eps, min_samples=min_samples, metric=metric)
     est.num_workers = n_devices
+    assert not est._use_cpu_fallback(), metric  # cosine must run natively
     got = est.fit(df).transform(df)["prediction"].to_numpy()
-    sk = SkDBSCAN(eps=eps, min_samples=min_samples).fit_predict(X.astype(np.float64))
+    sk = SkDBSCAN(eps=eps, min_samples=min_samples, metric=metric).fit_predict(
+        X.astype(np.float64)
+    )
     np.testing.assert_array_equal(got >= 0, sk >= 0, err_msg=f"noise mask {case}")
     # partitions correspond 1:1 both directions
     for lbl in set(sk[sk >= 0]):
