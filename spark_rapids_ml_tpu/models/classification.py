@@ -50,6 +50,26 @@ from ..core.params import (
 from ..ops.logistic import logreg_decision, logreg_fit
 
 
+def _validate_labels(y_host) -> "tuple[np.ndarray, int]":
+    """Shared label validation for the in-core and streamed LogisticRegression fit
+    paths: labels must be non-negative integers with every class 0..k-1 present
+    (reference raises with workaround text, classification.py:1093-1102).
+    Returns (classes, n_classes)."""
+    classes = np.unique(y_host)
+    n_classes = int(classes.max()) + 1 if len(classes) > 0 else 0
+    if not np.array_equal(classes, classes.astype(np.int64)) or (
+        len(classes) > 0 and classes.min() < 0
+    ):
+        raise ValueError("Labels must be non-negative integers 0..k-1.")
+    if len(classes) != n_classes and len(classes) > 1:
+        raise RuntimeError(
+            f"Labels {sorted(set(range(n_classes)) - set(classes.astype(int)))} "
+            "are missing from the dataset: every class in 0..k-1 must appear. "
+            "Re-index labels to be consecutive."
+        )
+    return classes, n_classes
+
+
 class _LogisticRegressionClass(_TpuClass):
     @classmethod
     def _param_mapping(cls):
@@ -284,19 +304,7 @@ class LogisticRegression(
                 lab = np.asarray(inputs.label)
                 w = np.asarray(inputs.row_weight)
                 y_host = lab[w > 0]
-            classes = np.unique(y_host)
-            n_classes = int(classes.max()) + 1 if len(classes) > 0 else 0
-            if not np.array_equal(classes, classes.astype(np.int64)) or (
-                len(classes) > 0 and classes.min() < 0
-            ):
-                raise ValueError("Labels must be non-negative integers 0..k-1.")
-            if len(classes) != n_classes and len(classes) > 1:
-                # reference raises with workaround text (classification.py:1093-1102)
-                raise RuntimeError(
-                    f"Labels {sorted(set(range(n_classes)) - set(classes.astype(int)))} "
-                    "are missing from the dataset: every class in 0..k-1 must appear. "
-                    "Re-index labels to be consecutive."
-                )
+            classes, n_classes = _validate_labels(y_host)
 
             param_sets = extra_params if extra_params is not None else [base]
             results = []
@@ -385,6 +393,67 @@ class LogisticRegression(
 
     def _create_pyspark_model(self, attrs: Dict[str, Any]) -> "LogisticRegressionModel":
         return LogisticRegressionModel(**attrs)
+
+    def _streaming_fit(self, fd) -> Dict[str, Any]:
+        """Out-of-core fit: X stays host-resident, every L-BFGS objective/gradient
+        evaluation streams batches through the device (ops/streaming.py) — the
+        LogisticRegression analog of the reference's UVM/SAM path (reference
+        utils.py:184-241) that BASELINE config 3 (500M x 256) requires. Routes
+        in-core (with a warning) for the combinations the streamed loop does not
+        cover: L1/elastic-net, coefficient bounds, sparse features, single-class
+        degenerate fits."""
+        from .. import config as _config
+        from ..core.dataset import _is_sparse, densify as _densify
+        from ..ops.streaming import streaming_logreg_fit
+        from ..parallel.mesh import get_mesh
+
+        p = self._tpu_params
+        bounds_set = any(
+            self.isDefined(name) and self.getOrDefault(name) is not None
+            for name in (
+                "lowerBoundsOnCoefficients", "upperBoundsOnCoefficients",
+                "lowerBoundsOnIntercepts", "upperBoundsOnIntercepts",
+            )
+        )
+        classes, n_classes = _validate_labels(fd.label)
+        if (
+            float(p["l1_ratio"]) * float(p["alpha"]) > 0.0
+            or bounds_set
+            or _is_sparse(fd.features)
+            or len(classes) <= 1
+        ):
+            self.logger.warning(
+                "streamed LogisticRegression covers dense L2/no-penalty "
+                "multi-class fits only; fitting in-core despite "
+                "stream_threshold_bytes."
+            )
+            inputs = self._build_fit_inputs(fd)
+            return self._get_tpu_fit_func(None)(inputs)
+        family = p["family"]
+        multinomial = family == "multinomial" or (family == "auto" and n_classes > 2)
+        if not multinomial and n_classes > 2:
+            raise ValueError(
+                f"Binomial family only supports 1 or 2 outcome classes but "
+                f"found {n_classes}."
+            )
+        attrs = streaming_logreg_fit(
+            _densify(fd.features, self._float32_inputs),
+            fd.label,
+            fd.weight,
+            n_classes=n_classes,
+            reg=float(p["alpha"]),
+            l1_ratio=float(p["l1_ratio"]),
+            fit_intercept=bool(p["fit_intercept"]),
+            standardize=bool(p["standardization"]),
+            max_iter=int(p["max_iter"]),
+            tol=float(p["tol"]),
+            multinomial=multinomial,
+            batch_rows=int(_config.get("stream_batch_rows")),
+            mesh=get_mesh(self.num_workers),
+            float32=self._float32_inputs,
+        )
+        attrs["num_classes"] = n_classes
+        return attrs
 
     def _fit_fallback_model(self, twin: type, fd) -> Dict[str, Any]:
         X = densify(fd.features, float32=self._float32_inputs)

@@ -264,7 +264,10 @@ class UMAP(_UMAPClass, _TpuEstimator, _UMAPParams):
 
     def _out_schema(self) -> List[str]:
         return ["embedding", "raw_data", "a", "b", "n_neighbors", "metric",
-                "metric_kwds", "local_connectivity"]
+                "metric_kwds", "local_connectivity",
+                # transform-side SGD refinement settings
+                "n_epochs", "negative_sample_rate", "learning_rate",
+                "repulsion_strength", "random_state"]
 
     def _use_label(self) -> bool:
         # supervised UMAP when a labelCol is explicitly set (reference umap.py)

@@ -133,6 +133,253 @@ def streaming_covariance(
     return cov, mean, sw
 
 
+@functools.partial(jax.jit, static_argnames=("fit_intercept", "multinomial"))
+def _logreg_batch_value_grad(params, X, y_enc, w, scale, fit_intercept, multinomial):
+    """UNNORMALIZED batch cross-entropy value+grad (no /Σw, no penalty): batches
+    accumulate exactly; the caller normalizes and adds the L2 term once. The
+    per-batch loss form mirrors ops/logistic._binomial_loss_fn /
+    _multinomial_loss_fn so the streamed objective is the in-core objective."""
+
+    def f(p):
+        if multinomial:
+            coef_s, b = p[:, :-1], p[:, -1]
+            z = pdot(X, (coef_s / scale).T) + jnp.where(fit_intercept, b, 0.0)
+            return -jnp.sum(w * jnp.sum(y_enc * jax.nn.log_softmax(z, axis=1), axis=1))
+        coef_s, b = p[:-1], p[-1]
+        z = pdot(X, coef_s / scale) + jnp.where(fit_intercept, b, 0.0)
+        return jnp.sum(w * (jax.nn.softplus(z) - y_enc * z))
+
+    return jax.value_and_grad(f)(params)
+
+
+@jax.jit
+def _accum_moments(carry, X, w):
+    sx, sxx, sw = carry
+    return (sx + pdot(w, X), sxx + pdot(w, X * X), sw + jnp.sum(w))
+
+
+def _strong_wolfe(f, x, fx, gx, p, max_steps: int, c1=1e-4, c2=0.9):
+    """Strong-Wolfe line search (zoom), scipy-style: each trial costs one full
+    streamed data pass. Returns (alpha, f_new, g_new, n_evals); falls back to the
+    last trial point if the conditions never both hold within max_steps (the
+    reference's QN solver caps linesearch at 20 the same way)."""
+    d0 = float(np.vdot(gx, p))
+    if d0 >= 0:  # not a descent direction (numerical breakdown): bail
+        return 0.0, fx, gx, 0
+
+    def phi(alpha):
+        fv, gv = f(x + alpha * p)
+        return fv, gv, float(np.vdot(gv, p))
+
+    alpha_prev, f_prev = 0.0, fx
+    alpha = 1.0
+    n_evals = 0
+    lo = hi = None
+    f_lo = g_lo = None
+    for i in range(max_steps):
+        f_a, g_a, d_a = phi(alpha)
+        n_evals += 1
+        if f_a > fx + c1 * alpha * d0 or (i > 0 and f_a >= f_prev):
+            lo, hi, f_lo = alpha_prev, alpha, f_prev
+            break
+        if abs(d_a) <= -c2 * d0:
+            return alpha, f_a, g_a, n_evals
+        if d_a >= 0:
+            lo, hi, f_lo = alpha, alpha_prev, f_a
+            break
+        alpha_prev, f_prev = alpha, f_a
+        alpha *= 2.0
+    else:
+        return alpha, f_a, g_a, n_evals  # ran out of expansion steps
+
+    # zoom phase
+    best = (alpha, f_a, g_a)
+    while n_evals < max_steps:
+        mid = 0.5 * (lo + hi)
+        f_m, g_m, d_m = phi(mid)
+        n_evals += 1
+        if f_m > fx + c1 * mid * d0 or f_m >= f_lo:
+            hi = mid
+        else:
+            if abs(d_m) <= -c2 * d0:
+                return mid, f_m, g_m, n_evals
+            if d_m * (hi - lo) >= 0:
+                hi = lo
+            lo, f_lo = mid, f_m
+        if f_m < best[1]:
+            best = (mid, f_m, g_m)
+    return best[0], best[1], best[2], n_evals
+
+
+def streaming_logreg_fit(
+    X: np.ndarray,
+    y: np.ndarray,
+    w: Optional[np.ndarray],
+    n_classes: int,
+    reg: float,
+    l1_ratio: float,
+    fit_intercept: bool,
+    standardize: bool,
+    max_iter: int,
+    tol: float,
+    multinomial: bool,
+    batch_rows: int,
+    mesh=None,
+    float32: bool = True,
+):
+    """Out-of-core distributed L-BFGS logistic regression: X stays HOST-resident;
+    each objective/gradient evaluation streams batches through the device and
+    accumulates the unnormalized loss and gradient (sharded over the mesh when
+    given — the per-batch contraction carries the gradient psum exactly where the
+    in-core path does). The L-BFGS two-loop recursion and strong-Wolfe zoom line
+    search run on host over the SMALL parameter vector (memory 10, linesearch
+    <= 20 evals — the reference's QN settings, classification.py:1046-1052).
+
+    This is the LogisticRegression analog of the reference's UVM/SAM
+    larger-than-device-memory fitting (reference utils.py:184-241): BASELINE
+    config 3 (500M x 256) cannot stage the design matrix in HBM. L2/no-penalty
+    only (the FISTA L1 path needs a different streamed loop); callers route
+    l1_ratio > 0 in-core."""
+    from ..parallel.mesh import shard_array
+    from ..parallel.partition import pad_rows
+
+    if reg * l1_ratio > 0.0:
+        raise ValueError(
+            "streaming_logreg_fit supports only L2/no-penalty "
+            "(elasticNetParam must be 0)."
+        )
+    dt = np.float32 if float32 else np.float64
+    n, d = X.shape
+    reg_l2 = reg * (1.0 - l1_ratio)
+
+    def _batches():
+        for s in range(0, n, batch_rows):
+            e = min(s + batch_rows, n)
+            Xb = np.ascontiguousarray(X[s:e], dtype=dt)
+            yb = np.ascontiguousarray(y[s:e], dtype=dt)
+            wb = (
+                np.ones((e - s,), dt)
+                if w is None
+                else np.ascontiguousarray(w[s:e], dtype=dt)
+            )
+            if mesh is not None:
+                Xb, pad_w, (yb_p, wb_p) = pad_rows(Xb, mesh.devices.size, yb, wb)
+                Xb = shard_array(Xb, mesh)
+                yb = shard_array(yb_p, mesh)
+                wb = shard_array(pad_w * wb_p, mesh)
+            yield jnp.asarray(Xb), jnp.asarray(yb), jnp.asarray(wb)
+
+    # streamed standardization moments (Spark Summarizer wsum-1 variance,
+    # matching ops/linalg.weighted_moments)
+    if standardize:
+        carry = (jnp.zeros((d,), dt), jnp.zeros((d,), dt), jnp.zeros((), dt))
+        for Xb, _, wb in _batches():
+            carry = _accum_moments(carry, Xb, wb)
+        sx, sxx, sw_j = carry
+        wsum = float(sw_j)
+        mean = np.asarray(sx) / wsum
+        var = np.maximum(
+            (np.asarray(sxx) - wsum * mean * mean) / (wsum - 1.0), 0.0
+        )
+        scale_h = np.sqrt(var)
+        scale_h[scale_h <= 0.0] = 1.0
+    else:
+        scale_h = np.ones((d,), dt)
+        wsum = float(np.sum(w)) if w is not None else float(n)
+    scale = jnp.asarray(scale_h.astype(dt))
+
+    if multinomial:
+        shape = (n_classes, d + 1)
+    else:
+        shape = (d + 1,)
+
+    def value_and_grad(params_flat: np.ndarray):
+        params = jnp.asarray(params_flat.reshape(shape).astype(dt))
+        acc_v = 0.0
+        acc_g = np.zeros(shape, np.float64)
+        for Xb, yb, wb in _batches():
+            y_enc = (
+                jax.nn.one_hot(yb.astype(jnp.int32), n_classes, dtype=Xb.dtype)
+                * (wb > 0)[:, None]
+                if multinomial
+                else yb
+            )
+            v, g = _logreg_batch_value_grad(
+                params, Xb, y_enc, wb, scale, bool(fit_intercept), bool(multinomial)
+            )
+            acc_v += float(v)
+            acc_g += np.asarray(g, np.float64)
+        coef_s = params_flat.reshape(shape)[..., :-1]
+        value = acc_v / wsum + 0.5 * reg_l2 * float(np.sum(coef_s * coef_s))
+        grad = acc_g / wsum
+        grad[..., :-1] += reg_l2 * coef_s
+        return value, grad.reshape(-1)
+
+    # ---- host L-BFGS (two-loop recursion, memory 10) ----
+    m = 10
+    x = np.zeros(int(np.prod(shape)), np.float64)
+    fx, gx = value_and_grad(x)
+    s_hist: list = []
+    y_hist: list = []
+    n_iter = 0
+    for it in range(int(max_iter)):
+        gnorm = float(np.linalg.norm(gx))
+        if gnorm <= tol:
+            break
+        # two-loop recursion
+        q = gx.copy()
+        alphas = []
+        for s_i, y_i in zip(reversed(s_hist), reversed(y_hist)):
+            rho_i = 1.0 / float(np.vdot(y_i, s_i))
+            a_i = rho_i * float(np.vdot(s_i, q))
+            q -= a_i * y_i
+            alphas.append((a_i, rho_i))
+        if s_hist:
+            gamma = float(np.vdot(s_hist[-1], y_hist[-1])) / float(
+                np.vdot(y_hist[-1], y_hist[-1])
+            )
+            q *= gamma
+        for (a_i, rho_i), s_i, y_i in zip(reversed(alphas), s_hist, y_hist):
+            b_i = rho_i * float(np.vdot(y_i, q))
+            q += (a_i - b_i) * s_i
+        p = -q
+        alpha, f_new, g_new, _ = _strong_wolfe(
+            value_and_grad, x, fx, gx, p, max_steps=20
+        )
+        if alpha == 0.0:
+            break
+        x_new = x + alpha * p
+        s_i = x_new - x
+        y_i = g_new - gx
+        if float(np.vdot(s_i, y_i)) > 1e-10:
+            s_hist.append(s_i)
+            y_hist.append(y_i)
+            if len(s_hist) > m:
+                s_hist.pop(0)
+                y_hist.pop(0)
+        delta = abs(fx - f_new) / max(abs(f_new), 1.0)
+        x, fx, gx = x_new, f_new, g_new
+        n_iter = it + 1
+        if delta <= tol:
+            break
+
+    params = x.reshape(shape)
+    if multinomial:
+        coef = params[:, :-1] / scale_h
+        intercept = params[:, -1]
+        if fit_intercept:
+            intercept = intercept - intercept.mean()
+    else:
+        coef = (params[:-1] / scale_h).reshape(1, -1)
+        intercept = params[-1:]
+    return {
+        "coefficients": coef.astype(np.float32),
+        "intercepts": intercept.astype(np.float32),
+        "n_iter": int(n_iter),
+        "objective": float(fx),
+    }
+
+
 @functools.partial(jax.jit, static_argnames=("cosine",))
 def _accum_kmeans(carry, centers, X, w, cosine: bool = False):
     """One batch of a streamed Lloyd iteration: accumulate per-cluster weighted sums,

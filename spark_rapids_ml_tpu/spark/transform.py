@@ -55,15 +55,21 @@ def _broadcast_key(b: Any) -> Any:
         v = getattr(b, attr, None)
         if v is not None:
             return ("bid", v)
-    return ("obj", id(b))  # no stable id exposed: no cross-task caching
+    return None  # no stable id exposed
 
 
 def _worker_model(bcasts: list) -> Any:
-    key = tuple(_broadcast_key(b) for b in bcasts)
+    import pickle
+
+    keys = [_broadcast_key(b) for b in bcasts]
+    if any(k is None for k in keys):
+        # no stable broadcast id: do NOT cache — a python id() key can collide
+        # after GC (reused worker, same malloc address) and silently return the
+        # wrong model
+        return pickle.loads(b"".join(bytes(b.value) for b in bcasts))
+    key = tuple(keys)
     model = _WORKER_MODELS.get(key)
     if model is None:
-        import pickle
-
         model = pickle.loads(b"".join(bytes(b.value) for b in bcasts))
         while len(_WORKER_MODELS) >= _WORKER_MODELS_MAX:
             _WORKER_MODELS.pop(next(iter(_WORKER_MODELS)))

@@ -250,8 +250,10 @@ def test_dbscan_random_configs(case, metric, n_devices):
 @pytest.mark.parametrize("case", range(6))
 def test_streaming_equals_incore_random_configs(case, n_devices):
     """The streamed accumulation is algebraically identical to the in-core pass —
-    exact-match oracle across random shapes/batch sizes for PCA and LinReg."""
+    exact-match oracle across random shapes/batch sizes for PCA and LinReg, and a
+    convex-optimum oracle for the streamed L-BFGS LogisticRegression."""
     from spark_rapids_ml_tpu import config
+    from spark_rapids_ml_tpu.classification import LogisticRegression
     from spark_rapids_ml_tpu.feature import PCA
     from spark_rapids_ml_tpu.regression import LinearRegression
 
@@ -261,15 +263,20 @@ def test_streaming_equals_incore_random_configs(case, n_devices):
     batch = int(rng.integers(16, 256))
     X = (rng.normal(size=(n, d)) * rng.uniform(0.2, 5.0, d)).astype(np.float32)
     y = X @ rng.normal(size=d) + rng.normal(0, 0.05, n)
+    ybin = (y > np.median(y)).astype(np.float64)
     df = pd.DataFrame({"features": list(X), "label": y.astype(np.float64)})
+    df_cls = pd.DataFrame({"features": list(X), "label": ybin})
+    lr_kw = dict(regParam=0.05, maxIter=150, tol=1e-9)
 
     incore_pca = PCA(k=min(3, d), inputCol="features").fit(df[["features"]])
     incore_lin = LinearRegression(regParam=0.1).fit(df)
+    incore_log = LogisticRegression(**lr_kw).fit(df_cls)
     config.set("stream_threshold_bytes", 1)
     config.set("stream_batch_rows", batch)
     try:
         streamed_pca = PCA(k=min(3, d), inputCol="features").fit(df[["features"]])
         streamed_lin = LinearRegression(regParam=0.1).fit(df)
+        streamed_log = LogisticRegression(**lr_kw).fit(df_cls)
     finally:
         config.unset("stream_threshold_bytes")
         config.unset("stream_batch_rows")
@@ -283,6 +290,12 @@ def test_streaming_equals_incore_random_configs(case, n_devices):
         np.asarray(incore_lin.coefficients),
         rtol=1e-3,
         atol=1e-4,
+    )
+    np.testing.assert_allclose(
+        np.asarray(streamed_log.coefficients),
+        np.asarray(incore_log.coefficients),
+        rtol=1e-2,
+        atol=1e-3,
     )
 
 

@@ -1,6 +1,8 @@
 """Scale-tier tests, gated behind --runslow (reference python/tests_large/: fits
-1e6+-row synthetic data with the distributed generators and checks the objective vs
-the CPU baseline, tests_large/test_large_logistic_regression.py:40-60)."""
+1e7+-row synthetic data with the distributed generators and checks the objective vs
+the CPU baseline, tests_large/test_large_logistic_regression.py:40-60). The 1e7
+tier uses the columnar featuresCols layout (no per-row object cells) and the
+streamed out-of-core paths."""
 
 import os
 import sys
@@ -164,3 +166,72 @@ def test_large_cagra_recall(n_devices):
     got = np.asarray(ids)
     recall = np.mean([len(set(g) & set(s)) / 10.0 for g, s in zip(got, sk_idx)])
     assert recall > 0.7, recall
+
+
+def test_large_1e7_linreg_multicol(n_devices):
+    """1e7 x 32 in the columnar (featuresCols) layout — the reference's tests_large
+    scale (tests_large/test_large_logistic_regression.py:40-60 fits 1e7+ rows).
+    Multi-col pandas stays columnar (no per-row object cells), so the driver-side
+    frame is ~1.3 GiB, not tens of GiB."""
+    from spark_rapids_ml_tpu.regression import LinearRegression
+
+    rng = np.random.default_rng(11)
+    n, d = 10_000_000, 32
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    coef = rng.normal(size=d).astype(np.float32)
+    y = (X @ coef + rng.normal(0, 1.0, n)).astype(np.float32)
+    import pandas as pd
+
+    df = pd.DataFrame({f"c{i}": X[:, i] for i in range(d)})
+    df["label"] = y
+    est = LinearRegression(
+        featuresCols=[f"c{i}" for i in range(d)], standardization=False
+    )
+    est.num_workers = n_devices
+    model = est.fit(df)
+    np.testing.assert_allclose(model.coefficients, coef, atol=5e-3)
+    rmse = np.sqrt(np.mean((y - (X @ np.asarray(model.coefficients) + model.intercept)) ** 2))
+    assert rmse < 1.01  # noise floor sigma=1
+
+
+def test_large_1e7_streamed_logreg(n_devices):
+    """1e7 x 64 binomial fit through the STREAMED out-of-core L-BFGS path (forced
+    via stream_threshold_bytes): the design matrix passes through the device in
+    batches, device residency stays one batch. This is BASELINE config 3's
+    mechanism at CI scale."""
+    import pandas as pd
+
+    from spark_rapids_ml_tpu import config
+    from spark_rapids_ml_tpu.classification import LogisticRegression
+
+    rng = np.random.default_rng(13)
+    n, d = 10_000_000, 64
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    coef = rng.normal(size=d)
+    y = ((X @ coef + rng.logistic(0, 1.0, n)) > 0).astype(np.float64)
+    df = pd.DataFrame({f"c{i}": X[:, i] for i in range(d)})
+    df["label"] = y
+    config.set("stream_threshold_bytes", 1 << 28)  # 256 MiB << 2.56 GB matrix
+    config.set("stream_batch_rows", 1_000_000)
+    try:
+        est = LogisticRegression(
+            featuresCols=[f"c{i}" for i in range(d)],
+            regParam=1e-4,
+            standardization=False,
+            maxIter=12,
+            tol=1e-6,
+        )
+        est.num_workers = n_devices
+        model = est.fit(df)
+    finally:
+        config.unset("stream_threshold_bytes")
+        config.unset("stream_batch_rows")
+    # sign agreement with the generating coefficients on strong features
+    strong = np.abs(coef) > 0.5
+    got = np.asarray(model.coefficients)
+    assert (np.sign(got[strong]) == np.sign(coef[strong])).mean() > 0.97
+    acc = (
+        model.transform(df.iloc[:100_000])["prediction"].to_numpy()
+        == y[:100_000]
+    ).mean()
+    assert acc > 0.8, acc

@@ -113,3 +113,101 @@ def test_streaming_kmeans_cosine(n_devices, tiny_stream_threshold):
     c = np.asarray(model.cluster_centers_)
     # spherical centers are unit-norm and aligned with the two directions
     np.testing.assert_allclose(np.linalg.norm(c, axis=1), 1.0, atol=1e-5)
+
+
+@pytest.mark.parametrize("standardize", [True, False])
+def test_streaming_logreg_binomial_matches_incore(
+    n_devices, tiny_stream_threshold, standardize
+):
+    from spark_rapids_ml_tpu.classification import LogisticRegression
+
+    rng = np.random.default_rng(2)
+    X = (rng.normal(size=(600, 8)) * np.linspace(0.5, 4, 8)).astype(np.float32)
+    y = (X @ rng.normal(size=8) > 0).astype(np.float64)
+    df = pd.DataFrame({"features": list(X), "label": y})
+    kw = dict(regParam=0.05, maxIter=100, tol=1e-8, standardization=standardize)
+    streamed = LogisticRegression(**kw).fit(df)
+
+    config.set("stream_threshold_bytes", 1 << 40)
+    incore = LogisticRegression(**kw).fit(df)
+
+    np.testing.assert_allclose(
+        streamed.coefficients, incore.coefficients, rtol=5e-3, atol=5e-4
+    )
+    np.testing.assert_allclose(
+        streamed.intercept, incore.intercept, rtol=5e-3, atol=5e-4
+    )
+
+
+def test_streaming_logreg_multinomial_matches_incore(n_devices, tiny_stream_threshold):
+    from spark_rapids_ml_tpu.classification import LogisticRegression
+
+    rng = np.random.default_rng(5)
+    X = rng.normal(size=(700, 6)).astype(np.float32)
+    logits = X @ rng.normal(size=(6, 3))
+    y = logits.argmax(1).astype(np.float64)
+    df = pd.DataFrame({"features": list(X), "label": y})
+    kw = dict(regParam=0.1, maxIter=120, tol=1e-8, family="multinomial")
+    streamed = LogisticRegression(**kw).fit(df)
+
+    config.set("stream_threshold_bytes", 1 << 40)
+    incore = LogisticRegression(**kw).fit(df)
+
+    np.testing.assert_allclose(
+        streamed.coefficientMatrix, incore.coefficientMatrix, rtol=1e-2, atol=2e-3
+    )
+    np.testing.assert_allclose(
+        streamed.interceptVector, incore.interceptVector, rtol=1e-2, atol=2e-3
+    )
+    # same predictions end-to-end
+    ps = streamed.transform(df)["prediction"].to_numpy()
+    pi = incore.transform(df)["prediction"].to_numpy()
+    assert (ps == pi).mean() > 0.995
+
+
+def test_streaming_logreg_weighted(n_devices, tiny_stream_threshold):
+    from spark_rapids_ml_tpu.classification import LogisticRegression
+
+    rng = np.random.default_rng(7)
+    X = rng.normal(size=(400, 5)).astype(np.float32)
+    y = (X[:, 0] + 0.3 * X[:, 1] > 0).astype(np.float64)
+    wcol = rng.uniform(0.2, 3.0, 400)
+    df = pd.DataFrame({"features": list(X), "label": y, "w": wcol})
+    kw = dict(regParam=0.02, maxIter=100, tol=1e-8, weightCol="w")
+    streamed = LogisticRegression(**kw).fit(df)
+    config.set("stream_threshold_bytes", 1 << 40)
+    incore = LogisticRegression(**kw).fit(df)
+    np.testing.assert_allclose(
+        streamed.coefficients, incore.coefficients, rtol=5e-3, atol=5e-4
+    )
+
+
+def test_streaming_logreg_l1_routes_incore(n_devices, tiny_stream_threshold):
+    """Elastic-net has no streamed loop: the fit must run in-core (with a warning)
+    and still produce the sparse-inducing solution."""
+    import logging
+
+    from spark_rapids_ml_tpu.classification import LogisticRegression
+
+    rng = np.random.default_rng(9)
+    X = rng.normal(size=(300, 6)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float64)
+    df = pd.DataFrame({"features": list(X), "label": y})
+    # the package logger sets propagate=False, so capture on the logger itself
+    records = []
+    handler = logging.Handler()
+    handler.emit = records.append
+    logger = logging.getLogger("spark_rapids_ml_tpu.LogisticRegression")
+    logger.addHandler(handler)
+    try:
+        streamed = LogisticRegression(
+            regParam=0.5, elasticNetParam=1.0, maxIter=80
+        ).fit(df)
+    finally:
+        logger.removeHandler(handler)
+    assert any("fitting in-core" in r.getMessage() for r in records)
+    config.set("stream_threshold_bytes", 1 << 40)
+    incore = LogisticRegression(regParam=0.5, elasticNetParam=1.0, maxIter=80).fit(df)
+    np.testing.assert_allclose(
+        streamed.coefficients, incore.coefficients, rtol=1e-5, atol=1e-6
+    )
