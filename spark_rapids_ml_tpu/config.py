@@ -47,6 +47,12 @@ _DEFAULTS: Dict[str, Any] = {
     #   high    = 3-pass bf16 (~2x faster on MXU, error ~2^-22 vs ~2^-24)
     # a TPU-measured accuracy/throughput tradeoff knob; tests pin highest
     "parity_precision": "highest",
+    # fused one-X-read pallas Gram kernel for the PCA covariance fit
+    # (ops/pallas_xtwx.py; the normal-equation solvers still use the XLA
+    # gram_and_xty): "auto" = on for TPU unit-weight f32 fits (measured 6x the
+    # XLA path at 12M x 128), "0" = force XLA, "1" = skip the platform check
+    # (tests — runs the kernel's interpreter off-TPU)
+    "pallas_xtwx": "auto",
 }
 
 _ENV_KEYS: Dict[str, str] = {
@@ -60,6 +66,7 @@ _ENV_KEYS: Dict[str, str] = {
     "spark_fit_mode": "SRML_TPU_SPARK_FIT_MODE",
     "fast_math": "SRML_TPU_FAST_MATH",
     "parity_precision": "SRML_TPU_PARITY_PRECISION",
+    "pallas_xtwx": "SRML_TPU_PALLAS_XTWX",
 }
 
 _overrides: Dict[str, Any] = {}

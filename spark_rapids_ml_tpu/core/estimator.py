@@ -60,6 +60,10 @@ class FitInputs:
     host_label: Optional[np.ndarray] = None
     host_row_weight: Optional[np.ndarray] = None
     row_id: Optional[np.ndarray] = None
+    # True when row_weight is PURELY the pad_rows suffix mask (no sample weights):
+    # kernels may then take prefix-mask fast paths (ops/pallas_xtwx.py) that avoid
+    # streaming a weight vector entirely
+    unit_weight: bool = False
 
 
 # type of the value returned by _get_tpu_fit_func
@@ -191,6 +195,7 @@ class _TpuCaller(_TpuClass, _TpuParams):
             host_label=fd.label,
             host_row_weight=fd.weight,
             row_id=fd.row_id,
+            unit_weight=sw_p is None,
         )
 
     def _build_fit_inputs(self, fd: FeatureData) -> FitInputs:
@@ -229,6 +234,7 @@ class _TpuCaller(_TpuClass, _TpuParams):
             host_label=fd.label,
             host_row_weight=fd.weight,
             row_id=fd.row_id,
+            unit_weight=sw_p is None,
         )
 
     def _build_fit_inputs_from_global(
@@ -239,12 +245,16 @@ class _TpuCaller(_TpuClass, _TpuParams):
         total_rows: int,
         mesh: Any,
         rank_rows: Optional[List[int]] = None,
+        unit_weight: bool = False,
     ) -> FitInputs:
         """FitInputs from pre-placed GLOBAL arrays (multi-host Spark path,
         spark/integration.py: each process contributed its local shard via
         jax.make_array_from_process_local_data). `rank_rows` carries the true
         per-process real-row counts when the caller knows them (allGathered
-        PartitionInfo); otherwise a contiguous layout is assumed."""
+        PartitionInfo); otherwise a contiguous layout is assumed. `unit_weight`
+        asserts the caller built row_weight purely as per-process suffix pad
+        masks (no sample weights) — each device shard is then a prefix mask and
+        kernels may take the fused pallas paths (ops/pallas_xtwx.py)."""
         n_dev = mesh.devices.size
         padded_m = X_global.shape[0]
         if rank_rows is None:
@@ -263,6 +273,7 @@ class _TpuCaller(_TpuClass, _TpuParams):
             mesh=mesh,
             params=dict(self._tpu_params),
             dtype=np.float32 if self._float32_inputs else np.float64,
+            unit_weight=unit_weight,
         )
 
     def _build_sparse_fit_inputs_from_global(
@@ -276,6 +287,7 @@ class _TpuCaller(_TpuClass, _TpuParams):
         mesh: Any,
         rank_rows: Optional[List[int]] = None,
         nnz: int = -1,
+        unit_weight: bool = False,
     ) -> FitInputs:
         """Sparse twin of _build_fit_inputs_from_global: ELL arrays already padded to
         the global max row-width and placed on the mesh (spark/integration.py pads
@@ -298,6 +310,7 @@ class _TpuCaller(_TpuClass, _TpuParams):
             mesh=mesh,
             params=dict(self._tpu_params),
             dtype=np.float32 if self._float32_inputs else np.float64,
+            unit_weight=unit_weight,
         )
 
     def _call_tpu_fit_func(

@@ -107,8 +107,7 @@ class PCA(_PCAClass, _TpuEstimator, _PCAParams):
         base_k = self.getOrDefault("k")
 
         def _fit(inputs: FitInputs):
-            from ..ops.linalg import weighted_covariance
-            from ..ops.pca import pca_attrs_from_cov
+            from ..ops.pca import covariance_for_fit, pca_attrs_from_cov
 
             ks = (
                 [int(p.get("n_components", base_k)) for p in extra_params]
@@ -120,7 +119,12 @@ class PCA(_PCAClass, _TpuEstimator, _PCAParams):
                     raise ValueError(
                         f"k={k} exceeds the number of features {inputs.desc.n}"
                     )
-            cov, mean, wsum = weighted_covariance(inputs.features, inputs.row_weight)
+            cov, mean, wsum = covariance_for_fit(
+                inputs.features,
+                inputs.row_weight,
+                mesh=inputs.mesh,
+                unit_weight=inputs.unit_weight,
+            )
             results = [pca_attrs_from_cov(cov, mean, wsum, k) for k in ks]
             return results if extra_params is not None else results[0]
 

@@ -45,11 +45,64 @@ def _pca_from_cov(cov: jax.Array, k: int):
     return vals, vecs, total_var
 
 
-def pca_fit(X: jax.Array, w: jax.Array, k: int) -> Dict[str, np.ndarray]:
+def use_fused_gram(n_cols: int, unit_weight: bool, dtype=jnp.float32) -> bool:
+    """Whether the fused one-X-read pallas Gram kernel (ops/pallas_xtwx.py) should
+    carry this covariance/normal-equation fit.
+
+    The SEMANTIC requirements — prefix-mask unit weights, a feature width inside
+    the kernel's VMEM budget, f32 data (the kernel accumulates via bf16 splits
+    into f32; an f64 fit must keep the XLA path the user asked for) — are never
+    overridable. The `pallas_xtwx` config only steers the remaining heuristics:
+    "0" forces the XLA path, "1" skips the TPU-platform check (tests/interpret),
+    "auto" requires a real TPU backend."""
+    from .. import config as _config
+
+    mode = str(_config.get("pallas_xtwx")).lower()
+    if mode not in ("0", "false", "off", "1", "true", "on", "auto"):
+        raise ValueError(
+            f"pallas_xtwx must be '0', '1' or 'auto', got '{mode}'."
+        )
+    if mode in ("0", "false", "off"):
+        return False
+    from .pallas_xtwx import MAX_FUSED_COLS
+
+    if not (
+        unit_weight
+        and n_cols <= MAX_FUSED_COLS
+        and jnp.dtype(dtype) == jnp.float32
+    ):
+        return False
+    if mode in ("1", "true", "on"):
+        return True
+    return jax.devices()[0].platform == "tpu"
+
+
+def covariance_for_fit(
+    X: jax.Array, w: jax.Array, mesh=None, unit_weight: bool = False
+):
+    """Covariance dispatch for estimator fits: the fused pallas kernel when the
+    measured win applies (see use_fused_gram), else the XLA sufficient-statistics
+    pass. Both return (cov, mean, wsum) with identical semantics."""
+    if use_fused_gram(X.shape[1], unit_weight, dtype=X.dtype):
+        from ._precision import parity_precision
+        from .pallas_xtwx import covariance_prefix_mask
+
+        # force-on ("1") off-TPU is the tests' escape hatch: Mosaic can't lower
+        # for CPU/GPU backends, so run the kernel's interpreter there
+        interpret = jax.devices()[0].platform != "tpu"
+        return covariance_prefix_mask(
+            X, w, mesh=mesh, precision=parity_precision(), interpret=interpret
+        )
+    return weighted_covariance(X, w)
+
+
+def pca_fit(
+    X: jax.Array, w: jax.Array, k: int, mesh=None, unit_weight: bool = False
+) -> Dict[str, np.ndarray]:
     """Distributed PCA fit. X: (padded_m, d) rows sharded over the mesh; w: padding/
     sample weights. Returns host-side model attributes (the analog of the model row the
     reference collects, feature.py:260-285)."""
-    cov, mean, wsum = weighted_covariance(X, w)
+    cov, mean, wsum = covariance_for_fit(X, w, mesh=mesh, unit_weight=unit_weight)
     return pca_attrs_from_cov(cov, mean, wsum, k)
 
 

@@ -180,6 +180,7 @@ def _barrier_train_udf(estimator_payload: bytes) -> Callable:
                 fd.n_cols, mesh,
                 rank_rows=[i.n_rows for i in infos],
                 nnz=sum(i.nnz for i in infos if i.nnz > 0),
+                unit_weight=fd.weight is None,
             )
         else:
             X_local = np.zeros((pad_to, fd.n_cols), np.float32)
@@ -188,6 +189,7 @@ def _barrier_train_udf(estimator_payload: bytes) -> Callable:
             fit_inputs = est._build_fit_inputs_from_global(
                 X_global, w_global, label_global, total_rows, mesh,
                 rank_rows=[i.n_rows for i in infos],
+                unit_weight=fd.weight is None,
             )
 
         # run the estimator's fit program (same SPMD program on every host)

@@ -1,0 +1,157 @@
+"""Fused pallas Gram kernel (ops/pallas_xtwx.py): interpret-mode parity vs the XLA
+weighted_covariance, single-device and per-shard under shard_map, plus the
+estimator-facing dispatch gate (ops/pca.py::use_fused_gram)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_ml_tpu import config as srml_config
+from spark_rapids_ml_tpu.ops.linalg import weighted_covariance
+from spark_rapids_ml_tpu.ops.pallas_xtwx import (
+    covariance_prefix_mask,
+    xtx_pallas,
+)
+
+
+def _data(n=1000, d=24, seed=0):
+    # modest column offsets: the S2 - n*mean^2 correction cancels ~|mean|^2/var of
+    # the f32 mantissa in BOTH paths, so huge offsets would only test rounding noise
+    rng = np.random.default_rng(seed)
+    return (rng.normal(0, 2, (n, d)) + rng.normal(0, 0.5, (d,))).astype(np.float32)
+
+
+def test_xtx_matches_numpy_with_prefix_mask():
+    X = _data()
+    n_valid = 937  # ragged: mask must zero rows 937..999 in-kernel
+    s2, s1 = xtx_pallas(jnp.asarray(X), n_valid, interpret=True)
+    Xv = X[:n_valid].astype(np.float64)
+    np.testing.assert_allclose(np.asarray(s2), Xv.T @ Xv, rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(s1), Xv.sum(0), rtol=1e-4)
+
+
+def test_xtx_ragged_tail_block_masked():
+    # n not a multiple of the block: the edge block loads unspecified values that
+    # the in-kernel mask must zero before arithmetic
+    X = _data(n=777)
+    s2, s1 = xtx_pallas(jnp.asarray(X), 777, interpret=True, blk=512)
+    Xv = X.astype(np.float64)
+    np.testing.assert_allclose(np.asarray(s2), Xv.T @ Xv, rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(s1), Xv.sum(0), rtol=1e-4)
+
+
+@pytest.mark.parametrize("precision", ["DEFAULT", "HIGH", "HIGHEST"])
+def test_covariance_matches_xla_path(precision):
+    """Parity across precision tiers: on the CPU interpret backend every tier is a
+    real f32 matmul, so all must agree with the XLA weighted_covariance."""
+    X = _data(n=1203)
+    w = np.ones((1203,), np.float32)
+    w[1100:] = 0.0  # suffix pad mask, the pad_rows contract
+    cov_ref, mean_ref, ws_ref = jax.jit(weighted_covariance)(
+        jnp.asarray(X), jnp.asarray(w)
+    )
+    cov_p, mean_p, ws_p = covariance_prefix_mask(
+        jnp.asarray(X),
+        jnp.asarray(w),
+        precision=getattr(jax.lax.Precision, precision),
+        interpret=True,
+    )
+    np.testing.assert_allclose(np.asarray(cov_p), np.asarray(cov_ref), rtol=2e-3, atol=5e-4)
+    np.testing.assert_allclose(np.asarray(mean_p), np.asarray(mean_ref), rtol=1e-5, atol=1e-6)
+    assert float(ws_p) == pytest.approx(float(ws_ref))
+
+
+def test_covariance_sharded_psum(n_devices):
+    """8-device mesh: per-shard kernel + psum must equal the single-device result.
+    Padding sits at the global end (pad_rows), so only the last shard masks rows."""
+    from spark_rapids_ml_tpu.parallel.mesh import get_mesh, shard_array
+    from spark_rapids_ml_tpu.parallel.partition import pad_rows
+
+    X = _data(n=1000, d=16)
+    mesh = get_mesh(n_devices)
+    Xp, w, _ = pad_rows(X, n_devices)
+    Xd = shard_array(Xp, mesh)
+    wd = shard_array(w, mesh)
+    cov_p, mean_p, ws_p = covariance_prefix_mask(Xd, wd, mesh=mesh, interpret=True)
+    cov_ref, mean_ref, ws_ref = jax.jit(weighted_covariance)(
+        jnp.asarray(Xp), jnp.asarray(w)
+    )
+    np.testing.assert_allclose(np.asarray(cov_p), np.asarray(cov_ref), rtol=2e-3, atol=5e-4)
+    np.testing.assert_allclose(np.asarray(mean_p), np.asarray(mean_ref), rtol=1e-5, atol=1e-6)
+    assert float(ws_p) == pytest.approx(1000.0)
+
+
+def test_cse_guard_does_not_change_result():
+    X = _data(n=500)
+    s2a, _ = xtx_pallas(jnp.asarray(X), 500, interpret=True, cse_guard=0.0)
+    s2b, _ = xtx_pallas(jnp.asarray(X), 500, interpret=True, cse_guard=1e-37)
+    np.testing.assert_allclose(np.asarray(s2a), np.asarray(s2b), rtol=1e-6)
+
+
+def test_use_fused_gram_gate():
+    from spark_rapids_ml_tpu.ops.pca import use_fused_gram
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    # auto: requires unit weights + narrow-enough features + f32 + TPU
+    assert use_fused_gram(128, unit_weight=True) == on_tpu
+    assert use_fused_gram(128, unit_weight=False) is False
+    assert use_fused_gram(4096, unit_weight=True) is False
+    assert use_fused_gram(128, unit_weight=True, dtype=np.float64) is False
+    srml_config.set("pallas_xtwx", "0")
+    try:
+        assert use_fused_gram(128, unit_weight=True) is False
+    finally:
+        srml_config.unset("pallas_xtwx")
+    srml_config.set("pallas_xtwx", "1")
+    try:
+        # force-on overrides only the platform check — never the SEMANTIC
+        # requirements (sample weights would be silently dropped, wide features
+        # would blow the kernel's VMEM budget, f64 would lose the user's precision)
+        assert use_fused_gram(128, unit_weight=True) is True
+        assert use_fused_gram(128, unit_weight=False) is False
+        assert use_fused_gram(4096, unit_weight=True) is False
+        assert use_fused_gram(128, unit_weight=True, dtype=np.float64) is False
+    finally:
+        srml_config.unset("pallas_xtwx")
+
+
+def test_pca_estimator_fused_dispatch_runs_kernel(monkeypatch):
+    """End-to-end PCA.fit through the FUSED branch: force the gate on and thread
+    interpret=True into covariance_prefix_mask so the pallas kernel really executes
+    on the CPU backend. Model attributes must match the XLA-path fit, and the
+    kernel must actually have been invoked (not silently fall back)."""
+    import pandas as pd
+
+    from spark_rapids_ml_tpu.feature import PCA
+    from spark_rapids_ml_tpu.ops import pallas_xtwx as px
+
+    X = _data(n=400, d=12, seed=3)
+    df = pd.DataFrame({"features": list(X)})
+    m_ref = PCA(k=4, inputCol="features").fit(df)
+
+    calls = []
+    real = px.covariance_prefix_mask
+
+    def spy(Xa, wa, mesh=None, **kw):
+        calls.append(1)
+        kw["interpret"] = True
+        return real(Xa, wa, mesh=mesh, **kw)
+
+    monkeypatch.setattr(px, "covariance_prefix_mask", spy)
+    srml_config.set("pallas_xtwx", "1")
+    try:
+        m_fused = PCA(k=4, inputCol="features").fit(df)
+    finally:
+        srml_config.unset("pallas_xtwx")
+    assert calls, "fused covariance kernel was not dispatched"
+    np.testing.assert_allclose(
+        np.asarray(m_ref.components_), np.asarray(m_fused.components_),
+        rtol=1e-4, atol=1e-5,
+    )
+    np.testing.assert_allclose(
+        np.asarray(m_ref.explained_variance_),
+        np.asarray(m_fused.explained_variance_),
+        rtol=1e-4,
+    )
