@@ -1,0 +1,348 @@
+"""End-to-end execution of the barrier FIT data plane (spark/integration.py::
+fit_on_spark + _barrier_train_udf) against a protocol mock with real barrier-task
+semantics: N partitions run the udf closure in N concurrent threads, the fake
+BarrierTaskContext.allGather is a genuine thread barrier exchanging the
+encode/decode_partition_info payloads, and the multi-host global-array assembly
+(jax.make_array_from_process_local_data) is simulated by a rank-ordered concat
+across the threads onto the real 8-device mesh. pyspark itself is uninstallable
+here (no network); this mock drives every line of the plane except the real
+jax.distributed process bootstrap, which tests/test_multihost_bootstrap.py covers
+with real processes.
+
+Reference analog: the `dataset.mapInPandas(_train_udf).rdd.barrier()` fan-out of
+reference core.py:1005-1011."""
+
+import pickle
+import sys
+import threading
+import types
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import jax
+
+from spark_rapids_ml_tpu import config as srml_config
+
+
+# ---------------------------------------------------------------- fake pyspark
+
+class FakeTaskInfo:
+    def __init__(self, address="127.0.0.1:0"):
+        self.address = address
+
+
+class FakeBarrierTaskContext:
+    """Thread-local barrier context: allGather really blocks until every task of
+    the stage has contributed, then all see the full payload list — the semantics
+    the udf's control plane depends on."""
+
+    _local = threading.local()
+    _stage = None  # set by FakeBarrierRDD before launching threads
+    _asm_stage = None  # the GlobalAssembler's stage, for abort-on-failure
+
+    @classmethod
+    def get(cls):
+        return cls._local.ctx
+
+    def __init__(self, rank, stage):
+        self._rank = rank
+        self._stage_ref = stage
+
+    def partitionId(self):
+        return self._rank
+
+    def getTaskInfos(self):
+        return [FakeTaskInfo() for _ in range(self._stage_ref.n_tasks)]
+
+    def allGather(self, payload: str):
+        st = self._stage_ref
+        with st.lock:
+            st.gathered[self._rank] = payload
+        st.barrier.wait(timeout=120)
+        out = [st.gathered[r] for r in range(st.n_tasks)]
+        st.barrier.wait(timeout=120)  # don't reuse the dict until all have read
+        return out
+
+
+class _Stage:
+    def __init__(self, n_tasks):
+        self.n_tasks = n_tasks
+        self.barrier = threading.Barrier(n_tasks)
+        self.lock = threading.Lock()
+        self.gathered = {}
+
+
+class GlobalAssembler:
+    """Simulates jax.make_array_from_process_local_data for N simulated hosts in
+    one real process: each thread contributes its local block; blocks concat in
+    rank order into the true global array placed on the real mesh. Call sites run
+    in the same order in every thread (w, label?, X), so a per-thread call index
+    pairs up corresponding calls."""
+
+    def __init__(self, stage):
+        self.stage = stage
+        self.calls = {}  # call_idx -> {rank: local}
+        self.results = {}  # call_idx -> global jax.Array
+        self._tls = threading.local()
+
+    def __call__(self, sharding, local, **kw):
+        idx = getattr(self._tls, "idx", 0)
+        self._tls.idx = idx + 1
+        rank = FakeBarrierTaskContext.get().partitionId()
+        st = self.stage
+        with st.lock:
+            self.calls.setdefault(idx, {})[rank] = np.asarray(local)
+        st.barrier.wait(timeout=120)
+        with st.lock:
+            if idx not in self.results:
+                blocks = [self.calls[idx][r] for r in range(st.n_tasks)]
+                self.results[idx] = jax.device_put(
+                    np.concatenate(blocks, axis=0), sharding
+                )
+        st.barrier.wait(timeout=120)
+        return self.results[idx]
+
+
+class FakeConf:
+    def get(self, key, default=None):
+        return {"spark.master": "local[8]"}.get(key, default)
+
+
+class FakeSparkContext:
+    def getConf(self):
+        return FakeConf()
+
+
+class FakeSession:
+    def __init__(self):
+        self.sparkContext = FakeSparkContext()
+        self.version = "3.5.1"
+
+
+class FakeBarrierRDD:
+    def __init__(self, udf, pdf, n_partitions):
+        self.udf = udf
+        self.pdf = pdf
+        self.n_partitions = n_partitions
+
+    def barrier(self):
+        return self
+
+    def mapPartitions(self, f):
+        return self
+
+    def withResources(self, rp):
+        return self
+
+    def collect(self):
+        """One thread per barrier task; each consumes its partition as an iterator
+        of two batches (mirroring Arrow batch streaming) and runs the udf."""
+        stage = _Stage(self.n_partitions)
+        FakeBarrierTaskContext._stage = stage
+        chunks = np.array_split(np.arange(len(self.pdf)), self.n_partitions)
+        rows, errs = [], []
+        lock = threading.Lock()
+
+        def run(rank, idx):
+            FakeBarrierTaskContext._local.ctx = FakeBarrierTaskContext(rank, stage)
+            part = self.pdf.iloc[idx].reset_index(drop=True)
+            batches = iter(
+                [part.iloc[: len(part) // 2], part.iloc[len(part) // 2:]]
+            )
+            try:
+                for out_pdf in self.udf(batches):
+                    with lock:
+                        rows.extend(out_pdf.to_dict("records"))
+            except Exception as e:  # surface thread failures to pytest
+                with lock:
+                    errs.append(e)
+                # release peers blocked on either barrier so the suite fails
+                # fast instead of deadlocking
+                stage.barrier.abort()
+                asm_stage = FakeBarrierTaskContext._asm_stage
+                if asm_stage is not None:
+                    asm_stage.barrier.abort()
+
+        threads = [
+            threading.Thread(target=run, args=(r, idx))
+            for r, idx in enumerate(chunks)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=150)
+        if errs:
+            raise errs[0]
+        return rows
+
+
+class _MappedDF:
+    def __init__(self, rdd):
+        self.rdd = rdd
+
+
+class FakeFitSparkDF:
+    """The DataFrame surface fit_on_spark touches: repartition / mapInPandas /
+    sparkSession. Module name makes _is_spark_df treat it as a Spark frame."""
+
+    def __init__(self, pdf, n_partitions=2):
+        self._pdf = pdf.reset_index(drop=True)
+        self._n_partitions = n_partitions
+        self.sparkSession = FakeSession()
+
+    def repartition(self, n):
+        return FakeFitSparkDF(self._pdf, n)
+
+    def mapInPandas(self, udf, schema):
+        assert schema == "model binary"
+        return _MappedDF(FakeBarrierRDD(udf, self._pdf, self._n_partitions))
+
+    # transform-plane surface, so model.transform on the fake frame also works
+    def limit(self, n):
+        return FakeFitSparkDF(self._pdf.head(n), 1)
+
+    def toPandas(self):
+        return self._pdf
+
+
+FakeFitSparkDF.__module__ = "pyspark.sql.mock"
+
+
+@pytest.fixture
+def barrier_env(monkeypatch):
+    """Injects the fake pyspark module, no-ops the jax.distributed bootstrap
+    (single real process), and patches the global-array assembly to the
+    rank-ordered thread concat."""
+    fake_pyspark = types.ModuleType("pyspark")
+    fake_pyspark.BarrierTaskContext = FakeBarrierTaskContext
+    monkeypatch.setitem(sys.modules, "pyspark", fake_pyspark)
+
+    from spark_rapids_ml_tpu.parallel import bootstrap
+
+    boot_calls = []
+    monkeypatch.setattr(
+        bootstrap,
+        "init_process_group",
+        lambda **kw: boot_calls.append(kw),
+    )
+    assembler_holder = {}
+
+    real_make = jax.make_array_from_process_local_data
+
+    def fake_make(sharding, local, **kw):
+        return assembler_holder["asm"](sharding, local, **kw)
+
+    monkeypatch.setattr(jax, "make_array_from_process_local_data", fake_make)
+
+    def install(n_tasks):
+        stage = _Stage(n_tasks)
+        assembler_holder["asm"] = GlobalAssembler(stage)
+        FakeBarrierTaskContext._asm_stage = stage
+        return boot_calls
+
+    install.real_make = real_make
+    return install
+
+
+def _blob_pdf(n=256, d=6, seed=0, label=None):
+    rng = np.random.default_rng(seed)
+    X = np.concatenate(
+        [rng.normal(-2, 1, (n // 2, d)), rng.normal(2, 1, (n - n // 2, d))]
+    ).astype(np.float32)
+    rng.shuffle(X)
+    pdf = pd.DataFrame({"features": list(X)})
+    if label == "binary":
+        w_true = rng.normal(size=(d,))
+        p = 1 / (1 + np.exp(-(X @ w_true)))
+        pdf["label"] = (rng.random(n) < p).astype(np.float64)
+    elif label == "cont":
+        w_true = rng.normal(size=(d,))
+        pdf["label"] = (X @ w_true + 0.1 * rng.normal(size=n)).astype(np.float64)
+    return pdf
+
+
+def test_kmeans_fit_on_spark_matches_direct(barrier_env):
+    """4 simulated barrier hosts; n divisible by every pad boundary so both data
+    planes see byte-identical global arrays -> identical centers."""
+    from spark_rapids_ml_tpu.clustering import KMeans
+    from spark_rapids_ml_tpu.spark.integration import fit_on_spark
+
+    boot_calls = barrier_env(4)
+    pdf = _blob_pdf(n=256)
+    est = KMeans(k=2, maxIter=10, seed=7)
+    direct = est.fit(pdf)
+
+    sdf = FakeFitSparkDF(pdf, n_partitions=4)
+    model = fit_on_spark(KMeans(k=2, maxIter=10, seed=7), sdf, num_hosts=4)
+
+    assert len(boot_calls) == 4  # every simulated host bootstrapped
+    ranks = sorted(c["process_id"] for c in boot_calls)
+    assert ranks == [0, 1, 2, 3]
+    # all hosts agreed on one coordinator (rank 0's)
+    assert len({c["coordinator_address"] for c in boot_calls}) == 1
+    np.testing.assert_allclose(
+        np.sort(np.asarray(model.cluster_centers_), axis=0),
+        np.sort(np.asarray(direct.cluster_centers_), axis=0),
+        rtol=1e-5,
+        atol=1e-5,
+    )
+    # the barrier-fit model transforms identically to the direct model
+    got = model.transform(pdf)["prediction"].to_numpy()
+    want = direct.transform(pdf)["prediction"].to_numpy()
+    assert (got == want).mean() == 1.0
+
+
+def test_logreg_fit_on_spark_matches_direct(barrier_env):
+    from spark_rapids_ml_tpu.classification import LogisticRegression
+    from spark_rapids_ml_tpu.spark.integration import fit_on_spark
+
+    barrier_env(3)
+    pdf = _blob_pdf(n=240, label="binary")
+    est = LogisticRegression(maxIter=30, regParam=0.01)
+    direct = est.fit(pdf)
+
+    sdf = FakeFitSparkDF(pdf, n_partitions=3)
+    model = fit_on_spark(LogisticRegression(maxIter=30, regParam=0.01), sdf, num_hosts=3)
+
+    np.testing.assert_allclose(
+        np.asarray(model.coefficients), np.asarray(direct.coefficients),
+        rtol=1e-4, atol=1e-5,
+    )
+    np.testing.assert_allclose(
+        np.asarray(model.intercept), np.asarray(direct.intercept),
+        rtol=1e-4, atol=1e-5,
+    )
+
+
+def test_estimator_fit_routes_to_barrier_plane(barrier_env):
+    """est.fit(spark_df) with spark_fit_mode=barrier goes through fit_on_spark —
+    the dispatch the reference performs inside _fit_internal (core.py:1005-1011)."""
+    from spark_rapids_ml_tpu.regression import LinearRegression
+
+    barrier_env(2)
+    pdf = _blob_pdf(n=128, label="cont")
+    direct = LinearRegression(regParam=0.0).fit(pdf)
+
+    srml_config.set("spark_fit_mode", "barrier")
+    try:
+        est = LinearRegression(regParam=0.0)
+        est._num_workers = 2  # num_hosts for the barrier plane
+        model = est.fit(FakeFitSparkDF(pdf, n_partitions=2))
+    finally:
+        srml_config.unset("spark_fit_mode")
+    np.testing.assert_allclose(
+        np.asarray(model.coefficients), np.asarray(direct.coefficients),
+        rtol=1e-4, atol=1e-4,
+    )
+
+
+def test_empty_partition_raises_actionable_error(barrier_env):
+    from spark_rapids_ml_tpu.clustering import KMeans
+    from spark_rapids_ml_tpu.spark.integration import fit_on_spark
+
+    barrier_env(4)
+    pdf = _blob_pdf(n=2)  # 2 rows over 4 partitions -> empty barrier partitions
+    with pytest.raises(RuntimeError, match="empty partition"):
+        fit_on_spark(KMeans(k=2), FakeFitSparkDF(pdf, 4), num_hosts=4)
