@@ -85,9 +85,28 @@ def _device_init_watchdog(attempts: int = 2, timeout_s: float = 90.0) -> None:
 
 
 def main() -> None:
+    # total wall budget: anchored BEFORE the watchdog probes and carried through
+    # the CPU-fallback re-exec (SRML_BENCH_DEADLINE_TS), so wedged-tunnel probe
+    # time counts against the same driver timeout. Families are deadline-guarded
+    # (benchmark/chip_bench.py); unfinished ones land in `skipped`.
+    budget_s = float(os.environ.get("SRML_BENCH_BUDGET_S", "240"))
+    if "SRML_BENCH_DEADLINE_TS" in os.environ:
+        deadline_ts = float(os.environ["SRML_BENCH_DEADLINE_TS"])
+    else:
+        deadline_ts = time.time() + budget_s
+        os.environ["SRML_BENCH_DEADLINE_TS"] = str(deadline_ts)
     _device_init_watchdog()
+
     import jax
     import jax.numpy as jnp
+
+    try:
+        # persistent compile cache: family benches compile ~10 programs; repeat
+        # runs (and the driver's run after this session's) skip all of it
+        jax.config.update("jax_compilation_cache_dir", "/tmp/srml_jax_cache")
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass
 
     from spark_rapids_ml_tpu.ops.kmeans import lloyd_fit
     from spark_rapids_ml_tpu.parallel.mesh import get_mesh, shard_array
@@ -146,50 +165,64 @@ def main() -> None:
             ts.append(time.perf_counter() - t0)
         return float(np.median(ts)), out
 
-    # compile warmup for both cache entries (1-iter and full fit), excluded from
-    # timing; the 1-iter fit anchors the marginal (per-iteration) rate below
-    _sync(lloyd_fit(Xd, w, init, 0.0, 1)[0])
-    centers, inertia, n_iter = lloyd_fit(Xd, w, init, 0.0, iters)
-    _sync(centers)
-
-    fit_time, (centers, inertia, n_iter) = _timed(
-        lambda: lloyd_fit(Xd, w, init, 0.0, iters)
-    )
-    t1_time, _ = _timed(lambda: lloyd_fit(Xd, w, init, 0.0, 1))
-    n_iter = int(n_iter)
-
     n_chips = jax.device_count()
-    # headline: whole-fit throughput (reference protocol base.py:232-285 times the
-    # whole fit); the marginal rate (fit constants cancelled) is a secondary
-    value = n_rows * n_iter / fit_time / n_chips
-    if n_iter > 1:
-        marginal_t = max(fit_time - t1_time, 1e-9) / (n_iter - 1)
-        marginal_rate_chip = n_rows / marginal_t / n_chips
-    else:
-        # fit_time - t1_time is pure timing noise at n_iter=1; no marginal rate
-        print(
-            "bench: fit converged in <=1 iteration; marginal rate undefined",
-            file=sys.stderr,
+    peak_bw = 819e9  # v5e HBM ~819 GB/s per chip
+
+    def _kmeans_rates(X_, w_, init_, n_, d_):
+        """THE Lloyd timing recipe (protocol 2): whole-fit throughput (reference
+        protocol base.py:232-285 times the whole fit) plus the steady-state
+        marginal rate (full fit minus a 1-iter fit cancels per-fit constants)
+        and the two-X-read HBM roofline fraction — one helper so the headline
+        and the 256-col tier can never drift apart. The Lloyd step reads X twice
+        per iteration (distance matmul + one-hot update) plus the (n, k)
+        intermediates once each; peak_bw is per-chip HBM."""
+        _sync(lloyd_fit(X_, w_, init_, 0.0, 1)[0])  # compile warmups, untimed
+        _sync(lloyd_fit(X_, w_, init_, 0.0, iters)[0])
+        t_full, (centers_, inertia_, it_) = _timed(
+            lambda: lloyd_fit(X_, w_, init_, 0.0, iters)
         )
-        marginal_t = None
-        marginal_rate_chip = None
+        t_one, _ = _timed(lambda: lloyd_fit(X_, w_, init_, 0.0, 1))
+        it_ = int(it_)
+        whole = n_ * it_ / t_full / n_chips
+        if it_ > 1:
+            marg_t = max(t_full - t_one, 1e-9) / (it_ - 1)
+            marginal = n_ / marg_t / n_chips
+        else:
+            # t_full - t_one is pure timing noise at n_iter=1; no marginal rate
+            print(
+                "bench: fit converged in <=1 iteration; marginal rate undefined",
+                file=sys.stderr,
+            )
+            marg_t, marginal = None, None
+        bytes_per_iter = 2 * n_ * d_ * 4 + 2 * n_ * k * 4
+        roof = (
+            (bytes_per_iter / peak_bw) / marg_t / n_chips
+            if on_tpu and marg_t is not None
+            else None
+        )
+        iter_ceiling = peak_bw / (2 * d_ * 4 + 2 * k * 4)
+        return {
+            "t_full": t_full,
+            "centers": centers_,
+            "inertia": inertia_,
+            "n_iter": it_,
+            "whole": whole,
+            "marginal": marginal,
+            "roofline_frac": roof,
+            "whole_frac": whole / iter_ceiling if on_tpu else None,
+        }
+
+    hr = _kmeans_rates(Xd, w, init, n_rows, n_cols)
+    fit_time, inertia, n_iter = hr["t_full"], hr["inertia"], hr["n_iter"]
+    value = hr["whole"]
+    marginal_rate_chip = hr["marginal"]
+    roofline_frac = hr["roofline_frac"]
 
     # estimated MFU: one Lloyd iteration is ~4*n*d*k matmul FLOPs (2ndk distance
     # cross-term + 2nkd one-hot update); peak per chip assumes v5e f32 on MXU
     flops = 4.0 * n_rows * n_cols * k * n_iter
     peak_f32 = 98e12  # v5e ~197 TFLOP/s bf16 -> ~98 TFLOP/s f32-equivalent
     est_mfu = flops / fit_time / n_chips / peak_f32 if on_tpu else None
-    # HBM roofline fraction of the STEADY-STATE iteration: the XLA Lloyd step
-    # reads X twice (distance matmul + one-hot update) plus the (n,k)
-    # distance/one-hot intermediates once each; at small k the X reads dominate
-    # per-chip: each chip streams its row shard, and peak_bw is per-chip HBM
-    bytes_per_iter = 2 * n_rows * n_cols * 4 + 2 * n_rows * k * 4
-    peak_bw = 819e9  # v5e HBM ~819 GB/s
-    roofline_frac = (
-        (bytes_per_iter / peak_bw) / marginal_t / n_chips
-        if on_tpu and marginal_t is not None
-        else None
-    )
 
     # profiler trace AFTER the timed region (trace capture inflates the timed run)
     from spark_rapids_ml_tpu.profiling import trace as xplane_trace
@@ -242,15 +275,72 @@ def main() -> None:
         except Exception as e:  # pragma: no cover
             print(f"bench: fused pallas lloyd unavailable: {e}", file=sys.stderr)
 
-    # secondary metric: PCA covariance-fit throughput on the same matrix (the second
-    # north-star algorithm; one warm + one timed pass, reported in the same line)
-    from spark_rapids_ml_tpu.ops.linalg import weighted_covariance
+    # per-family secondaries: a number AND a quality score for every algorithm
+    # family (reference protocol base.py:232-285), deadline-guarded. PCA (the
+    # second north-star) now runs the fused pallas Gram kernel with a chained
+    # marginal-rate protocol — the old one-warm-one-timed whole pass measured
+    # mostly the ~67 ms tunnel dispatch overhead.
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from benchmark.chip_bench import make_ctx, run_families
 
-    cov_jit = jax.jit(weighted_covariance)
-    cov, mean, wsum = cov_jit(Xd, w)
-    _sync(cov)
-    pca_time, _ = _timed(lambda: cov_jit(Xd, w))
-    pca_rows_per_sec_chip = n_rows / pca_time / n_chips
+    ctx = make_ctx(
+        Xd, w, mesh, on_tpu, platform,
+        repo_root=os.path.dirname(os.path.abspath(__file__)),
+    )
+    family_secondary = run_families(ctx, deadline_ts=deadline_ts - 45.0)
+
+    # 256-col variants of the two north-star algorithms (BASELINE targets are
+    # x256): drop the 128-col matrix first — 6 GiB each, both won't fit
+    wide_secondary = {}
+    if time.time() < deadline_ts - 30.0:
+        try:
+            # drop every live reference (ctx holds one) so HBM is actually freed
+            ctx = dict(ctx, X=None, w=None)
+            del Xd, w
+            n256, d256 = (6_000_000, 256) if on_tpu else (50_000, 64)
+            rowsh256 = NamedSharding(mesh, P("data", None))
+
+            @functools.partial(jax.jit, out_shardings=(rowsh256, None))
+            def make_wide(key):
+                k1, k2, k3 = jax.random.split(key, 3)
+                c = jax.random.normal(k1, (k, d256), jnp.float32) * 5.0
+                a = jax.random.randint(k2, (n256,), 0, k)
+                Xw_ = c[a] + jax.random.normal(k3, (n256, d256), jnp.float32)
+                return Xw_, Xw_[:k] * 1.0
+
+            X256, init256 = make_wide(jax.random.PRNGKey(1))
+            _sync(X256[:1])
+            w256 = shard_array(np.ones((n256,), np.float32), mesh)
+            wr = _kmeans_rates(X256, w256, init256, n256, d256)
+            # key names carry the REAL width: the CPU-fallback tier runs 64 cols
+            # and must not masquerade as the 256-col north-star shape
+            tag = f"kmeans_{d256}col"
+            if wr["marginal"] is not None:
+                wide_secondary[f"{tag}_marginal_rows_per_sec_per_chip"] = round(
+                    wr["marginal"], 1
+                )
+                wide_secondary[f"{tag}_frac_of_ceiling"] = (
+                    round(wr["roofline_frac"], 3)
+                    if wr["roofline_frac"] is not None
+                    else None
+                )
+            if time.time() < deadline_ts - 20.0:
+                ctx256 = dict(ctx)
+                ctx256.update(X=X256, w=w256)
+                from benchmark.chip_bench import bench_pca
+
+                p256 = bench_pca(ctx256)
+                wide_secondary[f"pca_{d256}col_rows_per_sec_per_chip"] = p256.get(
+                    "pca_cov_rows_per_sec_per_chip"
+                )
+                wide_secondary[f"pca_{d256}col_roofline_frac"] = p256.get(
+                    "pca_roofline_frac"
+                )
+        except Exception as e:
+            print(f"bench: 256-col tier failed: {e}", file=sys.stderr)
+            wide_secondary["wide_tier_error"] = str(e)[:200]
+    else:
+        wide_secondary["skipped_wide"] = True
 
     baseline_path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_BASELINE.json")
     vs_baseline = 1.0
@@ -296,43 +386,56 @@ def main() -> None:
     metric = "kmeans_lloyd_rows_per_sec_per_chip"
     if not on_tpu:
         metric += f"_{platform}_fallback"
-    print(
-        json.dumps(
-            {
-                "metric": metric,
-                "value": round(value, 1),
-                "unit": "rows*iters/sec/chip",
-                "vs_baseline": round(vs_baseline, 4),
-                "secondary": {
-                    "kmeans_marginal_rows_per_sec_per_chip": (
-                        round(marginal_rate_chip, 1)
-                        if marginal_rate_chip is not None
-                        else None
-                    ),
-                    "kmeans_n_iter": n_iter,
-                    "kmeans_fast_math_rows_per_sec_per_chip": round(
-                        fast_rows_per_sec_chip, 1
-                    ),
-                    "pca_cov_rows_per_sec_per_chip": round(pca_rows_per_sec_chip, 1),
-                    "kmeans_fused_pallas_rows_per_sec_per_chip": (
-                        round(fused_rows_per_sec_chip, 1)
-                        if fused_rows_per_sec_chip is not None
-                        else None
-                    ),
-                    "fused_parity_ok": fused_parity_ok,
-                    "est_mfu": round(est_mfu, 4) if est_mfu is not None else None,
-                    "roofline_frac": (
-                        round(roofline_frac, 3) if roofline_frac is not None else None
-                    ),
-                    "xplane_trace": trace_dir,
-                    "platform": platform,
-                    "n_rows": n_rows,
-                    "n_cols": n_cols,
-                    "kmeans_inertia": float(inertia),
-                },
-            }
+    # whole-fit ceiling: the marginal two-X-read roofline applied to n_iter
+    # iterations (per-fit constants excluded — which is why whole-fit frac < the
+    # marginal roofline_frac)
+    iter_ceiling = peak_bw / (2 * n_cols * 4 + 2 * k * 4)
+    secondary = {
+        "kmeans_marginal_rows_per_sec_per_chip": (
+            round(marginal_rate_chip, 1) if marginal_rate_chip is not None else None
+        ),
+        "kmeans_n_iter": n_iter,
+        "kmeans_frac_of_ceiling": (
+            round(value / iter_ceiling, 3) if on_tpu else None
+        ),
+        "kmeans_fast_math_rows_per_sec_per_chip": round(fast_rows_per_sec_chip, 1),
+        "kmeans_fused_pallas_rows_per_sec_per_chip": (
+            round(fused_rows_per_sec_chip, 1)
+            if fused_rows_per_sec_chip is not None
+            else None
+        ),
+        "fused_parity_ok": fused_parity_ok,
+        "est_mfu": round(est_mfu, 4) if est_mfu is not None else None,
+        "roofline_frac": (
+            round(roofline_frac, 3) if roofline_frac is not None else None
+        ),
+        "xplane_trace": trace_dir,
+        "platform": platform,
+        "n_rows": n_rows,
+        "n_cols": n_cols,
+        "kmeans_inertia": float(inertia),
+        "bench_budget_s": budget_s,
+    }
+    secondary.update(family_secondary)
+    secondary.update(wide_secondary)
+    line = {
+        "metric": metric,
+        "value": round(value, 1),
+        "unit": "rows*iters/sec/chip",
+        "vs_baseline": round(vs_baseline, 4),
+        "secondary": secondary,
+    }
+    # cumulative on-disk record (evidence survives even if a later run times out)
+    try:
+        results_dir = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "benchmark", "results"
         )
-    )
+        os.makedirs(results_dir, exist_ok=True)
+        with open(os.path.join(results_dir, f"chip_bench_{platform}.json"), "w") as f:
+            json.dump(line, f, indent=1)
+    except OSError:
+        pass
+    print(json.dumps(line))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,564 @@
+#
+# On-chip per-family benchmarks: a number AND a quality score for every algorithm
+# family, following the reference's timed-fit-with-quality-score protocol
+# (reference python/benchmark/benchmark/base.py:232-285 — fit_time + e.g. kmeans
+# inertia / classification accuracy / ANN recall). bench.py runs these as
+# secondaries after the KMeans headline and merges the dict into its one JSON line.
+#
+# Measurement notes (all TPU-measured, see bench.py):
+#   * single dispatches through the axon tunnel carry ~67 ms of dispatch+sync
+#     overhead — sub-second kernels are timed with a chained multi-pass marginal
+#     protocol (CSE defeated via runtime scalars) where it matters (PCA/LinReg);
+#     multi-second fits (LogReg/RF/UMAP) amortize it and are timed whole.
+#   * every throughput metric carries a `*_frac_of_ceiling` versus a
+#     roofline-derived ceiling (HBM single-read bandwidth or MXU peak, whichever
+#     binds) so the number is anchored to the hardware, not to a previous run.
+#   * a global deadline guards the driver's bench timeout: families run in
+#     priority order and unfinished ones are reported in `skipped`.
+#
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Callable, Dict, List
+
+import numpy as np
+
+PEAK_BW = 819e9  # v5e HBM GB/s per chip
+PEAK_BF16 = 197e12  # v5e MXU bf16 FLOP/s per chip
+PEAK_F32 = 98e12
+
+
+def _sync(*arrays):
+    return [np.asarray(a) for a in arrays]
+
+
+def _timed(fn, repeats=2):
+    out = fn()
+    _sync(out[0] if isinstance(out, tuple) else out)
+    ts = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        out = fn()
+        _sync(out[0] if isinstance(out, tuple) else out)
+        ts.append(time.perf_counter() - t0)
+    return float(np.median(ts)), out
+
+
+def _accuracy(pred: np.ndarray, y: np.ndarray) -> float:
+    return float((pred == y).mean())
+
+
+# --------------------------------------------------------------------------- pca
+
+
+def bench_pca(ctx) -> Dict:
+    """Fused covariance marginal rate at the headline shape + parity vs the XLA
+    path. Ceiling: one HBM read of X (the kernel's whole design point)."""
+    import jax
+    import jax.numpy as jnp
+
+    from spark_rapids_ml_tpu.ops.linalg import weighted_covariance
+    from spark_rapids_ml_tpu.ops.pallas_xtwx import covariance_prefix_mask
+
+    X, w, mesh = ctx["X"], ctx["w"], ctx["mesh"]
+    n, d = X.shape
+    n_chips = ctx["n_chips"]
+    out: Dict = {}
+
+    def mk(m, precision):
+        @jax.jit
+        def f(X, w):
+            def step(c, _):
+                cov, mean, ws = covariance_prefix_mask(
+                    X, w, mesh=mesh, precision=precision,
+                    cse_guard=jnp.float32(1e-37) * c[1],
+                )
+                return (c[0] + cov, cov[0, 0]), None
+
+            res, _ = jax.lax.scan(
+                step,
+                (jnp.zeros((d, d), jnp.float32), jnp.float32(0)),
+                None,
+                length=m,
+            )
+            return res[0]
+
+        return f
+
+    if ctx["on_tpu"]:
+        prec_name = "HIGHEST"
+        f6, f1 = mk(6, jax.lax.Precision.HIGHEST), mk(1, jax.lax.Precision.HIGHEST)
+        t6, _ = _timed(lambda: f6(X, w))
+        t1, _ = _timed(lambda: f1(X, w))
+        marginal = max((t6 - t1) / 5, 1e-9)
+    else:
+        # CPU fallback: plain whole-pass timing of the XLA path (pallas interpret
+        # is orders slower than XLA on CPU and would just measure the interpreter)
+        prec_name = "XLA"
+        cf = __import__("jax").jit(weighted_covariance)
+        marginal, _ = _timed(lambda: cf(X, w))
+    rate = n / marginal / n_chips
+    ceiling = PEAK_BW / (d * 4)  # rows/s at one f32 X read per chip
+    out["pca_cov_rows_per_sec_per_chip"] = round(rate, 1)
+    out["pca_cov_precision"] = prec_name
+    out["pca_roofline_frac"] = round(rate / ceiling, 3) if ctx["on_tpu"] else None
+
+    # parity: fused (6-pass) vs XLA HIGHEST on the full matrix
+    if ctx["on_tpu"]:
+        cov_f, mean_f, ws_f = covariance_prefix_mask(X, w, mesh=mesh)
+        cov_x, mean_x, ws_x = __import__("jax").jit(weighted_covariance)(X, w)
+        cf_, cx_ = np.asarray(cov_f), np.asarray(cov_x)
+        rel = float(np.max(np.abs(cf_ - cx_)) / np.max(np.abs(cx_)))
+        out["pca_parity_max_rel"] = round(rel, 8)
+        out["pca_parity_ok"] = bool(rel < 1e-4)
+        # quality score: top-4 explained-variance ratio (blob data concentrates
+        # variance in the cluster-separation directions)
+        from spark_rapids_ml_tpu.ops.pca import pca_attrs_from_cov
+
+        attrs = pca_attrs_from_cov(cov_f, mean_f, ws_f, k=4)
+        out["pca_explained_variance_ratio_top4"] = round(
+            float(np.sum(attrs["explained_variance_ratio"])), 4
+        )
+    return out
+
+
+# ------------------------------------------------------------------------ linreg
+
+
+def bench_linreg(ctx) -> Dict:
+    """Normal-equation fit at the headline shape; ceiling = the XLA gram's two
+    HBM reads of X (gram_and_xty streams X for XᵀWX and XᵀWy)."""
+    import jax
+    import jax.numpy as jnp
+
+    from spark_rapids_ml_tpu.ops.linear import linreg_fit
+
+    X, w = ctx["X"], ctx["w"]
+    n, d = X.shape
+    n_chips = ctx["n_chips"]
+    key = jax.random.PRNGKey(11)
+    w_true = jax.random.normal(key, (d,), jnp.float32)
+    y = (X @ w_true + 0.1 * jax.random.normal(key, (n,), jnp.float32)).block_until_ready()
+
+    t, attrs_list = _timed(
+        lambda: jnp.asarray(
+            linreg_fit(X, y, w, 0.0, 0.0, True, False, 1, 1e-6)[0]["coefficients"]
+        ),
+        repeats=1,
+    )
+    rate = n / t / n_chips
+    attrs = linreg_fit(X, y, w, 0.0, 0.0, True, False, 1, 1e-6)[0]
+    coef = np.asarray(attrs["coefficients"])
+    # quality: R^2 on a 100k sample
+    Xs = np.asarray(X[:100_000])
+    ys = np.asarray(y[:100_000])
+    pred = Xs @ coef + float(attrs["intercept"])
+    r2 = 1.0 - float(((ys - pred) ** 2).sum() / ((ys - ys.mean()) ** 2).sum())
+    ceiling = PEAK_BW / (2 * d * 4)
+    return {
+        "linreg_rows_per_sec_per_chip": round(rate, 1),
+        "linreg_frac_of_ceiling": round(rate / ceiling, 3) if ctx["on_tpu"] else None,
+        "linreg_r2": round(r2, 4),
+    }
+
+
+# ------------------------------------------------------------------------ logreg
+
+
+def bench_logreg(ctx) -> Dict:
+    """Distributed L-BFGS (BASELINE config 3 class). Metric: rows*iters/s/chip
+    whole-fit; quality: train accuracy + final objective. Ceiling: each L-BFGS
+    iteration reads X twice (logits + gradient) plus ~2 line-search objective
+    passes (1 read each) => ~4 X reads/iter."""
+    import jax
+    import jax.numpy as jnp
+
+    from spark_rapids_ml_tpu.ops.logistic import logreg_decision, logreg_fit
+
+    X, w = ctx["X"], ctx["w"]
+    n, d = X.shape
+    n_chips = ctx["n_chips"]
+    key = jax.random.PRNGKey(5)
+    w_true = jax.random.normal(key, (d,), jnp.float32) / np.sqrt(d)
+    logits = X @ w_true
+    y = (
+        jax.random.uniform(jax.random.PRNGKey(6), (n,)) < jax.nn.sigmoid(logits)
+    ).astype(jnp.float32)
+    y.block_until_ready()
+
+    max_iter = 20
+    t0 = time.perf_counter()
+    attrs = logreg_fit(
+        X, y, w, 2, 0.01, 0.0, True, False, max_iter, 1e-9, False
+    )
+    _sync(np.asarray(attrs["coefficients"]))
+    t = time.perf_counter() - t0
+    n_iter = int(attrs.get("n_iter", max_iter))
+    rate = n * max(n_iter, 1) / t / n_chips
+    # quality on a 200k sample
+    Xs, ys = X[:200_000], np.asarray(y[:200_000])
+    dec = np.asarray(
+        logreg_decision(
+            Xs,
+            jnp.asarray(attrs["coefficients"]),
+            jnp.asarray(np.atleast_1d(attrs["intercepts"])),
+            False,
+        )
+    )
+    acc = _accuracy((dec.reshape(-1) > 0).astype(np.float32), ys)
+    ceiling = PEAK_BW / (4 * d * 4)
+    return {
+        "logreg_rows_iters_per_sec_per_chip": round(rate, 1),
+        "logreg_n_iter": n_iter,
+        "logreg_frac_of_ceiling": round(rate / ceiling, 3) if ctx["on_tpu"] else None,
+        "logreg_train_accuracy": round(acc, 4),
+        "logreg_objective": round(float(attrs.get("objective", np.nan)), 6),
+    }
+
+
+# ---------------------------------------------------------------------------- rf
+
+
+def bench_rf(ctx) -> Dict:
+    """Histogram forest fit (BASELINE config 4 class). Metric: rows*trees/s/chip;
+    quality: train accuracy. The builder is level-synchronous histogram+psum —
+    the reference's per-GPU cuML forest analog (tree.py:394-413)."""
+    import jax.numpy as jnp
+
+    from spark_rapids_ml_tpu.ops.trees import forest_fit, predict_forest
+
+    rng = np.random.default_rng(17)
+    n, d = ctx["rf_shape"]
+    centers = rng.normal(0, 3, (2, d)).astype(np.float32)
+    yh = rng.integers(0, 2, n)
+    Xh = (centers[yh] + rng.normal(0, 2.0, (n, d))).astype(np.float32)
+    stats = np.eye(2, dtype=np.float32)[yh]
+
+    n_trees, depth = 10, 8
+    t0 = time.perf_counter()
+    model = forest_fit(
+        Xh, stats, n_trees, depth, 32, "gini", d, 1, 0.0, 1.0, True, 42,
+    )
+    t = time.perf_counter() - t0
+    rate = n * n_trees / t / ctx["n_chips"]
+    sample = slice(0, 100_000)
+    pred = np.asarray(
+        predict_forest(
+            jnp.asarray(Xh[sample]),
+            jnp.asarray(model["feature"]),
+            jnp.asarray(model["threshold"]),
+            jnp.asarray(model["is_leaf"]),
+            jnp.asarray(model["value"]),
+            depth,
+        )
+    )
+    acc = _accuracy(pred.argmax(-1), yh[sample])
+    return {
+        "rf_rows_trees_per_sec_per_chip": round(rate, 1),
+        "rf_train_accuracy": round(acc, 4),
+        "rf_n_trees": n_trees,
+        "rf_max_depth": depth,
+    }
+
+
+# --------------------------------------------------------------------------- knn
+
+
+def bench_knn(ctx) -> Dict:
+    """Exact kNN throughput: blocked brute-force scan (the compute inside the
+    reference's NN-MG all-to-all, knn.py:763-774). Quality is definitionally
+    exact; report the MXU ceiling fraction (the scan is one big distance matmul:
+    2*nq*n*d FLOPs at FAST/bf16 precision)."""
+    import jax.numpy as jnp
+
+    from spark_rapids_ml_tpu.ops.knn import exact_knn_single
+
+    X, w = ctx["X"], ctx["w"]
+    n, d = X.shape
+    nq = 8192 if ctx["on_tpu"] else 256  # CPU brute force is minutes at 8192
+    Q = X[:nq]
+    valid = w > 0
+
+    t, (d2, idx) = _timed(lambda: exact_knn_single(Q, X, valid, 10), repeats=2)
+    qps = nq / t / ctx["n_chips"]
+    flops = 2.0 * nq * n * d
+    frac = flops / t / ctx["n_chips"] / PEAK_BF16
+    # sanity quality: each query's nearest neighbor is itself (distance 0)
+    self_hit = float((np.asarray(idx)[:, 0] == np.arange(nq)).mean())
+    return {
+        "knn_queries_per_sec_per_chip": round(qps, 1),
+        "knn_frac_of_ceiling": round(frac, 3) if ctx["on_tpu"] else None,
+        "knn_recall_at_10": 1.0,  # exact by construction
+        "knn_self_hit": round(self_hit, 4),
+        "knn_items": n,
+    }
+
+
+# --------------------------------------------------------------------------- ann
+
+
+def bench_ann(ctx) -> Dict:
+    """IVF-Flat build+search (BASELINE config 5 class): queries/s at nprobe
+    settings + measured recall@10 vs the exact scan. Also writes the
+    recall-vs-nprobe sweep to benchmark/results/report.csv (the reference's ANN
+    bench structure, bench_approximate_nearest_neighbors.py)."""
+    import jax.numpy as jnp
+
+    from spark_rapids_ml_tpu.ops.knn import (
+        exact_knn_single,
+        ivfflat_build,
+        ivfflat_search,
+    )
+
+    X, w = ctx["X"], ctx["w"]
+    n, d = X.shape
+    sub = ctx["ann_items"]
+    Xa = X[:sub]
+    wa = w[:sub]
+    nq = 2048 if ctx["on_tpu"] else 256
+    nlist = 1024 if ctx["on_tpu"] else 64
+    Q = Xa[:nq]
+
+    t_build0 = time.perf_counter()
+    index = ivfflat_build(Xa, wa, nlist=nlist, max_iter=5, seed=3)
+    t_build = time.perf_counter() - t_build0
+    centers = jnp.asarray(index["centers"])
+    cells = jnp.asarray(index["cells"])
+    cell_ids = jnp.asarray(index["cell_ids"])
+
+    d2x, idx_exact = exact_knn_single(Q, Xa, wa > 0, 10)
+    exact_ids = np.asarray(idx_exact)
+
+    rows = []
+    out: Dict = {
+        "ann_build_rows_per_sec_per_chip": round(sub / t_build / ctx["n_chips"], 1)
+    }
+    for nprobe in (8, 16, 32, 64):
+        t, (d2a, ids) = _timed(
+            lambda np_=nprobe: ivfflat_search(
+                Q, centers, cells, cell_ids, 10, np_
+            ),
+            repeats=1,
+        )
+        got = np.asarray(ids)
+        recall = float(
+            np.mean(
+                [
+                    len(set(got[i]) & set(exact_ids[i])) / 10.0
+                    for i in range(nq)
+                ]
+            )
+        )
+        rows.append((nprobe, nq / t / ctx["n_chips"], recall))
+        if nprobe == 32:
+            out["ann_queries_per_sec_per_chip"] = round(nq / t / ctx["n_chips"], 1)
+            out["ann_recall_at_10"] = round(recall, 4)
+    try:
+        os.makedirs(os.path.join(ctx["repo_root"], "benchmark", "results"), exist_ok=True)
+        path = os.path.join(ctx["repo_root"], "benchmark", "results", "report.csv")
+        import csv
+
+        new = not os.path.exists(path)
+        with open(path, "a", newline="") as f:
+            wr = csv.writer(f)
+            if new:
+                wr.writerow(
+                    ["bench", "param", "value", "queries_per_sec_per_chip", "recall_at_10", "platform"]
+                )
+            for nprobe, qps, rec in rows:
+                wr.writerow(
+                    ["ann_ivfflat", "nprobe", nprobe, round(qps, 1), round(rec, 4), ctx["platform"]]
+                )
+    except OSError:
+        pass
+    return out
+
+
+# -------------------------------------------------------------------------- umap
+
+
+def bench_umap(ctx) -> Dict:
+    """UMAP fit (graph + SGD layout): rows/s whole-fit + trustworthiness on a
+    held-out-free subsample (the reference bench's quality score, bench_umap.py)."""
+    from spark_rapids_ml_tpu.ops.umap_ops import umap_fit
+
+    rng = np.random.default_rng(23)
+    n, d = ctx["umap_shape"]
+    k_clusters = 8
+    centers = rng.normal(0, 5, (k_clusters, d)).astype(np.float32)
+    assign = rng.integers(0, k_clusters, n)
+    Xh = (centers[assign] + rng.normal(0, 1.0, (n, d))).astype(np.float32)
+
+    t0 = time.perf_counter()
+    attrs = umap_fit(
+        Xh, n_neighbors=15, n_components=2, n_epochs=100, min_dist=0.1,
+        spread=1.0, negative_sample_rate=5, learning_rate=1.0, seed=7,
+        init="random",
+    )
+    t = time.perf_counter() - t0
+    emb = np.asarray(attrs["embedding"])
+    rate = n / t / ctx["n_chips"]
+
+    sub = rng.choice(n, 1500, replace=False)
+    tw = _trustworthiness(Xh[sub], emb[sub], 15)
+    return {
+        "umap_rows_per_sec_per_chip": round(rate, 1),
+        "umap_trustworthiness": round(tw, 4),
+        "umap_n": n,
+    }
+
+
+def _trustworthiness(X: np.ndarray, E: np.ndarray, k: int) -> float:
+    """sklearn-equivalent trustworthiness on a small sample (O(m^2) host math)."""
+    m = len(X)
+    dx = ((X[:, None] - X[None]) ** 2).sum(-1)
+    de = ((E[:, None] - E[None]) ** 2).sum(-1)
+    np.fill_diagonal(dx, np.inf)
+    np.fill_diagonal(de, np.inf)
+    rank_x = np.argsort(np.argsort(dx, axis=1), axis=1)  # 0 = nearest
+    nn_e = np.argsort(de, axis=1)[:, :k]
+    penalty = 0.0
+    for i in range(m):
+        r = rank_x[i, nn_e[i]]
+        penalty += np.maximum(r - k + 1, 0).sum()
+    return 1.0 - penalty * 2.0 / (m * k * (2 * m - 3 * k - 1))
+
+
+# ------------------------------------------------------------------------ dbscan
+
+
+def bench_dbscan(ctx) -> Dict:
+    """DBSCAN label propagation: rows/s + ARI vs sklearn on a subsample."""
+    import jax.numpy as jnp
+
+    from spark_rapids_ml_tpu.ops.dbscan import dbscan_fit_predict
+
+    rng = np.random.default_rng(31)
+    n, d = ctx["dbscan_shape"]
+    k_clusters = 5
+    centers = rng.normal(0, 10, (k_clusters, d)).astype(np.float32)
+    assign = rng.integers(0, k_clusters, n)
+    Xh = (centers[assign] + rng.normal(0, 0.5, (n, d))).astype(np.float32)
+    eps = 3.0
+
+    Xd = jnp.asarray(Xh)
+    valid = jnp.ones((n,), bool)
+    t0 = time.perf_counter()
+    labels = dbscan_fit_predict(Xd, valid, eps, 5)
+    t = time.perf_counter() - t0
+    rate = n / t / ctx["n_chips"]
+
+    ari = None
+    try:
+        from sklearn.cluster import DBSCAN as SkDBSCAN
+        from sklearn.metrics import adjusted_rand_score
+
+        sub = rng.choice(n, min(8000, n), replace=False)
+        sk = SkDBSCAN(eps=eps, min_samples=5).fit(Xh[sub])
+        ari = float(adjusted_rand_score(sk.labels_, np.asarray(labels)[sub]))
+    except Exception:
+        pass
+    return {
+        "dbscan_rows_per_sec_per_chip": round(rate, 1),
+        "dbscan_ari_vs_sklearn": round(ari, 4) if ari is not None else None,
+        "dbscan_clusters": int(len(set(np.asarray(labels).tolist()) - {-1})),
+    }
+
+
+# ----------------------------------------------------------- e2e ingest + fit
+
+
+def bench_fit_e2e(ctx) -> Dict:
+    """End-to-end fit() INCLUDING host->device ingest (the reference's fit_time
+    includes executor Arrow->cupy ingest, core.py:906-941). Times host-numpy ->
+    shard_array -> kmeans fit; reports the ingest fraction. Ingest ceiling is the
+    tunnel/PCIe path, not HBM — the measured fraction is the point."""
+    import jax.numpy as jnp
+
+    from spark_rapids_ml_tpu.ops.kmeans import lloyd_fit
+    from spark_rapids_ml_tpu.parallel.mesh import get_mesh, shard_array
+
+    mesh = ctx["mesh"]
+    n, d = ctx["e2e_shape"]
+    rng = np.random.default_rng(41)
+    centers = rng.normal(0, 5, (8, d)).astype(np.float32)
+    Xh = (centers[rng.integers(0, 8, n)] + rng.normal(0, 1, (n, d))).astype(
+        np.float32
+    )
+    wh = np.ones((n,), np.float32)
+
+    t0 = time.perf_counter()
+    Xd = shard_array(Xh, mesh)
+    wd = shard_array(wh, mesh)
+    Xd.block_until_ready()
+    t_ingest = time.perf_counter() - t0
+    init = np.asarray(Xd[:8])
+    t1 = time.perf_counter()
+    centers_f, inertia, n_iter = lloyd_fit(Xd, wd, jnp.asarray(init), 0.0, 10)
+    _sync(centers_f)
+    t_fit = time.perf_counter() - t1
+    total = t_ingest + t_fit
+    return {
+        "fit_e2e_rows_per_sec": round(n / total, 1),
+        "fit_e2e_ingest_frac": round(t_ingest / total, 3),
+        "fit_e2e_ingest_gbytes_per_sec": round(Xh.nbytes / t_ingest / 1e9, 3),
+        "fit_e2e_shape": list(ctx["e2e_shape"]),
+    }
+
+
+# ---------------------------------------------------------------------- runner
+
+FAMILIES: List = [
+    ("pca", bench_pca),
+    ("logreg", bench_logreg),
+    ("linreg", bench_linreg),
+    ("rf", bench_rf),
+    ("knn", bench_knn),
+    ("ann", bench_ann),
+    ("umap", bench_umap),
+    ("dbscan", bench_dbscan),
+    ("fit_e2e", bench_fit_e2e),
+]
+
+
+def run_families(ctx, deadline_ts: float) -> Dict:
+    """Run each family until the deadline; record errors/skips instead of dying."""
+    out: Dict = {}
+    skipped = []
+    for name, fn in FAMILIES:
+        if time.time() > deadline_ts:
+            skipped.append(name)
+            continue
+        try:
+            t0 = time.time()
+            out.update(fn(ctx))
+            out[f"{name}_bench_secs"] = round(time.time() - t0, 1)
+        except Exception as e:  # never kill the bench line
+            out[f"{name}_error"] = f"{type(e).__name__}: {str(e)[:200]}"
+    if skipped:
+        out["skipped"] = skipped
+    return out
+
+
+def make_ctx(X, w, mesh, on_tpu: bool, platform: str, repo_root: str) -> Dict:
+    """Shared context; X/w are the headline design matrix reused by the dense
+    families (PCA/LinReg/LogReg/kNN/ANN slices)."""
+    import jax
+
+    big = bool(on_tpu)
+    return {
+        "X": X,
+        "w": w,
+        "mesh": mesh,
+        "on_tpu": on_tpu,
+        "platform": platform,
+        "n_chips": jax.device_count(),
+        "repo_root": repo_root,
+        "ann_items": 2_000_000 if big else 20_000,
+        "rf_shape": (2_000_000, 64) if big else (20_000, 16),
+        "umap_shape": (100_000, 64) if big else (3_000, 16),
+        "dbscan_shape": (200_000, 32) if big else (5_000, 8),
+        "e2e_shape": (2_000_000, 256) if big else (50_000, 32),
+    }
