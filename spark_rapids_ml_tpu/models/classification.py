@@ -398,9 +398,9 @@ class LogisticRegression(
         """Out-of-core fit: X stays host-resident, every L-BFGS objective/gradient
         evaluation streams batches through the device (ops/streaming.py) — the
         LogisticRegression analog of the reference's UVM/SAM path (reference
-        utils.py:184-241) that BASELINE config 3 (500M x 256) requires. Routes
-        in-core (with a warning) for the combinations the streamed loop does not
-        cover: L1/elastic-net, coefficient bounds, sparse features, single-class
+        utils.py:184-241) that BASELINE config 3 (500M x 256) requires.
+        L1/elastic-net runs the streamed FISTA; routes in-core (with a warning)
+        only for coefficient bounds, sparse features, and single-class
         degenerate fits."""
         from .. import config as _config
         from ..core.dataset import _is_sparse, densify as _densify
@@ -416,15 +416,10 @@ class LogisticRegression(
             )
         )
         classes, n_classes = _validate_labels(fd.label)
-        if (
-            float(p["l1_ratio"]) * float(p["alpha"]) > 0.0
-            or bounds_set
-            or _is_sparse(fd.features)
-            or len(classes) <= 1
-        ):
+        if bounds_set or _is_sparse(fd.features) or len(classes) <= 1:
             self.logger.warning(
-                "streamed LogisticRegression covers dense L2/no-penalty "
-                "multi-class fits only; fitting in-core despite "
+                "streamed LogisticRegression covers dense multi-class fits "
+                "only (no coefficient bounds); fitting in-core despite "
                 "stream_threshold_bytes."
             )
             inputs = self._build_fit_inputs(fd)

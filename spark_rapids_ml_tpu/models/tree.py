@@ -233,6 +233,63 @@ class _RandomForestEstimator(_RandomForestClass, _TpuEstimatorSupervised, _Rando
         # its n_bins differs (P6 pattern, reference tree.py:475-507)
         return True
 
+    def _streaming_fit(self, fd) -> Dict[str, Any]:
+        """Out-of-core fit: X streams through host binning in row blocks and only
+        the binned uint8 matrix (4x smaller than f32) + per-row stats reside on
+        device (ops/trees.streaming_forest_fit) — the RandomForest analog of the
+        reference's UVM/SAM path (reference utils.py:184-241). BASELINE config 4
+        (50M x 64, ~12.8 GiB f32) bins to ~3.1 GiB on a 16 GiB chip. Selected by
+        core/estimator.py when the design matrix exceeds stream_threshold_bytes;
+        maxBins must fit uint8 (<= 256) — wider binning routes in-core."""
+        from types import SimpleNamespace
+
+        from .. import config as _config
+        from ..core.dataset import densify as _densify
+        from ..ops.trees import streaming_forest_fit
+        from ..parallel.mesh import get_mesh, shard_array
+        from ..parallel.partition import pad_rows
+
+        p = self._tpu_params
+        if int(p["n_bins"]) > 256:
+            self.logger.warning(
+                "streamed RandomForest bins to uint8 (maxBins <= 256); fitting "
+                "in-core despite stream_threshold_bytes."
+            )
+            inputs = self._build_fit_inputs(fd)
+            return self._get_tpu_fit_func(None)(inputs)
+        X = _densify(fd.features, self._float32_inputs)
+        stats, n_classes = self._row_stats(
+            SimpleNamespace(host_label=fd.label, host_row_weight=fd.weight)
+        )
+        mesh = get_mesh(self.num_workers)
+        n_dev = mesh.devices.size
+
+        def shard_fn(arr: np.ndarray):
+            padded, _, _ = pad_rows(arr, n_dev)
+            return shard_array(padded, mesh)
+
+        attrs = streaming_forest_fit(
+            np.asarray(X),
+            stats,
+            n_trees=int(p["n_estimators"]),
+            max_depth=int(p["max_depth"]),
+            max_bins=int(p["n_bins"]),
+            impurity=self._impurity_name(),
+            feature_subset=resolve_feature_subset(
+                str(p["max_features"]), X.shape[1], self._is_classification
+            ),
+            min_instances=int(p["min_samples_leaf"]),
+            min_info_gain=float(p["min_impurity_decrease"]),
+            subsampling_rate=float(p["max_samples"]),
+            bootstrap=bool(p["bootstrap"]),
+            seed=int(p["random_state"]) if p["random_state"] is not None else 0,
+            batch_rows=int(_config.get("stream_batch_rows")),
+            shard_fn=shard_fn,
+            mesh=mesh,
+        )
+        attrs["num_classes"] = n_classes
+        return attrs
+
     def _get_tpu_fit_func(self, extra_params: Optional[List[Dict[str, Any]]] = None):
         base = dict(self._tpu_params)
         is_cls = self._is_classification

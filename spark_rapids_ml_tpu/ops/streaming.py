@@ -7,7 +7,7 @@
 # the device and ACCUMULATE the model-sufficient statistics on device:
 #   * PCA / LinearRegression: (XᵀWX, XᵀWy, Σwx, Σwy, Σw) accumulate exactly —
 #     the fit result is IDENTICAL to the in-core path, with device residency bounded
-#     by one batch + the d×d stats,
+#     by two batches (double-buffered prefetch) + the d×d stats,
 #   * KMeans: per-pass Lloyd over batches (minibatch-free exact variant: each
 #     iteration streams all batches, accumulating one-hotᵀX sums and counts).
 # Estimators switch to this path automatically when the padded design matrix would
@@ -17,6 +17,7 @@
 from __future__ import annotations
 
 import functools
+from collections import deque
 from typing import Optional, Tuple
 
 import jax
@@ -24,6 +25,55 @@ import jax.numpy as jnp
 import numpy as np
 
 from ._precision import pdot
+
+
+def _prefetch(iterable, depth: int = 1):
+    """Double-buffered batch pipeline: keep `depth` extra batches in flight so the
+    host slice/pad/device_put of batch i+1 overlaps the device accumulation of
+    batch i (jax dispatch is async; the DMA rides a separate engine on TPU). This
+    is the streamed-ingest overlap the reference gets implicitly from UVM
+    prefetching. Peak device residency is depth+1 batches — depth=1 is true
+    double buffering (the out-of-core batch-size guidance assumes 2 live
+    batches; a larger depth trades HBM for pipeline slack)."""
+    it = iter(iterable)
+    buf: deque = deque()
+    try:
+        for _ in range(depth):
+            buf.append(next(it))
+    except StopIteration:
+        pass
+    while buf:
+        yield buf.popleft()
+        try:
+            buf.append(next(it))
+        except StopIteration:
+            pass
+
+
+def _batch_stream(n: int, batch_rows: int, mesh, slicer):
+    """THE out-of-core ingest loop, shared by every streamed fit: `slicer(s, e)`
+    returns row-aligned HOST arrays — X first, the weight vector LAST — for rows
+    [s, e); this pads to the mesh (zero-weighting pad rows), shards, and yields
+    device tuples. The ragged tail keeps its natural size: it compiles one extra
+    accumulator entry ONCE and reuses it every pass (padding it to batch_rows
+    instead was measured to upload a nearly-all-zeros full batch per pass when
+    n % batch_rows is small)."""
+    from ..parallel.mesh import shard_array
+    from ..parallel.partition import pad_rows
+
+    for s in range(0, n, batch_rows):
+        e = min(s + batch_rows, n)
+        arrays = slicer(s, e)
+        if mesh is not None:
+            X_, *extras = arrays
+            Xp, pad_w, extras_p = pad_rows(X_, mesh.devices.size, *extras)
+            *mid, wv = extras_p
+            out = [shard_array(Xp, mesh)]
+            out += [shard_array(a, mesh) for a in mid]
+            out.append(shard_array(pad_w * wv, mesh))
+            yield tuple(out)
+        else:
+            yield tuple(jnp.asarray(a) for a in arrays)
 
 
 @jax.jit
@@ -62,9 +112,6 @@ def streaming_linreg_stats(
     Each batch is device_put (sharded over the mesh when given) and accumulated.
     dtype follows float32 (float64 additionally needs jax x64 mode, matching the
     in-core path's device behavior)."""
-    from ..parallel.mesh import shard_array
-    from ..parallel.partition import pad_rows
-
     dt = np.float32 if float32 else np.float64
     d = X.shape[1]
     A = jnp.zeros((d, d), dt)
@@ -75,21 +122,18 @@ def streaming_linreg_stats(
     carry = (A, b, sx, sy, sw)
 
     n = X.shape[0]
-    for s in range(0, n, batch_rows):
-        e = min(s + batch_rows, n)
-        Xb = np.ascontiguousarray(X[s:e], dtype=dt)
-        yb = np.ascontiguousarray(y[s:e], dtype=dt)
-        wb = (
+
+    def slicer(s, e):
+        return (
+            np.ascontiguousarray(X[s:e], dtype=dt),
+            np.ascontiguousarray(y[s:e], dtype=dt),
             np.ones((e - s,), dt)
             if w is None
-            else np.ascontiguousarray(w[s:e], dtype=dt)
+            else np.ascontiguousarray(w[s:e], dtype=dt),
         )
-        if mesh is not None:
-            Xb, pad_w, (yb_p, wb_p) = pad_rows(Xb, mesh.devices.size, yb, wb)
-            Xb = shard_array(Xb, mesh)
-            yb = shard_array(yb_p, mesh)
-            wb = shard_array(pad_w * wb_p, mesh)
-        carry = _accum_linreg(carry, jnp.asarray(Xb), jnp.asarray(yb), jnp.asarray(wb))
+
+    for Xb_j, yb_j, wb_j in _prefetch(_batch_stream(n, batch_rows, mesh, slicer)):
+        carry = _accum_linreg(carry, Xb_j, yb_j, wb_j)
     A, b, sx, sy, sw = carry
     return A, b, sx / sw, sy / sw, sw
 
@@ -103,9 +147,6 @@ def streaming_covariance(
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Streamed weighted covariance (cov, mean, Σw) for PCA — the same math as
     ops/linalg.weighted_covariance, dtype per `float32` (see streaming_linreg_stats)."""
-    from ..parallel.mesh import shard_array
-    from ..parallel.partition import pad_rows
-
     dt = np.float32 if float32 else np.float64
     d = X.shape[1]
     carry = (
@@ -114,19 +155,17 @@ def streaming_covariance(
         jnp.zeros((), dt),
     )
     n = X.shape[0]
-    for s in range(0, n, batch_rows):
-        e = min(s + batch_rows, n)
-        Xb = np.ascontiguousarray(X[s:e], dtype=dt)
-        wb = (
+
+    def slicer(s, e):
+        return (
+            np.ascontiguousarray(X[s:e], dtype=dt),
             np.ones((e - s,), dt)
             if w is None
-            else np.ascontiguousarray(w[s:e], dtype=dt)
+            else np.ascontiguousarray(w[s:e], dtype=dt),
         )
-        if mesh is not None:
-            Xb, pad_w, (wb_p,) = pad_rows(Xb, mesh.devices.size, wb)
-            Xb = shard_array(Xb, mesh)
-            wb = shard_array(pad_w * wb_p, mesh)
-        carry = _accum_cov(carry, jnp.asarray(Xb), jnp.asarray(wb))
+
+    for Xb_j, wb_j in _prefetch(_batch_stream(n, batch_rows, mesh, slicer)):
+        carry = _accum_cov(carry, Xb_j, wb_j)
     S2, sx, sw = carry
     mean = sx / sw
     cov = (S2 - sw * jnp.outer(mean, mean)) / (sw - 1.0)
@@ -160,9 +199,11 @@ def _accum_moments(carry, X, w):
 
 def _strong_wolfe(f, x, fx, gx, p, max_steps: int, c1=1e-4, c2=0.9):
     """Strong-Wolfe line search (zoom), scipy-style: each trial costs one full
-    streamed data pass. Returns (alpha, f_new, g_new, n_evals); falls back to the
-    last trial point if the conditions never both hold within max_steps (the
-    reference's QN solver caps linesearch at 20 the same way)."""
+    streamed data pass. Returns (alpha, f_new, g_new, n_evals); when the budget
+    runs out it falls back to the best SUFFICIENT-DECREASE (Armijo) point seen —
+    never to an objective-increasing trial — and signals failure with alpha=0 if
+    no trial achieved sufficient decrease at all (the caller stops rather than
+    step uphill). The reference's QN solver caps linesearch at 20 the same way."""
     d0 = float(np.vdot(gx, p))
     if d0 >= 0:  # not a descent direction (numerical breakdown): bail
         return 0.0, fx, gx, 0
@@ -171,15 +212,23 @@ def _strong_wolfe(f, x, fx, gx, p, max_steps: int, c1=1e-4, c2=0.9):
         fv, gv = f(x + alpha * p)
         return fv, gv, float(np.vdot(gv, p))
 
+    def armijo(alpha, f_a):
+        return f_a <= fx + c1 * alpha * d0
+
+    if max_steps <= 0:
+        return 0.0, fx, gx, 0
+    best = None  # best Armijo-satisfying trial: (alpha, f, g)
     alpha_prev, f_prev = 0.0, fx
     alpha = 1.0
     n_evals = 0
     lo = hi = None
-    f_lo = g_lo = None
+    f_lo = None
     for i in range(max_steps):
         f_a, g_a, d_a = phi(alpha)
         n_evals += 1
-        if f_a > fx + c1 * alpha * d0 or (i > 0 and f_a >= f_prev):
+        if armijo(alpha, f_a) and (best is None or f_a < best[1]):
+            best = (alpha, f_a, g_a)
+        if not armijo(alpha, f_a) or (i > 0 and f_a >= f_prev):
             lo, hi, f_lo = alpha_prev, alpha, f_prev
             break
         if abs(d_a) <= -c2 * d0:
@@ -190,24 +239,29 @@ def _strong_wolfe(f, x, fx, gx, p, max_steps: int, c1=1e-4, c2=0.9):
         alpha_prev, f_prev = alpha, f_a
         alpha *= 2.0
     else:
-        return alpha, f_a, g_a, n_evals  # ran out of expansion steps
+        # expansion budget exhausted with every trial Armijo-passing: return the
+        # LAST EVALUATED point (alpha has already been doubled past it — returning
+        # alpha would pair an unevaluated step with stale f/g and corrupt the
+        # L-BFGS curvature history)
+        return alpha_prev, f_a, g_a, n_evals
 
     # zoom phase
-    best = (alpha, f_a, g_a)
     while n_evals < max_steps:
         mid = 0.5 * (lo + hi)
         f_m, g_m, d_m = phi(mid)
         n_evals += 1
-        if f_m > fx + c1 * mid * d0 or f_m >= f_lo:
+        if not armijo(mid, f_m) or f_m >= f_lo:
             hi = mid
         else:
+            if best is None or f_m < best[1]:
+                best = (mid, f_m, g_m)
             if abs(d_m) <= -c2 * d0:
                 return mid, f_m, g_m, n_evals
             if d_m * (hi - lo) >= 0:
                 hi = lo
             lo, f_lo = mid, f_m
-        if f_m < best[1]:
-            best = (mid, f_m, g_m)
+    if best is None:
+        return 0.0, fx, gx, n_evals  # no sufficient decrease anywhere: signal stop
     return best[0], best[1], best[2], n_evals
 
 
@@ -237,43 +291,40 @@ def streaming_logreg_fit(
 
     This is the LogisticRegression analog of the reference's UVM/SAM
     larger-than-device-memory fitting (reference utils.py:184-241): BASELINE
-    config 3 (500M x 256) cannot stage the design matrix in HBM. L2/no-penalty
-    only (the FISTA L1 path needs a different streamed loop); callers route
-    l1_ratio > 0 in-core."""
-    from ..parallel.mesh import shard_array
-    from ..parallel.partition import pad_rows
+    config 3 (500M x 256) cannot stage the design matrix in HBM.
 
-    if reg * l1_ratio > 0.0:
-        raise ValueError(
-            "streaming_logreg_fit supports only L2/no-penalty "
-            "(elasticNetParam must be 0)."
-        )
+    Solver dispatch mirrors the in-core logreg_fit: elasticNetParam > 0 runs a
+    streamed FISTA (full-pass smooth gradient + host prox/Nesterov updates, the
+    Lipschitz constant from a streamed Gram pass); otherwise distributed L-BFGS.
+
+    Pass counts (docs/performance.md): L-BFGS costs 1 + ~2-4 streamed passes per
+    iteration (one per line-search objective evaluation); FISTA costs exactly
+    1 + n_iter passes plus one Gram pass (+1 moments pass when standardizing).
+    Every batch is re-uploaded per pass — that is the out-of-core contract; the
+    ragged tail batch compiles one extra accumulator entry once and reuses it
+    every pass."""
     dt = np.float32 if float32 else np.float64
     n, d = X.shape
+    reg_l1 = reg * l1_ratio
     reg_l2 = reg * (1.0 - l1_ratio)
 
+    def _slicer(s, e):
+        return (
+            np.ascontiguousarray(X[s:e], dtype=dt),
+            np.ascontiguousarray(y[s:e], dtype=dt),
+            np.ones((e - s,), dt)
+            if w is None
+            else np.ascontiguousarray(w[s:e], dtype=dt),
+        )
+
     def _batches():
-        for s in range(0, n, batch_rows):
-            e = min(s + batch_rows, n)
-            Xb = np.ascontiguousarray(X[s:e], dtype=dt)
-            yb = np.ascontiguousarray(y[s:e], dtype=dt)
-            wb = (
-                np.ones((e - s,), dt)
-                if w is None
-                else np.ascontiguousarray(w[s:e], dtype=dt)
-            )
-            if mesh is not None:
-                Xb, pad_w, (yb_p, wb_p) = pad_rows(Xb, mesh.devices.size, yb, wb)
-                Xb = shard_array(Xb, mesh)
-                yb = shard_array(yb_p, mesh)
-                wb = shard_array(pad_w * wb_p, mesh)
-            yield jnp.asarray(Xb), jnp.asarray(yb), jnp.asarray(wb)
+        return _batch_stream(n, batch_rows, mesh, _slicer)
 
     # streamed standardization moments (Spark Summarizer wsum-1 variance,
     # matching ops/linalg.weighted_moments)
     if standardize:
         carry = (jnp.zeros((d,), dt), jnp.zeros((d,), dt), jnp.zeros((), dt))
-        for Xb, _, wb in _batches():
+        for Xb, _, wb in _prefetch(_batches()):
             carry = _accum_moments(carry, Xb, wb)
         sx, sxx, sw_j = carry
         wsum = float(sw_j)
@@ -297,7 +348,7 @@ def streaming_logreg_fit(
         params = jnp.asarray(params_flat.reshape(shape).astype(dt))
         acc_v = 0.0
         acc_g = np.zeros(shape, np.float64)
-        for Xb, yb, wb in _batches():
+        for Xb, yb, wb in _prefetch(_batches()):
             y_enc = (
                 jax.nn.one_hot(yb.astype(jnp.int32), n_classes, dtype=Xb.dtype)
                 * (wb > 0)[:, None]
@@ -314,6 +365,50 @@ def streaming_logreg_fit(
         grad = acc_g / wsum
         grad[..., :-1] += reg_l2 * coef_s
         return value, grad.reshape(-1)
+
+    if reg_l1 > 0.0:
+        # ---- streamed FISTA (elastic net): the in-core _fista_fit with the
+        # smooth gradient evaluated by streamed passes; prox/Nesterov updates on
+        # the small host parameter vector. Lipschitz from one streamed Gram pass
+        # (the same (0.5|0.25)*lmax + reg_l2 bound as ops/logistic.py:311-312).
+        from .linalg import power_iteration_lmax
+
+        carry = (jnp.zeros((d, d), dt), jnp.zeros((d,), dt), jnp.zeros((), dt))
+        for Xb, _, wb in _prefetch(_batches()):
+            carry = _accum_cov(carry, Xb / scale, wb)
+        S2, _, sw_g = carry
+        lmax = float(power_iteration_lmax(S2 / sw_g))
+        lipschitz = (0.5 if multinomial else 0.25) * lmax + reg_l2 + 1e-12
+        step = 1.0 / lipschitz
+        coef_mask = np.ones(shape, np.float64)
+        coef_mask[..., -1] = 0.0  # intercept entries are never penalized
+
+        def prox(pv):
+            soft = np.sign(pv) * np.maximum(np.abs(pv) - step * reg_l1, 0.0)
+            return np.where(coef_mask > 0, soft, pv)
+
+        pk = np.zeros(shape, np.float64)
+        zk = pk.copy()
+        tk = 1.0
+        n_iter = 0
+        for it in range(int(max_iter)):
+            _, g = value_and_grad(zk.reshape(-1))
+            p_next = prox(zk - step * g.reshape(shape))
+            t_next = 0.5 * (1.0 + np.sqrt(1.0 + 4.0 * tk * tk))
+            zk = p_next + ((tk - 1.0) / t_next) * (p_next - pk)
+            delta = float(
+                np.max(np.abs(p_next - pk)) / (np.max(np.abs(p_next)) + 1e-12)
+            )
+            pk, tk = p_next, t_next
+            n_iter = it + 1
+            if delta <= tol:
+                break
+        x = pk.reshape(-1)
+        fx, _ = value_and_grad(x)
+        fx += reg_l1 * float(np.sum(np.abs(pk * coef_mask)))
+        return _finish_logreg(
+            x, shape, scale_h, fit_intercept, multinomial, n_iter, fx
+        )
 
     # ---- host L-BFGS (two-loop recursion, memory 10) ----
     m = 10
@@ -363,6 +458,12 @@ def streaming_logreg_fit(
         if delta <= tol:
             break
 
+    return _finish_logreg(x, shape, scale_h, fit_intercept, multinomial, n_iter, fx)
+
+
+def _finish_logreg(x, shape, scale_h, fit_intercept, multinomial, n_iter, fx):
+    """Un-standardize + Spark intercept centering, shared by both streamed solvers
+    (same finishing as ops/logistic.logreg_fit)."""
     params = x.reshape(shape)
     if multinomial:
         coef = params[:, :-1] / scale_h
@@ -443,6 +544,17 @@ def streaming_kmeans_fit(
     if cosine:
         centers = _normalize_rows(centers)
 
+    def _slicer(s, e):
+        Xb = np.ascontiguousarray(X[s:e], dtype=dt)
+        if cosine:
+            norms = np.linalg.norm(Xb, axis=1, keepdims=True)
+            if np.any(norms <= 0):
+                raise ValueError(
+                    "Cosine distance is not defined for zero-length vectors."
+                )
+            Xb = Xb / norms
+        return Xb, np.ascontiguousarray(w[s:e], dtype=dt)
+
     inertia = np.inf
     n_iter = 0
     for it in range(max_iter):
@@ -451,24 +563,8 @@ def streaming_kmeans_fit(
             jnp.zeros((k,), dt),
             jnp.zeros((), dt),
         )
-        for s in range(0, n, batch_rows):
-            e = min(s + batch_rows, n)
-            Xb = np.ascontiguousarray(X[s:e], dtype=dt)
-            if cosine:
-                norms = np.linalg.norm(Xb, axis=1, keepdims=True)
-                if np.any(norms <= 0):
-                    raise ValueError(
-                        "Cosine distance is not defined for zero-length vectors."
-                    )
-                Xb = Xb / norms
-            wb = np.ascontiguousarray(w[s:e], dtype=dt)
-            if mesh is not None:
-                Xb, pad_w, (wb_p,) = pad_rows(Xb, mesh.devices.size, wb)
-                Xb = shard_array(Xb, mesh)
-                wb = shard_array(pad_w * wb_p, mesh)
-            carry = _accum_kmeans(
-                carry, centers, jnp.asarray(Xb), jnp.asarray(wb), cosine
-            )
+        for Xb_j, wb_j in _prefetch(_batch_stream(n, batch_rows, mesh, _slicer)):
+            carry = _accum_kmeans(carry, centers, Xb_j, wb_j, cosine)
         sums, counts, inertia_j = carry
         new_centers = jnp.where(
             counts[:, None] > 0,

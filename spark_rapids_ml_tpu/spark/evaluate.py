@@ -57,8 +57,12 @@ def evaluate_on_spark(evaluator: Any, spark_df: Any) -> float:
         if acc is not None:
             yield pd.DataFrame({"partial": [pickle.dumps(acc)]})
 
-    out = spark_df.mapInPandas(partial_udf, schema="partial binary").toPandas()
-    _unpersist(bcasts)
+    try:
+        out = spark_df.mapInPandas(partial_udf, schema="partial binary").toPandas()
+    finally:
+        # always release the chunked broadcasts — an executor failure mid-scan
+        # must not leak broadcast blocks on the cluster
+        _unpersist(bcasts)
     if len(out) == 0:
         raise RuntimeError("Distributed evaluate produced no partials (empty input?).")
     return float(
@@ -110,10 +114,12 @@ def transform_evaluate_on_spark(
     logger.info(
         "distributed transform+evaluate: %d model(s), partial-merge scan", n_models
     )
-    out = spark_df.mapInPandas(
-        evaluate_udf, schema="model_index bigint, partial binary"
-    ).toPandas()
-    _unpersist(bcasts)
+    try:
+        out = spark_df.mapInPandas(
+            evaluate_udf, schema="model_index bigint, partial binary"
+        ).toPandas()
+    finally:
+        _unpersist(bcasts)
     if len(out) == 0:
         raise RuntimeError(
             "Distributed evaluate produced no partials (empty input?)."

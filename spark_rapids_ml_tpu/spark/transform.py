@@ -50,11 +50,20 @@ def _broadcast_key(b: Any) -> Any:
     """Stable per-broadcast cache key. Spark broadcast ids start at 0, so an
     `or`-style falsy fallback would silently key the FIRST broadcast of a context
     by Python object identity — which differs per task (the closure re-deserializes
-    the Broadcast wrapper), defeating the cache and churning the FIFO."""
+    the Broadcast wrapper), defeating the cache and churning the FIFO.
+
+    Executor-side real-pyspark Broadcast objects expose neither `id` nor `_bid`
+    in Python — only `_path` (the spill file the driver wrote), which is unique
+    per broadcast and stable across tasks on one executor, so it serves as the
+    cache key there (without it every task would re-deserialize the full model
+    payload — correct but slow for large UMAP models)."""
     for attr in ("id", "_bid"):
         v = getattr(b, attr, None)
         if v is not None:
             return ("bid", v)
+    path = getattr(b, "_path", None)
+    if path:
+        return ("path", str(path))
     return None  # no stable id exposed
 
 

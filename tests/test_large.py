@@ -235,3 +235,68 @@ def test_large_1e7_streamed_logreg(n_devices):
         == y[:100_000]
     ).mean()
     assert acc > 0.8, acc
+
+
+def test_large_1e7x256_streamed_logreg_estimator(n_devices):
+    """BASELINE config-3 shape class (1e7 x 256, 10 GiB f32) through the ESTIMATOR
+    streamed path: binary + multinomial-3, objective parity against an in-core fit
+    on a 1e6 subsample, per-iteration wall-clock logged (VERDICT r3 task #7)."""
+    import time as _time
+
+    import pandas as pd
+
+    from spark_rapids_ml_tpu import config
+    from spark_rapids_ml_tpu.classification import LogisticRegression
+    from spark_rapids_ml_tpu.metrics.utils import logistic_regression_objective
+
+    rng = np.random.default_rng(17)
+    n, d = 10_000_000, 256
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    coef = (rng.normal(size=d) * (rng.random(d) < 0.2)).astype(np.float32)
+
+    for family, n_classes, max_iter in (("binomial", 2, 8), ("multinomial", 3, 6)):
+        if n_classes == 2:
+            y = ((X @ coef + rng.logistic(0, 1.0, n)) > 0).astype(np.float64)
+        else:
+            W3 = rng.normal(size=(d, 3)).astype(np.float32) * 0.2
+            y = (X @ W3 + rng.gumbel(0, 1.0, (n, 3))).argmax(1).astype(np.float64)
+        df = pd.DataFrame({f"c{i}": X[:, i] for i in range(d)})
+        df["label"] = y
+        kw = dict(
+            featuresCols=[f"c{i}" for i in range(d)],
+            regParam=0.01,
+            standardization=False,
+            maxIter=max_iter,
+            tol=1e-9,
+            family=family,
+        )
+        config.set("stream_threshold_bytes", 1 << 28)
+        config.set("stream_batch_rows", 1_000_000)
+        try:
+            est = LogisticRegression(**kw)
+            est.num_workers = n_devices
+            t0 = _time.perf_counter()
+            streamed = est.fit(df)
+            t_fit = _time.perf_counter() - t0
+        finally:
+            config.unset("stream_threshold_bytes")
+            config.unset("stream_batch_rows")
+        attrs = streamed.get_model_attributes()
+        n_iter = max(int(attrs.get("n_iter", max_iter)), 1)
+        print(
+            f"streamed 1e7x256 {family}: {t_fit:.1f}s total, "
+            f"{t_fit / n_iter:.1f}s/iter ({n_iter} iters)"
+        )
+
+        # objective parity on a 1e6 subsample: the streamed full-data model must
+        # score within a few percent of an in-core model FIT on that subsample
+        sub = slice(0, 1_000_000)
+        df_sub = df.iloc[sub]
+        est_in = LogisticRegression(**kw)
+        est_in.num_workers = n_devices
+        incore = est_in.fit(df_sub)
+
+        o_s = logistic_regression_objective(df_sub, streamed)
+        o_i = logistic_regression_objective(df_sub, incore)
+        assert o_s <= o_i * 1.05 + 1e-6, (family, o_s, o_i)
+        del df, df_sub, y

@@ -182,32 +182,160 @@ def test_streaming_logreg_weighted(n_devices, tiny_stream_threshold):
     )
 
 
-def test_streaming_logreg_l1_routes_incore(n_devices, tiny_stream_threshold):
-    """Elastic-net has no streamed loop: the fit must run in-core (with a warning)
-    and still produce the sparse-inducing solution."""
-    import logging
-
+@pytest.mark.parametrize("l1_ratio", [1.0, 0.5])
+def test_streaming_logreg_l1_matches_incore(
+    n_devices, tiny_stream_threshold, l1_ratio
+):
+    """Elastic-net now runs a STREAMED FISTA (full-pass smooth gradient + host
+    prox): same sparse-inducing solution as the in-core _fista_fit."""
     from spark_rapids_ml_tpu.classification import LogisticRegression
 
     rng = np.random.default_rng(9)
     X = rng.normal(size=(300, 6)).astype(np.float32)
     y = (X[:, 0] > 0).astype(np.float64)
     df = pd.DataFrame({"features": list(X), "label": y})
-    # the package logger sets propagate=False, so capture on the logger itself
+    kw = dict(regParam=0.5, elasticNetParam=l1_ratio, maxIter=200, tol=1e-9)
+    streamed = LogisticRegression(**kw).fit(df)
+    config.set("stream_threshold_bytes", 1 << 40)
+    incore = LogisticRegression(**kw).fit(df)
+    np.testing.assert_allclose(
+        streamed.coefficients, incore.coefficients, rtol=1e-3, atol=2e-4
+    )
+    np.testing.assert_allclose(
+        streamed.intercept, incore.intercept, rtol=1e-3, atol=2e-4
+    )
+    # L1=1.0 at reg 0.5 must actually zero coefficients (prox really applied)
+    if l1_ratio == 1.0:
+        assert np.sum(np.abs(np.asarray(streamed.coefficients)) < 1e-9) >= 4
+
+
+def test_streaming_logreg_l1_multinomial_matches_incore(
+    n_devices, tiny_stream_threshold
+):
+    from spark_rapids_ml_tpu.classification import LogisticRegression
+
+    rng = np.random.default_rng(13)
+    X = rng.normal(size=(400, 5)).astype(np.float32)
+    y = (X @ rng.normal(size=(5, 3))).argmax(1).astype(np.float64)
+    df = pd.DataFrame({"features": list(X), "label": y})
+    kw = dict(
+        regParam=0.1, elasticNetParam=0.5, maxIter=200, tol=1e-9,
+        family="multinomial",
+    )
+    streamed = LogisticRegression(**kw).fit(df)
+    config.set("stream_threshold_bytes", 1 << 40)
+    incore = LogisticRegression(**kw).fit(df)
+    np.testing.assert_allclose(
+        streamed.coefficientMatrix, incore.coefficientMatrix, rtol=5e-3, atol=5e-4
+    )
+
+
+def test_streaming_rf_matches_incore(n_devices, tiny_stream_threshold):
+    """Out-of-core RF: same edges (full rows at this size), same bootstrap RNG,
+    uint8 vs int32 bins — the forests must be IDENTICAL."""
+    from spark_rapids_ml_tpu.classification import RandomForestClassifier
+
+    rng = np.random.default_rng(21)
+    X = rng.normal(size=(800, 10)).astype(np.float32)
+    y = (X[:, 0] + X[:, 1] > 0).astype(np.float64)
+    df = pd.DataFrame({"features": list(X), "label": y})
+    kw = dict(numTrees=5, maxDepth=4, seed=3)
+    streamed = RandomForestClassifier(**kw).fit(df)
+    config.set("stream_threshold_bytes", 1 << 40)
+    incore = RandomForestClassifier(**kw).fit(df)
+
+    np.testing.assert_array_equal(streamed.get_model_attributes()["feature"], incore.get_model_attributes()["feature"])
+    np.testing.assert_allclose(
+        streamed.get_model_attributes()["threshold"], incore.get_model_attributes()["threshold"], rtol=1e-6
+    )
+    np.testing.assert_allclose(
+        streamed.get_model_attributes()["value"], incore.get_model_attributes()["value"], rtol=1e-5, atol=1e-6
+    )
+    ps = streamed.transform(df)["prediction"].to_numpy()
+    pi = incore.transform(df)["prediction"].to_numpy()
+    np.testing.assert_array_equal(ps, pi)
+
+
+def test_streaming_rf_regressor_matches_incore(n_devices, tiny_stream_threshold):
+    from spark_rapids_ml_tpu.regression import RandomForestRegressor
+
+    rng = np.random.default_rng(27)
+    X = rng.normal(size=(600, 8)).astype(np.float32)
+    y = (X @ rng.normal(size=8) + 0.1 * rng.normal(size=600)).astype(np.float64)
+    df = pd.DataFrame({"features": list(X), "label": y})
+    kw = dict(numTrees=4, maxDepth=4, seed=11)
+    streamed = RandomForestRegressor(**kw).fit(df)
+    config.set("stream_threshold_bytes", 1 << 40)
+    incore = RandomForestRegressor(**kw).fit(df)
+    np.testing.assert_array_equal(streamed.get_model_attributes()["feature"], incore.get_model_attributes()["feature"])
+    ps = streamed.transform(df)["prediction"].to_numpy()
+    pi = incore.transform(df)["prediction"].to_numpy()
+    np.testing.assert_allclose(ps, pi, rtol=1e-5, atol=1e-5)
+
+
+def test_streaming_rf_wide_bins_route_incore(n_devices, tiny_stream_threshold):
+    """maxBins > 256 cannot bin to uint8: the streamed path must hand off in-core
+    rather than corrupt bins."""
+    import logging
+
+    from spark_rapids_ml_tpu.classification import RandomForestClassifier
+
+    rng = np.random.default_rng(33)
+    X = rng.normal(size=(300, 4)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float64)
+    df = pd.DataFrame({"features": list(X), "label": y})
     records = []
     handler = logging.Handler()
     handler.emit = records.append
-    logger = logging.getLogger("spark_rapids_ml_tpu.LogisticRegression")
+    logger = logging.getLogger("spark_rapids_ml_tpu.RandomForestClassifier")
     logger.addHandler(handler)
     try:
-        streamed = LogisticRegression(
-            regParam=0.5, elasticNetParam=1.0, maxIter=80
-        ).fit(df)
+        model = RandomForestClassifier(numTrees=2, maxDepth=3, maxBins=300, seed=1).fit(df)
     finally:
         logger.removeHandler(handler)
     assert any("fitting in-core" in r.getMessage() for r in records)
-    config.set("stream_threshold_bytes", 1 << 40)
-    incore = LogisticRegression(regParam=0.5, elasticNetParam=1.0, maxIter=80).fit(df)
-    np.testing.assert_allclose(
-        streamed.coefficients, incore.coefficients, rtol=1e-5, atol=1e-6
-    )
+    assert model.transform(df)["prediction"].notna().all()
+
+
+def test_strong_wolfe_never_returns_uphill_point():
+    """Regression for the zoom-exhaustion fallback: with a tiny eval budget on a
+    nasty nonconvex line, the search must either return a point with sufficient
+    decrease or signal failure with alpha=0 — never an objective-increasing
+    iterate (the round-3 advisor finding)."""
+    from spark_rapids_ml_tpu.ops.streaming import _strong_wolfe
+
+    def f(x):
+        t = float(x[0])
+        # steep rise right after a narrow dip: expansion overshoots immediately
+        v = (t - 0.05) ** 2 * 400.0 + np.sin(40.0 * t) * 0.5
+        g = 2.0 * (t - 0.05) * 400.0 + np.cos(40.0 * t) * 20.0
+        return v, np.array([g])
+
+    x0 = np.array([0.0])
+    fx, gx = f(x0)
+    p = -gx  # descent direction
+    for budget in (1, 2, 3, 5, 20):
+        alpha, f_new, _, _ = _strong_wolfe(f, x0, fx, gx, p, max_steps=budget)
+        assert f_new <= fx + 1e-12, (budget, alpha, f_new, fx)
+        if alpha == 0.0:
+            assert f_new == fx
+
+
+def test_strong_wolfe_expansion_exhaustion_returns_evaluated_point():
+    """On a monotonically-decreasing line with a tiny budget, the expansion loop
+    exhausts — the returned (alpha, f) pair must be a point that was actually
+    evaluated, not the already-doubled alpha with stale f/g."""
+    from spark_rapids_ml_tpu.ops.streaming import _strong_wolfe
+
+    evals = []
+
+    def f(x):
+        t = float(x[0])
+        evals.append(t)
+        return -t, np.array([-1.0])  # f strictly decreasing, slope -1 forever
+
+    x0 = np.array([0.0])
+    fx, gx = f(x0)
+    alpha, f_new, g_new, _ = _strong_wolfe(f, x0, fx, gx, np.array([1.0]), max_steps=3)
+    assert alpha in evals, (alpha, evals)
+    assert f_new == -alpha
