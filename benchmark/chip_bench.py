@@ -50,6 +50,30 @@ def _accuracy(pred: np.ndarray, y: np.ndarray) -> float:
     return float((pred == y).mean())
 
 
+def _append_report(ctx, rows) -> None:
+    """Append sweep rows to benchmark/results/report.csv (the reference bench's
+    CSV report role, base.py:262-285). rows: (bench, param, value, throughput,
+    quality) tuples; one shared schema so ANN/RF sweeps land in one table."""
+    try:
+        import csv
+
+        os.makedirs(
+            os.path.join(ctx["repo_root"], "benchmark", "results"), exist_ok=True
+        )
+        path = os.path.join(ctx["repo_root"], "benchmark", "results", "report.csv")
+        new = not os.path.exists(path)
+        with open(path, "a", newline="") as f:
+            wr = csv.writer(f)
+            if new:
+                wr.writerow(
+                    ["bench", "param", "value", "throughput_per_chip", "quality", "platform"]
+                )
+            for bench, param, value, thr, q in rows:
+                wr.writerow([bench, param, value, round(thr, 1), round(q, 4), ctx["platform"]])
+    except OSError:
+        pass
+
+
 # --------------------------------------------------------------------------- pca
 
 
@@ -236,30 +260,45 @@ def bench_rf(ctx) -> Dict:
     Xh = (centers[yh] + rng.normal(0, 2.0, (n, d))).astype(np.float32)
     stats = np.eye(2, dtype=np.float32)[yh]
 
-    n_trees, depth = 10, 8
-    t0 = time.perf_counter()
-    model = forest_fit(
-        Xh, stats, n_trees, depth, 32, "gini", d, 1, 0.0, 1.0, True, 42,
-    )
-    t = time.perf_counter() - t0
-    rate = n * n_trees / t / ctx["n_chips"]
-    sample = slice(0, 100_000)
-    pred = np.asarray(
-        predict_forest(
-            jnp.asarray(Xh[sample]),
-            jnp.asarray(model["feature"]),
-            jnp.asarray(model["threshold"]),
-            jnp.asarray(model["is_leaf"]),
-            jnp.asarray(model["value"]),
-            depth,
+    def run(n_trees, depth):
+        t0 = time.perf_counter()
+        model = forest_fit(
+            Xh, stats, n_trees, depth, 32, "gini", d, 1, 0.0, 1.0, True, 42,
         )
+        t = time.perf_counter() - t0
+        sample = slice(0, 100_000)
+        pred = np.asarray(
+            predict_forest(
+                jnp.asarray(Xh[sample]),
+                jnp.asarray(model["feature"]),
+                jnp.asarray(model["threshold"]),
+                jnp.asarray(model["is_leaf"]),
+                jnp.asarray(model["value"]),
+                depth,
+            )
+        )
+        acc = _accuracy(pred.argmax(-1), yh[sample])
+        return n * n_trees / t / ctx["n_chips"], acc
+
+    # n_trees/max_depth scaling sweep (the reference bench's structure,
+    # bench_random_forest.py) -> benchmark/results/report.csv
+    sweep = [(10, 8), (20, 8), (10, 12)] if ctx["on_tpu"] else [(5, 4), (10, 4)]
+    rows = [(nt, dp, *run(nt, dp)) for nt, dp in sweep]
+    _append_report(
+        ctx,
+        [("rf", "n_trees/max_depth", f"{nt}/{dp}", r_, a_) for nt, dp, r_, a_ in rows],
     )
-    acc = _accuracy(pred.argmax(-1), yh[sample])
+    n_trees, depth, rate, acc = rows[0]
     return {
         "rf_rows_trees_per_sec_per_chip": round(rate, 1),
         "rf_train_accuracy": round(acc, 4),
         "rf_n_trees": n_trees,
         "rf_max_depth": depth,
+        "rf_sweep": [
+            {"n_trees": nt, "max_depth": dp,
+             "rows_trees_per_sec_per_chip": round(r_, 1), "accuracy": round(a_, 4)}
+            for nt, dp, r_, a_ in rows
+        ],
     }
 
 
@@ -355,24 +394,9 @@ def bench_ann(ctx) -> Dict:
         if nprobe == 32:
             out["ann_queries_per_sec_per_chip"] = round(nq / t / ctx["n_chips"], 1)
             out["ann_recall_at_10"] = round(recall, 4)
-    try:
-        os.makedirs(os.path.join(ctx["repo_root"], "benchmark", "results"), exist_ok=True)
-        path = os.path.join(ctx["repo_root"], "benchmark", "results", "report.csv")
-        import csv
-
-        new = not os.path.exists(path)
-        with open(path, "a", newline="") as f:
-            wr = csv.writer(f)
-            if new:
-                wr.writerow(
-                    ["bench", "param", "value", "queries_per_sec_per_chip", "recall_at_10", "platform"]
-                )
-            for nprobe, qps, rec in rows:
-                wr.writerow(
-                    ["ann_ivfflat", "nprobe", nprobe, round(qps, 1), round(rec, 4), ctx["platform"]]
-                )
-    except OSError:
-        pass
+    _append_report(
+        ctx, [("ann_ivfflat", "nprobe", nprobe, qps, rec) for nprobe, qps, rec in rows]
+    )
     return out
 
 

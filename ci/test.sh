@@ -28,6 +28,11 @@ fi
 python benchmark/benchmark_runner.py kmeans --num_rows 2000 --num_cols 32 --k 5 --no_cpu
 python benchmark/benchmark_runner.py pca --num_rows 2000 --num_cols 32 --k 3 --no_cpu
 
+# JVM half: attempt compile+test where a Scala toolchain exists; always record
+# the outcome (ci/jvm_build_status.json) — reference CI runs run_plugin_test.sh
+# unconditionally (ci/test.sh:46-47)
+./jvm/build.sh
+
 # driver entry points
 python __graft_entry__.py
 echo "CI $MODE PASSED"
