@@ -239,41 +239,48 @@ def main() -> None:
     fast_time, (_, _, n_iter_f) = _timed(lambda: fast_fit(Xd, w, init, 0.0, iters))
     fast_rows_per_sec_chip = n_rows * int(n_iter_f) / fast_time / n_chips
 
-    # secondary metric (TPU only): the fused pallas Lloyd at 6-pass parity
-    # precision — measured slower than the XLA path at this small-k shape (see
-    # ops/pallas_kmeans.py header), reported to keep tracking it, plus a live
-    # parity check (same n_iter, inertia within fp32 tolerance) guarding the
-    # SRML_TPU_PALLAS_KMEANS opt-in. Guarded so an unexpected Mosaic issue on new
+    # secondary metrics (TPU only): the fused pallas Lloyd variants at 6-pass
+    # parity precision — weighted (measured slower than XLA at this small-k shape,
+    # see ops/pallas_kmeans.py header) and masked/no-weight-stream (the (blk,1)-
+    # operand elimination that took the Gram kernel 3x; candidate to displace the
+    # XLA headline path). Each carries a live parity check (same n_iter, inertia
+    # within fp32 tolerance) and is exception-guarded so a Mosaic issue on new
     # hardware can never kill the benchmark line.
-    fused_rows_per_sec_chip = None
-    fused_parity_ok = None
-    if on_tpu:
+    def _pallas_variant(label, **variant_kw):
         try:
             from spark_rapids_ml_tpu.ops.pallas_kmeans import lloyd_fit_pallas
 
             mesh_obj = getattr(getattr(Xd, "sharding", None), "mesh", None)
-            fused = functools.partial(
-                lloyd_fit_pallas, mesh=mesh_obj, precision=jax.lax.Precision.HIGHEST
+            fit = functools.partial(
+                lloyd_fit_pallas, mesh=mesh_obj,
+                precision=jax.lax.Precision.HIGHEST, **variant_kw,
             )
-            c_f, in_f, it_f = fused(Xd, w, init, 0.0, iters)
-            _sync(c_f)
-            fused_time, (c_f, in_f, it_f) = _timed(
-                lambda: fused(Xd, w, init, 0.0, iters)
-            )
-            it_f = int(it_f)
-            if it_f <= 1:
+            _sync(fit(Xd, w, init, 0.0, iters)[0])  # compile warmup
+            t, (c_v, in_v, it_v) = _timed(lambda: fit(Xd, w, init, 0.0, iters))
+            it_v = int(it_v)
+            if it_v <= 1:
                 print(
-                    "bench: fused fit converged in <=1 iteration; "
+                    f"bench: {label} fit converged in <=1 iteration; "
                     "whole-fit rate reflects per-fit constants only",
                     file=sys.stderr,
                 )
-            fused_rows_per_sec_chip = n_rows * it_f / fused_time / n_chips
-            fused_parity_ok = bool(
-                it_f == n_iter
-                and abs(float(in_f) - float(inertia)) <= 1e-4 * abs(float(inertia))
+            rate = n_rows * it_v / t / n_chips
+            parity = bool(
+                it_v == n_iter
+                and abs(float(in_v) - float(inertia)) <= 1e-4 * abs(float(inertia))
             )
+            return rate, parity
         except Exception as e:  # pragma: no cover
-            print(f"bench: fused pallas lloyd unavailable: {e}", file=sys.stderr)
+            print(f"bench: {label} pallas lloyd unavailable: {e}", file=sys.stderr)
+            return None, None
+
+    fused_rows_per_sec_chip = fused_parity_ok = None
+    masked_rows_per_sec_chip = masked_parity_ok = None
+    if on_tpu:
+        fused_rows_per_sec_chip, fused_parity_ok = _pallas_variant("fused")
+        masked_rows_per_sec_chip, masked_parity_ok = _pallas_variant(
+            "masked", unit_mask=True
+        )
 
     # per-family secondaries: a number AND a quality score for every algorithm
     # family (reference protocol base.py:232-285), deadline-guarded. PCA (the
@@ -405,6 +412,12 @@ def main() -> None:
             else None
         ),
         "fused_parity_ok": fused_parity_ok,
+        "kmeans_masked_pallas_rows_per_sec_per_chip": (
+            round(masked_rows_per_sec_chip, 1)
+            if masked_rows_per_sec_chip is not None
+            else None
+        ),
+        "masked_parity_ok": masked_parity_ok,
         "est_mfu": round(est_mfu, 4) if est_mfu is not None else None,
         "roofline_frac": (
             round(roofline_frac, 3) if roofline_frac is not None else None

@@ -54,6 +54,7 @@ def _append_report(ctx, rows) -> None:
     """Append sweep rows to benchmark/results/report.csv (the reference bench's
     CSV report role, base.py:262-285). rows: (bench, param, value, throughput,
     quality) tuples; one shared schema so ANN/RF sweeps land in one table."""
+    header = ["bench", "param", "value", "throughput_per_chip", "quality", "platform"]
     try:
         import csv
 
@@ -61,13 +62,18 @@ def _append_report(ctx, rows) -> None:
             os.path.join(ctx["repo_root"], "benchmark", "results"), exist_ok=True
         )
         path = os.path.join(ctx["repo_root"], "benchmark", "results", "report.csv")
+        if os.path.exists(path):
+            with open(path) as f:
+                first = f.readline().strip()
+            if first != ",".join(header):
+                # schema changed since the file was started: rotate rather than
+                # append rows a by-name consumer would misparse
+                os.replace(path, path + ".old")
         new = not os.path.exists(path)
         with open(path, "a", newline="") as f:
             wr = csv.writer(f)
             if new:
-                wr.writerow(
-                    ["bench", "param", "value", "throughput_per_chip", "quality", "platform"]
-                )
+                wr.writerow(header)
             for bench, param, value, thr, q in rows:
                 wr.writerow([bench, param, value, round(thr, 1), round(q, 4), ctx["platform"]])
     except OSError:

@@ -31,7 +31,7 @@ python benchmark/benchmark_runner.py pca --num_rows 2000 --num_cols 32 --k 3 --n
 # JVM half: attempt compile+test where a Scala toolchain exists; always record
 # the outcome (ci/jvm_build_status.json) — reference CI runs run_plugin_test.sh
 # unconditionally (ci/test.sh:46-47)
-./jvm/build.sh
+./jvm/build.sh || echo "WARN: jvm build attempt failed; see ci/jvm_build_status.json"
 
 # driver entry points
 python __graft_entry__.py

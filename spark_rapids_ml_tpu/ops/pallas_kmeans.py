@@ -156,6 +156,96 @@ def _lloyd_kernel(
     inertia_ref[...] += jnp.sum(w * d2min)[None, None]
 
 
+def _lloyd_kernel_masked(
+    n_split, nv_ref, x_ref, c_ref, c2_ref, sums_ref, counts_ref, inertia_ref
+):
+    """Unit-weight variant of _lloyd_kernel: NO weight vector operand. A (blk, 1)
+    w block tile-pads to 128 lanes in VMEM and forces a layout-converting DMA —
+    measured 3x slower on the sibling Gram kernel (ops/pallas_xtwx.py header).
+    Row validity is the runtime scalar nv_ref (the pad_rows prefix-mask
+    contract); sample-weighted fits keep the weighted kernel."""
+    b = pl.program_id(0)
+
+    @pl.when(b == 0)
+    def _():
+        sums_ref[...] = jnp.zeros_like(sums_ref)
+        counts_ref[...] = jnp.zeros_like(counts_ref)
+        inertia_ref[...] = jnp.zeros_like(inertia_ref)
+
+    Xb = x_ref[...]  # (B, d)
+    C = c_ref[...]  # (k, d)
+    c2 = c2_ref[...]  # (1, k)
+
+    row0 = b * Xb.shape[0]
+    rows = row0 + jax.lax.broadcasted_iota(jnp.int32, (Xb.shape[0], 1), 0)
+    valid = rows < nv_ref[0, 0]
+    # select, don't multiply: unspecified edge-block values can be NaN
+    Xb = jnp.where(valid, Xb, 0.0)
+
+    cross = _dot_multipass(Xb, C, (((1,), (1,)), ((), ())), n_split)  # (B, k)
+    part = c2 - 2.0 * cross
+    assign = jnp.argmin(part, axis=1)
+    k = C.shape[0]
+    cols = jax.lax.broadcasted_iota(jnp.int32, (Xb.shape[0], k), 1)
+    onehot = jnp.where(
+        valid, (cols == assign[:, None]).astype(jnp.float32), 0.0
+    )  # (B, k)
+
+    sums_ref[...] += _dot_multipass(onehot, Xb, (((0,), (0,)), ((), ())), n_split)
+    counts_ref[...] += jnp.sum(onehot, axis=0)[None, :]
+    x2 = jnp.sum(Xb * Xb, axis=1, keepdims=True)
+    min_part = jnp.min(part, axis=1, keepdims=True)
+    d2min = jnp.maximum(x2 + min_part, 0.0)
+    inertia_ref[...] += jnp.sum(jnp.where(valid, d2min, 0.0))[None, None]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret", "blk", "n_split"))
+def _lloyd_step_masked_jit(X, n_valid, centers, interpret: bool, blk: int, n_split: int):
+    n, d = X.shape
+    k = centers.shape[0]
+    c2 = jnp.sum(centers * centers, axis=1)[None, :]
+
+    sums, counts, inertia = pl.pallas_call(
+        functools.partial(_lloyd_kernel_masked, n_split),
+        grid=((n + blk - 1) // blk,),
+        in_specs=[
+            pl.BlockSpec((1, 1), lambda b: (0, 0)),
+            pl.BlockSpec((blk, d), lambda b: (b, 0)),
+            pl.BlockSpec((k, d), lambda b: (0, 0)),
+            pl.BlockSpec((1, k), lambda b: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((k, d), lambda b: (0, 0)),
+            pl.BlockSpec((1, k), lambda b: (0, 0)),
+            pl.BlockSpec((1, 1), lambda b: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((k, d), jnp.float32),
+            jax.ShapeDtypeStruct((1, k), jnp.float32),
+            jax.ShapeDtypeStruct((1, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(jnp.asarray(n_valid, jnp.int32).reshape(1, 1), X, centers, c2)
+    return sums, counts[0], inertia[0, 0]
+
+
+def lloyd_step_pallas_masked(
+    X: jax.Array,
+    n_valid,
+    centers: jax.Array,
+    interpret: bool = False,
+    blk: int | None = None,
+    precision: jax.lax.Precision = jax.lax.Precision.DEFAULT,
+):
+    """Unit-weight fused Lloyd pass over the first n_valid rows (runtime scalar);
+    one X read, no weight stream. Returns (sums, counts, inertia)."""
+    n_split = _N_SPLIT[precision]
+    return _lloyd_step_masked_jit(
+        X, n_valid, centers, interpret,
+        blk if blk else _block_rows(X.shape[1], n_split), n_split,
+    )
+
+
 def lloyd_step_pallas(
     X: jax.Array,  # (n, d) f32
     w: jax.Array,  # (n,) f32 — 0 for padding rows
@@ -222,7 +312,13 @@ def _lloyd_step_jit(
 
 
 @functools.lru_cache(maxsize=None)
-def _fit_fn(mesh, interpret: bool, blk: int, precision=jax.lax.Precision.DEFAULT):
+def _fit_fn(
+    mesh,
+    interpret: bool,
+    blk: int,
+    precision=jax.lax.Precision.DEFAULT,
+    unit_mask: bool = False,
+):
     """Build (and cache) the jitted full-loop fit for a mesh/interpret/blk combo.
 
     The whole Lloyd loop runs ON DEVICE as a lax.while_loop around the fused step —
@@ -251,14 +347,30 @@ def _fit_fn(mesh, interpret: bool, blk: int, precision=jax.lax.Precision.DEFAULT
             check_vma=False,
         )
         def step(x_local, w_local, centers):
-            s, c, i = lloyd_step_pallas(
-                x_local, w_local, centers, interpret=interpret, blk=blk,
-                precision=precision,
-            )
+            if unit_mask:
+                # per-shard valid-prefix count: one cheap read of w vs streaming
+                # a (blk, 1) weight block through VMEM every grid step
+                s, c, i = lloyd_step_pallas_masked(
+                    x_local, jnp.sum(w_local.astype(jnp.int32)), centers,
+                    interpret=interpret, blk=blk, precision=precision,
+                )
+            else:
+                s, c, i = lloyd_step_pallas(
+                    x_local, w_local, centers, interpret=interpret, blk=blk,
+                    precision=precision,
+                )
             return (
                 jax.lax.psum(s, DATA_AXIS),
                 jax.lax.psum(c, DATA_AXIS),
                 jax.lax.psum(i, DATA_AXIS),
+            )
+
+    elif unit_mask:
+
+        def step(X, w, centers):
+            return lloyd_step_pallas_masked(
+                X, jnp.sum(w.astype(jnp.int32)), centers,
+                interpret=interpret, blk=blk, precision=precision,
             )
 
     else:
@@ -308,15 +420,21 @@ def lloyd_fit_pallas(
     mesh=None,
     interpret: bool = False,
     precision: jax.lax.Precision = jax.lax.Precision.DEFAULT,
+    unit_mask: bool = False,
 ):
     """Full Lloyd loop over the fused kernel; identical convergence semantics to
     ops/kmeans.lloyd_fit (movement^2 <= tol^2). With a multi-device mesh the kernel
     runs per-shard under shard_map and the (sums, counts, inertia) partials psum.
 
     precision=HIGHEST makes the in-loop numerics match lloyd_fit's parity path
-    (f32 assignment + f32 update accumulation); DEFAULT matches fast_math."""
+    (f32 assignment + f32 update accumulation); DEFAULT matches fast_math.
+
+    unit_mask=True requires w to be the pad_rows {1…1,0…0} prefix mask per shard
+    (FitInputs.unit_weight) and runs the weight-stream-free kernel — the same
+    (blk, 1)-operand elimination that took the Gram kernel from 25.7 to
+    8.2 ms/pass (ops/pallas_xtwx.py header)."""
     n_split = _N_SPLIT[precision]
     centers, inertia, n_iter = _fit_fn(
-        mesh, interpret, _block_rows(X.shape[1], n_split), precision
+        mesh, interpret, _block_rows(X.shape[1], n_split), precision, unit_mask
     )(X, w, init_centers, jnp.asarray(tol, X.dtype), max_iter)
     return centers, float(inertia), int(n_iter)

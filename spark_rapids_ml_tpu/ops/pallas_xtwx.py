@@ -156,7 +156,7 @@ def covariance_prefix_mask(
             check_vma=False,
         )
         def run(x_local, w_local):
-            nv = jnp.sum(w_local).astype(jnp.int32)
+            nv = jnp.sum(w_local.astype(jnp.int32))
             s2, s1 = xtx_pallas(
                 x_local, nv, precision=precision, interpret=interpret,
                 cse_guard=cse_guard,
@@ -169,7 +169,7 @@ def covariance_prefix_mask(
 
         s2, s1, wsum = run(X, w)
     else:
-        nv = jnp.sum(w).astype(jnp.int32)
+        nv = jnp.sum(w.astype(jnp.int32))
         s2, s1 = xtx_pallas(
             X, nv, precision=precision, interpret=interpret, cse_guard=cse_guard
         )

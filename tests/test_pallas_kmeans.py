@@ -119,3 +119,49 @@ def test_estimator_env_gate(monkeypatch, n_devices):
     np.testing.assert_allclose(
         canon(base.cluster_centers_), canon(fused.cluster_centers_), atol=1e-3
     )
+
+
+def test_masked_step_matches_weighted_step():
+    """Unit-weight masked kernel (no weight operand) must reproduce the weighted
+    kernel's accumulators when w is a prefix mask."""
+    import jax.numpy as jnp
+
+    from spark_rapids_ml_tpu.ops.pallas_kmeans import lloyd_step_pallas_masked
+
+    X, init = _blobs(n=600)
+    n_valid = 530
+    w = np.ones((600,), np.float32)
+    w[n_valid:] = 0.0
+    s_ref, c_ref, i_ref = lloyd_step_pallas(
+        jnp.asarray(X), jnp.asarray(w), jnp.asarray(init), interpret=True
+    )
+    s_m, c_m, i_m = lloyd_step_pallas_masked(
+        jnp.asarray(X), n_valid, jnp.asarray(init), interpret=True
+    )
+    np.testing.assert_allclose(np.asarray(s_m), np.asarray(s_ref), rtol=1e-5, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(c_m), np.asarray(c_ref), atol=1e-5)
+    assert float(i_m) == pytest.approx(float(i_ref), rel=1e-5)
+
+
+@pytest.mark.parametrize("precision", ["DEFAULT", "HIGHEST"])
+def test_masked_fit_matches_lloyd_fit(n_devices, precision):
+    import jax
+    import jax.numpy as jnp
+
+    from spark_rapids_ml_tpu.parallel.mesh import get_mesh, shard_array
+    from spark_rapids_ml_tpu.parallel.partition import pad_rows
+
+    X, init = _blobs(n=500)
+    mesh = get_mesh(n_devices)
+    Xp, w, _ = pad_rows(X, n_devices)
+    Xd, wd = shard_array(Xp, mesh), shard_array(w, mesh)
+    c_ref, in_ref, it_ref = lloyd_fit(
+        jnp.asarray(Xp), jnp.asarray(w), jnp.asarray(init), 1e-6, 20
+    )
+    c_m, in_m, it_m = lloyd_fit_pallas(
+        Xd, wd, jnp.asarray(init), 1e-6, 20, mesh=mesh, interpret=True,
+        precision=getattr(jax.lax.Precision, precision), unit_mask=True,
+    )
+    np.testing.assert_allclose(np.asarray(c_m), np.asarray(c_ref), rtol=1e-4, atol=1e-3)
+    assert in_m == pytest.approx(float(in_ref), rel=1e-4)
+    assert it_m == int(it_ref)
