@@ -320,6 +320,36 @@ class ApproximateNearestNeighbors(_ApproxNNClass, _TpuEstimator, _NNParams):
 
         return _fit
 
+    def _streaming_fit(self, fd) -> Dict[str, Any]:
+        """Out-of-core IVF-Flat build: items stay host-resident; the device sees
+        only assignment batches (ops/ann_streaming.py) — the ANN leg of the
+        reference's UVM/SAM tier (utils.py:184-241). Search then pages in only
+        the probed cells (ApproximateNearestNeighborsModel.kneighbors picks the
+        streamed search when the cells exceed the stream threshold). IVF-PQ/
+        CAGRA and cosine route in-core with a warning."""
+        from .. import config as _config
+        from ..core.dataset import densify as _densify
+        from ..ops.ann_streaming import streaming_ivfflat_build
+
+        algo = self.getOrDefault("algorithm")
+        if algo not in ("ivfflat", "ivf_flat") or self.getOrDefault("metric") == "cosine":
+            self.logger.warning(
+                "streamed ANN covers euclidean ivfflat only; fitting in-core "
+                "despite stream_threshold_bytes."
+            )
+            inputs = self._build_fit_inputs(fd)
+            return self._get_tpu_fit_func(None)(inputs)
+        algo_params = self.getOrDefault("algoParams") or {}
+        nlist = int(_ap(algo_params, "nlist", "n_lists", default=64))
+        X = np.asarray(_densify(fd.features, self._float32_inputs))
+        return streaming_ivfflat_build(
+            X,
+            nlist=min(nlist, fd.n_rows),
+            max_iter=20,
+            seed=int(algo_params.get("seed", 42)),
+            batch_rows=int(_config.get("stream_batch_rows")),
+        )
+
     def _create_pyspark_model(self, attrs) -> "ApproximateNearestNeighborsModel":
         return ApproximateNearestNeighborsModel(**attrs)
 
@@ -470,14 +500,33 @@ class ApproximateNearestNeighborsModel(_ApproxNNClass, _TpuModel, _NNParams):
                         k=k,
                     )
             else:
-                dists_j, ids_j = ivfflat_search(
-                    jnp.asarray(Q),
-                    jnp.asarray(self._model_attributes["centers"]),
-                    jnp.asarray(self._model_attributes["cells"]),
-                    jnp.asarray(self._model_attributes["cell_ids"]),
-                    k=k,
-                    nprobe=min(nprobe, nlist),
-                )
+                from .. import config as _config
+
+                cells_np = self._model_attributes["cells"]
+                threshold = _config.get("stream_threshold_bytes")
+                if threshold and getattr(cells_np, "nbytes", 0) > threshold:
+                    # out-of-core search: cells stay host-resident, only the
+                    # probed cells page onto the device (ops/ann_streaming.py)
+                    from ..ops.ann_streaming import streaming_ivfflat_search
+
+                    self.logger.info(
+                        "IVF cells ~%.0f MiB exceed stream_threshold_bytes; "
+                        "searching with host-resident cells",
+                        cells_np.nbytes / 2**20,
+                    )
+                    dists_j, ids_j = streaming_ivfflat_search(
+                        np.asarray(Q), self._model_attributes, k=k,
+                        nprobe=min(nprobe, nlist),
+                    )
+                else:
+                    dists_j, ids_j = ivfflat_search(
+                        jnp.asarray(Q),
+                        jnp.asarray(self._model_attributes["centers"]),
+                        jnp.asarray(cells_np),
+                        jnp.asarray(self._model_attributes["cell_ids"]),
+                        k=k,
+                        nprobe=min(nprobe, nlist),
+                    )
             dists = np.asarray(dists_j)
             pos = np.asarray(ids_j)
 

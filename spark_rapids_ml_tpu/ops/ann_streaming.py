@@ -1,0 +1,138 @@
+#
+# Out-of-core IVF-Flat: the ANN leg of the UVM/SAM replacement tier
+# (reference utils.py:184-241 lets cuVS index datasets beyond device memory via
+# managed memory; reference ANN role: knn.py:1538-1690).
+#
+# TPU formulation: the ITEM SET stays host-resident end to end.
+#   * build: coarse centers fit in-core on a bounded row subsample, then the
+#     full dataset streams through the device in batches only to be ASSIGNED to
+#     cells (one (batch, nlist) distance matmul per batch); the dense
+#     cell layout is materialized host-side.
+#   * search: per query block, only the PROBED cells travel host->device —
+#     device residency is (block, nprobe, max_cell, d) + centers, never the
+#     dataset. This is the managed-memory access pattern made explicit: the
+#     probe list is the page table, the gathered cells are the pages.
+#
+# In-core ivfflat (ops/knn.py) remains the fast path when cells fit HBM; the
+# estimator (models/knn.py) picks this module above the stream threshold.
+#
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def streaming_ivfflat_build(
+    X: np.ndarray,
+    nlist: int,
+    max_iter: int,
+    seed: int,
+    batch_rows: int,
+    sample_rows: int = 1 << 18,
+) -> Dict[str, np.ndarray]:
+    """Build the IVF layout with the dataset host-resident: centers from an
+    in-core kmeans on a strided subsample (rows are not assumed shuffled), then
+    streamed batch assignment. Returns the same dict shape as ops/knn.py::
+    ivfflat_build but with `cells`/`cell_ids` as HOST arrays."""
+    from .kmeans import kmeans_fit, kmeans_predict
+
+    n, d = X.shape
+    step = max(1, n // min(n, sample_rows))
+    Xs = np.ascontiguousarray(X[::step], dtype=np.float32)
+    # the coarse kmeans trains on the SUBSAMPLE: k must fit it, not just n
+    nlist = min(nlist, len(Xs))
+    fitted = kmeans_fit(
+        jnp.asarray(Xs), jnp.ones((len(Xs),), jnp.float32), k=nlist,
+        max_iter=max_iter, tol=1e-4, init="k-means||", init_steps=2, seed=seed,
+    )
+    centers = fitted["cluster_centers"]
+    centers_j = jnp.asarray(centers)
+
+    assign = np.empty((n,), np.int32)
+    for s in range(0, n, batch_rows):
+        e = min(s + batch_rows, n)
+        assign[s:e] = np.asarray(
+            kmeans_predict(
+                jnp.asarray(np.ascontiguousarray(X[s:e], dtype=np.float32)),
+                centers_j,
+            )
+        )
+
+    from .knn import layout_cells
+
+    cells, cell_ids, cell_sizes = layout_cells(
+        np.asarray(X, dtype=np.float32), assign, nlist
+    )
+    return {
+        "centers": centers,
+        "cells": cells,
+        "cell_ids": cell_ids,
+        "cell_sizes": cell_sizes,
+    }
+
+
+@functools.partial(jax.jit, static_argnames=("nprobe",))
+def _probe_cells(Q: jax.Array, centers: jax.Array, nprobe: int):
+    from .knn import _block_sq_dists
+
+    cd2 = _block_sq_dists(Q, centers)
+    _, probe = jax.lax.top_k(-cd2, nprobe)
+    return probe
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def _scan_probed(qb, probed_items, probed_ids, k):
+    """(bq, nprobe, max_cell, d) probed cells -> per-query top-k. EXACT f32
+    difference-form distances, matching ops/knn.py::ivfflat_search's in-core
+    cell scan rank-for-rank (the candidate set per query is small, so the exact
+    form costs nothing; the expanded bf16 form was observed to reorder
+    near-duplicate candidates vs the in-core path)."""
+    bq, nprobe, max_cell, d = probed_items.shape
+    flat = probed_items.reshape(bq, nprobe * max_cell, d)
+    flat_ids = probed_ids.reshape(bq, nprobe * max_cell)
+    d2 = jnp.sum((flat - qb[:, None, :]) ** 2, axis=2)
+    d2 = jnp.where(flat_ids >= 0, d2, jnp.inf)
+    neg, pos = jax.lax.top_k(-d2, k)
+    ids = jnp.take_along_axis(flat_ids, pos, axis=1)
+    dists = jnp.sqrt(jnp.maximum(-neg, 0.0))
+    return jnp.where(ids >= 0, dists, jnp.inf), ids
+
+
+def streaming_ivfflat_search(
+    Q: np.ndarray,
+    index: Dict[str, np.ndarray],
+    k: int,
+    nprobe: int,
+    block: int = 256,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Search with host-resident cells: per query block the probe list is
+    computed on device, then ONLY the probed cells are gathered host-side and
+    device_put — (block, nprobe, max_cell, d) device residency. Returns
+    (euclidean distances, item ids) of width k_eff = min(k, nprobe*max_cell),
+    id -1 where fewer than k found — the SAME width contract as the in-core
+    ivfflat_search, so results are byte-identical across the threshold."""
+    centers_j = jnp.asarray(index["centers"])
+    cells = index["cells"]
+    cell_ids = index["cell_ids"]
+    nlist, max_cell, d = cells.shape
+    nq = Q.shape[0]
+    k_eff = min(k, nprobe * max_cell)
+
+    out_d = np.full((nq, k_eff), np.inf, np.float32)
+    out_i = np.full((nq, k_eff), -1, np.int64)
+    for s in range(0, nq, block):
+        e = min(s + block, nq)
+        qb = jnp.asarray(np.ascontiguousarray(Q[s:e], dtype=np.float32))
+        probe = np.asarray(_probe_cells(qb, centers_j, nprobe))  # (bq, nprobe)
+        # the host gather IS the out-of-core page-in
+        probed_items = jnp.asarray(cells[probe])
+        probed_ids = jnp.asarray(cell_ids[probe])
+        dists, ids = _scan_probed(qb, probed_items, probed_ids, k_eff)
+        out_d[s:e] = np.asarray(dists)
+        out_i[s:e] = np.asarray(ids)
+    return out_d, out_i

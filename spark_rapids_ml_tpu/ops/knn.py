@@ -134,16 +134,36 @@ def ivfflat_build(
     centers = fitted["cluster_centers"]
     assign = np.asarray(kmeans_predict(X, jnp.asarray(centers)))
     valid = np.asarray(w) > 0
-    n, d = X.shape
-    cell_sizes = np.bincount(assign[valid], minlength=nlist)
+    cells, cell_ids, cell_sizes = layout_cells(np.asarray(X), assign, nlist, valid)
+    out = {
+        "centers": centers,
+        "cells": cells,
+        "cell_ids": cell_ids,
+        "cell_sizes": cell_sizes,
+    }
+    if return_assign:
+        out["assign"] = assign
+    return out
+
+
+def layout_cells(
+    Xh: np.ndarray,
+    assign: np.ndarray,
+    nlist: int,
+    valid: "np.ndarray | None" = None,
+):
+    """Dense (nlist, max_cell, d) cell layout with -1 id sentinels — shared by the
+    in-core and streamed (ops/ann_streaming.py) IVF builds so the sentinel/offset
+    conventions the probe scans depend on cannot diverge. Vectorized: stable-sort
+    rows by cell, then each row's slot is its sorted position minus the cell's
+    start offset (the former per-row Python loop was O(n) interpreted —
+    disqualifying at 10M items)."""
+    n, d = Xh.shape
+    valid_idx = np.arange(n) if valid is None else np.nonzero(valid)[0]
+    cell_sizes = np.bincount(assign[valid_idx], minlength=nlist)
     max_cell = max(int(cell_sizes.max()), 1)
     cells = np.zeros((nlist, max_cell, d), dtype=np.float32)
     cell_ids = np.full((nlist, max_cell), -1, dtype=np.int64)
-    Xh = np.asarray(X)
-    # vectorized cell layout: stable-sort rows by cell, then each row's slot within
-    # its cell is its sorted position minus the cell's start offset (the former
-    # per-row Python loop was O(n) interpreted — disqualifying at 10M items)
-    valid_idx = np.nonzero(valid)[0]
     order = np.argsort(assign[valid_idx], kind="stable")
     sorted_rows = valid_idx[order]
     sorted_cells = assign[sorted_rows]
@@ -152,15 +172,7 @@ def ivfflat_build(
     )
     cells[sorted_cells, within] = Xh[sorted_rows]
     cell_ids[sorted_cells, within] = sorted_rows
-    out = {
-        "centers": centers,
-        "cells": cells,
-        "cell_ids": cell_ids,
-        "cell_sizes": cell_sizes.astype(np.int32),
-    }
-    if return_assign:
-        out["assign"] = assign
-    return out
+    return cells, cell_ids, cell_sizes.astype(np.int32)
 
 
 def ivfpq_build(

@@ -339,3 +339,51 @@ def test_strong_wolfe_expansion_exhaustion_returns_evaluated_point():
     alpha, f_new, g_new, _ = _strong_wolfe(f, x0, fx, gx, np.array([1.0]), max_steps=3)
     assert alpha in evals, (alpha, evals)
     assert f_new == -alpha
+
+
+def test_streaming_ivfflat_search_matches_incore_on_same_index(n_devices):
+    """Same index, two search paths: the host-resident-cells streamed search must
+    return exactly the in-core scan's neighbors (both are deterministic)."""
+    import jax.numpy as jnp
+
+    from spark_rapids_ml_tpu.ops.ann_streaming import (
+        streaming_ivfflat_build,
+        streaming_ivfflat_search,
+    )
+    from spark_rapids_ml_tpu.ops.knn import ivfflat_search
+
+    rng = np.random.default_rng(37)
+    X = rng.normal(size=(3000, 16)).astype(np.float32)
+    Q = X[:100]
+    index = streaming_ivfflat_build(X, nlist=32, max_iter=10, seed=3, batch_rows=500)
+    d_s, i_s = streaming_ivfflat_search(Q, index, k=8, nprobe=8, block=32)
+    d_i, i_i = ivfflat_search(
+        jnp.asarray(Q), jnp.asarray(index["centers"]), jnp.asarray(index["cells"]),
+        jnp.asarray(index["cell_ids"]), k=8, nprobe=8,
+    )
+    np.testing.assert_array_equal(i_s, np.asarray(i_i))
+    np.testing.assert_allclose(d_s, np.asarray(d_i), rtol=1e-5, atol=1e-5)
+
+
+def test_streaming_ann_estimator_end_to_end(n_devices, tiny_stream_threshold):
+    """ANN estimator above the stream threshold: host-resident build + paged
+    search, recall@8 vs brute force stays high."""
+    from spark_rapids_ml_tpu.knn import ApproximateNearestNeighbors
+
+    rng = np.random.default_rng(41)
+    X = rng.normal(size=(2000, 12)).astype(np.float32)
+    df = pd.DataFrame({"features": list(X), "id": np.arange(2000)})
+    est = ApproximateNearestNeighbors(
+        k=8, algorithm="ivfflat", algoParams={"nlist": 16, "nprobe": 8},
+        inputCol="features", idCol="id"
+    )
+    model = est.fit(df)
+    _, _, knn_df = model.kneighbors(pd.DataFrame({"features": list(X[:64]), "id": np.arange(64)}))
+    got = np.stack(knn_df["indices"].to_numpy())
+    # exact neighbors
+    d2 = ((X[:64, None] - X[None]) ** 2).sum(-1)
+    exact = np.argsort(d2, axis=1)[:, :8]
+    recall = np.mean([
+        len(set(got[i]) & set(exact[i])) / 8.0 for i in range(64)
+    ])
+    assert recall > 0.9, recall
