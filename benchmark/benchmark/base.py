@@ -31,6 +31,10 @@ class BenchmarkBase:
     def add_arguments(self, parser: argparse.ArgumentParser) -> None:
         pass
 
+    # params that change the generated/loaded DATA, not just the estimator: a
+    # sweep over any of these must reload the dataframe per sweep point
+    _DATA_PARAMS = frozenset({"num_rows", "num_cols", "seed", "train_path", "dtype"})
+
     def parse_arguments(self, argv: List[str]) -> argparse.Namespace:
         parser = argparse.ArgumentParser(prog=f"benchmark {self.name}")
         parser.add_argument("--num_rows", type=int, default=5000)
@@ -39,11 +43,24 @@ class BenchmarkBase:
         parser.add_argument("--train_path", default=None, help="parquet input; generated when absent")
         parser.add_argument("--transform_path", default=None)
         parser.add_argument("--num_runs", type=int, default=1)
+        parser.add_argument(
+            "--sweep",
+            default="",
+            help="param sweep 'name=v1,v2,...' — repeats every run per value "
+            "(e.g. --sweep k=8,16,32); values coerce to the param's argparse type",
+        )
         parser.add_argument("--report_path", default="")
         parser.add_argument("--no_cpu", action="store_true", help="skip the sklearn CPU run")
         parser.add_argument("--num_workers", type=int, default=None)
         parser.add_argument("--seed", type=int, default=0)
         self.add_arguments(parser)
+        # argparse-declared types drive --sweep value coercion (a default of None
+        # says nothing about the param's type; store_true flags are unsweepable)
+        self._arg_types = {
+            a.dest: a.type
+            for a in parser._actions
+            if a.dest != "help" and not isinstance(a.const, bool)
+        }
         return parser.parse_args(argv)
 
     # ---- data ----
@@ -77,30 +94,90 @@ class BenchmarkBase:
 
     def run(self, argv: List[str]) -> List[Dict[str, Any]]:
         args = self.parse_arguments(argv)
-        df = self.load_dataframe(args)
+
+        # validate the sweep BEFORE loading data (fail fast on a bad spec)
+        sweep_name, sweep_values = None, [None]
+        if args.sweep:
+            sweep_name, raw = args.sweep.split("=", 1)
+            if sweep_name not in self._arg_types:
+                raise ValueError(
+                    f"--sweep names unknown param '{sweep_name}' "
+                    f"(sweepable: {sorted(self._arg_types)})"
+                )
+            coerce = self._arg_types[sweep_name] or str
+            sweep_values = [coerce(v) for v in raw.split(",")]
+
+        df = None if sweep_name in self._DATA_PARAMS else self.load_dataframe(args)
         rows: List[Dict[str, Any]] = []
-        for run_idx in range(args.num_runs):
-            for mode in ("tpu",) if args.no_cpu else ("tpu", "cpu"):
-                t0 = time.perf_counter()
-                metrics = (self.run_tpu if mode == "tpu" else self.run_cpu)(df, args)
-                total = time.perf_counter() - t0
-                row = {
-                    "benchmark": self.name,
-                    "mode": mode,
-                    "run": run_idx,
-                    "num_rows": len(df),
-                    "total_time": round(total, 4),
-                    **{k: (round(v, 6) if isinstance(v, float) else v) for k, v in metrics.items()},
-                }
-                print(row)
-                rows.append(row)
+        for sweep_value in sweep_values:
+            if sweep_name is not None:
+                setattr(args, sweep_name, sweep_value)
+                if sweep_name in self._DATA_PARAMS:
+                    df = self.load_dataframe(args)  # the sweep changes the DATA
+            for run_idx in range(args.num_runs):
+                for mode in ("tpu",) if args.no_cpu else ("tpu", "cpu"):
+                    t0 = time.perf_counter()
+                    metrics = (self.run_tpu if mode == "tpu" else self.run_cpu)(df, args)
+                    total = time.perf_counter() - t0
+                    row = {
+                        "benchmark": self.name,
+                        "mode": mode,
+                        "run": run_idx,
+                        "num_rows": len(df),
+                        "total_time": round(total, 4),
+                        **{k: (round(v, 6) if isinstance(v, float) else v) for k, v in metrics.items()},
+                    }
+                    if sweep_name is not None:
+                        row["sweep_param"] = sweep_name
+                        row["sweep_value"] = sweep_value
+                    print(row)
+                    rows.append(row)
+        rows += self._aggregate(rows)
         if args.report_path:
             self.write_report(rows, args.report_path)
         return rows
 
+    @staticmethod
+    def _aggregate(rows: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+        """Mean/min summary rows per (mode, sweep point) across runs — the
+        reference's multi-run report aggregation (base.py:262-285 reports per-run
+        rows; consumers want the distilled number)."""
+        groups: Dict[Any, List[Dict[str, Any]]] = {}
+        for r in rows:
+            key = (r["mode"], r.get("sweep_param"), r.get("sweep_value"))
+            groups.setdefault(key, []).append(r)
+        out = []
+        for (mode, sp, sv), grp in groups.items():
+            if len(grp) < 2:
+                continue
+            agg: Dict[str, Any] = {
+                "benchmark": grp[0]["benchmark"],
+                "mode": mode,
+                "run": "mean-of-%d" % len(grp),
+                "num_rows": grp[0]["num_rows"],
+            }
+            if sp is not None:
+                agg["sweep_param"], agg["sweep_value"] = sp, sv
+            for k in ("fit_time", "transform_time", "total_time", "score"):
+                vals = [r[k] for r in grp if isinstance(r.get(k), (int, float))]
+                if vals:
+                    agg[k] = round(float(np.mean(vals)), 6)
+                    agg[f"{k}_min"] = round(float(np.min(vals)), 6)
+            out.append(agg)
+            print(agg)
+        return out
+
     def write_report(self, rows: List[Dict[str, Any]], path: str) -> None:
-        """Append rows to a CSV report (reference base.py:262-285)."""
+        """Append rows to a CSV report (reference base.py:262-285). If the
+        existing file's header doesn't cover this run's columns (e.g. sweep/
+        aggregate columns appeared), the old file rotates to .old rather than
+        appending misaligned rows."""
         fieldnames = sorted({k for r in rows for k in r})
+        if os.path.exists(path):
+            with open(path) as f:
+                first = f.readline().strip()
+            if first != ",".join(fieldnames):
+                os.replace(path, path + ".old")
         exists = os.path.exists(path)
         with open(path, "a", newline="") as f:
             writer = csv.DictWriter(f, fieldnames=fieldnames)

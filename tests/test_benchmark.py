@@ -159,3 +159,43 @@ def test_gen_data_distributed_all_kinds(tmp_path):
         )
         df = read_parquet_dataset(out)
         assert len(df) == 200, kind
+
+
+def test_sweep_and_aggregation_rows():
+    """--sweep repeats runs per param value; multi-run groups gain a mean/min
+    summary row (the reference's multi-run report role, base.py:262-285)."""
+    from benchmark.benchmark.bench_kmeans import BenchmarkKMeans
+
+    rows = BenchmarkKMeans().run(
+        [
+            "--num_rows", "300", "--num_cols", "8", "--num_runs", "2",
+            "--sweep", "k=2,3", "--no_cpu",
+        ]
+    )
+    per_run = [r for r in rows if isinstance(r["run"], int)]
+    aggs = [r for r in rows if isinstance(r["run"], str)]
+    assert len(per_run) == 4  # 2 sweep values x 2 runs
+    assert {r["sweep_value"] for r in per_run} == {2, 3}
+    assert len(aggs) == 2
+    for a in aggs:
+        assert a["run"] == "mean-of-2"
+        assert a["fit_time_min"] <= a["fit_time"]
+
+
+def test_sweep_rejects_unknown_param():
+    import pytest as _pytest
+
+    from benchmark.benchmark.bench_kmeans import BenchmarkKMeans
+
+    with _pytest.raises(ValueError, match="unknown param"):
+        BenchmarkKMeans().run(["--num_rows", "100", "--sweep", "nope=1,2", "--no_cpu"])
+
+
+def test_sweep_over_data_param_reloads_dataframe():
+    from benchmark.benchmark.bench_kmeans import BenchmarkKMeans
+
+    rows = BenchmarkKMeans().run(
+        ["--num_cols", "8", "--sweep", "num_rows=200,400", "--no_cpu"]
+    )
+    per_run = [r for r in rows if isinstance(r["run"], int)]
+    assert sorted(r["num_rows"] for r in per_run) == [200, 400]
