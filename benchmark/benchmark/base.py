@@ -177,7 +177,7 @@ class BenchmarkBase:
             with open(path) as f:
                 first = f.readline().strip()
             if first != ",".join(fieldnames):
-                os.replace(path, path + ".old")
+                os.replace(path, f"{path}.{int(time.time())}.old")
         exists = os.path.exists(path)
         with open(path, "a", newline="") as f:
             writer = csv.DictWriter(f, fieldnames=fieldnames)
