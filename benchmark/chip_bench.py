@@ -21,7 +21,7 @@ from __future__ import annotations
 
 import os
 import time
-from typing import Callable, Dict, List
+from typing import Dict, List
 
 import numpy as np
 
@@ -535,7 +535,7 @@ def bench_fit_e2e(ctx) -> Dict:
     import jax.numpy as jnp
 
     from spark_rapids_ml_tpu.ops.kmeans import lloyd_fit
-    from spark_rapids_ml_tpu.parallel.mesh import get_mesh, shard_array
+    from spark_rapids_ml_tpu.parallel.mesh import shard_array
 
     mesh = ctx["mesh"]
     n, d = ctx["e2e_shape"]

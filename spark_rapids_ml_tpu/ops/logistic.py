@@ -25,7 +25,7 @@
 from __future__ import annotations
 
 import functools
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict
 
 import jax
 import jax.numpy as jnp
@@ -225,7 +225,7 @@ def logreg_fit(
     max_iter: int,
     tol: float,
     multinomial: bool,
-    bounds: "Optional[Tuple[Any, Any, Any, Any]]" = None,
+    bounds: "tuple | None" = None,
 ) -> Dict[str, Any]:
     """Full fit; returns Spark-layout model attributes:
     coefficients (k_rows, d) and intercepts (k_rows,) with k_rows = 1 for binomial.

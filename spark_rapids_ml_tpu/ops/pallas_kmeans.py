@@ -33,7 +33,6 @@ import functools
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 from jax.experimental import pallas as pl
 
 BLOCK_ROWS = 0  # 0 = adaptive (see _block_rows); tests may pin a fixed size

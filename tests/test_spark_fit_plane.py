@@ -12,7 +12,6 @@ with real processes.
 Reference analog: the `dataset.mapInPandas(_train_udf).rdd.barrier()` fan-out of
 reference core.py:1005-1011."""
 
-import pickle
 import sys
 import threading
 import types
