@@ -203,6 +203,7 @@ class KMeans(_KMeansClass, _TpuEstimator, _KMeansParams):
                     init_steps=int(p["init_steps"]),
                     seed=int(p["random_state"]) if p["random_state"] is not None else 1,
                     metric=str(p.get("metric", "euclidean")),
+                    unit_weight=inputs.unit_weight,
                 )
                 # one assignment pass for the training summary's clusterSizes
                 # (Spark KMeansSummary; the reference produces no summary). Done

@@ -49,6 +49,7 @@ def streaming_ivfflat_build(
     fitted = kmeans_fit(
         jnp.asarray(Xs), jnp.ones((len(Xs),), jnp.float32), k=nlist,
         max_iter=max_iter, tol=1e-4, init="k-means||", init_steps=2, seed=seed,
+        unit_weight=True,
     )
     centers = fitted["cluster_centers"]
     centers_j = jnp.asarray(centers)

@@ -310,6 +310,7 @@ def kmeans_fit(
     init_steps: int,
     seed: int,
     metric: str = "euclidean",
+    unit_weight: bool = False,
 ) -> Dict[str, object]:
     cosine = metric == "cosine"
     if cosine:
@@ -325,16 +326,20 @@ def kmeans_fit(
     init_centers = jnp.asarray(kmeans_init(X, w, k, init, init_steps, seed))
     from .. import config as _config
 
-    # The fused pallas Lloyd is an explicit opt-in (SRML_TPU_PALLAS_KMEANS=1), NOT
+    # The fused pallas Lloyd is an explicit opt-in (SRML_TPU_PALLAS_KMEANS), NOT
     # the default and NOT tied to fast_math: steady-state TPU measurement at the
     # bench shape (12M x 128, k=20, v5e) puts the XLA path at 18.7 ms/iter (~92%
-    # of the two-X-reads HBM roofline) vs 26.3/37.5 ms/iter for the fused kernel
-    # at 1-pass/6-pass precision — at small k both fused matmuls pad k to the
-    # 128-lane MXU width and the per-block argmin/one-hot VPU work dominates, so
-    # streaming X once does not pay. The kernel may still win at large k (less
-    # lane padding, and XLA's (n, k) intermediates grow); hence the escape hatch.
+    # of the two-X-reads HBM roofline) vs 26.3/37.5 ms/iter for the WEIGHTED fused
+    # kernel at 1-pass/6-pass precision — at small k both fused matmuls pad k to
+    # the 128-lane MXU width and the per-block argmin/one-hot VPU work dominates,
+    # so streaming X once does not pay. Values:
+    #   "1"    weighted kernel (any w)
+    #   "mask" weight-stream-free kernel — requires unit_weight (the pad_rows
+    #          prefix-mask contract); the (blk,1)-operand elimination measured 3x
+    #          on the Gram kernel (ops/pallas_xtwx.py); falls back to "1" when
+    #          sample weights are present
     _pallas_env = __import__("os").environ.get("SRML_TPU_PALLAS_KMEANS", "")
-    use_fused = not cosine and _pallas_env == "1"
+    use_fused = not cosine and _pallas_env in ("1", "mask")
     if use_fused:
         from jax.sharding import NamedSharding
 
@@ -355,6 +360,7 @@ def kmeans_fit(
             X, w, init_centers, float(tol), int(max_iter), mesh=mesh,
             interpret=(jax.default_backend() != "tpu"),
             precision=prec,
+            unit_mask=(_pallas_env == "mask" and unit_weight),
         )
     else:
         centers, inertia, n_iter = lloyd_fit(

@@ -127,9 +127,11 @@ def ivfflat_build(
     to the max cell size (static shapes for the probe scan)."""
     from .kmeans import kmeans_fit, kmeans_predict
 
+    # ANN builds have no sample weights: w is purely the pad mask, so the
+    # masked (weight-stream-free) Lloyd kernel is eligible under the mask opt-in
     fitted = kmeans_fit(
         X, w, k=nlist, max_iter=max_iter, tol=1e-4, init="k-means||",
-        init_steps=2, seed=seed,
+        init_steps=2, seed=seed, unit_weight=True,
     )
     centers = fitted["cluster_centers"]
     assign = np.asarray(kmeans_predict(X, jnp.asarray(centers)))
@@ -218,7 +220,7 @@ def ivfpq_build(
         k_eff = min(n_codes, sub.shape[0])
         fitted = kmeans_fit(
             jnp.asarray(sub), wv, k=k_eff, max_iter=max_iter, tol=1e-4,
-            init="k-means||", init_steps=2, seed=seed + m_i,
+            init="k-means||", init_steps=2, seed=seed + m_i, unit_weight=True,
         )
         cb = np.zeros((n_codes, sub_d), np.float32)
         cb[:k_eff] = fitted["cluster_centers"]
