@@ -50,6 +50,14 @@ def _accuracy(pred: np.ndarray, y: np.ndarray) -> float:
     return float((pred == y).mean())
 
 
+def _recall_at(got: np.ndarray, exact: np.ndarray, k: int) -> float:
+    """Mean fraction of exact top-k ids recovered per query (-1 ids never match
+    since exact ids are nonnegative)."""
+    return float(
+        np.mean([len(set(got[i]) & set(exact[i])) / k for i in range(len(got))])
+    )
+
+
 def _append_report(ctx, rows) -> None:
     """Append sweep rows to benchmark/results/report.csv (the reference bench's
     CSV report role, base.py:262-285). rows: (bench, param, value, throughput,
@@ -414,15 +422,7 @@ def bench_ann(ctx) -> Dict:
             ),
             repeats=1,
         )
-        got = np.asarray(ids)
-        recall = float(
-            np.mean(
-                [
-                    len(set(got[i]) & set(exact_ids[i])) / 10.0
-                    for i in range(nq)
-                ]
-            )
-        )
+        recall = _recall_at(np.asarray(ids), exact_ids, 10)
         rows.append((nprobe, nq / t / ctx["n_chips"], recall))
         if nprobe == 32:
             out["ann_queries_per_sec_per_chip"] = round(nq / t / ctx["n_chips"], 1)
@@ -430,6 +430,46 @@ def bench_ann(ctx) -> Dict:
     _append_report(
         ctx, [("ann_ivfflat", "nprobe", nprobe, qps, rec) for nprobe, qps, rec in rows]
     )
+
+    # CAGRA-class graph index: recall@10 vs itopk sweep (the reference ANN
+    # bench's itopk axis, bench_approximate_nearest_neighbors.py) on a smaller
+    # item set — graph build is O(n * degree) distance work
+    try:
+        from spark_rapids_ml_tpu.ops.knn import cagra_build, cagra_search
+
+        sub_g = min(sub, 200_000 if ctx["on_tpu"] else 5_000)
+        Xg = Xa[:sub_g]
+        wg = wa[:sub_g]
+        t_gb0 = time.perf_counter()
+        gindex = cagra_build(Xg, wg, graph_degree=32, seed=7)
+        t_gb = time.perf_counter() - t_gb0
+        out["cagra_build_rows_per_sec_per_chip"] = round(
+            sub_g / t_gb / ctx["n_chips"], 1
+        )
+        items_j = jnp.asarray(gindex["items"])
+        graph_j = jnp.asarray(gindex["graph"])
+        nq_g = min(nq, 512)
+        Qg = Xg[:nq_g]
+        _, exact_g = exact_knn_single(Qg, Xg, wg > 0, 10)
+        exact_g = np.asarray(exact_g)
+        grows = []
+        for itopk in (32, 64, 128):
+            t_s, (dg, ig) = _timed(
+                lambda it_=itopk: cagra_search(Qg, items_j, graph_j, 10, itopk=it_),
+                repeats=1,
+            )
+            rec_g = _recall_at(np.asarray(ig), exact_g, 10)
+            grows.append((itopk, nq_g / t_s / ctx["n_chips"], rec_g))
+            if itopk == 64:
+                out["cagra_queries_per_sec_per_chip"] = round(
+                    nq_g / t_s / ctx["n_chips"], 1
+                )
+                out["cagra_recall_at_10"] = round(rec_g, 4)
+        _append_report(
+            ctx, [("ann_cagra", "itopk", it_, qps_, rec_) for it_, qps_, rec_ in grows]
+        )
+    except Exception as e:
+        out["cagra_error"] = f"{type(e).__name__}: {str(e)[:160]}"
     return out
 
 
