@@ -200,3 +200,27 @@ def test_collect_mode_fit_on_mock_spark_df():
     centers = np.asarray(model.cluster_centers_)
     assert centers.shape == (2, 4)
     assert abs(abs(centers[:, 0]).mean() - 3.0) < 1.0
+
+
+def test_broadcast_key_falls_back_to_executor_path():
+    """Real executor-side pyspark Broadcast objects expose only `_path`; the
+    worker model cache must key on it rather than disable caching (round-3
+    advisor finding)."""
+    from spark_rapids_ml_tpu.spark.transform import _broadcast_key
+
+    class ExecutorSideBroadcast:
+        _path = "/tmp/spark-broadcast-42/broadcast_7"
+
+    class NoIdsAtAll:
+        pass
+
+    assert _broadcast_key(ExecutorSideBroadcast()) == (
+        "path", "/tmp/spark-broadcast-42/broadcast_7",
+    )
+    assert _broadcast_key(NoIdsAtAll()) is None
+    # driver-side id wins over _path when both exist
+    class DriverSide:
+        id = 3
+        _path = "/x"
+
+    assert _broadcast_key(DriverSide()) == ("bid", 3)
