@@ -155,3 +155,16 @@ def test_pca_estimator_fused_dispatch_runs_kernel(monkeypatch):
         np.asarray(m_fused.explained_variance_),
         rtol=1e-4,
     )
+
+
+@pytest.mark.parametrize("d", [129, 512])
+def test_xtx_boundary_widths(d):
+    """Lane-padding (d=129) and the MAX_FUSED_COLS VMEM boundary (d=512) —
+    widths the dispatch gate admits but hardware time hasn't covered."""
+    rng = np.random.default_rng(7)
+    n = 700
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    s2, s1 = xtx_pallas(jnp.asarray(X), n - 60, interpret=True, blk=256)
+    Xv = X[: n - 60].astype(np.float64)
+    np.testing.assert_allclose(np.asarray(s2), Xv.T @ Xv, rtol=2e-4, atol=1e-3)
+    np.testing.assert_allclose(np.asarray(s1), Xv.sum(0), rtol=1e-4, atol=1e-4)
