@@ -19,7 +19,12 @@ python ci/lint_python.py
 ./native/build.sh || echo "WARN: native build failed; numpy fallbacks in use"
 
 if [ "$MODE" = "nightly" ]; then
-  python -m pytest tests/ -q --runslow
+  # the scale tier runs in ITS OWN process: 10+ GiB test_large allocations have
+  # been observed to crash the XLA CPU compiler (segfault in
+  # backend_compile_and_load) for LATER compiles in the same process —
+  # reproduced twice at the same spot, tests pass in isolation
+  python -m pytest tests/ -q --runslow --ignore tests/test_large.py
+  python -m pytest tests/test_large.py -q --runslow
 else
   python -m pytest tests/ -q
 fi
