@@ -247,13 +247,39 @@ def bench_logreg(ctx) -> Dict:
     )
     acc = _accuracy((dec.reshape(-1) > 0).astype(np.float32), ys)
     ceiling = PEAK_BW / (4 * d * 4)
-    return {
+    out = {
         "logreg_rows_iters_per_sec_per_chip": round(rate, 1),
         "logreg_n_iter": n_iter,
         "logreg_frac_of_ceiling": round(rate / ceiling, 3) if ctx["on_tpu"] else None,
         "logreg_train_accuracy": round(acc, 4),
         "logreg_objective": round(float(attrs.get("objective", np.nan)), 6),
     }
+
+    # streamed out-of-core variant (BASELINE config 3's mechanism): host-resident
+    # rows through the distributed L-BFGS accumulator; objective must land within
+    # a few percent of the in-core solve above (same data, fewer iters allowed)
+    try:
+        from spark_rapids_ml_tpu.ops.streaming import streaming_logreg_fit
+
+        ns = min(n, 2_000_000 if ctx["on_tpu"] else 50_000)
+        Xh = np.asarray(X[:ns])
+        yh = np.asarray(y[:ns], np.float64)
+        t0 = time.perf_counter()
+        sattrs = streaming_logreg_fit(
+            Xh, yh, None, n_classes=2, reg=0.01, l1_ratio=0.0,
+            fit_intercept=True, standardize=False, max_iter=10, tol=1e-9,
+            multinomial=False, batch_rows=max(ns // 8, 1), mesh=ctx["mesh"],
+        )
+        t_s = time.perf_counter() - t0
+        s_iter = max(int(sattrs.get("n_iter", 1)), 1)
+        out["logreg_streamed_rows_iters_per_sec_per_chip"] = round(
+            ns * s_iter / t_s / ctx["n_chips"], 1
+        )
+        out["logreg_streamed_objective"] = round(float(sattrs["objective"]), 6)
+        out["logreg_streamed_n_iter"] = s_iter
+    except Exception as e:
+        out["logreg_streamed_error"] = f"{type(e).__name__}: {str(e)[:120]}"
+    return out
 
 
 # ---------------------------------------------------------------------------- rf
