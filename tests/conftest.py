@@ -53,6 +53,36 @@ def _hermetic_reexec(config) -> None:
 import pytest
 
 
+@pytest.fixture(scope="session", autouse=True)
+def _serialize_xla_compiles():
+    """Serialize native XLA compiles process-wide for the whole test session.
+
+    This jaxlib's CPU backend_compile_and_load has been observed to SEGFAULT
+    intermittently when invoked from concurrent Python threads (reproduced twice
+    in --runslow runs: once from the barrier-mock's worker threads compiling the
+    same logreg program, once at a later unrelated compile after CrossValidator's
+    thread pools had raced compiles). A lock around the compile entry point
+    removes the race while leaving all other concurrency (thread barriers,
+    allGather exchanges, sharded execution) untouched; compiled programs are
+    cached, so the lock is uncontended after first compilation."""
+    import threading
+
+    from jax._src import compiler as _jax_compiler
+
+    real = _jax_compiler.backend_compile_and_load
+    lock = threading.Lock()
+
+    def locked(*a, **kw):
+        with lock:
+            return real(*a, **kw)
+
+    _jax_compiler.backend_compile_and_load = locked
+    try:
+        yield
+    finally:
+        _jax_compiler.backend_compile_and_load = real
+
+
 @pytest.fixture(scope="session")
 def n_devices() -> int:
     import jax
