@@ -1,16 +1,29 @@
 #!/usr/bin/env python
-"""Flagship benchmark: distributed KMeans fit throughput on the local device(s).
+"""Flagship benchmark: distributed KMeans fit throughput + per-family secondaries.
 
 Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
 
 Protocol follows the reference harness (reference python/benchmark/benchmark/base.py:
-232-285: timed fit with quality score). The metric is Lloyd-iteration row throughput —
-rows * iterations / wall-clock — on a dataset sized to the available memory, which is
-the quantity the north-star target tracks (BASELINE.json: rows/sec/chip).
+232-285: timed fit with quality score). The headline metric is Lloyd-iteration row
+throughput — rows * iterations / wall-clock — which the north-star target tracks
+(BASELINE.json: rows/sec/chip); per-family numbers land in `secondary`.
+
+Wedge-proof architecture (round-5): the axon TPU tunnel can wedge so hard that any
+jax-importing process hangs forever. All device work therefore runs in a WORKER
+subprocess that appends each benchmark unit's result to a progress JSONL file the
+moment it completes. The ORCHESTRATOR (this process, never imports jax) probes the
+device, spawns the worker, watches for stalls, kills a wedged worker, re-probes and
+respawns it with the completed+wedged units skipped, and finally assembles the line
+from whatever landed in the progress file:
+
+  * any TPU unit completed  -> platform "tpu" (+ `partial: true` if units are
+    missing) — a mid-run wedge can no longer erase captured TPU evidence;
+  * zero TPU evidence       -> CPU-fallback worker, metric explicitly suffixed
+    `_cpu_fallback` (a CPU number must never masquerade as a TPU result).
 
 `vs_baseline`: the reference publishes no machine-readable numbers (BASELINE.md), so
 the ratio is computed against a locally-recorded baseline in BENCH_BASELINE.json when
-present (first run writes it), else 1.0.
+present (first TPU run writes it), else 1.0.
 """
 
 import functools
@@ -22,80 +35,81 @@ import time
 
 import numpy as np
 
+# Benchmark units, in priority order: cheap/high-value families land before the
+# O(n*nq) kNN/ANN scans so a deadline or wedge preserves the most evidence.
+# "kmeans_headline" carries the headline metric; the rest merge into `secondary`.
+UNITS = [
+    "kmeans_headline",
+    "pca",
+    "logreg",
+    "linreg",
+    "rf",
+    "umap",
+    "dbscan",
+    "fit_e2e",
+    "knn",
+    "ann",
+    "wide256",
+]
 
-def _probe_once(timeout_s: float) -> int:
-    probe = subprocess.Popen(
-        [sys.executable, "-c", "import jax; jax.devices()"],
-        stdout=subprocess.DEVNULL,
-        stderr=subprocess.DEVNULL,
-    )
+ASSEMBLY_MARGIN_S = 12.0  # orchestrator time reserved to assemble + print
+UNIT_START_MARGIN_S = 30.0  # don't start a unit with less than this left
+
+
+def _stall_window_s() -> float:
+    """No progress-file activity for this long => worker is wedged. Scaled to the
+    budget so the detector can actually fire inside a default (240 s) run — a
+    fixed 330 s window would make the deadline kill always win and report every
+    wedge as budget exhaustion — but floored high enough that one legitimately
+    long unit (cold-cache compile + fit) isn't mistaken for a wedge."""
+    budget = float(os.environ.get("SRML_BENCH_BUDGET_S", "240"))
+    return min(330.0, max(90.0, 0.6 * budget))
+
+
+# --------------------------------------------------------------------- progress IO
+
+
+def _flush_progress(path: str, entry: dict) -> None:
+    """Append one JSON line and fsync so the orchestrator sees it immediately
+    even if this process hangs or dies right after."""
+    entry = dict(entry, ts=round(time.time(), 2))
+    with open(path, "a") as f:
+        f.write(json.dumps(entry) + "\n")
+        f.flush()
+        os.fsync(f.fileno())
+
+
+def _read_progress(path: str) -> dict:
+    """Latest entry per unit (later lines win)."""
+    state: dict = {}
     try:
-        return probe.wait(timeout=timeout_s)
-    except subprocess.TimeoutExpired:
-        probe.kill()
-        probe.wait()
-        return -1
-
-
-def _device_init_watchdog(attempts: int = 2, timeout_s: float = 90.0) -> None:
-    """The axon TPU tunnel can wedge so hard that `import jax` hangs every process.
-    Probe device init in a subprocess with retry+backoff (the tunnel can recover
-    between probes); only after all probes fail, re-exec ourselves on the CPU
-    backend so the driver still gets a benchmark line (clearly labeled)."""
-    if os.environ.get("SRML_BENCH_NO_WATCHDOG") == "1":
-        return
-    marker = "/tmp/.srml_bench_device_ok"
-    try:
-        # only trust a recent healthy probe: the tunnel can wedge minutes after a
-        # good run (observed), and a stale marker would skip the probe and let the
-        # un-watchdogged jax import hang the whole benchmark
-        if os.path.exists(marker) and time.time() - os.path.getmtime(marker) < 600:
-            return
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    e = json.loads(line)
+                except json.JSONDecodeError:
+                    continue  # torn write from a killed worker
+                state[e.get("unit", "?")] = e
     except OSError:
         pass
-    # budget note: the whole probe sequence must leave room for the CPU-fallback
-    # compute inside a ~300 s driver timeout (2 x 90 s + 10 s backoff + ~60 s run)
-    rc = -1
-    for attempt in range(attempts):
-        if attempt:
-            time.sleep(10.0 * attempt)  # linear backoff
-        rc = _probe_once(timeout_s)
-        if rc == 0:
-            break
-        print(
-            f"bench watchdog: device probe attempt {attempt + 1}/{attempts} "
-            f"failed (rc={rc})",
-            file=sys.stderr,
-        )
-    if rc == 0:
-        try:
-            open(marker, "w").close()
-        except OSError:
-            pass
-        return
-    if rc != 0:
-        env = dict(os.environ)
-        env.update(
-            JAX_PLATFORMS="cpu",
-            XLA_FLAGS=(env.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8").strip(),
-            PALLAS_AXON_POOL_IPS="",
-            SRML_BENCH_NO_WATCHDOG="1",
-        )
-        os.execve(sys.executable, [sys.executable, os.path.abspath(__file__)], env)
+    return state
 
 
-def main() -> None:
-    # total wall budget: anchored BEFORE the watchdog probes and carried through
-    # the CPU-fallback re-exec (SRML_BENCH_DEADLINE_TS), so wedged-tunnel probe
-    # time counts against the same driver timeout. Families are deadline-guarded
-    # (benchmark/chip_bench.py); unfinished ones land in `skipped`.
-    budget_s = float(os.environ.get("SRML_BENCH_BUDGET_S", "240"))
-    if "SRML_BENCH_DEADLINE_TS" in os.environ:
-        deadline_ts = float(os.environ["SRML_BENCH_DEADLINE_TS"])
-    else:
-        deadline_ts = time.time() + budget_s
-        os.environ["SRML_BENCH_DEADLINE_TS"] = str(deadline_ts)
-    _device_init_watchdog()
+# ------------------------------------------------------------------------- worker
+
+
+def _worker_main() -> None:
+    """Device-touching half: build data, run each unit, flush results incrementally.
+    Runs under the orchestrator with SRML_BENCH_ROLE=worker; may be killed at any
+    moment — every completed unit must already be on disk."""
+    progress = os.environ["SRML_BENCH_PROGRESS"]
+    skip = set(filter(None, os.environ.get("SRML_BENCH_SKIP", "").split(",")))
+    deadline_ts = float(os.environ["SRML_BENCH_DEADLINE_TS"])
+
+    _flush_progress(progress, {"unit": "boot", "status": "start"})
 
     import jax
     import jax.numpy as jnp
@@ -108,11 +122,14 @@ def main() -> None:
     except Exception:
         pass
 
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
     from spark_rapids_ml_tpu.ops.kmeans import lloyd_fit
     from spark_rapids_ml_tpu.parallel.mesh import get_mesh, shard_array
 
     platform = jax.devices()[0].platform
     on_tpu = platform == "tpu"
+    n_chips = jax.device_count()
 
     # size to platform: HBM-filling on TPU (~6 GiB f32 design matrix per chip on a
     # 16 GiB v5e, leaving headroom for the one-hot update and compiler scratch),
@@ -123,16 +140,25 @@ def main() -> None:
         n_rows, n_cols, k, iters = 100_000, 64, 8, 10
 
     # synthesize blobs ON DEVICE: host→device transfer is the enemy (and the metric
-    # tracks compute, not ingest — the reference times cuML fit after cudf ingest too).
-    # The init is k REAL ROWS of X (what k-means|| reduces to), NOT the true centers:
-    # a near-optimal init converges in ~2 Lloyd iterations and the whole-fit metric
-    # then measures per-fit constants instead of iteration throughput (this exact
-    # distortion made the round-2 headline read 101M when the steady-state rate of
-    # the same code was ~640M rows*iters/s).
-    from jax.sharding import NamedSharding, PartitionSpec as P
-
+    # tracks compute, not ingest — the reference times cuML fit after cudf ingest
+    # too). The init is k REAL ROWS of X (what k-means|| reduces to), NOT the true
+    # centers: a near-optimal init converges in ~2 Lloyd iterations and the
+    # whole-fit metric then measures per-fit constants instead of iteration
+    # throughput (this exact distortion made the round-2 headline read 101M when
+    # the steady-state rate of the same code was ~640M rows*iters/s).
     mesh = get_mesh()
     rowsh = NamedSharding(mesh, P("data", None))
+
+    # only units in this set read the shared headline design matrix; a respawn
+    # whose remaining units all build their own data (rf/umap/dbscan/fit_e2e/
+    # wide256) skips the ~6 GiB generation entirely — that time comes straight
+    # out of the wedge-recovery budget
+    NEED_X = {"kmeans_headline", "pca", "logreg", "linreg", "knn", "ann"}
+    remaining = [
+        u for u in UNITS
+        if u not in skip and time.time() < deadline_ts - UNIT_START_MARGIN_S
+    ]
+    need_data = bool(NEED_X & set(remaining))
 
     @functools.partial(jax.jit, out_shardings=(rowsh, None))
     def make_data(key):
@@ -143,9 +169,23 @@ def main() -> None:
         init = X[:k] * 1.0
         return X, init
 
-    Xd, init = make_data(jax.random.PRNGKey(0))
-    Xd.block_until_ready()
-    w = shard_array(np.ones((n_rows,), dtype=np.float32), mesh)
+    if need_data:
+        Xd, init = make_data(jax.random.PRNGKey(0))
+        Xd.block_until_ready()
+        w = shard_array(np.ones((n_rows,), dtype=np.float32), mesh)
+    else:
+        Xd = init = w = None
+
+    _flush_progress(
+        progress,
+        {
+            "unit": "boot",
+            "status": "done",
+            "platform": platform,
+            "n_chips": n_chips,
+            "result": {"n_rows": n_rows, "n_cols": n_cols},
+        },
+    )
 
     def _sync(*arrays):
         """Force completion by pulling the values to host. Under the axon remote
@@ -165,7 +205,6 @@ def main() -> None:
             ts.append(time.perf_counter() - t0)
         return float(np.median(ts)), out
 
-    n_chips = jax.device_count()
     peak_bw = 819e9  # v5e HBM ~819 GB/s per chip
 
     def _kmeans_rates(X_, w_, init_, n_, d_):
@@ -212,154 +251,404 @@ def main() -> None:
             "whole_frac": whole / iter_ceiling if on_tpu else None,
         }
 
-    hr = _kmeans_rates(Xd, w, init, n_rows, n_cols)
-    fit_time, inertia, n_iter = hr["t_full"], hr["inertia"], hr["n_iter"]
-    value = hr["whole"]
-    marginal_rate_chip = hr["marginal"]
-    roofline_frac = hr["roofline_frac"]
+    def unit_kmeans_headline():
+        hr = _kmeans_rates(Xd, w, init, n_rows, n_cols)
+        fit_time, inertia, n_iter = hr["t_full"], hr["inertia"], hr["n_iter"]
+        value = hr["whole"]
 
-    # estimated MFU: one Lloyd iteration is ~4*n*d*k matmul FLOPs (2ndk distance
-    # cross-term + 2nkd one-hot update); peak per chip assumes v5e f32 on MXU
-    flops = 4.0 * n_rows * n_cols * k * n_iter
-    peak_f32 = 98e12  # v5e ~197 TFLOP/s bf16 -> ~98 TFLOP/s f32-equivalent
-    est_mfu = flops / fit_time / n_chips / peak_f32 if on_tpu else None
+        # estimated MFU: one Lloyd iteration is ~4*n*d*k matmul FLOPs (2ndk
+        # distance cross-term + 2nkd one-hot update); peak per chip assumes v5e
+        # f32 on MXU
+        flops = 4.0 * n_rows * n_cols * k * n_iter
+        peak_f32 = 98e12  # v5e ~197 TFLOP/s bf16 -> ~98 TFLOP/s f32-equivalent
+        est_mfu = flops / fit_time / n_chips / peak_f32 if on_tpu else None
 
-    # profiler trace AFTER the timed region (trace capture inflates the timed run)
-    from spark_rapids_ml_tpu.profiling import trace as xplane_trace
+        # profiler trace AFTER the timed region (trace capture inflates the run)
+        from spark_rapids_ml_tpu.profiling import trace as xplane_trace
 
-    trace_dir = "/tmp/srml_bench_xplane" if on_tpu else None
-    if trace_dir:
-        with xplane_trace(trace_dir):
-            _sync(lloyd_fit(Xd, w, init, 0.0, iters)[0])
+        trace_dir = "/tmp/srml_bench_xplane" if on_tpu else None
+        if trace_dir:
+            with xplane_trace(trace_dir):
+                _sync(lloyd_fit(Xd, w, init, 0.0, iters)[0])
 
-    # secondary metric: the fast-math variant (assignment distances at MXU bf16,
-    # model attributes still parity precision — config key fast_math)
-    fast_fit = functools.partial(lloyd_fit, fast_math=True)
-    _sync(fast_fit(Xd, w, init, 0.0, iters)[0])
-    fast_time, (_, _, n_iter_f) = _timed(lambda: fast_fit(Xd, w, init, 0.0, iters))
-    fast_rows_per_sec_chip = n_rows * int(n_iter_f) / fast_time / n_chips
+        # secondary metric: the fast-math variant (assignment distances at MXU
+        # bf16, model attributes still parity precision — config key fast_math)
+        fast_fit = functools.partial(lloyd_fit, fast_math=True)
+        _sync(fast_fit(Xd, w, init, 0.0, iters)[0])
+        fast_time, (_, _, n_iter_f) = _timed(lambda: fast_fit(Xd, w, init, 0.0, iters))
+        fast_rate = n_rows * int(n_iter_f) / fast_time / n_chips
 
-    # secondary metrics (TPU only): the fused pallas Lloyd variants at 6-pass
-    # parity precision — weighted (measured slower than XLA at this small-k shape,
-    # see ops/pallas_kmeans.py header) and masked/no-weight-stream (the (blk,1)-
-    # operand elimination that took the Gram kernel 3x; candidate to displace the
-    # XLA headline path). Each carries a live parity check (same n_iter, inertia
-    # within fp32 tolerance) and is exception-guarded so a Mosaic issue on new
-    # hardware can never kill the benchmark line.
-    def _pallas_variant(label, **variant_kw):
-        try:
-            from spark_rapids_ml_tpu.ops.pallas_kmeans import lloyd_fit_pallas
+        # TPU-only: the fused pallas Lloyd variants at 6-pass parity precision —
+        # weighted (measured slower than XLA at this small-k shape, see
+        # ops/pallas_kmeans.py header) and masked/no-weight-stream (the (blk,1)-
+        # operand elimination that took the Gram kernel 3x; candidate to displace
+        # the XLA headline path). Each carries a live parity check (same n_iter,
+        # inertia within fp32 tolerance) and is exception-guarded so a Mosaic
+        # issue on new hardware can never kill the benchmark line.
+        def _pallas_variant(label, **variant_kw):
+            try:
+                import jax as _jax
 
-            mesh_obj = getattr(getattr(Xd, "sharding", None), "mesh", None)
-            fit = functools.partial(
-                lloyd_fit_pallas, mesh=mesh_obj,
-                precision=jax.lax.Precision.HIGHEST, **variant_kw,
-            )
-            _sync(fit(Xd, w, init, 0.0, iters)[0])  # compile warmup
-            t, (c_v, in_v, it_v) = _timed(lambda: fit(Xd, w, init, 0.0, iters))
-            it_v = int(it_v)
-            if it_v <= 1:
-                print(
-                    f"bench: {label} fit converged in <=1 iteration; "
-                    "whole-fit rate reflects per-fit constants only",
-                    file=sys.stderr,
+                from spark_rapids_ml_tpu.ops.pallas_kmeans import lloyd_fit_pallas
+
+                mesh_obj = getattr(getattr(Xd, "sharding", None), "mesh", None)
+                fit = functools.partial(
+                    lloyd_fit_pallas, mesh=mesh_obj,
+                    precision=_jax.lax.Precision.HIGHEST, **variant_kw,
                 )
-            rate = n_rows * it_v / t / n_chips
-            parity = bool(
-                it_v == n_iter
-                and abs(float(in_v) - float(inertia)) <= 1e-4 * abs(float(inertia))
-            )
-            return rate, parity
-        except Exception as e:  # pragma: no cover
-            print(f"bench: {label} pallas lloyd unavailable: {e}", file=sys.stderr)
-            return None, None
+                _sync(fit(Xd, w, init, 0.0, iters)[0])  # compile warmup
+                t, (c_v, in_v, it_v) = _timed(lambda: fit(Xd, w, init, 0.0, iters))
+                it_v = int(it_v)
+                if it_v <= 1:
+                    print(
+                        f"bench: {label} fit converged in <=1 iteration; "
+                        "whole-fit rate reflects per-fit constants only",
+                        file=sys.stderr,
+                    )
+                rate = n_rows * it_v / t / n_chips
+                parity = bool(
+                    it_v == n_iter
+                    and abs(float(in_v) - float(inertia))
+                    <= 1e-4 * abs(float(inertia))
+                )
+                return rate, parity
+            except Exception as e:  # pragma: no cover
+                print(f"bench: {label} pallas lloyd unavailable: {e}", file=sys.stderr)
+                return None, None
 
-    fused_rows_per_sec_chip = fused_parity_ok = None
-    masked_rows_per_sec_chip = masked_parity_ok = None
-    if on_tpu:
-        fused_rows_per_sec_chip, fused_parity_ok = _pallas_variant("fused")
-        masked_rows_per_sec_chip, masked_parity_ok = _pallas_variant(
-            "masked", unit_mask=True
+        fused_rate = fused_parity = masked_rate = masked_parity = None
+        if on_tpu:
+            fused_rate, fused_parity = _pallas_variant("fused")
+            masked_rate, masked_parity = _pallas_variant("masked", unit_mask=True)
+
+        return {
+            "_value": round(value, 1),
+            "kmeans_marginal_rows_per_sec_per_chip": (
+                round(hr["marginal"], 1) if hr["marginal"] is not None else None
+            ),
+            "kmeans_n_iter": n_iter,
+            "kmeans_frac_of_ceiling": (
+                round(hr["whole_frac"], 3) if hr["whole_frac"] is not None else None
+            ),
+            "kmeans_fast_math_rows_per_sec_per_chip": round(fast_rate, 1),
+            "kmeans_fused_pallas_rows_per_sec_per_chip": (
+                round(fused_rate, 1) if fused_rate is not None else None
+            ),
+            "fused_parity_ok": fused_parity,
+            "kmeans_masked_pallas_rows_per_sec_per_chip": (
+                round(masked_rate, 1) if masked_rate is not None else None
+            ),
+            "masked_parity_ok": masked_parity,
+            "est_mfu": round(est_mfu, 4) if est_mfu is not None else None,
+            "roofline_frac": (
+                round(hr["roofline_frac"], 3)
+                if hr["roofline_frac"] is not None
+                else None
+            ),
+            "xplane_trace": trace_dir,
+            "kmeans_inertia": float(inertia),
+        }
+
+    repo_root = os.path.dirname(os.path.abspath(__file__))
+    sys.path.insert(0, repo_root)
+    from benchmark.chip_bench import FAMILIES, make_ctx
+
+    ctx = make_ctx(Xd, w, mesh, on_tpu, platform, repo_root=repo_root)
+    family_fns = dict(FAMILIES)
+
+    def unit_wide256():
+        """256-col variants of the two north-star algorithms (BASELINE targets
+        are x256): drop the 128-col matrix first — 6 GiB each, both won't fit."""
+        nonlocal ctx, Xd, w
+        out = {}
+        # drop every live reference (ctx holds one) so HBM is actually freed
+        ctx = dict(ctx, X=None, w=None)
+        Xd = w = None
+        n256, d256 = (6_000_000, 256) if on_tpu else (50_000, 64)
+        rowsh256 = NamedSharding(mesh, P("data", None))
+
+        @functools.partial(jax.jit, out_shardings=(rowsh256, None))
+        def make_wide(key):
+            k1, k2, k3 = jax.random.split(key, 3)
+            c = jax.random.normal(k1, (k, d256), jnp.float32) * 5.0
+            a = jax.random.randint(k2, (n256,), 0, k)
+            Xw_ = c[a] + jax.random.normal(k3, (n256, d256), jnp.float32)
+            return Xw_, Xw_[:k] * 1.0
+
+        X256, init256 = make_wide(jax.random.PRNGKey(1))
+        _sync(X256[:1])
+        w256 = shard_array(np.ones((n256,), np.float32), mesh)
+        wr = _kmeans_rates(X256, w256, init256, n256, d256)
+        # key names carry the REAL width: the CPU-fallback tier runs 64 cols
+        # and must not masquerade as the 256-col north-star shape
+        tag = f"kmeans_{d256}col"
+        if wr["marginal"] is not None:
+            out[f"{tag}_marginal_rows_per_sec_per_chip"] = round(wr["marginal"], 1)
+            out[f"{tag}_frac_of_ceiling"] = (
+                round(wr["roofline_frac"], 3)
+                if wr["roofline_frac"] is not None
+                else None
+            )
+        ctx256 = dict(ctx)
+        ctx256.update(X=X256, w=w256)
+        from benchmark.chip_bench import bench_pca
+
+        p256 = bench_pca(ctx256)
+        out[f"pca_{d256}col_rows_per_sec_per_chip"] = p256.get(
+            "pca_cov_rows_per_sec_per_chip"
         )
+        out[f"pca_{d256}col_roofline_frac"] = p256.get("pca_roofline_frac")
+        return out
 
-    # per-family secondaries: a number AND a quality score for every algorithm
-    # family (reference protocol base.py:232-285), deadline-guarded. PCA (the
-    # second north-star) now runs the fused pallas Gram kernel with a chained
-    # marginal-rate protocol — the old one-warm-one-timed whole pass measured
-    # mostly the ~67 ms tunnel dispatch overhead.
-    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-    from benchmark.chip_bench import make_ctx, run_families
+    def run_unit(name):
+        if name == "kmeans_headline":
+            return unit_kmeans_headline()
+        if name == "wide256":
+            return unit_wide256()
+        return family_fns[name](ctx)
 
-    ctx = make_ctx(
-        Xd, w, mesh, on_tpu, platform,
-        repo_root=os.path.dirname(os.path.abspath(__file__)),
-    )
-    family_secondary = run_families(ctx, deadline_ts=deadline_ts - 45.0)
-
-    # 256-col variants of the two north-star algorithms (BASELINE targets are
-    # x256): drop the 128-col matrix first — 6 GiB each, both won't fit
-    wide_secondary = {}
-    if time.time() < deadline_ts - 30.0:
+    for name in UNITS:
+        if name in skip:
+            continue
+        if time.time() > deadline_ts - UNIT_START_MARGIN_S:
+            _flush_progress(progress, {"unit": name, "status": "deadline_skip"})
+            continue
+        _flush_progress(progress, {"unit": name, "status": "start"})
+        t0 = time.time()
         try:
-            # drop every live reference (ctx holds one) so HBM is actually freed
-            ctx = dict(ctx, X=None, w=None)
-            del Xd, w
-            n256, d256 = (6_000_000, 256) if on_tpu else (50_000, 64)
-            rowsh256 = NamedSharding(mesh, P("data", None))
+            result = run_unit(name)
+            result[f"{name}_bench_secs"] = round(time.time() - t0, 1)
+            _flush_progress(
+                progress,
+                {
+                    "unit": name,
+                    "status": "done",
+                    "platform": platform,
+                    "result": result,
+                },
+            )
+        except Exception as e:  # never kill the remaining units
+            _flush_progress(
+                progress,
+                {
+                    "unit": name,
+                    "status": "error",
+                    "platform": platform,
+                    "error": f"{type(e).__name__}: {str(e)[:200]}",
+                },
+            )
 
-            @functools.partial(jax.jit, out_shardings=(rowsh256, None))
-            def make_wide(key):
-                k1, k2, k3 = jax.random.split(key, 3)
-                c = jax.random.normal(k1, (k, d256), jnp.float32) * 5.0
-                a = jax.random.randint(k2, (n256,), 0, k)
-                Xw_ = c[a] + jax.random.normal(k3, (n256, d256), jnp.float32)
-                return Xw_, Xw_[:k] * 1.0
 
-            X256, init256 = make_wide(jax.random.PRNGKey(1))
-            _sync(X256[:1])
-            w256 = shard_array(np.ones((n256,), np.float32), mesh)
-            wr = _kmeans_rates(X256, w256, init256, n256, d256)
-            # key names carry the REAL width: the CPU-fallback tier runs 64 cols
-            # and must not masquerade as the 256-col north-star shape
-            tag = f"kmeans_{d256}col"
-            if wr["marginal"] is not None:
-                wide_secondary[f"{tag}_marginal_rows_per_sec_per_chip"] = round(
-                    wr["marginal"], 1
-                )
-                wide_secondary[f"{tag}_frac_of_ceiling"] = (
-                    round(wr["roofline_frac"], 3)
-                    if wr["roofline_frac"] is not None
-                    else None
-                )
-            if time.time() < deadline_ts - 20.0:
-                ctx256 = dict(ctx)
-                ctx256.update(X=X256, w=w256)
-                from benchmark.chip_bench import bench_pca
+# ------------------------------------------------------------------- orchestrator
 
-                p256 = bench_pca(ctx256)
-                wide_secondary[f"pca_{d256}col_rows_per_sec_per_chip"] = p256.get(
-                    "pca_cov_rows_per_sec_per_chip"
-                )
-                wide_secondary[f"pca_{d256}col_roofline_frac"] = p256.get(
-                    "pca_roofline_frac"
-                )
-        except Exception as e:
-            print(f"bench: 256-col tier failed: {e}", file=sys.stderr)
-            wide_secondary["wide_tier_error"] = str(e)[:200]
-    else:
-        wide_secondary["skipped_wide"] = True
 
-    baseline_path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_BASELINE.json")
-    vs_baseline = 1.0
+def _probe_once(timeout_s: float) -> int:
+    probe = subprocess.Popen(
+        [sys.executable, "-c", "import jax; jax.devices()"],
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+    )
     try:
-        # protocol 2 = whole-fit timing with a k-real-rows far init (n_iter ≈
-        # max_iter); protocol-less baselines were recorded under the old
-        # near-optimal init whose n_iter=2 made the same code read ~6x slower —
-        # comparing across protocols would report a spurious "speedup", so a
-        # mismatched baseline is reseeded instead of compared against
+        return probe.wait(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        probe.kill()
+        probe.wait()
+        return -1
+
+
+def _probe_device(deadline_ts: float, attempts: int = 2, timeout_s: float = 75.0) -> bool:
+    """The axon TPU tunnel can wedge so hard that `import jax` hangs every
+    process. Probe device init in a subprocess with retry+backoff (the tunnel can
+    recover between probes). Each probe is capped at a quarter of the remaining
+    budget so a wedged tunnel cannot eat the CPU-fallback's time."""
+    marker = "/tmp/.srml_bench_device_ok"
+    try:
+        # only trust a recent healthy probe: the tunnel can wedge minutes after a
+        # good run (observed), and a stale marker would admit a worker spawn that
+        # hangs through its whole stall window
+        if os.path.exists(marker) and time.time() - os.path.getmtime(marker) < 300:
+            return True
+    except OSError:
+        pass
+    for attempt in range(attempts):
+        if attempt:
+            time.sleep(5.0)
+        budget = deadline_ts - time.time() - ASSEMBLY_MARGIN_S
+        if budget <= 25.0:
+            return False
+        rc = _probe_once(min(timeout_s, max(20.0, 0.25 * budget)))
+        if rc == 0:
+            try:
+                open(marker, "w").close()
+            except OSError:
+                pass
+            return True
+        print(
+            f"bench orchestrator: device probe attempt {attempt + 1}/{attempts} "
+            f"failed (rc={rc})",
+            file=sys.stderr,
+        )
+    return False
+
+
+def _spawn_worker(progress_path: str, skip: set, cpu: bool) -> subprocess.Popen:
+    env = dict(os.environ)
+    env.update(
+        SRML_BENCH_ROLE="worker",
+        SRML_BENCH_PROGRESS=progress_path,
+        SRML_BENCH_SKIP=",".join(sorted(skip)),
+    )
+    if cpu:
+        env.update(
+            JAX_PLATFORMS="cpu",
+            XLA_FLAGS=(
+                env.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+            ).strip(),
+            PALLAS_AXON_POOL_IPS="",
+        )
+    # worker stdout -> our stderr: diagnostics stay visible, the single JSON
+    # line on OUR stdout stays clean. fileno() can RAISE on swapped-in streams
+    # (pytest CaptureIO, StringIO) even though the attribute exists.
+    try:
+        err_fd = sys.stderr.fileno()
+    except Exception:
+        err_fd = subprocess.DEVNULL
+    return subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__)],
+        env=env,
+        stdout=err_fd,
+        stderr=None,
+    )
+
+
+def _mark_inflight_killed(progress_path: str, reason: str) -> None:
+    state = _read_progress(progress_path)
+    for name, e in state.items():
+        if e.get("status") == "start" and name != "boot":
+            _flush_progress(
+                progress_path, {"unit": name, "status": "killed", "reason": reason}
+            )
+
+
+def _monitor_worker(child: subprocess.Popen, progress_path: str, deadline_ts: float) -> str:
+    """Wait for the worker; kill it on deadline or stall. Returns how it ended:
+    'exit' | 'crash' | 'deadline_kill' | 'stall_kill'. On a kill or crash, the
+    in-flight unit gets a 'killed' progress entry recording the reason (a
+    deadline kill is budget exhaustion, not tunnel evidence — assembly reports
+    the two differently; a crash, e.g. an XLA compile segfault, is respawnable)."""
+    stall_s = _stall_window_s()
+
+    def _last_activity() -> float:
+        try:
+            return os.path.getmtime(progress_path)
+        except OSError:
+            return time.time()
+
+    def _kill(reason: str) -> str:
+        child.kill()
+        child.wait()
+        _mark_inflight_killed(progress_path, reason)
+        return reason
+
+    while True:
+        if child.poll() is not None:
+            if child.returncode != 0:
+                _mark_inflight_killed(progress_path, "crash")
+                return "crash"
+            return "exit"
+        now = time.time()
+        if now > deadline_ts - ASSEMBLY_MARGIN_S:
+            return _kill("deadline_kill")
+        if now - _last_activity() > stall_s:
+            return _kill("stall_kill")
+        time.sleep(2.0)
+
+
+def _assemble(progress_path: str, budget_s: float, baseline_dir: str = None) -> dict:
+    """Build the one-line result from whatever the workers flushed. Baseline
+    read/seed IO only happens when `baseline_dir` is given (the real orchestrator
+    passes the repo root; unit tests call with None so a synthetic progress file
+    can never poison the repo's recorded baseline)."""
+    state = _read_progress(progress_path)
+    boot = state.pop("boot", {})
+    secondary: dict = {}
+    headline_value = None
+    headline_platform = None
+    unit_platform: dict = {}  # unit -> platform it was MEASURED on (done only)
+    wedged, skipped, error_units, crashed = [], [], [], []
+    for name in UNITS:
+        e = state.get(name)
+        if e is None:
+            skipped.append(name)
+            continue
+        st = e.get("status")
+        if st == "done":
+            unit_platform[name] = e.get("platform")
+            result = dict(e.get("result", {}))
+            if name == "kmeans_headline":
+                headline_value = result.pop("_value", None)
+                headline_platform = e.get("platform")
+            secondary.update(result)
+        elif st == "error":
+            error_units.append(name)
+            secondary[f"{name}_error"] = e.get("error")
+        elif st == "deadline_skip":
+            skipped.append(name)
+        elif st == "killed" and e.get("reason") == "deadline_kill":
+            skipped.append(name)  # ran out of budget mid-unit, not a wedge
+        elif st == "killed" and e.get("reason") == "crash":
+            crashed.append(name)  # worker died (e.g. XLA segfault) — not tunnel
+        else:  # start with no terminal entry, or a stall kill: tunnel wedge
+            wedged.append(name)
+
+    metric = "kmeans_lloyd_rows_per_sec_per_chip"
+    unit_name = "rows*iters/sec/chip"
+    _family_of = {
+        "pca_cov_rows_per_sec_per_chip": "pca",
+        "logreg_rows_iters_per_sec_per_chip": "logreg",
+        "linreg_rows_per_sec_per_chip": "linreg",
+        "rf_rows_trees_per_sec_per_chip": "rf",
+    }
+    if headline_value is None:
+        # headline unit never completed: promote the first captured family
+        # number so the line still carries a real measurement (clearly named)
+        for key, unit_n in (
+            ("pca_cov_rows_per_sec_per_chip", "rows/sec/chip"),
+            ("logreg_rows_iters_per_sec_per_chip", "rows*iters/sec/chip"),
+            ("linreg_rows_per_sec_per_chip", "rows/sec/chip"),
+            ("rf_rows_trees_per_sec_per_chip", "rows*trees/sec/chip"),
+        ):
+            if secondary.get(key) is not None:
+                metric, unit_name = key, unit_n
+                headline_value = secondary[key]
+                headline_platform = unit_platform.get(_family_of[key])
+                secondary["headline_fallback"] = True
+                break
+    # the metric suffix follows the platform the HEADLINE VALUE was measured on
+    # (a TPU-attributed error entry or mixed-platform run must never let a
+    # CPU-measured number ship under an unsuffixed TPU metric name)
+    platform = headline_platform or boot.get("platform") or "none"
+    if platform != "tpu":
+        metric += f"_{platform}_fallback"
+    measured_platforms = sorted(set(unit_platform.values()))
+    if len(measured_platforms) > 1:
+        secondary["platforms_by_unit"] = unit_platform
+
+    # vs_baseline (protocol 2 = whole-fit timing with a k-real-rows far init;
+    # protocol-less baselines were recorded under the old near-optimal init whose
+    # n_iter=2 made the same code read ~6x slower — comparing across protocols
+    # would report a spurious "speedup", so a mismatched baseline is reseeded)
+    vs_baseline = 1.0
+    baseline_path = (
+        os.path.join(baseline_dir, "BENCH_BASELINE.json") if baseline_dir else None
+    )
+    is_kmeans_headline = metric.startswith("kmeans_lloyd_rows_per_sec_per_chip")
+    try:
         protocol = 2
         base = None
-        if os.path.exists(baseline_path):
+        if baseline_path is None:
+            pass
+        elif os.path.exists(baseline_path):
             with open(baseline_path) as f:
                 base = json.load(f)
             if base.get("protocol") != protocol:
@@ -369,18 +658,24 @@ def main() -> None:
                     file=sys.stderr,
                 )
                 base = None
-        if base is not None:
+        if base is not None and is_kmeans_headline and headline_value:
             if base.get("platform") == platform and base.get("value", 0) > 0:
-                vs_baseline = value / base["value"]
-        elif on_tpu:
+                vs_baseline = headline_value / base["value"]
+        elif (
+            baseline_path is not None
+            and base is None
+            and platform == "tpu"
+            and is_kmeans_headline
+            and headline_value
+        ):
             # only a real-TPU run may seed the local baseline; a transient
             # CPU-fallback run must not poison it
             with open(baseline_path, "w") as f:
                 json.dump(
                     {
                         "platform": platform,
-                        "value": value,
-                        "unit": "rows*iters/sec/chip",
+                        "value": headline_value,
+                        "unit": unit_name,
                         "protocol": protocol,
                     },
                     f,
@@ -388,64 +683,135 @@ def main() -> None:
     except OSError:
         pass
 
-    # a non-TPU run (watchdog fallback) is labeled in the metric name itself so the
-    # recorded number can never masquerade as a TPU result
-    metric = "kmeans_lloyd_rows_per_sec_per_chip"
-    if not on_tpu:
-        metric += f"_{platform}_fallback"
-    # whole-fit ceiling: the marginal two-X-read roofline applied to n_iter
-    # iterations (per-fit constants excluded — which is why whole-fit frac < the
-    # marginal roofline_frac)
-    iter_ceiling = peak_bw / (2 * n_cols * 4 + 2 * k * 4)
-    secondary = {
-        "kmeans_marginal_rows_per_sec_per_chip": (
-            round(marginal_rate_chip, 1) if marginal_rate_chip is not None else None
-        ),
-        "kmeans_n_iter": n_iter,
-        "kmeans_frac_of_ceiling": (
-            round(value / iter_ceiling, 3) if on_tpu else None
-        ),
-        "kmeans_fast_math_rows_per_sec_per_chip": round(fast_rows_per_sec_chip, 1),
-        "kmeans_fused_pallas_rows_per_sec_per_chip": (
-            round(fused_rows_per_sec_chip, 1)
-            if fused_rows_per_sec_chip is not None
-            else None
-        ),
-        "fused_parity_ok": fused_parity_ok,
-        "kmeans_masked_pallas_rows_per_sec_per_chip": (
-            round(masked_rows_per_sec_chip, 1)
-            if masked_rows_per_sec_chip is not None
-            else None
-        ),
-        "masked_parity_ok": masked_parity_ok,
-        "est_mfu": round(est_mfu, 4) if est_mfu is not None else None,
-        "roofline_frac": (
-            round(roofline_frac, 3) if roofline_frac is not None else None
-        ),
-        "xplane_trace": trace_dir,
-        "platform": platform,
-        "n_rows": n_rows,
-        "n_cols": n_cols,
-        "kmeans_inertia": float(inertia),
-        "bench_budget_s": budget_s,
-    }
-    secondary.update(family_secondary)
-    secondary.update(wide_secondary)
-    line = {
+    secondary["platform"] = platform
+    secondary["bench_budget_s"] = budget_s
+    if boot.get("result"):
+        secondary.update(
+            {f"headline_{k}": v for k, v in boot["result"].items()}
+        )
+    done_units = [n for n in UNITS if state.get(n, {}).get("status") == "done"]
+    partial = "tpu" in measured_platforms and len(done_units) < len(UNITS)
+    if partial:
+        secondary["partial"] = True
+    if wedged:
+        secondary["tunnel_wedged_units"] = wedged
+    if skipped:
+        secondary["skipped"] = skipped
+    if error_units:
+        secondary["error_units"] = error_units
+    if crashed:
+        secondary["crashed_units"] = crashed
+    return {
         "metric": metric,
-        "value": round(value, 1),
-        "unit": "rows*iters/sec/chip",
+        "value": headline_value if headline_value is not None else 0.0,
+        "unit": unit_name,
         "vs_baseline": round(vs_baseline, 4),
         "secondary": secondary,
     }
+
+
+def main() -> None:
+    if os.environ.get("SRML_BENCH_ROLE") == "worker":
+        _worker_main()
+        return
+
+    # total wall budget: anchored at orchestrator start; every probe, worker run
+    # and respawn counts against the same driver timeout. Units are
+    # deadline-guarded in the worker; unfinished ones land in `skipped`.
+    budget_s = float(os.environ.get("SRML_BENCH_BUDGET_S", "240"))
+    if "SRML_BENCH_DEADLINE_TS" in os.environ:
+        deadline_ts = float(os.environ["SRML_BENCH_DEADLINE_TS"])
+    else:
+        deadline_ts = time.time() + budget_s
+        os.environ["SRML_BENCH_DEADLINE_TS"] = str(deadline_ts)
+
+    progress_path = os.environ.setdefault(
+        "SRML_BENCH_PROGRESS", f"/tmp/srml_bench_progress_{os.getpid()}.jsonl"
+    )
+    # fresh run: a stale progress file would masquerade as this run's evidence
+    try:
+        if os.path.exists(progress_path):
+            os.remove(progress_path)
+    except OSError:
+        pass
+
+    def _done_and_wedged():
+        state = _read_progress(progress_path)
+        done = {
+            n
+            for n in UNITS
+            if state.get(n, {}).get("status") in ("done", "error", "deadline_skip")
+        }
+        wedged = {
+            n
+            for n in UNITS
+            if state.get(n, {}).get("status") in ("start", "killed")
+        }
+        return done, wedged
+
+    # TPU attempt loop: spawn, monitor, on wedge re-probe + respawn with the
+    # completed AND wedged units excluded (a unit that wedged once gets no
+    # second chance — it would likely wedge again and burn the budget)
+    tpu_attempts = 0
+    skip: set = set()
+    while time.time() < deadline_ts - ASSEMBLY_MARGIN_S - 30.0 and tpu_attempts < 3:
+        done, wedged = _done_and_wedged()
+        skip = done | wedged
+        if len(skip) >= len(UNITS):
+            break
+        if not _probe_device(deadline_ts):
+            break
+        tpu_attempts += 1
+        child = _spawn_worker(progress_path, skip, cpu=False)
+        ended = _monitor_worker(child, progress_path, deadline_ts)
+        print(f"bench orchestrator: worker attempt {tpu_attempts} ended: {ended}",
+              file=sys.stderr)
+        if ended in ("exit", "deadline_kill"):
+            break
+        # 'stall_kill' (tunnel wedged mid-run) and 'crash' (e.g. XLA compile
+        # segfault) both loop: re-probe, respawn with done+wedged units skipped
+
+    state = _read_progress(progress_path)
+    have_tpu = any(
+        state.get(n, {}).get("platform") == "tpu"
+        and state.get(n, {}).get("status") == "done"
+        for n in UNITS
+    )
+    # a box with no TPU at all boots the first worker straight onto CPU — that is
+    # a complete CPU run, not a wedged tunnel; no fallback respawn, no tunnel flag
+    booted_cpu = state.get("boot", {}).get("platform") == "cpu"
+    tunnel_down = not have_tpu and not booted_cpu
+    if tunnel_down and time.time() < deadline_ts - ASSEMBLY_MARGIN_S - 10.0:
+        # zero TPU evidence (tunnel down from the start): CPU fallback so the
+        # driver still gets a benchmark line (clearly labeled _cpu_fallback).
+        # Skip only COMPLETED units: a unit that wedged the TPU worker is
+        # tunnel-specific and must be retried on the tunnel-free CPU backend.
+        print("bench orchestrator: no TPU evidence; running CPU fallback",
+              file=sys.stderr)
+        done, _ = _done_and_wedged()
+        child = _spawn_worker(progress_path, done, cpu=True)
+        _monitor_worker(child, progress_path, deadline_ts)
+
+    line = _assemble(
+        progress_path, budget_s,
+        baseline_dir=os.path.dirname(os.path.abspath(__file__)),
+    )
+    if tunnel_down:
+        line["secondary"]["tunnel_down"] = True
     # cumulative on-disk record (evidence survives even if a later run times out)
     try:
         results_dir = os.path.join(
             os.path.dirname(os.path.abspath(__file__)), "benchmark", "results"
         )
         os.makedirs(results_dir, exist_ok=True)
-        with open(os.path.join(results_dir, f"chip_bench_{platform}.json"), "w") as f:
+        plat = line["secondary"].get("platform", "none")
+        with open(os.path.join(results_dir, f"chip_bench_{plat}.json"), "w") as f:
             json.dump(line, f, indent=1)
+        import shutil
+
+        shutil.copyfile(
+            progress_path, os.path.join(results_dir, "bench_progress_last.jsonl")
+        )
     except OSError:
         pass
     print(json.dumps(line))

@@ -679,25 +679,6 @@ FAMILIES: List = [
 ]
 
 
-def run_families(ctx, deadline_ts: float) -> Dict:
-    """Run each family until the deadline; record errors/skips instead of dying."""
-    out: Dict = {}
-    skipped = []
-    for name, fn in FAMILIES:
-        if time.time() > deadline_ts:
-            skipped.append(name)
-            continue
-        try:
-            t0 = time.time()
-            out.update(fn(ctx))
-            out[f"{name}_bench_secs"] = round(time.time() - t0, 1)
-        except Exception as e:  # never kill the bench line
-            out[f"{name}_error"] = f"{type(e).__name__}: {str(e)[:200]}"
-    if skipped:
-        out["skipped"] = skipped
-    return out
-
-
 def make_ctx(X, w, mesh, on_tpu: bool, platform: str, repo_root: str) -> Dict:
     """Shared context; X/w are the headline design matrix reused by the dense
     families (PCA/LinReg/LogReg/kNN/ANN slices)."""

@@ -1,0 +1,234 @@
+"""Wedge-proof bench orchestrator: assembly of the one-line result from the
+progress JSONL must preserve partial TPU evidence (round-4 verdict: a tunnel
+wedge mid-run degraded the whole line to a CPU number — never again).
+
+These tests drive the pure-Python half (no jax import): `_read_progress`,
+`_assemble`, `_monitor_worker`'s kill bookkeeping, and the worker-skip logic.
+Reference role: the bench runner protocol in the reference harness
+(python/benchmark/benchmark/base.py:232-285) times every family; our orchestrator
+additionally guarantees the capture survives a mid-run device hang.
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def bench():
+    spec = importlib.util.spec_from_file_location("bench", os.path.join(REPO, "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _write(path, entries):
+    with open(path, "w") as f:
+        for e in entries:
+            f.write(json.dumps(e) + "\n")
+
+
+def test_read_progress_last_entry_wins_and_skips_torn_lines(bench, tmp_path):
+    p = tmp_path / "prog.jsonl"
+    with open(p, "w") as f:
+        f.write(json.dumps({"unit": "pca", "status": "start"}) + "\n")
+        f.write(json.dumps({"unit": "pca", "status": "done", "result": {"a": 1}}) + "\n")
+        f.write('{"unit": "logreg", "status": "do')  # torn write from a kill
+    state = bench._read_progress(str(p))
+    assert state["pca"]["status"] == "done"
+    assert "logreg" not in state
+
+
+def test_assemble_full_tpu_run(bench, tmp_path):
+    p = tmp_path / "prog.jsonl"
+    entries = [
+        {"unit": "boot", "status": "done", "platform": "tpu",
+         "result": {"n_rows": 100, "n_cols": 8}},
+    ]
+    for u in bench.UNITS:
+        r = {"_value": 123.0} if u == "kmeans_headline" else {f"{u}_metric": 1.0}
+        entries.append({"unit": u, "status": "done", "platform": "tpu", "result": r})
+    _write(p, entries)
+    line = bench._assemble(str(p), 240.0)
+    assert line["metric"] == "kmeans_lloyd_rows_per_sec_per_chip"
+    assert line["value"] == 123.0
+    s = line["secondary"]
+    assert s["platform"] == "tpu"
+    assert "partial" not in s and "skipped" not in s and "tunnel_wedged_units" not in s
+
+
+def test_assemble_partial_tpu_wedge_preserves_evidence(bench, tmp_path):
+    """THE round-4 failure mode: wedge after 3 TPU units. The line must stay
+    platform=tpu + partial=true with the captured numbers — not a CPU line."""
+    p = tmp_path / "prog.jsonl"
+    _write(p, [
+        {"unit": "boot", "status": "done", "platform": "tpu", "result": {}},
+        {"unit": "kmeans_headline", "status": "done", "platform": "tpu",
+         "result": {"_value": 999.0, "kmeans_n_iter": 10}},
+        {"unit": "pca", "status": "done", "platform": "tpu",
+         "result": {"pca_cov_rows_per_sec_per_chip": 7.0}},
+        {"unit": "logreg", "status": "start"},
+        {"unit": "logreg", "status": "killed", "reason": "stall_kill"},
+    ])
+    line = bench._assemble(str(p), 240.0)
+    assert line["metric"] == "kmeans_lloyd_rows_per_sec_per_chip"  # no _fallback
+    assert line["value"] == 999.0
+    s = line["secondary"]
+    assert s["platform"] == "tpu"
+    assert s["partial"] is True
+    assert s["tunnel_wedged_units"] == ["logreg"]
+    assert s["pca_cov_rows_per_sec_per_chip"] == 7.0
+    # everything never started is reported skipped
+    assert "rf" in s["skipped"] and "ann" in s["skipped"]
+
+
+def test_assemble_headline_missing_promotes_family_metric(bench, tmp_path):
+    p = tmp_path / "prog.jsonl"
+    _write(p, [
+        {"unit": "kmeans_headline", "status": "start"},
+        {"unit": "kmeans_headline", "status": "killed", "reason": "stall_kill"},
+        {"unit": "pca", "status": "done", "platform": "tpu",
+         "result": {"pca_cov_rows_per_sec_per_chip": 55.5}},
+    ])
+    line = bench._assemble(str(p), 240.0)
+    assert line["metric"] == "pca_cov_rows_per_sec_per_chip"
+    assert line["value"] == 55.5
+    assert line["secondary"]["headline_fallback"] is True
+    assert line["secondary"]["platform"] == "tpu"
+
+
+def test_assemble_deadline_kill_is_skip_not_wedge(bench, tmp_path):
+    p = tmp_path / "prog.jsonl"
+    _write(p, [
+        {"unit": "kmeans_headline", "status": "done", "platform": "cpu",
+         "result": {"_value": 5.0}},
+        {"unit": "pca", "status": "start"},
+        {"unit": "pca", "status": "killed", "reason": "deadline_kill"},
+    ])
+    line = bench._assemble(str(p), 60.0)
+    s = line["secondary"]
+    assert "pca" in s["skipped"]
+    assert "tunnel_wedged_units" not in s
+    # CPU platform is named in the metric itself
+    assert line["metric"].endswith("_cpu_fallback")
+
+
+def test_assemble_empty_progress_yields_labeled_zero_line(bench, tmp_path):
+    p = tmp_path / "prog.jsonl"
+    _write(p, [])
+    line = bench._assemble(str(p), 240.0)
+    assert line["value"] == 0.0
+    assert line["metric"].endswith("_none_fallback")
+    assert set(line["secondary"]["skipped"]) == set(bench.UNITS)
+
+
+def test_assemble_error_units_recorded_without_killing_line(bench, tmp_path):
+    p = tmp_path / "prog.jsonl"
+    _write(p, [
+        {"unit": "kmeans_headline", "status": "done", "platform": "tpu",
+         "result": {"_value": 10.0}},
+        {"unit": "umap", "status": "error", "platform": "tpu",
+         "error": "ValueError: boom"},
+    ])
+    line = bench._assemble(str(p), 240.0)
+    assert line["value"] == 10.0
+    assert line["secondary"]["umap_error"] == "ValueError: boom"
+
+
+def test_monitor_worker_stall_kill_marks_inflight_unit(bench, tmp_path):
+    """A child that writes a start entry then hangs must be killed after the
+    stall window and its in-flight unit marked 'killed' with the stall reason."""
+    p = tmp_path / "prog.jsonl"
+    _write(p, [{"unit": "rf", "status": "start"}])
+    # age the file so the stall window is already expired
+    old = time.time() - bench._stall_window_s() - 5
+    os.utime(p, (old, old))
+    child = subprocess.Popen([sys.executable, "-c", "import time; time.sleep(600)"])
+    try:
+        ended = bench._monitor_worker(child, str(p), deadline_ts=time.time() + 3600)
+    finally:
+        if child.poll() is None:
+            child.kill()
+            child.wait()
+    assert ended == "stall_kill"
+    state = bench._read_progress(str(p))
+    assert state["rf"]["status"] == "killed"
+    assert state["rf"]["reason"] == "stall_kill"
+
+
+def test_assemble_mixed_platform_suffix_follows_headline_value(bench, tmp_path):
+    """A TPU-attributed *error* entry must not suppress the _cpu_fallback suffix
+    when the promoted headline number was actually measured on CPU."""
+    p = tmp_path / "prog.jsonl"
+    _write(p, [
+        {"unit": "kmeans_headline", "status": "error", "platform": "tpu",
+         "error": "RuntimeError: tunnel reset"},
+        {"unit": "pca", "status": "done", "platform": "cpu",
+         "result": {"pca_cov_rows_per_sec_per_chip": 3.0}},
+    ])
+    line = bench._assemble(str(p), 240.0)
+    assert line["metric"] == "pca_cov_rows_per_sec_per_chip_cpu_fallback"
+    assert line["secondary"]["platform"] == "cpu"
+    assert line["secondary"]["error_units"] == ["kmeans_headline"]
+
+
+def test_assemble_mixed_platform_run_records_per_unit_platforms(bench, tmp_path):
+    p = tmp_path / "prog.jsonl"
+    _write(p, [
+        {"unit": "kmeans_headline", "status": "done", "platform": "tpu",
+         "result": {"_value": 42.0}},
+        {"unit": "pca", "status": "done", "platform": "cpu",
+         "result": {"pca_cov_rows_per_sec_per_chip": 3.0}},
+    ])
+    line = bench._assemble(str(p), 240.0)
+    assert line["metric"] == "kmeans_lloyd_rows_per_sec_per_chip"  # tpu headline
+    assert line["secondary"]["platforms_by_unit"] == {
+        "kmeans_headline": "tpu", "pca": "cpu"
+    }
+
+
+def test_assemble_crash_is_not_a_tunnel_wedge(bench, tmp_path):
+    """An XLA segfault (reason='crash') must land in crashed_units, not
+    tunnel_wedged_units — a triager must not chase a nonexistent tunnel wedge."""
+    p = tmp_path / "prog.jsonl"
+    _write(p, [
+        {"unit": "kmeans_headline", "status": "done", "platform": "tpu",
+         "result": {"_value": 1.0}},
+        {"unit": "pca", "status": "start"},
+        {"unit": "pca", "status": "killed", "reason": "crash"},
+    ])
+    line = bench._assemble(str(p), 240.0)
+    s = line["secondary"]
+    assert s["crashed_units"] == ["pca"]
+    assert "tunnel_wedged_units" not in s
+
+
+def test_monitor_worker_crash_marks_inflight_and_reports_crash(bench, tmp_path):
+    p = tmp_path / "prog.jsonl"
+    _write(p, [{"unit": "logreg", "status": "start"}])
+    child = subprocess.Popen([sys.executable, "-c", "import sys; sys.exit(3)"])
+    ended = bench._monitor_worker(child, str(p), deadline_ts=time.time() + 3600)
+    assert ended == "crash"
+    state = bench._read_progress(str(p))
+    assert state["logreg"] == {
+        **state["logreg"], "status": "killed", "reason": "crash"
+    }
+
+
+def test_worker_skip_env_and_deadline_skip(bench, tmp_path, monkeypatch):
+    """The worker respects SRML_BENCH_SKIP and flushes deadline_skip markers for
+    units it has no time to start (exercised via the flush/read primitives the
+    worker uses — spawning the real worker needs a device)."""
+    p = tmp_path / "prog.jsonl"
+    bench._flush_progress(str(p), {"unit": "pca", "status": "deadline_skip"})
+    state = bench._read_progress(str(p))
+    assert state["pca"]["status"] == "deadline_skip"
+    line = bench._assemble(str(p), 1.0)
+    assert "pca" in line["secondary"]["skipped"]
