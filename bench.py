@@ -187,6 +187,15 @@ def _worker_main() -> None:
         },
     )
 
+    def _hb(tag: str) -> None:
+        """Heartbeat between a unit's sub-measurements: refreshes the progress
+        file's mtime so a HEALTHY-but-slow unit (cold compiles, several timed
+        variants in one unit) isn't stall-killed as a tunnel wedge. '_hb' is not
+        a UNITS name, so assembly ignores the entries. Only called from points
+        the device just returned from (after a sync) — a genuinely wedged
+        dispatch reaches no heartbeat, so stall detection still fires."""
+        _flush_progress(progress, {"unit": "_hb", "status": "hb", "at": tag})
+
     def _sync(*arrays):
         """Force completion by pulling the values to host. Under the axon remote
         tunnel `block_until_ready` can acknowledge dispatch before the device has
@@ -255,6 +264,7 @@ def _worker_main() -> None:
         hr = _kmeans_rates(Xd, w, init, n_rows, n_cols)
         fit_time, inertia, n_iter = hr["t_full"], hr["inertia"], hr["n_iter"]
         value = hr["whole"]
+        _hb("kmeans_rates")
 
         # estimated MFU: one Lloyd iteration is ~4*n*d*k matmul FLOPs (2ndk
         # distance cross-term + 2nkd one-hot update); peak per chip assumes v5e
@@ -270,6 +280,7 @@ def _worker_main() -> None:
         if trace_dir:
             with xplane_trace(trace_dir):
                 _sync(lloyd_fit(Xd, w, init, 0.0, iters)[0])
+        _hb("xplane_trace")
 
         # secondary metric: the fast-math variant (assignment distances at MXU
         # bf16, model attributes still parity precision — config key fast_math)
@@ -277,6 +288,7 @@ def _worker_main() -> None:
         _sync(fast_fit(Xd, w, init, 0.0, iters)[0])
         fast_time, (_, _, n_iter_f) = _timed(lambda: fast_fit(Xd, w, init, 0.0, iters))
         fast_rate = n_rows * int(n_iter_f) / fast_time / n_chips
+        _hb("fast_math")
 
         # TPU-only: the fused pallas Lloyd variants at 6-pass parity precision —
         # weighted (measured slower than XLA at this small-k shape, see
@@ -319,7 +331,9 @@ def _worker_main() -> None:
         fused_rate = fused_parity = masked_rate = masked_parity = None
         if on_tpu:
             fused_rate, fused_parity = _pallas_variant("fused")
+            _hb("pallas_fused")
             masked_rate, masked_parity = _pallas_variant("masked", unit_mask=True)
+            _hb("pallas_masked")
 
         return {
             "_value": round(value, 1),
@@ -354,6 +368,7 @@ def _worker_main() -> None:
     from benchmark.chip_bench import FAMILIES, make_ctx
 
     ctx = make_ctx(Xd, w, mesh, on_tpu, platform, repo_root=repo_root)
+    ctx["heartbeat"] = _hb  # long multi-phase families beat between phases
     family_fns = dict(FAMILIES)
 
     def unit_wide256():
@@ -379,6 +394,7 @@ def _worker_main() -> None:
         _sync(X256[:1])
         w256 = shard_array(np.ones((n256,), np.float32), mesh)
         wr = _kmeans_rates(X256, w256, init256, n256, d256)
+        _hb("wide256_kmeans")
         # key names carry the REAL width: the CPU-fallback tier runs 64 cols
         # and must not masquerade as the 256-col north-star shape
         tag = f"kmeans_{d256}col"
@@ -456,12 +472,15 @@ def _probe_once(timeout_s: float) -> int:
         return -1
 
 
+MARKER_PATH = "/tmp/.srml_bench_device_ok"
+
+
 def _probe_device(deadline_ts: float, attempts: int = 2, timeout_s: float = 75.0) -> bool:
     """The axon TPU tunnel can wedge so hard that `import jax` hangs every
     process. Probe device init in a subprocess with retry+backoff (the tunnel can
     recover between probes). Each probe is capped at a quarter of the remaining
     budget so a wedged tunnel cannot eat the CPU-fallback's time."""
-    marker = "/tmp/.srml_bench_device_ok"
+    marker = MARKER_PATH
     try:
         # only trust a recent healthy probe: the tunnel can wedge minutes after a
         # good run (observed), and a stale marker would admit a worker spawn that
@@ -769,7 +788,15 @@ def main() -> None:
         if ended in ("exit", "deadline_kill"):
             break
         # 'stall_kill' (tunnel wedged mid-run) and 'crash' (e.g. XLA compile
-        # segfault) both loop: re-probe, respawn with done+wedged units skipped
+        # segfault) both loop: re-probe, respawn with done+wedged units skipped.
+        # A stall is live evidence the tunnel is wedged NOW — drop the healthy-
+        # probe marker so the next _probe_device really probes instead of
+        # trusting a pre-wedge marker and respawning straight into the hang.
+        if ended == "stall_kill":
+            try:
+                os.remove(MARKER_PATH)
+            except OSError:
+                pass
 
     state = _read_progress(progress_path)
     have_tpu = any(

@@ -166,40 +166,96 @@ def bench_pca(ctx) -> Dict:
 
 
 def bench_linreg(ctx) -> Dict:
-    """Normal-equation fit at the headline shape; ceiling = the XLA gram's two
-    HBM reads of X (gram_and_xty streams X for XᵀWX and XᵀWy)."""
+    """Normal-equation stats pass at the headline shape. On TPU the unit-weight
+    fit runs the fused one-X-read pallas pass (XᵀX + Xᵀy + yᵀy together,
+    ops/pallas_xtwx.py::normal_eq_prefix_mask), so the ceiling is ONE HBM read
+    of X — the round-4 two-read floor was a design choice, not a law
+    (VERDICT r4 weak #6). Marginal-rate protocol (chained passes with a CSE
+    guard, like PCA) because one pass is sub-second on chip."""
     import jax
     import jax.numpy as jnp
 
-    from spark_rapids_ml_tpu.ops.linear import linreg_fit
+    from spark_rapids_ml_tpu.ops.linear import linreg_fit, solve_from_stats
+    from spark_rapids_ml_tpu.ops.pallas_xtwx import normal_eq_prefix_mask
 
-    X, w = ctx["X"], ctx["w"]
+    X, w, mesh = ctx["X"], ctx["w"], ctx["mesh"]
     n, d = X.shape
     n_chips = ctx["n_chips"]
     key = jax.random.PRNGKey(11)
     w_true = jax.random.normal(key, (d,), jnp.float32)
     y = (X @ w_true + 0.1 * jax.random.normal(key, (n,), jnp.float32)).block_until_ready()
+    out: Dict = {}
 
-    t, attrs_list = _timed(
-        lambda: jnp.asarray(
-            linreg_fit(X, y, w, 0.0, 0.0, True, False, 1, 1e-6)[0]["coefficients"]
-        ),
-        repeats=1,
-    )
-    rate = n / t / n_chips
-    attrs = linreg_fit(X, y, w, 0.0, 0.0, True, False, 1, 1e-6)[0]
+    if ctx["on_tpu"]:
+        # fused one-read stats, steady-state marginal rate
+        def mk(m):
+            @jax.jit
+            def f(X, y, w):
+                def step(c, _):
+                    A, b, xbar, ybar, ws, yty = normal_eq_prefix_mask(
+                        X, y, w, mesh=mesh,
+                        cse_guard=jnp.float32(1e-37) * c[1],
+                    )
+                    return (c[0] + A, A[0, 0]), None
+
+                res, _ = jax.lax.scan(
+                    step, (jnp.zeros((d, d), jnp.float32), jnp.float32(0)),
+                    None, length=m,
+                )
+                return res[0]
+
+            return f
+
+        f4, f1 = mk(4), mk(1)
+        t4, _ = _timed(lambda: f4(X, y, w))
+        t1, _ = _timed(lambda: f1(X, y, w))
+        marginal = max((t4 - t1) / 3, 1e-9)
+        rate = n / marginal / n_chips
+        ceiling = PEAK_BW / (d * 4)  # ONE f32 X read per chip
+        out["linreg_stats_path"] = "pallas_fused_1read"
+        # fused-vs-XLA stats parity on the live matrix
+        A_f, b_f, xbar_f, ybar_f, ws_f, yty_f = normal_eq_prefix_mask(X, y, w, mesh=mesh)
+        from spark_rapids_ml_tpu.ops.linear import linreg_sufficient_stats
+
+        A_x, b_x, _, _, _ = linreg_sufficient_stats(X, y, w)
+        rel = float(
+            np.max(np.abs(np.asarray(A_f) - np.asarray(A_x)))
+            / np.max(np.abs(np.asarray(A_x)))
+        )
+        out["linreg_stats_parity_max_rel"] = round(rel, 8)
+        out["linreg_parity_ok"] = bool(rel < 1e-4)
+        attrs = solve_from_stats(
+            A_f, b_f, xbar_f, ybar_f, ws_f,
+            reg=0.0, l1_ratio=0.0, fit_intercept=True, standardize=False,
+            max_iter=1, tol=1e-6,
+        )[0]
+    else:
+        # CPU fallback: whole-fit timing of the XLA path (pallas interpret would
+        # just measure the interpreter)
+        t, _ = _timed(
+            lambda: jnp.asarray(
+                linreg_fit(X, y, w, 0.0, 0.0, True, False, 1, 1e-6)[0]["coefficients"]
+            ),
+            repeats=1,
+        )
+        rate = n / t / n_chips
+        ceiling = None
+        attrs = linreg_fit(X, y, w, 0.0, 0.0, True, False, 1, 1e-6)[0]
+
     coef = np.asarray(attrs["coefficients"])
     # quality: R^2 on a 100k sample
     Xs = np.asarray(X[:100_000])
     ys = np.asarray(y[:100_000])
     pred = Xs @ coef + float(attrs["intercept"])
     r2 = 1.0 - float(((ys - pred) ** 2).sum() / ((ys - ys.mean()) ** 2).sum())
-    ceiling = PEAK_BW / (2 * d * 4)
-    return {
+    out.update({
         "linreg_rows_per_sec_per_chip": round(rate, 1),
-        "linreg_frac_of_ceiling": round(rate / ceiling, 3) if ctx["on_tpu"] else None,
+        "linreg_frac_of_ceiling": (
+            round(rate / ceiling, 3) if ceiling is not None else None
+        ),
         "linreg_r2": round(r2, 4),
-    }
+    })
+    return out
 
 
 # ------------------------------------------------------------------------ logreg
@@ -255,6 +311,7 @@ def bench_logreg(ctx) -> Dict:
         "logreg_objective": round(float(attrs.get("objective", np.nan)), 6),
     }
 
+    ctx.get("heartbeat", lambda tag: None)("logreg_incore")
     # streamed out-of-core variant (BASELINE config 3's mechanism): host-resident
     # rows through the distributed L-BFGS accumulator; objective must land within
     # a few percent of the in-core solve above (same data, fewer iters allowed)
@@ -349,7 +406,11 @@ def bench_rf(ctx) -> Dict:
     # n_trees/max_depth scaling sweep (the reference bench's structure,
     # bench_random_forest.py) -> benchmark/results/report.csv
     sweep = [(10, 8), (20, 8), (10, 12)] if ctx["on_tpu"] else [(5, 4), (10, 4)]
-    rows = [(nt, dp, *run(nt, dp)) for nt, dp in sweep]
+    hb = ctx.get("heartbeat", lambda tag: None)
+    rows = []
+    for nt, dp in sweep:
+        rows.append((nt, dp, *run(nt, dp)))
+        hb(f"rf_{nt}x{dp}")
     _append_report(
         ctx,
         [("rf", "n_trees/max_depth", f"{nt}/{dp}", r_, a_) for nt, dp, r_, a_ in rows],
@@ -427,9 +488,11 @@ def bench_ann(ctx) -> Dict:
     nlist = 1024 if ctx["on_tpu"] else 64
     Q = Xa[:nq]
 
+    hb = ctx.get("heartbeat", lambda tag: None)
     t_build0 = time.perf_counter()
     index = ivfflat_build(Xa, wa, nlist=nlist, max_iter=5, seed=3)
     t_build = time.perf_counter() - t_build0
+    hb("ann_build")
     centers = jnp.asarray(index["centers"])
     cells = jnp.asarray(index["cells"])
     cell_ids = jnp.asarray(index["cell_ids"])
@@ -450,6 +513,7 @@ def bench_ann(ctx) -> Dict:
         )
         recall = _recall_at(np.asarray(ids), exact_ids, 10)
         rows.append((nprobe, nq / t / ctx["n_chips"], recall))
+        hb(f"ann_nprobe{nprobe}")
         if nprobe == 32:
             out["ann_queries_per_sec_per_chip"] = round(nq / t / ctx["n_chips"], 1)
             out["ann_recall_at_10"] = round(recall, 4)
@@ -472,6 +536,7 @@ def bench_ann(ctx) -> Dict:
         out["cagra_build_rows_per_sec_per_chip"] = round(
             sub_g / t_gb / ctx["n_chips"], 1
         )
+        hb("cagra_build")
         items_j = jnp.asarray(gindex["items"])
         graph_j = jnp.asarray(gindex["graph"])
         nq_g = min(nq, 512)
@@ -630,6 +695,7 @@ def bench_fit_e2e(ctx) -> Dict:
         "fit_e2e_shape": list(ctx["e2e_shape"]),
     }
 
+    ctx.get("heartbeat", lambda tag: None)("fit_e2e_staged")
     # streamed-overlap evidence (VERDICT r3 task #3): the double-buffered
     # streamed fit's wall-clock vs the upload-everything-then-fit serial sum
     # above. overlap_ratio < 1 means the prefetch pipeline really hides host

@@ -47,11 +47,12 @@ _DEFAULTS: Dict[str, Any] = {
     #   high    = 3-pass bf16 (~2x faster on MXU, error ~2^-22 vs ~2^-24)
     # a TPU-measured accuracy/throughput tradeoff knob; tests pin highest
     "parity_precision": "highest",
-    # fused one-X-read pallas Gram kernel for the PCA covariance fit
-    # (ops/pallas_xtwx.py; the normal-equation solvers still use the XLA
-    # gram_and_xty): "auto" = on for TPU unit-weight f32 fits (measured 6x the
-    # XLA path at 12M x 128), "0" = force XLA, "1" = skip the platform check
-    # (tests — runs the kernel's interpreter off-TPU)
+    # fused one-X-read pallas Gram kernels: the PCA covariance AND the
+    # normal-equation LinReg stats (ops/pallas_xtwx.py — the label rides as a
+    # tile-aligned operand so XᵀX/Xᵀy/yᵀy come from one X read): "auto" = on for
+    # TPU unit-weight f32 fits (measured 6x the XLA path at 12M x 128), "0" =
+    # force XLA, "1" = skip the platform check (tests — runs the kernel's
+    # interpreter off-TPU)
     "pallas_xtwx": "auto",
 }
 

@@ -268,7 +268,8 @@ class LinearRegression(_LinearRegressionClass, _TpuEstimatorSupervised, _LinearR
                     )
                 else:
                     sqres = linreg_fit(
-                        inputs.features, inputs.label, inputs.row_weight, **common
+                        inputs.features, inputs.label, inputs.row_weight,
+                        mesh=inputs.mesh, unit_weight=inputs.unit_weight, **common
                     )
                 for j, i in enumerate(sq):
                     results[i] = sqres[j]

@@ -142,14 +142,31 @@ def linreg_fit(
     max_iter: int,
     tol: float,
     extra_param_sets: Optional[List[Dict[str, Any]]] = None,
+    mesh=None,
+    unit_weight: bool = False,
 ) -> List[Dict[str, Any]]:
     """Full fit: one distributed stats pass, then per-param-map host-replicated solves.
 
     `extra_param_sets` reuses the SAME sufficient statistics for every param map — the
     single-pass fitMultiple the reference implements by looping cuML fits over the
     concatenated data (regression.py:657-674); here the data pass itself is shared.
-    Returns one attribute dict per model."""
-    A, b, xbar, ybar, n = linreg_sufficient_stats(X, y, w)
+    Returns one attribute dict per model.
+
+    Unit-weight fits on TPU take the fused one-X-read pallas stats pass
+    (ops/pallas_xtwx.py::normal_eq_prefix_mask — halves the HBM traffic of the
+    XLA two-read Gram); the same `use_fused_gram` gate as the PCA covariance."""
+    from .pca import use_fused_gram
+
+    if use_fused_gram(X.shape[1], unit_weight, dtype=X.dtype):
+        from ._precision import parity_precision
+        from .pallas_xtwx import normal_eq_prefix_mask
+
+        interpret = jax.devices()[0].platform != "tpu"
+        A, b, xbar, ybar, n, _yty = normal_eq_prefix_mask(
+            X, y, w, mesh=mesh, precision=parity_precision(), interpret=interpret
+        )
+    else:
+        A, b, xbar, ybar, n = linreg_sufficient_stats(X, y, w)
     return solve_from_stats(
         A, b, xbar, ybar, n,
         reg=reg, l1_ratio=l1_ratio, fit_intercept=fit_intercept,

@@ -3,10 +3,12 @@
 # valid-row prefix, in ONE streaming read of X.
 #
 # This is the hot op of the PCA covariance fit (the TPU replacement for PCAMG.fit's
-# in-cuML covariance allreduce, reference python/src/spark_rapids_ml/feature.py:228-253).
-# The normal-equation solvers (gram_and_xty) are NOT wired to it: their XᵀWy term
-# needs the label vector in-kernel, which hits the same (blk, 1) VMEM-padding poison
-# documented below. Two measured facts (v5e, 12M x 128 f32, steady-state
+# in-cuML covariance allreduce, reference python/src/spark_rapids_ml/feature.py:228-253)
+# and — via `normal_eq_prefix_mask` — of the unit-weight normal-equation LinReg fit
+# (the XᵀWy term rides along as a tile-aligned (blk/128, 128) label operand, NOT the
+# (blk, 1) layout documented below as poison, so one X read yields XᵀX, Xᵀy, and yᵀy
+# together; reference regression.py:548-558). Two measured facts (v5e, 12M x 128 f32,
+# steady-state
 # marginal rate — single calls carry ~67 ms of tunnel dispatch+sync overhead) shape the
 # design:
 #
@@ -123,6 +125,166 @@ def xtx_pallas(
         blk if blk else _block_rows(X.shape[1], n_split),
         n_split,
     )
+
+
+def _xtxy_kernel(n_split, nv_ref, s_ref, x_ref, y_ref, s2_ref, s1_ref, xty_ref, ys_ref):
+    """One row block of the fused NORMAL-EQUATION pass: S2 += XbᵀXb,
+    s1 += colsum(Xb), xty += Xbᵀyb, ys += [Σy, Σy²] — all from one HBM read of X.
+
+    The label enters as a TILE-ALIGNED (blk/128, 128) second operand, NOT as the
+    (blk, 1) column the module header documents as poison (3x measured slowdown)
+    and NOT as a column appended to X ([X|y] would widen the X block to d+1,
+    breaking 128-lane alignment and paying a second lane-tile of VMEM+DMA per
+    row). In-kernel it is relayouted to a (1, blk) row — a 16 KiB shuffle per
+    2 MiB X block — and XᵀY is one (1,blk)x(blk,d) MXU matmul at the same
+    multipass-bf16 precision as S2. Covers `gram_and_xty`'s role for unit-weight
+    fits (the header's "unwirable" note predates this layout)."""
+    b = pl.program_id(0)
+
+    @pl.when(b == 0)
+    def _():
+        s2_ref[...] = jnp.zeros_like(s2_ref) + s_ref[0, 0]
+        s1_ref[...] = jnp.zeros_like(s1_ref)
+        xty_ref[...] = jnp.zeros_like(xty_ref)
+        ys_ref[...] = jnp.zeros_like(ys_ref)
+
+    Xb = x_ref[...]  # (B, d)
+    B = Xb.shape[0]
+    row0 = b * B
+    rows = row0 + jax.lax.broadcasted_iota(jnp.int32, (B, 1), 0)
+    # select, don't multiply: the edge block's unspecified region can be NaN
+    Xb = jnp.where(rows < nv_ref[0, 0], Xb, 0.0)
+
+    yrow = y_ref[...].reshape(1, B)  # (B/128, 128) -> one long row
+    yrows = row0 + jax.lax.broadcasted_iota(jnp.int32, (1, B), 1)
+    yrow = jnp.where(yrows < nv_ref[0, 0], yrow, 0.0)
+
+    s2_ref[...] += _dot_multipass(Xb, Xb, (((0,), (0,)), ((), ())), n_split)
+    s1_ref[...] += jnp.sum(Xb, axis=0)[None, :]
+    xty_ref[...] += _dot_multipass(yrow, Xb, (((1,), (0,)), ((), ())), n_split)
+    ys_ref[...] += jnp.concatenate(
+        [jnp.sum(yrow, keepdims=True), jnp.sum(yrow * yrow, keepdims=True)], axis=1
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("interpret", "blk", "n_split"))
+def _xtxy_jit(X, y, n_valid, cse_guard, interpret: bool, blk: int, n_split: int):
+    n, d = X.shape
+    # y rides in 128-lane tiles aligned to the X row blocks; pad to a lane
+    # multiple (an O(n) copy of the 1-D label — ~1/d of the X read)
+    lanes = 128
+    n_pad = ((n + lanes - 1) // lanes) * lanes
+    y2d = jnp.pad(y.astype(jnp.float32), (0, n_pad - n)).reshape(-1, lanes)
+    s2, s1, xty, ys = pl.pallas_call(
+        functools.partial(_xtxy_kernel, n_split),
+        grid=((n + blk - 1) // blk,),
+        in_specs=[
+            pl.BlockSpec((1, 1), lambda b: (0, 0)),
+            pl.BlockSpec((1, 1), lambda b: (0, 0)),
+            pl.BlockSpec((blk, d), lambda b: (b, 0)),
+            pl.BlockSpec((blk // lanes, lanes), lambda b: (b, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((d, d), lambda b: (0, 0)),
+            pl.BlockSpec((1, d), lambda b: (0, 0)),
+            pl.BlockSpec((1, d), lambda b: (0, 0)),
+            pl.BlockSpec((1, 2), lambda b: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((d, d), jnp.float32),
+            jax.ShapeDtypeStruct((1, d), jnp.float32),
+            jax.ShapeDtypeStruct((1, d), jnp.float32),
+            jax.ShapeDtypeStruct((1, 2), jnp.float32),
+        ],
+        interpret=interpret,
+    )(
+        jnp.asarray(n_valid, jnp.int32).reshape(1, 1),
+        jnp.asarray(cse_guard, jnp.float32).reshape(1, 1),
+        X,
+        y2d,
+    )
+    return s2, s1[0], xty[0], ys[0, 0], ys[0, 1]
+
+
+def xtxy_pallas(
+    X: jax.Array,
+    y: jax.Array,
+    n_valid,
+    precision: jax.lax.Precision = jax.lax.Precision.HIGHEST,
+    interpret: bool = False,
+    blk: int | None = None,
+    cse_guard=0.0,
+):
+    """Single-device fused (XᵀX, colsum(X), Xᵀy, Σy, Σy²) over the first
+    `n_valid` rows in ONE X read. Traceable; n_valid may be a runtime scalar."""
+    n_split = _N_SPLIT[precision]
+    b = blk if blk else _block_rows(X.shape[1], n_split)
+    b = max(128, (b // 128) * 128)  # the y operand tiles at 128 rows per lane-row
+    return _xtxy_jit(X, y, n_valid, cse_guard, interpret, b, n_split)
+
+
+def normal_eq_prefix_mask(
+    X: jax.Array,
+    y: jax.Array,
+    w: jax.Array,
+    mesh=None,
+    precision: jax.lax.Precision = jax.lax.Precision.HIGHEST,
+    interpret: bool = False,
+    cse_guard=0.0,
+):
+    """Fused normal-equation sufficient statistics for UNIT-WEIGHT data under the
+    repo's padding contract: returns (A=XᵀX, b=Xᵀy, x̄, ȳ, Σw, Σy²) — the tuple
+    `ops/linear.py::linreg_sufficient_stats` produces, plus yᵀy (for R²/objective
+    without another pass) — while reading X from HBM ONCE instead of the XLA
+    path's twice (lhs and rhs stream independently; see module header).
+
+    Same eligibility contract as `covariance_prefix_mask`: w must be a {0,1}
+    prefix mask per shard (parallel/partition.py::pad_rows places padding at the
+    global end). Per-sample weights use the XLA path; callers gate on
+    `use_fused_gram` (ops/pca.py). Reference role: the cuML normal-equation
+    Gram/XᵀY allreduce inside LinearRegressionMG.fit
+    (reference python/src/spark_rapids_ml/regression.py:548-558).
+    """
+    if mesh is not None and mesh.devices.size > 1:
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        from ..parallel.mesh import DATA_AXIS
+
+        @functools.partial(
+            shard_map,
+            mesh=mesh,
+            in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS)),
+            out_specs=(P(), P(), P(), P()),
+            check_vma=False,
+        )
+        def run(x_local, y_local, w_local):
+            nv = jnp.sum(w_local.astype(jnp.int32))
+            s2, s1, xty, ysum, yty = xtxy_pallas(
+                x_local, y_local, nv, precision=precision, interpret=interpret,
+                cse_guard=cse_guard,
+            )
+            return (
+                jax.lax.psum(s2, DATA_AXIS),
+                jax.lax.psum(s1, DATA_AXIS),
+                jax.lax.psum(xty, DATA_AXIS),
+                jax.lax.psum(
+                    jnp.stack([ysum, yty, nv.astype(jnp.float32)]), DATA_AXIS
+                ),
+            )
+
+        s2, s1, xty, packed = run(X, y, w)
+        ysum, yty, wsum = packed[0], packed[1], packed[2]
+    else:
+        nv = jnp.sum(w.astype(jnp.int32))
+        s2, s1, xty, ysum, yty = xtxy_pallas(
+            X, y, nv, precision=precision, interpret=interpret, cse_guard=cse_guard
+        )
+        wsum = nv.astype(jnp.float32)
+
+    xbar = s1 / wsum
+    ybar = ysum / wsum
+    return s2, xty, xbar, ybar, wsum, yty
 
 
 def covariance_prefix_mask(

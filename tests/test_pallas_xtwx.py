@@ -157,6 +157,115 @@ def test_pca_estimator_fused_dispatch_runs_kernel(monkeypatch):
     )
 
 
+def test_xtxy_matches_numpy_with_prefix_mask():
+    """Fused normal-equation stats: one pass must yield XᵀX, colsum, Xᵀy, Σy, Σy²
+    over the valid prefix, with the ragged region masked in BOTH operands."""
+    from spark_rapids_ml_tpu.ops.pallas_xtwx import xtxy_pallas
+
+    X = _data(n=1000, d=24)
+    rng = np.random.default_rng(5)
+    y = rng.normal(0, 3, (1000,)).astype(np.float32)
+    n_valid = 937
+    s2, s1, xty, ysum, yty = xtxy_pallas(
+        jnp.asarray(X), jnp.asarray(y), n_valid, interpret=True
+    )
+    Xv = X[:n_valid].astype(np.float64)
+    yv = y[:n_valid].astype(np.float64)
+    np.testing.assert_allclose(np.asarray(s2), Xv.T @ Xv, rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(s1), Xv.sum(0), rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(xty), Xv.T @ yv, rtol=1e-4, atol=1e-3)
+    assert float(ysum) == pytest.approx(yv.sum(), rel=1e-4)
+    assert float(yty) == pytest.approx((yv * yv).sum(), rel=1e-4)
+
+
+def test_xtxy_ragged_and_non_lane_multiple():
+    """n neither a block nor a 128-lane multiple: the padded y tile and the
+    ragged X edge block must both mask to zero."""
+    from spark_rapids_ml_tpu.ops.pallas_xtwx import xtxy_pallas
+
+    n = 777
+    X = _data(n=n, d=16)
+    y = np.random.default_rng(9).normal(size=(n,)).astype(np.float32)
+    s2, s1, xty, ysum, yty = xtxy_pallas(
+        jnp.asarray(X), jnp.asarray(y), n, interpret=True, blk=512
+    )
+    Xv, yv = X.astype(np.float64), y.astype(np.float64)
+    np.testing.assert_allclose(np.asarray(xty), Xv.T @ yv, rtol=1e-4, atol=1e-3)
+    assert float(ysum) == pytest.approx(yv.sum(), rel=1e-4)
+
+
+def test_normal_eq_matches_xla_stats_sharded(n_devices):
+    """normal_eq_prefix_mask under an 8-device mesh vs linreg_sufficient_stats:
+    the fused one-read pass must reproduce (A, b, x̄, ȳ, Σw) and add Σy²."""
+    from spark_rapids_ml_tpu.ops.linear import linreg_sufficient_stats
+    from spark_rapids_ml_tpu.ops.pallas_xtwx import normal_eq_prefix_mask
+    from spark_rapids_ml_tpu.parallel.mesh import get_mesh, shard_array
+    from spark_rapids_ml_tpu.parallel.partition import pad_rows
+
+    X = _data(n=1000, d=16)
+    y = (X @ np.arange(16, dtype=np.float32) * 0.1).astype(np.float32)
+    mesh = get_mesh(n_devices)
+    Xp, w, _ = pad_rows(X, n_devices)
+    yp = np.zeros((Xp.shape[0],), np.float32)
+    yp[: len(y)] = y
+    Xd, wd, yd = shard_array(Xp, mesh), shard_array(w, mesh), shard_array(yp, mesh)
+    A_f, b_f, xbar_f, ybar_f, n_f, yty_f = normal_eq_prefix_mask(
+        Xd, yd, wd, mesh=mesh, interpret=True
+    )
+    A_r, b_r, xbar_r, ybar_r, n_r = linreg_sufficient_stats(
+        jnp.asarray(Xp), jnp.asarray(yp), jnp.asarray(w)
+    )
+    np.testing.assert_allclose(np.asarray(A_f), np.asarray(A_r), rtol=2e-3, atol=1e-2)
+    np.testing.assert_allclose(np.asarray(b_f), np.asarray(b_r), rtol=2e-3, atol=1e-2)
+    np.testing.assert_allclose(np.asarray(xbar_f), np.asarray(xbar_r), rtol=1e-5, atol=1e-6)
+    assert float(ybar_f) == pytest.approx(float(ybar_r), rel=1e-5)
+    assert float(n_f) == pytest.approx(1000.0)
+    yv = y.astype(np.float64)
+    assert float(yty_f) == pytest.approx(float((yv * yv).sum()), rel=1e-4)
+
+
+def test_linreg_fit_fused_path_matches_xla(monkeypatch):
+    """linreg_fit with the gate forced on must dispatch normal_eq_prefix_mask and
+    produce the same coefficients/intercept as the XLA stats path."""
+    from spark_rapids_ml_tpu.ops import linear as lin
+    from spark_rapids_ml_tpu.ops import pallas_xtwx as px
+
+    rng = np.random.default_rng(11)
+    n, d = 900, 12
+    X = _data(n=n, d=d, seed=11)
+    coef_true = rng.normal(size=(d,)).astype(np.float32)
+    y = (X @ coef_true + 0.5 + 0.01 * rng.normal(size=(n,))).astype(np.float32)
+    w = np.ones((n,), np.float32)
+    args = dict(reg=0.1, l1_ratio=0.0, fit_intercept=True, standardize=True,
+                max_iter=10, tol=1e-9)
+    ref = lin.linreg_fit(
+        jnp.asarray(X), jnp.asarray(y), jnp.asarray(w), **args
+    )[0]
+
+    calls = []
+    real = px.normal_eq_prefix_mask
+
+    def spy(Xa, ya, wa, **kw):
+        calls.append(1)
+        kw["interpret"] = True
+        return real(Xa, ya, wa, **kw)
+
+    monkeypatch.setattr(px, "normal_eq_prefix_mask", spy)
+    srml_config.set("pallas_xtwx", "1")
+    try:
+        fused = lin.linreg_fit(
+            jnp.asarray(X), jnp.asarray(y), jnp.asarray(w),
+            unit_weight=True, **args
+        )[0]
+    finally:
+        srml_config.unset("pallas_xtwx")
+    assert calls, "fused normal-equation kernel was not dispatched"
+    np.testing.assert_allclose(
+        fused["coefficients"], ref["coefficients"], rtol=5e-4, atol=5e-5
+    )
+    assert fused["intercept"] == pytest.approx(ref["intercept"], rel=5e-4, abs=5e-4)
+
+
 @pytest.mark.parametrize("d", [129, 512])
 def test_xtx_boundary_widths(d):
     """Lane-padding (d=129) and the MAX_FUSED_COLS VMEM boundary (d=512) —
