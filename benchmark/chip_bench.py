@@ -218,10 +218,18 @@ def bench_linreg(ctx) -> Dict:
         from spark_rapids_ml_tpu.ops.linear import linreg_sufficient_stats
 
         A_x, b_x, _, _, _ = linreg_sufficient_stats(X, y, w)
-        rel = float(
+        # parity must cover BOTH outputs: A rides the already-validated xtx path,
+        # but b=Xᵀy is what the new label-relayout computes — a lane misorder on
+        # real hardware would corrupt b while leaving A perfect
+        rel_a = float(
             np.max(np.abs(np.asarray(A_f) - np.asarray(A_x)))
             / np.max(np.abs(np.asarray(A_x)))
         )
+        rel_b = float(
+            np.max(np.abs(np.asarray(b_f) - np.asarray(b_x)))
+            / max(np.max(np.abs(np.asarray(b_x))), 1e-30)
+        )
+        rel = max(rel_a, rel_b)
         out["linreg_stats_parity_max_rel"] = round(rel, 8)
         out["linreg_parity_ok"] = bool(rel < 1e-4)
         attrs = solve_from_stats(

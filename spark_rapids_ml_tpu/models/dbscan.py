@@ -168,6 +168,30 @@ class DBSCANModel(_DBSCANClass, _TpuModel, _DBSCANParams):
             )
             labels = sk.fit_predict(X)
             return {self.getOrDefault("predictionCol"): labels.astype(np.int64)}
+        from .. import config as _config
+
+        threshold = int(_config.get("stream_threshold_bytes"))
+        if X.nbytes > threshold:
+            # out-of-core tier: the dataset stays host-resident and the device
+            # sees (query_block, item_block) distance tiles — the reference
+            # DBSCAN instead broadcasts the whole dataset and leans on UVM
+            # (reference clustering.py:1103-1163, utils.py:184-241)
+            from ..ops.pairwise_streaming import streaming_dbscan_fit_predict
+
+            self.logger.warning(
+                "dataset ~%.0f MiB exceeds stream_threshold_bytes=%d; using the "
+                "out-of-core blocked-pairwise DBSCAN (host-resident rows).",
+                X.nbytes / 2**20,
+                threshold,
+            )
+            labels = streaming_dbscan_fit_predict(
+                X,
+                eps=self.getOrDefault("eps"),
+                min_samples=self.getOrDefault("min_samples"),
+                metric=self.getOrDefault("metric"),
+                mesh=get_mesh(self.num_workers),
+            )
+            return {self.getOrDefault("predictionCol"): labels}
         mesh = get_mesh(self.num_workers)
         Xp, valid, _ = pad_rows(X, mesh.devices.size)
         Xd = shard_array(Xp, mesh)

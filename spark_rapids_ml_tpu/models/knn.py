@@ -161,11 +161,39 @@ class NearestNeighborsModel(_NearestNeighborsClass, _TpuModel, _NNParams):
 
         items = self._model_attributes["item_features"]
         item_ids = self._model_attributes["item_ids"]
+        k = min(self.getK(), items.shape[0])
+        from .. import config as _config
+
+        threshold = int(_config.get("stream_threshold_bytes"))
+        if items.nbytes > threshold:
+            # out-of-core tier: items stay host-resident; the device scans
+            # (query_block, item_block) tiles with a running top-k merge — the
+            # reference's UVM-backed brute scan made explicit
+            # (reference knn.py:763-774, utils.py:184-241)
+            from ..ops.pairwise_streaming import streaming_exact_knn
+
+            self.logger.warning(
+                "item set ~%.0f MiB exceeds stream_threshold_bytes=%d; using the "
+                "out-of-core blocked scan (host-resident items).",
+                items.nbytes / 2**20,
+                threshold,
+            )
+            dists, gidx = streaming_exact_knn(
+                Q, np.asarray(items), k, mesh=get_mesh(self.num_workers)
+            )
+            ids = item_ids[gidx]
+            knn_df = pd.DataFrame(
+                {
+                    f"query_{id_col}": query_ids,
+                    "indices": list(ids),
+                    "distances": list(dists.astype(np.float32)),
+                }
+            )
+            return self._item_df, query_df, knn_df
         mesh = get_mesh(self.num_workers)
         Xp, valid, _ = pad_rows(items, mesh.devices.size)
         Xd = shard_array(Xp, mesh)
         vd = shard_array(valid, mesh)
-        k = min(self.getK(), items.shape[0])
         if len(Q) >= _RING_QUERY_THRESHOLD and mesh.devices.size > 1:
             # large query sets shard over the mesh too and the item shards rotate
             # around the ring (ops/knn.exact_knn_ring) — nothing global materializes

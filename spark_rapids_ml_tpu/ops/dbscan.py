@@ -145,8 +145,14 @@ def dbscan_fit_predict(
     border = (~core_h) & valid_h & (border_min < np.iinfo(np.int32).max)
     out[border] = border_min[border]
 
-    # compact labels to 0..k-1 in first-appearance order (sklearn/cuML convention),
-    # vectorized: order cluster representatives by their first row of appearance
+    return _compact_labels(out)
+
+
+def _compact_labels(out: np.ndarray) -> np.ndarray:
+    """Compact labels to 0..k-1 in first-appearance order (sklearn/cuML
+    convention), vectorized: order cluster representatives by their first row of
+    appearance. Shared by the in-core and out-of-core (pairwise_streaming) paths."""
+    n = out.shape[0]
     clustered = out >= 0
     if clustered.any():
         uniq, first_idx = np.unique(out[clustered], return_index=True)

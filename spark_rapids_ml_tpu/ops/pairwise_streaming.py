@@ -1,0 +1,396 @@
+#
+# Out-of-core blocked-pairwise tier: exact kNN and DBSCAN with the DATASET
+# HOST-RESIDENT — the broadcast-replicate leg of the UVM/SAM replacement
+# (reference utils.py:184-241 gives cuML managed memory so its brute-force
+# paths can exceed device memory; DBSCAN broadcasts the entire dataset to every
+# worker, reference clustering.py:1103-1163; exact NN-MG scans all items per
+# query batch, reference knn.py:763-774).
+#
+# TPU formulation: the device only ever sees a (query_block, item_block)
+# distance tile plus O(block) running state. Both operand sets stream from host
+# through the double-buffered `_prefetch` pipeline (ops/streaming.py) so the
+# host slice/device_put of tile i+1 overlaps the matmul of tile i:
+#   * exact kNN: running top-k merge per query block (concat + top_k on device),
+#   * DBSCAN: streamed eps-neighbor counting (core mask), then min-label
+#     propagation rounds — device computes per-tile min CORE-neighbor labels,
+#     the hook + pointer-jump contraction runs on host numpy between rounds
+#     (O(n) host work vs the O(n*d*n/blk) device pass it steers).
+#
+# Cost model (why query blocks are large): one full sweep moves
+# ceil(n_q / query_block) * n_items * d * 4 bytes host->device. DBSCAN pays one
+# sweep for the core mask + one per propagation round (typically <= ~10 with
+# pointer jumping) + one for borders. The in-core paths (ops/knn.py,
+# ops/dbscan.py) stay the fast path below stream_threshold_bytes; the model
+# layer routes (models/dbscan.py, models/knn.py).
+#
+# Distances use the same FAST-precision `_block_sq_dists` as the in-core scans,
+# so streamed-vs-incore results agree rank-for-rank away from exact ties.
+#
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from .knn import _block_sq_dists
+from .streaming import _prefetch
+
+_I32MAX = np.iinfo(np.int32).max
+
+
+def _shard_blocks(X: np.ndarray, block: int, mesh, extras=None):
+    """Mesh variant of `_device_blocks`: each item block is SHARDED over the
+    data axis (host->device traffic stays one copy of the data per sweep; the
+    per-tile merge rides ICI collectives instead), row-aligned extras shard the
+    same way. `block` must be a mesh-size multiple."""
+    from ..parallel.mesh import shard_array
+
+    n = X.shape[0]
+
+    def gen():
+        for s in range(0, n, block):
+            e = min(s + block, n)
+            xb = np.zeros((block,) + X.shape[1:], np.float32)
+            xb[: e - s] = X[s:e]
+            devs = [shard_array(xb, mesh)]
+            for a in extras or ():
+                ab = np.zeros((block,) + a.shape[1:], a.dtype)
+                ab[: e - s] = a[s:e]
+                devs.append(shard_array(ab, mesh))
+            yield (s, e - s, *devs)
+
+    return _prefetch(gen(), depth=1)
+
+
+@functools.lru_cache(maxsize=8)
+def _mk_tile_topk_mesh(mesh, block: int, k: int):
+    """Sharded-items tile merge: local top-k per shard, all_gather the candidate
+    pools over ICI, fold into the replicated running top-k — the same
+    local-then-merge shape as ops/knn.py::_knn_local_then_merge_fn."""
+    from ..parallel.mesh import DATA_AXIS
+
+    n_dev = mesh.devices.size
+    shard_rows = block // n_dev
+    k_loc = min(k, shard_rows)
+
+    @jax.jit
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(), P(DATA_AXIS, None), P(), P(), P(), P()),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    def f(qb, xb_local, nv, base, best_d, best_i):
+        rank = jax.lax.axis_index(DATA_AXIS)
+        grow = rank * shard_rows + jnp.arange(shard_rows, dtype=jnp.int32)
+        d2 = _block_sq_dists(qb, xb_local)
+        d2 = jnp.where((grow < nv)[None, :], d2, jnp.inf)
+        neg, pos = jax.lax.top_k(-d2, k_loc)
+        ids = base + grow[pos]
+        d_all = jax.lax.all_gather(-neg, DATA_AXIS, axis=1)
+        i_all = jax.lax.all_gather(ids, DATA_AXIS, axis=1)
+        cat_d = jnp.concatenate([best_d, d_all.reshape(qb.shape[0], -1)], axis=1)
+        cat_i = jnp.concatenate([best_i, i_all.reshape(qb.shape[0], -1)], axis=1)
+        neg2, pos2 = jax.lax.top_k(-cat_d, k)
+        return -neg2, jnp.take_along_axis(cat_i, pos2, axis=1)
+
+    return f
+
+
+@functools.lru_cache(maxsize=8)
+def _mk_tile_count_mesh(mesh, block: int):
+    from ..parallel.mesh import DATA_AXIS
+
+    n_dev = mesh.devices.size
+    shard_rows = block // n_dev
+
+    @jax.jit
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(), P(DATA_AXIS, None), P(), P()),
+        out_specs=P(),
+        check_vma=False,
+    )
+    def f(qb, xb_local, nv, eps2):
+        rank = jax.lax.axis_index(DATA_AXIS)
+        grow = rank * shard_rows + jnp.arange(shard_rows, dtype=jnp.int32)
+        d2 = _block_sq_dists(qb, xb_local)
+        cnt = jnp.sum((d2 <= eps2) & (grow < nv)[None, :], axis=1).astype(jnp.int32)
+        return jax.lax.psum(cnt, DATA_AXIS)
+
+    return f
+
+
+@functools.lru_cache(maxsize=8)
+def _mk_tile_minlabel_mesh(mesh, block: int):
+    from ..parallel.mesh import DATA_AXIS
+
+    n_dev = mesh.devices.size
+    shard_rows = block // n_dev
+
+    @jax.jit
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(), P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS), P(), P()),
+        out_specs=P(),
+        check_vma=False,
+    )
+    def f(qb, xb_local, labels_local, core_local, nv, eps2):
+        rank = jax.lax.axis_index(DATA_AXIS)
+        grow = rank * shard_rows + jnp.arange(shard_rows, dtype=jnp.int32)
+        d2 = _block_sq_dists(qb, xb_local)
+        neigh = (d2 <= eps2) & core_local[None, :] & (grow < nv)[None, :]
+        m = jnp.min(jnp.where(neigh, labels_local[None, :], _I32MAX), axis=1)
+        return jax.lax.pmin(m, DATA_AXIS)
+
+    return f
+
+
+def _mesh_or_none(mesh):
+    return mesh if (mesh is not None and mesh.devices.size > 1) else None
+
+
+def _round_block(block: int, mesh) -> int:
+    n_dev = mesh.devices.size
+    return max(n_dev, ((block + n_dev - 1) // n_dev) * n_dev)
+
+
+def _device_blocks(X: np.ndarray, block: int, extras=None):
+    """Yield (start, n_valid, device_block, *device_extras) with the ragged tail
+    zero-padded to `block` (ONE compiled tile shape for the whole stream).
+    `extras`: list of row-aligned host arrays uploaded alongside (labels, masks)."""
+    n = X.shape[0]
+
+    def gen():
+        for s in range(0, n, block):
+            e = min(s + block, n)
+            xb = np.zeros((block,) + X.shape[1:], np.float32)
+            xb[: e - s] = X[s:e]
+            devs = [jax.device_put(jnp.asarray(xb))]
+            for a in extras or ():
+                ab = np.zeros((block,) + a.shape[1:], a.dtype)
+                ab[: e - s] = a[s:e]
+                devs.append(jax.device_put(jnp.asarray(ab)))
+            yield (s, e - s, *devs)
+
+    return _prefetch(gen(), depth=1)
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def _tile_topk_merge(qb, xb, nv_items, base_id, best_d, best_i, k: int):
+    """Merge one (qb, xb) tile into the per-query running top-k."""
+    d2 = _block_sq_dists(qb, xb)
+    iv = jnp.arange(xb.shape[0]) < nv_items
+    d2 = jnp.where(iv[None, :], d2, jnp.inf)
+    ids = (base_id + jnp.arange(xb.shape[0], dtype=jnp.int32))[None, :]
+    cat_d = jnp.concatenate([best_d, d2], axis=1)
+    cat_i = jnp.concatenate(
+        [best_i, jnp.broadcast_to(ids, d2.shape)], axis=1
+    )
+    neg, pos = jax.lax.top_k(-cat_d, k)
+    return -neg, jnp.take_along_axis(cat_i, pos, axis=1)
+
+
+def streaming_exact_knn(
+    Q: np.ndarray,
+    X: np.ndarray,
+    k: int,
+    query_block: int = 4096,
+    item_block: int = 131072,
+    mesh=None,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Exact kNN with HOST-RESIDENT items: returns (euclidean distances, item
+    row indices), matching ops/knn.py::exact_knn_single rank-for-rank (same
+    FAST-precision distance form) at any dataset size. Device residency is one
+    query block + one item block + the (query_block, k) running state. With a
+    multi-device `mesh`, item blocks shard over the data axis (one host copy of
+    the data per sweep; the per-tile candidate merge all_gathers over ICI)."""
+    n, d = X.shape
+    k_eff = min(k, n)
+    nq = Q.shape[0]
+    mesh = _mesh_or_none(mesh)
+    if mesh is not None:
+        item_block = _round_block(item_block, mesh)
+        tile = _mk_tile_topk_mesh(mesh, item_block, k_eff)
+
+        def merge(qb, xb, nv, s, bd, bi):
+            return tile(qb, xb, jnp.int32(nv), jnp.int32(s), bd, bi)
+
+        def blocks():
+            return _shard_blocks(X, item_block, mesh)
+    else:
+        def merge(qb, xb, nv, s, bd, bi):
+            return _tile_topk_merge(qb, xb, nv, s, bd, bi, k_eff)
+
+        def blocks():
+            return _device_blocks(X, item_block)
+
+    out_d = np.empty((nq, k_eff), np.float32)
+    out_i = np.empty((nq, k_eff), np.int64)
+    for qs in range(0, nq, query_block):
+        qe = min(qs + query_block, nq)
+        qb = jnp.asarray(np.ascontiguousarray(Q[qs:qe], np.float32))
+        best_d = jnp.full((qe - qs, k_eff), jnp.inf, jnp.float32)
+        best_i = jnp.full((qe - qs, k_eff), -1, jnp.int32)
+        for s, nv, xb in blocks():
+            best_d, best_i = merge(qb, xb, nv, s, best_d, best_i)
+        out_d[qs:qe] = np.sqrt(np.asarray(best_d))
+        out_i[qs:qe] = np.asarray(best_i).astype(np.int64)
+    return out_d, out_i
+
+
+@jax.jit
+def _tile_count(qb, xb, nv_items, eps2):
+    d2 = _block_sq_dists(qb, xb)
+    iv = jnp.arange(xb.shape[0]) < nv_items
+    return jnp.sum((d2 <= eps2) & iv[None, :], axis=1).astype(jnp.int32)
+
+
+@jax.jit
+def _tile_min_core_label(qb, xb, labels_b, core_b, nv_items, eps2):
+    d2 = _block_sq_dists(qb, xb)
+    iv = jnp.arange(xb.shape[0]) < nv_items
+    neigh = (d2 <= eps2) & core_b[None, :] & iv[None, :]
+    return jnp.min(jnp.where(neigh, labels_b[None, :], _I32MAX), axis=1)
+
+
+def _streamed_min_core_labels(
+    X: np.ndarray,
+    labels: np.ndarray,
+    core: np.ndarray,
+    eps2: float,
+    query_block: int,
+    item_block: int,
+    mesh=None,
+) -> np.ndarray:
+    """One full streamed sweep: per row, min label among its CORE eps-neighbors
+    (int32 max where none) — the out-of-core analog of
+    ops/dbscan.py::_min_core_neighbor_labels."""
+    n = X.shape[0]
+    if mesh is not None:
+        tile_fn = _mk_tile_minlabel_mesh(mesh, item_block)
+
+        def tile(qb, xb, lb, cb, nv):
+            return tile_fn(qb, xb, lb, cb, jnp.int32(nv), jnp.float32(eps2))
+
+        def blocks():
+            return _shard_blocks(X, item_block, mesh, extras=[labels, core])
+    else:
+        def tile(qb, xb, lb, cb, nv):
+            return _tile_min_core_label(qb, xb, lb, cb, nv, eps2)
+
+        def blocks():
+            return _device_blocks(X, item_block, extras=[labels, core])
+
+    mins = np.full((n,), _I32MAX, np.int32)
+    for qs in range(0, n, query_block):
+        qe = min(qs + query_block, n)
+        qb = jnp.asarray(np.ascontiguousarray(X[qs:qe], np.float32))
+        acc = jnp.full((qe - qs,), _I32MAX, jnp.int32)
+        for s, nv, xb, lb, cb in blocks():
+            acc = jnp.minimum(acc, tile(qb, xb, lb, cb, nv))
+        mins[qs:qe] = np.asarray(acc)
+    return mins
+
+
+def streaming_dbscan_fit_predict(
+    X: np.ndarray,
+    eps: float,
+    min_samples: int,
+    metric: str = "euclidean",
+    max_rounds: int = 64,
+    query_block: int = 8192,
+    item_block: int = 131072,
+    mesh=None,
+) -> np.ndarray:
+    """DBSCAN with the dataset host-resident; labels match
+    ops/dbscan.py::dbscan_fit_predict (noise = -1, clusters compacted in
+    first-appearance order). The propagation loop is host-driven: each round
+    pays one streamed pairwise sweep, then the hook + two pointer-jumping
+    contractions run in numpy (exactly ops/dbscan.py::_hook_and_jump's math)."""
+    from .dbscan import _compact_labels
+
+    X = np.ascontiguousarray(np.asarray(X), dtype=np.float32)
+    n = X.shape[0]
+    if metric == "cosine":
+        norms = np.linalg.norm(X, axis=1, keepdims=True)
+        if float(norms.min()) <= 0.0:
+            raise ValueError(
+                "Cosine distance is not defined for zero-length vectors; the "
+                "input contains an all-zero feature row."
+            )
+        # one host-side normalized copy; unavoidable without it: every tile
+        # would renormalize the same rows ceil(n/query_block) times
+        X = X / np.maximum(norms, 1e-30)
+        eps2 = 2.0 * float(eps)
+    else:
+        eps2 = float(eps) * float(eps)
+
+    mesh = _mesh_or_none(mesh)
+    if mesh is not None:
+        item_block = _round_block(item_block, mesh)
+        count_fn = _mk_tile_count_mesh(mesh, item_block)
+
+        def count_tile(qb, xb, nv):
+            return count_fn(qb, xb, jnp.int32(nv), jnp.float32(eps2))
+
+        def count_blocks():
+            return _shard_blocks(X, item_block, mesh)
+    else:
+        def count_tile(qb, xb, nv):
+            return _tile_count(qb, xb, nv, eps2)
+
+        def count_blocks():
+            return _device_blocks(X, item_block)
+
+    # pass 1: streamed core mask
+    core = np.empty((n,), bool)
+    for qs in range(0, n, query_block):
+        qe = min(qs + query_block, n)
+        qb = jnp.asarray(np.ascontiguousarray(X[qs:qe], np.float32))
+        acc = jnp.zeros((qe - qs,), jnp.int32)
+        for s, nv, xb in count_blocks():
+            acc = acc + count_tile(qb, xb, nv)
+        core[qs:qe] = np.asarray(acc) >= int(min_samples)
+
+    # min-label propagation with host-side hook + pointer jumping
+    labels = np.arange(n, dtype=np.int32)
+    mins = None
+    converged = False
+    for _ in range(max_rounds):
+        mins = _streamed_min_core_labels(
+            X, labels, core, eps2, query_block, item_block, mesh=mesh
+        )
+        new = np.where(core, np.minimum(labels, mins), labels).astype(np.int32)
+        new = new[new]
+        new = new[new]
+        if np.array_equal(new, labels):
+            converged = True
+            break
+        labels = new
+
+    # border pass + compaction, shared with the in-core path. On the converged
+    # exit the last round's `mins` was computed from exactly these labels, so
+    # re-streaming the dataset (the dominant cost unit) would recompute it
+    # verbatim; only the max_rounds-exhausted path needs a fresh sweep.
+    if converged and mins is not None:
+        border_min = mins
+    else:
+        border_min = _streamed_min_core_labels(
+            X, labels, core, eps2, query_block, item_block, mesh=mesh
+        )
+    out = np.full((n,), -1, dtype=np.int64)
+    out[core] = labels[core]
+    border = (~core) & (border_min < _I32MAX)
+    out[border] = border_min[border]
+    return _compact_labels(out)
