@@ -349,33 +349,67 @@ class ApproximateNearestNeighbors(_ApproxNNClass, _TpuEstimator, _NNParams):
         return _fit
 
     def _streaming_fit(self, fd) -> Dict[str, Any]:
-        """Out-of-core IVF-Flat build: items stay host-resident; the device sees
-        only assignment batches (ops/ann_streaming.py) — the ANN leg of the
-        reference's UVM/SAM tier (utils.py:184-241). Search then pages in only
-        the probed cells (ApproximateNearestNeighborsModel.kneighbors picks the
-        streamed search when the cells exceed the stream threshold). IVF-PQ/
-        CAGRA and cosine route in-core with a warning."""
+        """Out-of-core ANN builds: items stay host-resident; the device sees
+        only assignment/encoding/search batches (ops/ann_streaming.py) — the
+        ANN leg of the reference's UVM/SAM tier (utils.py:184-241). IVF-Flat
+        streams cell assignment; IVF-PQ adds subsample codebooks + streamed
+        encoding passes; CAGRA derives its graph from streamed IVF searches.
+        Search then pages in only the probed cells for the IVF indexes
+        (ApproximateNearestNeighborsModel.kneighbors picks the streamed search
+        when the cells exceed the stream threshold). Cosine routes in-core with
+        a warning (the build would need a normalized copy of the dataset)."""
         from .. import config as _config
         from ..core.dataset import densify as _densify
-        from ..ops.ann_streaming import streaming_ivfflat_build
+        from ..ops.ann_streaming import (
+            streaming_cagra_build,
+            streaming_ivfflat_build,
+            streaming_ivfpq_build,
+        )
 
         algo = self.getOrDefault("algorithm")
-        if algo not in ("ivfflat", "ivf_flat") or self.getOrDefault("metric") == "cosine":
+        if self.getOrDefault("metric") == "cosine" or algo not in (
+            "ivfflat", "ivf_flat", "ivfpq", "ivf_pq", "cagra",
+        ):
             self.logger.warning(
-                "streamed ANN covers euclidean ivfflat only; fitting in-core "
-                "despite stream_threshold_bytes."
+                "streamed ANN covers euclidean ivfflat/ivfpq/cagra; fitting "
+                "in-core despite stream_threshold_bytes."
             )
             inputs = self._build_fit_inputs(fd)
             return self._get_tpu_fit_func(None)(inputs)
         algo_params = self.getOrDefault("algoParams") or {}
         nlist = int(_ap(algo_params, "nlist", "n_lists", default=64))
+        seed = int(algo_params.get("seed", 42))
+        batch_rows = int(_config.get("stream_batch_rows"))
         X = np.asarray(_densify(fd.features, self._float32_inputs))
+        if algo == "cagra":
+            return streaming_cagra_build(
+                X,
+                graph_degree=int(
+                    _ap(
+                        algo_params, "graph_degree",
+                        "intermediate_graph_degree", default=32,
+                    )
+                ),
+                nlist=int(_ap(algo_params, "nlist", "n_lists", default=0)),
+                seed=seed,
+                batch_rows=batch_rows,
+            )
+        if algo in ("ivfpq", "ivf_pq"):
+            return streaming_ivfpq_build(
+                X,
+                nlist=min(nlist, fd.n_rows),
+                m_subvectors=int(_ap(algo_params, "M", "pq_dim", default=4)),
+                n_bits=int(_ap(algo_params, "n_bits", "pq_bits", default=8)),
+                max_iter=20,
+                seed=seed,
+                batch_rows=batch_rows,
+            )
         return streaming_ivfflat_build(
             X,
             nlist=min(nlist, fd.n_rows),
             max_iter=20,
-            seed=int(algo_params.get("seed", 42)),
-            batch_rows=int(_config.get("stream_batch_rows")),
+            seed=seed,
+            batch_rows=batch_rows,
         )
 
     def _create_pyspark_model(self, attrs) -> "ApproximateNearestNeighborsModel":
@@ -520,13 +554,33 @@ class ApproximateNearestNeighborsModel(_ApproxNNClass, _TpuModel, _NNParams):
                 )
                 if refine_ratio > 1:
                     # exact re-rank of the ADC candidates (reference knn.py:1642-1666)
-                    dists_j, ids_j = pq_refine(
-                        jnp.asarray(Q),
-                        jnp.asarray(self._model_attributes["cells"]),
-                        flat_pos,
-                        ids_j,
-                        k=k,
-                    )
+                    from .. import config as _config
+
+                    cells_np = self._model_attributes["cells"]
+                    threshold = _config.get("stream_threshold_bytes")
+                    if threshold and getattr(cells_np, "nbytes", 0) > threshold:
+                        # out-of-core: device_put of the full cell layout would
+                        # OOM exactly when the build streamed — page in only
+                        # the candidate vectors (ops/ann_streaming.py)
+                        from ..ops.ann_streaming import streaming_pq_refine
+
+                        self.logger.info(
+                            "IVF-PQ cells ~%.0f MiB exceed stream_threshold_"
+                            "bytes; refining with host-paged candidates",
+                            cells_np.nbytes / 2**20,
+                        )
+                        dists_j, ids_j = streaming_pq_refine(
+                            np.asarray(Q), np.asarray(cells_np),
+                            np.asarray(flat_pos), np.asarray(ids_j), k=k,
+                        )
+                    else:
+                        dists_j, ids_j = pq_refine(
+                            jnp.asarray(Q),
+                            jnp.asarray(cells_np),
+                            flat_pos,
+                            ids_j,
+                            k=k,
+                        )
             else:
                 from .. import config as _config
 

@@ -34,6 +34,7 @@ def streaming_ivfflat_build(
     seed: int,
     batch_rows: int,
     sample_rows: int = 1 << 18,
+    return_assign: bool = False,
 ) -> Dict[str, np.ndarray]:
     """Build the IVF layout with the dataset host-resident: centers from an
     in-core kmeans on a strided subsample (rows are not assumed shuffled), then
@@ -69,12 +70,143 @@ def streaming_ivfflat_build(
     cells, cell_ids, cell_sizes = layout_cells(
         np.asarray(X, dtype=np.float32), assign, nlist
     )
-    return {
+    out = {
         "centers": centers,
         "cells": cells,
         "cell_ids": cell_ids,
         "cell_sizes": cell_sizes,
     }
+    if return_assign:
+        out["assign"] = assign
+    return out
+
+
+def streaming_ivfpq_build(
+    X: np.ndarray,
+    nlist: int,
+    m_subvectors: int,
+    n_bits: int,
+    max_iter: int,
+    seed: int,
+    batch_rows: int,
+    sample_rows: int = 1 << 18,
+) -> Dict[str, np.ndarray]:
+    """Out-of-core IVF-PQ build (cuVS ivf_pq role, reference knn.py:1510-1524,
+    under the managed-memory tier utils.py:184-241): coarse cells via the
+    streamed IVF-Flat build, PQ codebooks trained in-core on a strided RESIDUAL
+    subsample, then codes assigned in streamed encoding passes — the dataset
+    itself never resides on device. Same index layout as ops/knn.py::ivfpq_build
+    (codebooks (m, 2^bits, d/m), codes (nlist, max_cell, m) uint8)."""
+    from .kmeans import kmeans_fit, kmeans_predict
+
+    n, d = X.shape
+    if d % m_subvectors != 0:
+        raise ValueError(f"n features {d} not divisible by pq m={m_subvectors}")
+    if not 1 <= n_bits <= 8:
+        raise ValueError(f"n_bits must be in [1, 8] (uint8 codes), got {n_bits}")
+    sub_d = d // m_subvectors
+    n_codes = 2**n_bits
+    flat = streaming_ivfflat_build(
+        X, nlist, max_iter, seed, batch_rows, sample_rows, return_assign=True
+    )
+    coarse = np.asarray(flat["centers"])
+    assign = flat.pop("assign")
+
+    # codebooks from a residual subsample (strided — rows are not assumed
+    # shuffled); the in-core build trains on ALL residuals, so codebooks differ
+    # in detail but the recall/quality contract is preserved (tested)
+    step = max(1, n // min(n, sample_rows))
+    sub_idx = np.arange(0, n, step)
+    resid_s = (
+        np.ascontiguousarray(X[sub_idx], np.float32) - coarse[assign[sub_idx]]
+    )
+    wv = jnp.ones((len(sub_idx),), jnp.float32)
+    codebooks = np.zeros((m_subvectors, n_codes, sub_d), np.float32)
+    for m_i in range(m_subvectors):
+        sub = resid_s[:, m_i * sub_d : (m_i + 1) * sub_d]
+        k_eff = min(n_codes, sub.shape[0])
+        fitted = kmeans_fit(
+            jnp.asarray(sub), wv, k=k_eff, max_iter=max_iter, tol=1e-4,
+            init="k-means||", init_steps=2, seed=seed + m_i, unit_weight=True,
+        )
+        cb = np.zeros((n_codes, sub_d), np.float32)
+        cb[:k_eff] = fitted["cluster_centers"]
+        if k_eff < n_codes:
+            cb[k_eff:] = 1e18  # unused codes: unreachable
+        codebooks[m_i] = cb
+
+    # streamed encoding passes: one batch upload covers all m sub-encodings
+    cb_j = [jnp.asarray(codebooks[m_i]) for m_i in range(m_subvectors)]
+    codes_flat = np.zeros((n, m_subvectors), np.uint8)
+    for s in range(0, n, batch_rows):
+        e = min(s + batch_rows, n)
+        resid_b = jnp.asarray(
+            np.ascontiguousarray(X[s:e], np.float32) - coarse[assign[s:e]]
+        )
+        for m_i in range(m_subvectors):
+            codes_flat[s:e, m_i] = np.asarray(
+                kmeans_predict(
+                    resid_b[:, m_i * sub_d : (m_i + 1) * sub_d], cb_j[m_i]
+                )
+            ).astype(np.uint8)
+
+    cell_ids = flat["cell_ids"]
+    max_cell = cell_ids.shape[1]
+    codes = np.zeros((nlist, max_cell, m_subvectors), np.uint8)
+    pos = cell_ids >= 0
+    codes[pos] = codes_flat[cell_ids[pos]]
+    return {
+        "centers": coarse,
+        "codebooks": codebooks,
+        "codes": codes,
+        "cell_ids": cell_ids,
+        "cell_sizes": flat["cell_sizes"],
+        "cells": flat["cells"],  # host-resident; kept for optional exact refine
+    }
+
+
+def streaming_cagra_build(
+    X: np.ndarray,
+    graph_degree: int = 32,
+    nlist: int = 0,
+    seed: int = 42,
+    batch_rows: int = 1 << 16,
+    sample_rows: int = 1 << 18,
+) -> Dict[str, np.ndarray]:
+    """Out-of-core CAGRA-class graph build (cuVS cagra role, reference
+    knn.py:1538-1690): the fixed-degree kNN graph comes from STREAMED IVF
+    searches — items host-resident, each item batch queries the paged IVF index
+    (streaming_ivfflat_search) for its deg+1 neighbors — then the same
+    reverse-edge optimization as the in-core build runs on host. Search remains
+    in-core (cagra_search walks the graph with random access; the returned
+    {"items", "graph"} match ops/knn.py::cagra_build's contract)."""
+    from .knn import _optimize_graph_reverse_edges
+
+    X = np.ascontiguousarray(np.asarray(X), dtype=np.float32)
+    n = X.shape[0]
+    deg = min(graph_degree, max(n - 1, 1))
+    if nlist <= 0:
+        nlist = max(int(np.sqrt(n)), 8)
+    index = streaming_ivfflat_build(
+        X, nlist=nlist, max_iter=10, seed=seed, batch_rows=batch_rows,
+        sample_rows=sample_rows,
+    )
+    nprobe = min(nlist, max(2, nlist // 8))
+    idx = np.full((n, deg + 1), -1, np.int64)
+    for s in range(0, n, batch_rows):
+        e = min(s + batch_rows, n)
+        _, ib = streaming_ivfflat_search(X[s:e], index, k=deg + 1, nprobe=nprobe)
+        # the paged search returns min(k, nprobe*max_cell) columns; leave any
+        # shortfall as -1 (mapped to node 0 below, same as the in-core build)
+        idx[s:e, : ib.shape[1]] = ib
+
+    rows = np.arange(n)[:, None]
+    not_self = idx != rows
+    order = np.argsort(~not_self, axis=1, kind="stable")
+    graph = np.take_along_axis(idx, order, axis=1)[:, :deg].astype(np.int32)
+    graph = np.maximum(graph, 0)  # any -1 from an undersized probe -> node 0
+    graph = _optimize_graph_reverse_edges(X, graph, deg)
+    return {"items": X, "graph": graph}
 
 
 @functools.partial(jax.jit, static_argnames=("nprobe",))
@@ -136,4 +268,47 @@ def streaming_ivfflat_search(
         dists, ids = _scan_probed(qb, probed_items, probed_ids, k_eff)
         out_d[s:e] = np.asarray(dists)
         out_i[s:e] = np.asarray(ids)
+    return out_d, out_i
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def _refine_exact_tile(qb, vecs, item_ids, k: int):
+    d2 = jnp.sum((vecs - qb[:, None, :]) ** 2, axis=-1)
+    d2 = jnp.where(item_ids >= 0, d2, jnp.inf)
+    neg, pos = jax.lax.top_k(-d2, k)
+    ids = jnp.take_along_axis(item_ids, pos, axis=1)
+    dists = jnp.sqrt(jnp.maximum(-neg, 0.0))
+    return jnp.where(ids >= 0, dists, jnp.inf), ids
+
+
+def streaming_pq_refine(
+    Q: np.ndarray,
+    cells: np.ndarray,
+    cand_ids_flat: np.ndarray,
+    cand_item_ids: np.ndarray,
+    k: int,
+    block: int = 1024,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Host-paged exact re-rank of ADC candidates (ops/knn.py::pq_refine with the
+    cell layout HOST-RESIDENT): the candidate gather is the page-in — only
+    (block, kc, d) candidate vectors ever reach the device, never the full
+    cell-padded dataset. Same result contract as pq_refine."""
+    flat = cells.reshape(-1, cells.shape[-1])
+    nq, kc = cand_item_ids.shape
+    k_eff = min(k, kc)
+    out_d = np.empty((nq, k_eff), np.float32)
+    out_i = np.empty((nq, k_eff), np.int64)
+    cand_pos = np.maximum(np.asarray(cand_ids_flat), 0)
+    cand_ids = np.asarray(cand_item_ids)
+    for s in range(0, nq, block):
+        e = min(s + block, nq)
+        vecs = jnp.asarray(flat[cand_pos[s:e]])  # the host page-in
+        d_b, i_b = _refine_exact_tile(
+            jnp.asarray(np.ascontiguousarray(Q[s:e], np.float32)),
+            vecs,
+            jnp.asarray(cand_ids[s:e]),
+            k_eff,
+        )
+        out_d[s:e] = np.asarray(d_b)
+        out_i[s:e] = np.asarray(i_b)
     return out_d, out_i

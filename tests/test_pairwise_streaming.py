@@ -171,8 +171,13 @@ def test_streaming_knn_mesh_sharded_matches_single(n_devices):
     d_m, i_m = streaming_exact_knn(
         Q, X, 9, query_block=64, item_block=512, mesh=mesh
     )
-    # same FAST-precision tile shape per shard can still round differently than
-    # the fused single-device tile; verify against the float64 oracle instead
+    # distance profiles must match the single-device scan within the FAST-
+    # precision tolerance (per-shard tiles can round differently than the fused
+    # tile), and ids must agree except where near-ties allow a legitimate swap
+    np.testing.assert_allclose(d_m, d_1, atol=3e-2)
+    id_agree = np.mean([len(set(i_m[r]) & set(i_1[r])) / 9 for r in range(len(Q))])
+    assert id_agree > 0.97, id_agree
+    # and both must be TRUE top-k sets per the float64 oracle
     dq = np.sqrt(
         ((Q[:, None].astype(np.float64) - X[None].astype(np.float64)) ** 2).sum(-1)
     )
