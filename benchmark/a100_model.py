@@ -1,0 +1,105 @@
+#
+# A100 cuML wall-clock ESTIMATES for the north-star anchor (BASELINE.json:
+# "within 1.5x of A100 cuML"). No A100 is reachable from this environment and
+# the reference publishes no numeric table (BASELINE.md), so the anchor is a
+# roofline-derived stand-in: the SAME operational-intensity model used for the
+# TPU ceilings in chip_bench.py, evaluated with published A100 80GB SXM peaks.
+# Each estimate deliberately credits the A100 with the BEST plausible cuML
+# implementation (one-read Gram, TF32 matmuls) so a `vs_a100_est` at or above
+# 1/1.5 genuinely clears the north-star bar rather than beating a strawman.
+#
+# vs_a100_est semantics: measured TPU per-chip rate / estimated A100 per-GPU
+# rate. >= 0.667 means within the 1.5x north-star envelope; > 1 means the
+# per-chip rate beats the A100 estimate outright.
+#
+# The model and its per-family assumptions are documented in BASELINE.md
+# ("A100 anchor model").
+#
+
+from __future__ import annotations
+
+# Published A100 80GB SXM peaks (NVIDIA A100 datasheet)
+A100_HBM_BW = 2.0e12  # bytes/s (2.039 TB/s nominal)
+A100_F32 = 19.5e12  # FLOP/s (CUDA cores)
+A100_TF32 = 156e12  # FLOP/s (tensor cores, no sparsity)
+A100_FP16 = 312e12  # FLOP/s (tensor cores, no sparsity)
+
+
+def kmeans_rows_iters_per_sec(d: int, k: int) -> float:
+    """Lloyd iteration throughput: same two-X-read + (n,k) intermediate model as
+    the TPU ceiling (bench.py _kmeans_rates); cuML's fused distance kernel is
+    HBM-bound at these shapes."""
+    return A100_HBM_BW / (2 * d * 4 + 2 * k * 4)
+
+
+def pca_cov_rows_per_sec(d: int) -> float:
+    """Covariance pass at the ONE-read floor (credits cuML's syrk with perfect
+    operand reuse, the same floor the fused pallas kernel is held to)."""
+    return A100_HBM_BW / (d * 4)
+
+
+def linreg_rows_per_sec(d: int) -> float:
+    """Normal-equation stats at the one-read floor (syrk + fused gemv credit —
+    matches the TPU fused [XᵀX|Xᵀy] pass's floor)."""
+    return A100_HBM_BW / (d * 4)
+
+
+def logreg_rows_iters_per_sec(d: int) -> float:
+    """L-BFGS iteration at ~4 X reads/iter (logits + gradient + ~2 line-search
+    objective passes — the same accounting as the TPU ceiling,
+    chip_bench.py bench_logreg)."""
+    return A100_HBM_BW / (4 * d * 4)
+
+
+def knn_queries_per_sec(n_items: int, d: int) -> float:
+    """Brute-force scan: 2*n*d FLOP/query on tensor cores (TF32 — RAFT's
+    pairwise gemm path), assuming perfect MXU-equivalent utilization."""
+    return A100_TF32 / (2.0 * n_items * d)
+
+
+def dbscan_rows_per_sec(n: int, d: int, passes: float = 3.0) -> float:
+    """Blocked adjacency scan: each row costs ~2*n*d FLOP per full pass
+    (core-mask + propagation rounds folded into `passes`); TF32 bound."""
+    return A100_TF32 / (2.0 * n * d * passes)
+
+
+def vs_a100(tpu_rate: "float | None", a100_rate: float) -> "float | None":
+    """Ratio field for the bench line (None-propagating): TPU per-chip rate
+    over the A100 per-GPU estimate; >= 0.667 clears the 1.5x north-star."""
+    if tpu_rate is None or a100_rate <= 0:
+        return None
+    return round(float(tpu_rate) / a100_rate, 4)
+
+
+# The BASELINE north star names v5p-64 as the target hardware; the bench chip is
+# a v5e (819 GB/s HBM, 197 TF/s bf16). A v5e chip cannot reach an A100 80GB on
+# HBM-bound ops even at 100% roofline (819/2000 = 0.41), so each vs_a100_est is
+# also projected to v5p by scaling the MEASURED roofline fraction to v5p peaks
+# (2765 GB/s HBM, 459 TF/s bf16 — same architecture family, so the achieved
+# fraction is the transferable quantity).
+V5E_HBM_BW = 819e9
+V5E_BF16 = 197e12
+V5P_SCALE_HBM = 2765e9 / V5E_HBM_BW  # ≈ 3.38
+V5P_SCALE_MXU = 459e12 / V5E_BF16  # ≈ 2.33
+
+
+def v5p_projection(vs_a100_v5e: "float | None", bound: str = "hbm") -> "float | None":
+    """Project a v5e-measured vs_a100_est to v5p hardware (the north-star chip)
+    by the ratio of peaks for the binding resource."""
+    if vs_a100_v5e is None:
+        return None
+    scale = V5P_SCALE_HBM if bound == "hbm" else V5P_SCALE_MXU
+    return round(vs_a100_v5e * scale, 4)
+
+
+def anchor_fields(
+    prefix: str, tpu_rate: "float | None", a100_rate: float, bound: str = "hbm"
+) -> dict:
+    """The two anchor keys every TPU family line carries: `<prefix>_vs_a100_est`
+    (v5e-measured) and `<prefix>_vs_a100_est_v5p` (north-star-hardware
+    projection). One helper so the semantics can never drift between families."""
+    v = vs_a100(tpu_rate, a100_rate)
+    return {
+        f"{prefix}_vs_a100_est": v,
+        f"{prefix}_vs_a100_est_v5p": v5p_projection(v, bound=bound),
+    }

@@ -142,6 +142,10 @@ def bench_pca(ctx) -> Dict:
     out["pca_cov_rows_per_sec_per_chip"] = round(rate, 1)
     out["pca_cov_precision"] = prec_name
     out["pca_roofline_frac"] = round(rate / ceiling, 3) if ctx["on_tpu"] else None
+    if ctx["on_tpu"]:
+        from . import a100_model
+
+        out.update(a100_model.anchor_fields("pca", rate, a100_model.pca_cov_rows_per_sec(d), bound="hbm"))
 
     # parity: fused (6-pass) vs XLA HIGHEST on the full matrix
     if ctx["on_tpu"]:
@@ -263,6 +267,10 @@ def bench_linreg(ctx) -> Dict:
         ),
         "linreg_r2": round(r2, 4),
     })
+    if ctx["on_tpu"]:
+        from . import a100_model
+
+        out.update(a100_model.anchor_fields("linreg", rate, a100_model.linreg_rows_per_sec(d), bound="hbm"))
     return out
 
 
@@ -318,6 +326,10 @@ def bench_logreg(ctx) -> Dict:
         "logreg_train_accuracy": round(acc, 4),
         "logreg_objective": round(float(attrs.get("objective", np.nan)), 6),
     }
+    if ctx["on_tpu"]:
+        from . import a100_model
+
+        out.update(a100_model.anchor_fields("logreg", rate, a100_model.logreg_rows_iters_per_sec(d), bound="hbm"))
 
     ctx.get("heartbeat", lambda tag: None)("logreg_incore")
     # streamed out-of-core variant (BASELINE config 3's mechanism): host-resident
@@ -462,13 +474,18 @@ def bench_knn(ctx) -> Dict:
     frac = flops / t / ctx["n_chips"] / PEAK_BF16
     # sanity quality: each query's nearest neighbor is itself (distance 0)
     self_hit = float((np.asarray(idx)[:, 0] == np.arange(nq)).mean())
-    return {
+    out = {
         "knn_queries_per_sec_per_chip": round(qps, 1),
         "knn_frac_of_ceiling": round(frac, 3) if ctx["on_tpu"] else None,
         "knn_recall_at_10": 1.0,  # exact by construction
         "knn_self_hit": round(self_hit, 4),
         "knn_items": n,
     }
+    if ctx["on_tpu"]:
+        from . import a100_model
+
+        out.update(a100_model.anchor_fields("knn", qps, a100_model.knn_queries_per_sec(n, d), bound="mxu"))
+    return out
 
 
 # --------------------------------------------------------------------------- ann
@@ -656,11 +673,16 @@ def bench_dbscan(ctx) -> Dict:
         ari = float(adjusted_rand_score(sk.labels_, np.asarray(labels)[sub]))
     except Exception:
         pass
-    return {
+    out = {
         "dbscan_rows_per_sec_per_chip": round(rate, 1),
         "dbscan_ari_vs_sklearn": round(ari, 4) if ari is not None else None,
         "dbscan_clusters": int(len(set(np.asarray(labels).tolist()) - {-1})),
     }
+    if ctx["on_tpu"]:
+        from . import a100_model
+
+        out.update(a100_model.anchor_fields("dbscan", rate, a100_model.dbscan_rows_per_sec(n, d), bound="mxu"))
+    return out
 
 
 # ----------------------------------------------------------- e2e ingest + fit

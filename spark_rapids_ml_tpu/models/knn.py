@@ -382,6 +382,17 @@ class ApproximateNearestNeighbors(_ApproxNNClass, _TpuEstimator, _NNParams):
         batch_rows = int(_config.get("stream_batch_rows"))
         X = np.asarray(_densify(fd.features, self._float32_inputs))
         if algo == "cagra":
+            # the BUILD streams, but cagra_search walks the graph with random
+            # access and needs the item set device-resident — unlike the IVF
+            # searches there is no paged variant, so query time will stage
+            # items on device. Say so now rather than OOM-ing at kneighbors.
+            self.logger.warning(
+                "streamed CAGRA build keeps items host-resident, but CAGRA "
+                "search requires the full item set (~%.0f MiB) on device; "
+                "kneighbors() will stage it and may exhaust device memory — "
+                "prefer algorithm='ivfflat'/'ivfpq' for datasets beyond HBM.",
+                X.nbytes / 2**20,
+            )
             return streaming_cagra_build(
                 X,
                 graph_degree=int(

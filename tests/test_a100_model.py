@@ -1,0 +1,45 @@
+"""A100 north-star anchor model (benchmark/a100_model.py): the roofline math
+behind the vs_a100_est fields in the bench line (BASELINE.md "A100 anchor
+model"). Pure-host math — exercised here so a model change can't silently skew
+the recorded ratios."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from benchmark import a100_model as m  # noqa: E402
+
+
+def test_hbm_bound_families_scale_inverse_width():
+    assert m.pca_cov_rows_per_sec(128) == m.A100_HBM_BW / 512
+    assert m.linreg_rows_per_sec(128) == m.pca_cov_rows_per_sec(128)
+    # logreg pays 4 reads -> quarter the one-read rate
+    assert m.logreg_rows_iters_per_sec(64) == m.pca_cov_rows_per_sec(64) / 4
+    # kmeans: two X reads + two (n,k) intermediates
+    assert m.kmeans_rows_iters_per_sec(128, 20) == m.A100_HBM_BW / (
+        2 * 128 * 4 + 2 * 20 * 4
+    )
+
+
+def test_mxu_bound_families():
+    assert m.knn_queries_per_sec(1_000_000, 128) == m.A100_TF32 / (2.0 * 1e6 * 128)
+    assert m.dbscan_rows_per_sec(1000, 32) == m.A100_TF32 / (2.0 * 1000 * 32 * 3.0)
+
+
+def test_vs_a100_semantics():
+    assert m.vs_a100(None, 5.0) is None
+    assert m.vs_a100(10.0, 0.0) is None
+    assert m.vs_a100(2.0, 4.0) == 0.5
+    # 1/1.5 rounds to 0.6667: the 1.5x north-star envelope boundary
+    assert m.vs_a100(2.0, 3.0) == 0.6667
+
+
+def test_v5p_projection_scales_by_binding_resource():
+    assert m.v5p_projection(None) is None
+    assert m.v5p_projection(0.2, bound="hbm") == round(0.2 * m.V5P_SCALE_HBM, 4)
+    assert m.v5p_projection(0.2, bound="mxu") == round(0.2 * m.V5P_SCALE_MXU, 4)
+    # clearing the 0.667 bar on v5p needs ~48% of the v5e HBM roofline:
+    # f=0.50 clears it, f=0.45 does not (vs_a100_v5e = 0.41*f; x3.376 to v5p)
+    assert m.v5p_projection(0.41 * 0.50, bound="hbm") > 0.667
+    assert m.v5p_projection(0.41 * 0.45, bound="hbm") < 0.667
