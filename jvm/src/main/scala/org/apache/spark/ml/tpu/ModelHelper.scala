@@ -115,4 +115,41 @@ object ModelHelper {
       if (classification) (root \ "num_classes").extractOpt[Int].getOrElse(2) else 0
     (numFeatures, numClasses)
   }
+
+  /** Inverse of userParamsJson: restore user-set params onto `target` from the
+   * persisted JSON dict (type-coerced per the concrete Param subclass — json4s
+   * surfaces every number as JInt/JDouble regardless of the param's type). */
+  def applyParamsJson(target: Params, paramsJson: String): Unit =
+    JsonMethods.parse(paramsJson) match {
+      case JObject(fields) =>
+        fields.foreach { case JField(name, v) =>
+          if (target.hasParam(name)) setCoerced(target, target.getParam(name), v)
+        }
+      case _ =>
+    }
+
+  private def setCoerced(target: Params, p: Param[_], v: JValue): Unit = {
+    import org.apache.spark.ml.param._
+    val value: Option[Any] = (p, v) match {
+      case (_: IntParam, JInt(i)) => Some(i.toInt)
+      case (_: IntParam, JDouble(d)) => Some(d.toInt)
+      case (_: LongParam, JInt(i)) => Some(i.toLong)
+      case (_: DoubleParam, JInt(i)) => Some(i.toDouble)
+      case (_: DoubleParam, JDouble(d)) => Some(d)
+      case (_: FloatParam, JInt(i)) => Some(i.toFloat)
+      case (_: FloatParam, JDouble(d)) => Some(d.toFloat)
+      case (_: BooleanParam, JBool(b)) => Some(b)
+      case (_: StringArrayParam, JArray(a)) =>
+        Some(a.map(_.extract[String]).toArray)
+      case (_: DoubleArrayParam, JArray(a)) =>
+        Some(a.map(_.extract[Double]).toArray)
+      case (_: IntArrayParam, JArray(a)) => Some(a.map(_.extract[Int]).toArray)
+      case (_, JString(s)) => Some(s)
+      case (_, JInt(i)) => Some(i.toInt)
+      case (_, JDouble(d)) => Some(d)
+      case (_, JBool(b)) => Some(b)
+      case _ => None
+    }
+    value.foreach(x => target.set(p.asInstanceOf[Param[Any]], x))
+  }
 }

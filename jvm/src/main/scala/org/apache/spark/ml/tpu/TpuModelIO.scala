@@ -1,0 +1,56 @@
+/*
+ * Session-free persistence for the Tpu model wrappers (role of the reference's
+ * RapidsModel write/read, jvm/src/main/scala/org/apache/spark/ml/rapids/
+ * RapidsModel.scala:47-95, which rides Spark's MLWriter/Hadoop FS). Re-designed
+ * as one JSON document via java.nio so model save/load — and its unit tests —
+ * need no SparkSession or Hadoop classpath: the TPU backend's model state is
+ * fully captured by (uid, class, user params, Python attribute JSON).
+ */
+package org.apache.spark.ml.tpu
+
+import java.nio.charset.StandardCharsets
+import java.nio.file.{Files, Paths}
+
+import org.json4s._
+import org.json4s.jackson.JsonMethods
+
+object TpuModelIO {
+
+  private implicit val formats: Formats = DefaultFormats
+
+  /** Everything needed to rebuild a Tpu model wrapper. */
+  case class Loaded(
+      uid: String,
+      className: String,
+      paramsJson: String,
+      attributesJson: String)
+
+  def save(
+      path: String,
+      uid: String,
+      className: String,
+      paramsJson: String,
+      attributesJson: String): Unit = {
+    val dir = Paths.get(path)
+    Files.createDirectories(dir)
+    val doc = JObject(
+      List(
+        JField("uid", JString(uid)),
+        JField("class", JString(className)),
+        JField("params", JsonMethods.parse(paramsJson)),
+        JField("attributes", JString(attributesJson))))
+    Files.write(
+      dir.resolve("tpu_model.json"),
+      JsonMethods.compact(JsonMethods.render(doc)).getBytes(StandardCharsets.UTF_8))
+  }
+
+  def load(path: String): Loaded = {
+    val bytes = Files.readAllBytes(Paths.get(path).resolve("tpu_model.json"))
+    val root = JsonMethods.parse(new String(bytes, StandardCharsets.UTF_8))
+    Loaded(
+      (root \ "uid").extract[String],
+      (root \ "class").extract[String],
+      JsonMethods.compact(JsonMethods.render(root \ "params")),
+      (root \ "attributes").extract[String])
+  }
+}

@@ -40,6 +40,14 @@ trait TpuModel extends Params {
       runner.close()
     }
   }
+
+  /** Session-free persistence (TpuModelIO): uid + user params + the Python
+   * attribute JSON fully determine the wrapper; companion `load`s rebuild it.
+   * The reference persists through Spark's MLWriter (RapidsModel.scala:47-95);
+   * this form also works without a SparkSession, which the unit tier exploits. */
+  def saveTpu(path: String): Unit =
+    TpuModelIO.save(
+      path, uid, getClass.getName, ModelHelper.userParamsJson(this), modelAttributes)
 }
 
 class TpuLogisticRegressionModel(
@@ -59,6 +67,28 @@ class TpuLogisticRegressionModel(
     else super.transform(dataset)
 }
 
+private[tpu] object TpuModelLoadCheck {
+  /** Loading a path persisted by a DIFFERENT model type must fail loudly — the
+   * attribute parsers degrade to defaults (e.g. forestShape -> (-1, 2)) and
+   * would otherwise hand back a silently-corrupt model. TpuModelIO persists the
+   * class name exactly for this check. */
+  def requireClass(doc: TpuModelIO.Loaded, expected: Class[_]): Unit =
+    require(
+      doc.className == expected.getName,
+      s"model at path was saved as ${doc.className}, not ${expected.getName}")
+}
+
+object TpuLogisticRegressionModel {
+  def load(path: String): TpuLogisticRegressionModel = {
+    val doc = TpuModelIO.load(path)
+    TpuModelLoadCheck.requireClass(doc, classOf[TpuLogisticRegressionModel])
+    val (coef, icpt, k) = ModelHelper.logisticRegressionAttributes(doc.attributesJson)
+    val m = new TpuLogisticRegressionModel(doc.uid, coef, icpt, k, doc.attributesJson)
+    ModelHelper.applyParamsJson(m, doc.paramsJson)
+    m
+  }
+}
+
 class TpuLinearRegressionModel(
     override val uid: String,
     coefficients: Vector,
@@ -72,6 +102,17 @@ class TpuLinearRegressionModel(
   override def transform(dataset: Dataset[_]): DataFrame =
     if (pythonTransformEnabled(dataset)) transformOnPython(dataset)
     else super.transform(dataset)
+}
+
+object TpuLinearRegressionModel {
+  def load(path: String): TpuLinearRegressionModel = {
+    val doc = TpuModelIO.load(path)
+    TpuModelLoadCheck.requireClass(doc, classOf[TpuLinearRegressionModel])
+    val (coef, icpt) = ModelHelper.linearRegressionAttributes(doc.attributesJson)
+    val m = new TpuLinearRegressionModel(doc.uid, coef, icpt, doc.attributesJson)
+    ModelHelper.applyParamsJson(m, doc.paramsJson)
+    m
+  }
 }
 
 class TpuRandomForestClassificationModel(
@@ -88,6 +129,17 @@ class TpuRandomForestClassificationModel(
   override def transform(dataset: Dataset[_]): DataFrame = transformOnPython(dataset)
 }
 
+object TpuRandomForestClassificationModel {
+  def load(path: String): TpuRandomForestClassificationModel = {
+    val doc = TpuModelIO.load(path)
+    TpuModelLoadCheck.requireClass(doc, classOf[TpuRandomForestClassificationModel])
+    val (nf, nc) = ModelHelper.forestShape(doc.attributesJson, classification = true)
+    val m = new TpuRandomForestClassificationModel(doc.uid, nf, nc, doc.attributesJson)
+    ModelHelper.applyParamsJson(m, doc.paramsJson)
+    m
+  }
+}
+
 class TpuRandomForestRegressionModel(
     override val uid: String,
     numFeaturesIn: Int,
@@ -97,6 +149,17 @@ class TpuRandomForestRegressionModel(
   override def modelOperatorName: String = "RandomForestRegressionModel"
 
   override def transform(dataset: Dataset[_]): DataFrame = transformOnPython(dataset)
+}
+
+object TpuRandomForestRegressionModel {
+  def load(path: String): TpuRandomForestRegressionModel = {
+    val doc = TpuModelIO.load(path)
+    TpuModelLoadCheck.requireClass(doc, classOf[TpuRandomForestRegressionModel])
+    val (nf, _) = ModelHelper.forestShape(doc.attributesJson, classification = false)
+    val m = new TpuRandomForestRegressionModel(doc.uid, nf, doc.attributesJson)
+    ModelHelper.applyParamsJson(m, doc.paramsJson)
+    m
+  }
 }
 
 /*

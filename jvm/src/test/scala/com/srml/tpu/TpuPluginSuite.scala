@@ -10,12 +10,17 @@
  */
 package com.srml.tpu
 
+import java.nio.file.Files
+
 import org.apache.spark.ml.linalg.Vectors
-import org.apache.spark.ml.tpu.{ModelHelper, TpuKMeansModel, TpuPCAModel}
+import org.apache.spark.ml.tpu.{ModelHelper, TpuKMeansModel, TpuLinearRegressionModel, TpuLogisticRegressionModel, TpuModelIO, TpuPCAModel, TpuRandomForestClassificationModel, TpuRandomForestRegressionModel}
 import org.apache.spark.sql.SparkSession
 import org.scalatest.funsuite.AnyFunSuite
 
 class TpuPluginSuite extends AnyFunSuite {
+
+  private def tempDir(): String =
+    Files.createTempDirectory("tpu-plugin-suite").toString
 
   // ---- gated Connect-session roundtrips (reference SparkRapidsMLSuite runs
   // these unconditionally; here the Python backend + Connect jars may be absent,
@@ -167,6 +172,170 @@ class TpuPluginSuite extends AnyFunSuite {
     }
   }
 
+  // ---- session-free model persistence roundtrips (the reference's per-family
+  // model.write/save + Model.load + modelAttributes-equality assertions,
+  // SparkRapidsMLSuite.scala:100-105 etc., portable to the unit tier because
+  // TpuModelIO needs no SparkSession) ----
+
+  test("persistence: LogisticRegression model roundtrips with attributes") {
+    val json =
+      """{"coefficients": {"__nd__": [[1.0, 2.0, 3.0]], "dtype": "float32"},
+         |"intercepts": {"__nd__": [0.25], "dtype": "float32"},
+         |"num_classes": 2, "n_iter": 9}""".stripMargin
+    val (coef, icpt, k) = ModelHelper.logisticRegressionAttributes(json)
+    val model = new org.apache.spark.ml.tpu.TpuLogisticRegressionModel(
+      "lr-uid-1", coef, icpt, k, json)
+    model.set(model.featuresCol, "test_feature")
+    model.set(model.maxIter, 23)
+    model.set(model.tol, 0.03)
+    val path = tempDir()
+    model.saveTpu(path)
+    val loaded = TpuLogisticRegressionModel.load(path)
+    assert(loaded.uid == model.uid)
+    assert(loaded.modelAttributes == model.modelAttributes)
+    assert(loaded.getFeaturesCol == "test_feature")
+    assert(loaded.getMaxIter == 23)
+    assert(loaded.getTol == 0.03)
+    assert(loaded.numClasses == 2)
+    assert(loaded.coefficientMatrix(0, 1) == 2.0)
+    assert(loaded.interceptVector(0) == 0.25)
+  }
+
+  test("persistence: LinearRegression model roundtrips with attributes") {
+    val json =
+      """{"coefficients": {"__nd__": [1.5, -2.5]}, "intercept": 0.5, "n_iter": 1}"""
+    val (coef, icpt) = ModelHelper.linearRegressionAttributes(json)
+    val model = new org.apache.spark.ml.tpu.TpuLinearRegressionModel(
+      "linreg-uid-1", coef, icpt, json)
+    model.set(model.labelCol, "class")
+    model.set(model.regParam, 0.5)
+    val path = tempDir()
+    model.saveTpu(path)
+    val loaded = TpuLinearRegressionModel.load(path)
+    assert(loaded.uid == model.uid)
+    assert(loaded.modelAttributes == model.modelAttributes)
+    assert(loaded.getLabelCol == "class")
+    assert(loaded.getRegParam == 0.5)
+    assert(loaded.coefficients(1) == -2.5)
+    assert(loaded.intercept == 0.5)
+  }
+
+  test("persistence: RandomForestClassification model roundtrips with attributes") {
+    val json = """{"num_features": 12, "num_classes": 3, "forest": {"trees": []}}"""
+    val model = new org.apache.spark.ml.tpu.TpuRandomForestClassificationModel(
+      "rfc-uid-1", 12, 3, json)
+    model.set(model.maxDepth, 4)
+    model.set(model.maxBins, 7)
+    val path = tempDir()
+    model.saveTpu(path)
+    val loaded = TpuRandomForestClassificationModel.load(path)
+    assert(loaded.uid == model.uid)
+    assert(loaded.modelAttributes == model.modelAttributes)
+    assert(loaded.getMaxDepth == 4)
+    assert(loaded.getMaxBins == 7)
+    assert(loaded.numFeatures == 12)
+    assert(loaded.numClasses == 3)
+  }
+
+  test("persistence: RandomForestRegression model roundtrips with attributes") {
+    val json = """{"num_features": 7, "forest": {"trees": []}}"""
+    val model = new org.apache.spark.ml.tpu.TpuRandomForestRegressionModel(
+      "rfr-uid-1", 7, json)
+    model.set(model.numTrees, 5)
+    val path = tempDir()
+    model.saveTpu(path)
+    val loaded = TpuRandomForestRegressionModel.load(path)
+    assert(loaded.uid == model.uid)
+    assert(loaded.modelAttributes == model.modelAttributes)
+    assert(loaded.numFeatures == 7)
+  }
+
+  test("persistence: load surfaces the persisted class name") {
+    val json = """{"coefficients": {"__nd__": [1.0]}, "intercept": 0.0}"""
+    val (coef, icpt) = ModelHelper.linearRegressionAttributes(json)
+    val model = new org.apache.spark.ml.tpu.TpuLinearRegressionModel(
+      "cls-uid", coef, icpt, json)
+    val path = tempDir()
+    model.saveTpu(path)
+    val doc = TpuModelIO.load(path)
+    assert(doc.className.endsWith("TpuLinearRegressionModel"))
+    assert(doc.uid == "cls-uid")
+  }
+
+  test("persistence: missing file fails loudly, not with a default model") {
+    intercept[Exception] {
+      TpuLinearRegressionModel.load(tempDir() + "/nonexistent")
+    }
+  }
+
+  test("persistence: loading a path saved by another model type is rejected") {
+    // forestShape would degrade missing fields to (-1, 2): without the class
+    // check the caller would get a silently-corrupt RF model
+    val json = """{"coefficients": {"__nd__": [1.0, 2.0]}, "intercept": 0.0}"""
+    val (coef, icpt) = ModelHelper.linearRegressionAttributes(json)
+    val model = new org.apache.spark.ml.tpu.TpuLinearRegressionModel(
+      "xtype-uid", coef, icpt, json)
+    val path = tempDir()
+    model.saveTpu(path)
+    val e = intercept[IllegalArgumentException] {
+      TpuRandomForestClassificationModel.load(path)
+    }
+    assert(e.getMessage.contains("TpuLinearRegressionModel"))
+  }
+
+  // ---- param JSON restore (the load half of the persisted-params contract) ----
+
+  test("applyParamsJson restores every user-set param with type coercion") {
+    val src = new TpuKMeans().setK(7).setMaxIter(11).setTol(0.5).setSeed(99L)
+    val json = ModelHelper.userParamsJson(src)
+    val dst = new TpuKMeans()
+    ModelHelper.applyParamsJson(dst, json)
+    assert(dst.getK == 7)
+    assert(dst.getMaxIter == 11)
+    assert(dst.getTol == 0.5)
+    assert(dst.getSeed == 99L)
+  }
+
+  test("applyParamsJson coerces ints into double params") {
+    // json4s parses 1 as JInt even when the target param is a DoubleParam
+    val dst = new TpuLinearRegression()
+    ModelHelper.applyParamsJson(dst, """{"regParam": 1, "maxIter": 5}""")
+    assert(dst.getRegParam == 1.0)
+    assert(dst.getMaxIter == 5)
+  }
+
+  test("applyParamsJson ignores unknown params instead of throwing") {
+    val dst = new TpuPCA()
+    ModelHelper.applyParamsJson(dst, """{"k": 3, "not_a_param": "x"}""")
+    assert(dst.getK == 3)
+    assert(!dst.isSet(dst.inputCol))
+  }
+
+  test("param JSON roundtrips for every accelerated estimator type") {
+    val pairs: Seq[(org.apache.spark.ml.param.Params,
+                    org.apache.spark.ml.param.Params)] = Seq(
+      new TpuLogisticRegression().setMaxIter(3).setRegParam(0.1) ->
+        new TpuLogisticRegression(),
+      new TpuLinearRegression().setRegParam(0.5).setElasticNetParam(0.2) ->
+        new TpuLinearRegression(),
+      new TpuKMeans().setK(4).setMaxIter(7) -> new TpuKMeans(),
+      new TpuPCA().setK(2).setInputCol("f") -> new TpuPCA(),
+      new TpuRandomForestClassifier().setNumTrees(9).setMaxDepth(3) ->
+        new TpuRandomForestClassifier(),
+      new TpuRandomForestRegressor().setMaxDepth(6).setMaxBins(15) ->
+        new TpuRandomForestRegressor()
+    )
+    pairs.foreach { case (src, dst) =>
+      ModelHelper.applyParamsJson(dst, ModelHelper.userParamsJson(src))
+      src.params.filter(src.isSet(_)).foreach { p =>
+        assert(dst.isSet(dst.getParam(p.name)), s"${src.getClass.getSimpleName}.${p.name}")
+        assert(
+          dst.get(dst.getParam(p.name)).get == src.get(p).get,
+          s"${src.getClass.getSimpleName}.${p.name}")
+      }
+    }
+  }
+
   // ---- Connect-session roundtrips (one per accelerated family; the reference
   // suite's RapidsLogisticRegression/RapidsKMeans/RapidsPCA/... tests) ----
 
@@ -225,6 +394,124 @@ class TpuPluginSuite extends AnyFunSuite {
       val model = new TpuRandomForestRegressor().setNumTrees(5).train(df)
       assert(model.numFeatures == 3)
       assert(model.transform(df).columns.contains("prediction"))
+    }
+  }
+
+  // ---- estimator persistence through Spark's writer (the reference's
+  // lr.write.overwrite().save + Estimator.load half, SparkRapidsMLSuite.scala:
+  // 82-89 — needs a session for the Hadoop FS path) ----
+
+  test("estimator persistence: LogisticRegression save/load keeps user params") {
+    withSession { _ =>
+      val est = new TpuLogisticRegression()
+        .setFeaturesCol("test_feature")
+        .setLabelCol("class")
+        .setMaxIter(23)
+        .setTol(0.03)
+      val path = tempDir() + "/LogisticRegression"
+      est.write.overwrite().save(path)
+      val loaded = TpuLogisticRegression.load(path)
+      assert(loaded.getFeaturesCol == "test_feature")
+      assert(loaded.getLabelCol == "class")
+      assert(loaded.getMaxIter == 23)
+      assert(loaded.getTol == 0.03)
+    }
+  }
+
+  test("estimator persistence: RandomForestClassifier save/load keeps user params") {
+    withSession { _ =>
+      val est = new TpuRandomForestClassifier()
+        .setFeaturesCol("test_feature")
+        .setLabelCol("class")
+        .setMaxDepth(4)
+        .setMaxBins(7)
+      val path = tempDir() + "/RandomForestClassifier"
+      est.write.overwrite().save(path)
+      val loaded = TpuRandomForestClassifier.load(path)
+      assert(loaded.getMaxDepth == 4)
+      assert(loaded.getMaxBins == 7)
+    }
+  }
+
+  test("estimator persistence: KMeans and PCA save/load keep user params") {
+    withSession { _ =>
+      val km = new TpuKMeans().setK(6).setSeed(3L)
+      val kmPath = tempDir() + "/KMeans"
+      km.write.overwrite().save(kmPath)
+      assert(TpuKMeans.load(kmPath).getK == 6)
+
+      val pca = new TpuPCA().setK(2).setInputCol("test_feature").setOutputCol("pca_feature")
+      val pcaPath = tempDir() + "/PCA"
+      pca.write.overwrite().save(pcaPath)
+      val loadedPca = TpuPCA.load(pcaPath)
+      assert(loadedPca.getK == 2)
+      assert(loadedPca.getInputCol == "test_feature")
+      assert(loadedPca.getOutputCol == "pca_feature")
+    }
+  }
+
+  // ---- Python-vs-JVM transform parity (the reference's
+  // "spark.rapids.ml.python.transform.enabled" toggle cases,
+  // SparkRapidsMLSuite.scala:107-120: same columns up to order, both collect) ----
+
+  test("transform toggle: LogisticRegression python and JVM paths agree on schema") {
+    withSession { spark =>
+      val df = binaryDf(spark)
+      val model = new TpuLogisticRegression().setMaxIter(20).train(df)
+      val dfPython = model.transform(df)
+      spark.conf.set("spark.rapids.ml.tpu.python.transform.enabled", "false")
+      try {
+        val dfJvm = model.transform(df)
+        assert(dfPython.schema.names.sorted sameElements dfJvm.schema.names.sorted)
+        dfPython.collect()
+        dfJvm.collect()
+      } finally {
+        spark.conf.set("spark.rapids.ml.tpu.python.transform.enabled", "true")
+      }
+    }
+  }
+
+  test("transform toggle: LinearRegression python and JVM paths agree on schema") {
+    withSession { spark =>
+      val df = binaryDf(spark)
+      val model = new TpuLinearRegression().setMaxIter(10).train(df)
+      val dfPython = model.transform(df)
+      spark.conf.set("spark.rapids.ml.tpu.python.transform.enabled", "false")
+      try {
+        val dfJvm = model.transform(df)
+        assert(dfPython.schema.names.sorted sameElements dfJvm.schema.names.sorted)
+        dfPython.collect()
+        dfJvm.collect()
+      } finally {
+        spark.conf.set("spark.rapids.ml.tpu.python.transform.enabled", "true")
+      }
+    }
+  }
+
+  // ---- array<double> features input (the reference's "array input" case,
+  // SparkRapidsMLSuite.scala:395-424: accelerated estimators accept raw array
+  // columns, which plain Spark ML rejects) ----
+
+  test("array input: KMeans fits on array<double> features") {
+    withSession { spark =>
+      val rows = (0 until 32).map { i =>
+        Tuple1(Array(i.toDouble / 32.0, 1.0 - i.toDouble / 32.0))
+      }
+      val df = spark.createDataFrame(rows).toDF("features")
+      val model = new TpuKMeans().setK(2).setSeed(1).fit(df)
+      assert(model.clusterCenters.length == 2)
+    }
+  }
+
+  test("array input: LogisticRegression fits on array<double> features") {
+    withSession { spark =>
+      val rows = (0 until 32).map { i =>
+        val x = i.toDouble / 32.0
+        (Array(x, 1.0 - x), if (x > 0.5) 1.0 else 0.0)
+      }
+      val df = spark.createDataFrame(rows).toDF("features", "label")
+      val model = new TpuLogisticRegression().setMaxIter(10).train(df)
+      assert(model.numClasses == 2)
     }
   }
 }

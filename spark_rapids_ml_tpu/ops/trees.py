@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import functools
 import math
+import time
 from typing import Dict, List
 
 import jax
@@ -129,20 +130,17 @@ def _histogram(
     )
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=(
-        "max_depth",
-        "nbins",
-        "impurity",
-        "k_features",
-        "min_instances",
-        "min_info_gain",
-        "use_pallas",
-        "mesh",  # jax.sharding.Mesh is hashable; static so shard_map can close over it
-    ),
-)
-def build_tree(
+# Opt-in per-level wall-clock collection: a test/bench sets
+# `ops.trees._LEVEL_TIMING = []` before fitting and reads (level, seconds)
+# tuples back. While set, _grow_forest calls _build_tree_impl eagerly with the
+# collector bound, so the per-level sync measures real device time — the heavy
+# per-level ops (histogram, routing matmuls) are independently jitted, so the
+# eager driver costs only dispatch overhead. The jitted build_tree entry point
+# never times (hooks inside a jit body would record trace time).
+_LEVEL_TIMING: "List | None" = None
+
+
+def _build_tree_impl(
     Xb: jax.Array,  # (n, d) int32 bins, rows may be sharded
     values: jax.Array,  # (n, s) per-row stats already weighted (0 rows contribute 0)
     edges: jax.Array,  # (d, nbins-1) real thresholds
@@ -155,6 +153,7 @@ def build_tree(
     min_info_gain: float,
     use_pallas: bool = False,
     mesh=None,
+    level_timing=None,
 ) -> Dict[str, jax.Array]:
     """Grow one tree; returns heap arrays of size 2^(max_depth+1):
     feature (int32, -1 for leaf), threshold (f32), is_leaf (bool), value (slots, v)."""
@@ -176,6 +175,7 @@ def build_tree(
     T = jnp.sum(values, axis=0)[None, :]  # (1, s) root stats
 
     for t in range(max_depth):
+        level_t0 = time.perf_counter() if level_timing is not None else None
         width = 2**t
         hist = _histogram(Xb, values, node_id, width, nbins, use_pallas, mesh)  # (w, d, b, s)
         cum = jnp.cumsum(hist, axis=2)
@@ -250,6 +250,9 @@ def build_tree(
         Lbest = cum[jnp.arange(width), best_feat, best_bin, :]  # (w, s)
         Rbest = T - Lbest
         T = jnp.stack([Lbest, Rbest], axis=1).reshape(2 * width, s)
+        if level_timing is not None:
+            T.block_until_ready()  # the sync exists only in timing mode
+            level_timing.append((t, time.perf_counter() - level_t0))
 
     # deepest level: all leaves
     width = 2**max_depth
@@ -345,6 +348,42 @@ def resolve_feature_subset(strategy: str, d: int, is_classification: bool) -> in
     raise ValueError(f"Unsupported featureSubsetStrategy: {strategy}")
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "max_depth",
+        "nbins",
+        "impurity",
+        "k_features",
+        "min_instances",
+        "min_info_gain",
+        "use_pallas",
+        "mesh",  # jax.sharding.Mesh is hashable; static so shard_map can close over it
+    ),
+)
+def build_tree(
+    Xb: jax.Array,
+    values: jax.Array,
+    edges: jax.Array,
+    key: jax.Array,
+    max_depth: int,
+    nbins: int,
+    impurity: str,
+    k_features: int,
+    min_instances: int,
+    min_info_gain: float,
+    use_pallas: bool = False,
+    mesh=None,
+) -> Dict[str, jax.Array]:
+    """Jitted tree growth (see _build_tree_impl). The jitted path NEVER times —
+    the level-timing hooks would record trace time, not device time — so
+    _grow_forest calls _build_tree_impl directly when _LEVEL_TIMING is set."""
+    return _build_tree_impl(
+        Xb, values, edges, key, max_depth, nbins, impurity, k_features,
+        min_instances, min_info_gain, use_pallas, mesh, level_timing=None,
+    )
+
+
 def forest_fit(
     X_host: np.ndarray,
     raw_stats_host: np.ndarray,  # (n, s) unweighted per-row stats (already include sample weight)
@@ -419,7 +458,11 @@ def _grow_forest(
         else:
             w_tree = np.ones((n,), np.float32)
         w_j = jnp.asarray(w_tree) if shard_fn is None else shard_fn(w_tree)
-        tree = build_tree(
+        if _LEVEL_TIMING is not None:
+            build_fn = functools.partial(_build_tree_impl, level_timing=_LEVEL_TIMING)
+        else:
+            build_fn = build_tree
+        tree = build_fn(
             Xb,
             raw_stats * w_j[:, None],
             edges_j,
