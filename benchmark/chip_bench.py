@@ -616,11 +616,66 @@ def bench_umap(ctx) -> Dict:
 
     sub = rng.choice(n, 1500, replace=False)
     tw = _trustworthiness(Xh[sub], emb[sub], 15)
-    return {
+    out = {
         "umap_rows_per_sec_per_chip": round(rate, 1),
         "umap_trustworthiness": round(tw, 4),
         "umap_n": n,
     }
+
+    # SGD epoch marginal rate + a stated ceiling (VERDICT r4 task #8). Both fits
+    # below are WARM: the 100-epoch fit above compiled the kNN/graph pipeline +
+    # optimize_layout(100); the 20-epoch fit gets one untimed warmup so its
+    # optimize_layout(20) compile cannot land asymmetrically in the delta (the
+    # naive-timing trap _timed's warmup-first pattern exists to avoid). Ceiling
+    # model = the segment-sorted epoch's HBM traffic — per edge: head+tail
+    # gathers, neg_samples negative gathers, the [order_t] permutation of the
+    # (E, dim) tail gradients (read+write), two (E,) deg_norm gathers, two
+    # segment-sum passes, plus reading/writing the (n, dim) embedding. E is
+    # estimated at n*k*1.5 (symmetrization dedupes up to half the reverse edges).
+    try:
+        def fit20():
+            return umap_fit(
+                Xh, n_neighbors=15, n_components=2, n_epochs=20, min_dist=0.1,
+                spread=1.0, negative_sample_rate=5, learning_rate=1.0, seed=7,
+                init="random",
+            )
+
+        fit20()  # compile warmup for the 20-epoch optimize_layout
+        t20_0 = time.perf_counter()
+        fit20()
+        t20 = time.perf_counter() - t20_0
+        t100_0 = time.perf_counter()
+        umap_fit(
+            Xh, n_neighbors=15, n_components=2, n_epochs=100, min_dist=0.1,
+            spread=1.0, negative_sample_rate=5, learning_rate=1.0, seed=7,
+            init="random",
+        )
+        t100 = time.perf_counter() - t100_0
+        if t100 - t20 <= 0:
+            # SGD cost is inside timing noise at this shape: no rate claim
+            out["umap_epoch_error"] = "marginal delta <= 0 (noise-dominated)"
+        else:
+            epoch_s = (t100 - t20) / 80
+            out["umap_epochs_per_sec_per_chip"] = round(
+                1.0 / epoch_s / ctx["n_chips"], 2
+            )
+            if ctx["on_tpu"]:
+                dim, neg, k_nn = 2, 5, 15
+                e_est = n * k_nn * 1.5
+                bytes_per_epoch = (
+                    e_est * (2 + neg) * dim * 4  # edge-end + negative gathers
+                    + 2 * e_est * dim * 4  # [order_t] permutation read+write
+                    + 2 * e_est * 4  # deg_norm gathers (heads, tails)
+                    + 2 * e_est * dim * 4  # two segment-sum passes
+                    + 2 * n * dim * 4  # embedding read + write
+                )
+                ceiling_epochs = PEAK_BW / bytes_per_epoch
+                out["umap_epoch_frac_of_ceiling"] = round(
+                    (1.0 / epoch_s) / ceiling_epochs, 3
+                )
+    except Exception as e:
+        out["umap_epoch_error"] = f"{type(e).__name__}: {str(e)[:120]}"
+    return out
 
 
 def _trustworthiness(X: np.ndarray, E: np.ndarray, k: int) -> float:

@@ -319,7 +319,32 @@ def segment_histogram(
             )(seg_ids, values)
         return _local_hist(seg_ids, values)
 
-    def per_feature(seg_j):
-        return jax.ops.segment_sum(values, seg_j, num_segments=n_segments)
+    def all_features(s, v):
+        return jax.vmap(
+            lambda seg_j: jax.ops.segment_sum(v, seg_j, num_segments=n_segments),
+            in_axes=1,
+        )(s)
 
-    return jax.vmap(per_feature, in_axes=1)(seg_ids)
+    # the vmapped scatter's update tensor holds n*d*s elements; past ~2^31 the
+    # XLA CPU scatter thunk overflows its 32-bit element indexing and SEGFAULTS
+    # (observed twice, deterministically, at 2e7 x 64 x 2). Chunk the rows so
+    # each scatter stays far below that — zero-padded tail rows hit segment 0
+    # with zero values, contributing nothing.
+    n, d = seg_ids.shape
+    s_dim = values.shape[1]
+    chunk = max(1, (1 << 28) // max(d * s_dim, 1))
+    if n > chunk:
+        pad = (-n) % chunk
+        seg_p = jnp.pad(seg_ids, ((0, pad), (0, 0)))
+        val_p = jnp.pad(values, ((0, pad), (0, 0)))
+        segs = seg_p.reshape(-1, chunk, d)
+        vals = val_p.reshape(-1, chunk, s_dim)
+
+        def chunk_step(carry, sv):
+            sc, vc = sv
+            return carry + all_features(sc, vc), None
+
+        init = jnp.zeros((d, n_segments, s_dim), values.dtype)
+        out, _ = jax.lax.scan(chunk_step, init, (segs, vals))
+        return out
+    return all_features(seg_ids, values)

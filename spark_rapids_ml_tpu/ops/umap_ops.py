@@ -146,7 +146,23 @@ def optimize_layout(
     gamma: float = 1.0,
 ) -> jax.Array:
     E = heads.shape[0]
-    wsum_per_vertex = jax.ops.segment_sum(weights, heads, num_segments=n_vertices)
+    # SEGMENT-SORTED edge layout (round-5, VERDICT r4 task #8): TPU scatter-add
+    # (`.at[].add`) lowers to a serialized/sort-per-epoch scatter — the slowest
+    # op class on this hardware. Sorting the edge list ONCE by head (and keeping
+    # a head-order→tail-order permutation) turns both per-epoch accumulations
+    # into `segment_sum(indices_are_sorted=True)`, which XLA lowers to dense
+    # scans. Edge order is math-irrelevant (the epoch sums all edge forces), so
+    # results are an equally-valid UMAP run; only float summation order changes.
+    order_h = jnp.argsort(heads)
+    heads = heads[order_h]
+    tails = tails[order_h]
+    weights = weights[order_h]
+    order_t = jnp.argsort(tails)  # canonical(head-sorted) order -> tail-sorted
+    tails_sorted = tails[order_t]
+
+    wsum_per_vertex = jax.ops.segment_sum(
+        weights, heads, num_segments=n_vertices, indices_are_sorted=True
+    )
     deg_norm = 1.0 / jnp.maximum(wsum_per_vertex, 1e-6)
 
     def epoch(e, state):
@@ -173,9 +189,18 @@ def optimize_layout(
         f_rep = jnp.clip(g_rep[..., None] * diff_n, -4.0, 4.0) * weights[:, None, None]
 
         grad_h = f_att + jnp.sum(f_rep, axis=1) / neg_samples
-        upd = jnp.zeros_like(emb)
-        upd = upd.at[heads].add(grad_h * deg_norm[heads][:, None])
-        upd = upd.at[tails].add(-f_att * deg_norm[tails][:, None])
+        upd = jax.ops.segment_sum(
+            grad_h * deg_norm[heads][:, None],
+            heads,
+            num_segments=n_vertices,
+            indices_are_sorted=True,
+        )
+        upd = upd + jax.ops.segment_sum(
+            (-f_att * deg_norm[tails][:, None])[order_t],
+            tails_sorted,
+            num_segments=n_vertices,
+            indices_are_sorted=True,
+        )
         return emb + lr * upd, key
 
     emb, _ = jax.lax.fori_loop(0, n_epochs, epoch, (emb0, key))
@@ -233,9 +258,10 @@ def optimize_transform_layout(
         )
 
         grad_h = f_att + jnp.sum(f_rep, axis=1) / neg_samples
-        upd = jnp.zeros_like(qe).at[heads].add(
-            grad_h * deg_norm[heads][:, None]
-        )
+        # heads = repeat(arange(nq), k) is CONTIGUOUS by construction: the
+        # per-query accumulation is a dense (nq, k, dim) reshape-sum — no
+        # scatter at all (scatter-add is the slowest op class on TPU)
+        upd = jnp.sum(grad_h.reshape(nq, k, -1), axis=1) * deg_norm[:, None]
         return qe + lr * upd, key
 
     qe, _ = jax.lax.fori_loop(0, n_epochs, epoch, (q_emb0, key))
