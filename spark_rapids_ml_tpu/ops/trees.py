@@ -132,12 +132,128 @@ def _histogram(
 
 # Opt-in per-level wall-clock collection: a test/bench sets
 # `ops.trees._LEVEL_TIMING = []` before fitting and reads (level, seconds)
-# tuples back. While set, _grow_forest calls _build_tree_impl eagerly with the
-# collector bound, so the per-level sync measures real device time — the heavy
-# per-level ops (histogram, routing matmuls) are independently jitted, so the
-# eager driver costs only dispatch overhead. The jitted build_tree entry point
-# never times (hooks inside a jit body would record trace time).
+# tuples back. While set, _grow_forest routes through _build_tree_impl, which
+# runs each level as ONE AOT-compiled program (_level_step_jit.lower().compile()
+# outside the timed window) with a sync after it — real device wall-clock,
+# compile excluded, and no full-eager slowdown. The jitted build_tree entry
+# point never times (hooks inside a jit body would record trace time).
 _LEVEL_TIMING: "List | None" = None
+
+
+def _level_step(
+    state,
+    Xb: jax.Array,
+    values: jax.Array,
+    edges: jax.Array,
+    t: int,
+    nbins: int,
+    impurity: str,
+    k_features: int,
+    min_instances: int,
+    min_info_gain: float,
+    use_pallas: bool,
+    mesh,
+):
+    """One tree level (width = 2**t): histogram, split selection, heap writes,
+    row routing, child-stat carry. Pure state -> state so it can run either
+    INLINED inside the jitted build_tree trace (the fast path — identical
+    program to the old unrolled loop) or as its own jitted program per level
+    (timing mode: one compiled dispatch + sync per level measures real device
+    wall-clock without making the whole tree eager — a full-eager 2e7-row level
+    was measured 3-10x slower on the 1-core CPU tier and unusable)."""
+    (feat_arr, thr_arr, leaf_arr, val_arr, gain_arr, wgt_arr, node_id, T, key) = state
+    n, d = Xb.shape
+    s = values.shape[1]
+    width = 2**t
+    hist = _histogram(Xb, values, node_id, width, nbins, use_pallas, mesh)  # (w, d, b, s)
+    cum = jnp.cumsum(hist, axis=2)
+    L = cum[:, :, :-1, :]  # split at bin 0..b-2
+    R = T[:, None, None, :] - L
+
+    wT = _stat_weight(T, impurity)  # (w,)
+    wL = _stat_weight(L, impurity)  # (w, d, b-1)
+    wR = _stat_weight(R, impurity)
+    gain = (
+        _impurity_times_w(T, impurity)[:, None, None]
+        - _impurity_times_w(L, impurity)
+        - _impurity_times_w(R, impurity)
+    ) / jnp.maximum(wT, 1e-12)[:, None, None]
+
+    valid = (wL >= min_instances) & (wR >= min_instances)
+    if k_features < d:
+        key, sub = jax.random.split(key)
+        scores = jax.random.uniform(sub, (width, d))
+        kth = jax.lax.top_k(scores, k_features)[0][:, -1]
+        valid = valid & (scores >= kth[:, None])[:, :, None]
+    gain = jnp.where(valid, gain, -jnp.inf)
+
+    flat = gain.reshape(width, -1)
+    best = jnp.argmax(flat, axis=1)
+    best_gain = jnp.take_along_axis(flat, best[:, None], axis=1)[:, 0]
+    best_feat = (best // (nbins - 1)).astype(jnp.int32)
+    best_bin = (best % (nbins - 1)).astype(jnp.int32)
+
+    is_leaf_t = ~(best_gain > min_info_gain)  # also catches all -inf / NaN
+    slots = width + jnp.arange(width)
+    feat_arr = feat_arr.at[slots].set(jnp.where(is_leaf_t, -1, best_feat))
+    thr_arr = thr_arr.at[slots].set(edges[best_feat, best_bin])
+    leaf_arr = leaf_arr.at[slots].set(is_leaf_t)
+    val_arr = val_arr.at[slots].set(_leaf_value(T, impurity))
+    gain_arr = gain_arr.at[slots].set(
+        jnp.where(is_leaf_t, 0.0, jnp.maximum(best_gain, 0.0))
+    )
+    wgt_arr = wgt_arr.at[slots].set(wT)
+
+    # route rows; leaf rows stay in the left child slot (unreachable at predict).
+    # The naive per-row lane gather (take_along_axis by best_feat[node]) is the
+    # slowest op class on TPU — measured 164 ms/level at 4M x 64, w=256. Two
+    # gather-free formulations (both bit-identical to the gather on hardware):
+    #  - matmul route: G=onehot(node) bf16, picked = rowsum((G @ onehot(feat)) * X)
+    #    (23.8 ms measured) — exact while the per-row one-hot sums and the bin
+    #    ids stay <= 256 (bf16 integer range) and G (n x width) fits HBM;
+    #  - row-gather route: A[node] for A=(width,d) one-hot + mask-sum (77 ms) —
+    #    no (n, width) intermediate, used for deep/wide levels.
+    leaf_f = is_leaf_t.astype(jnp.float32)
+    # n * width bound: G is a materialized (n, width) bf16 array — cap it at
+    # ~2.5 GiB so flagship-scale fits (12M rows) fall back to the row-gather
+    # route at deep levels instead of OOMing HBM
+    if width <= 256 and nbins <= 256 and n * width * 2 <= 2_500_000_000:
+        G = jax.nn.one_hot(node_id, width, dtype=jnp.bfloat16)
+        A = jax.nn.one_hot(best_feat, d, dtype=jnp.bfloat16)
+        picked = jnp.sum(
+            jnp.matmul(G, A).astype(jnp.float32) * Xb.astype(jnp.float32), axis=1
+        )
+        thr_r = jnp.matmul(G, best_bin.astype(jnp.bfloat16)[:, None])[:, 0]
+        leaf_r = jnp.matmul(G, leaf_f.astype(jnp.bfloat16)[:, None])[:, 0] > 0.5
+        go_right = (picked > thr_r.astype(jnp.float32)) & ~leaf_r
+    else:
+        A = jax.nn.one_hot(best_feat, d, dtype=jnp.float32)
+        picked = jnp.sum(A[node_id] * Xb.astype(jnp.float32), axis=1)
+        go_right = (picked > best_bin[node_id].astype(jnp.float32)) & ~(
+            is_leaf_t[node_id]
+        )
+    node_id = node_id * 2 + go_right.astype(jnp.int32)
+
+    # children stats carried from the winning split
+    Lbest = cum[jnp.arange(width), best_feat, best_bin, :]  # (w, s)
+    Rbest = T - Lbest
+    T = jnp.stack([Lbest, Rbest], axis=1).reshape(2 * width, s)
+    return (feat_arr, thr_arr, leaf_arr, val_arr, gain_arr, wgt_arr, node_id, T, key)
+
+
+_level_step_jit = functools.partial(
+    jax.jit,
+    static_argnames=(
+        "t",
+        "nbins",
+        "impurity",
+        "k_features",
+        "min_instances",
+        "min_info_gain",
+        "use_pallas",
+        "mesh",
+    ),
+)(_level_step)
 
 
 def _build_tree_impl(
@@ -162,97 +278,40 @@ def _build_tree_impl(
     n_slots = 2 ** (max_depth + 1)
     v_dim = 1 if impurity == "variance" else s
 
-    feat_arr = jnp.full((n_slots,), -1, jnp.int32)
-    thr_arr = jnp.zeros((n_slots,), jnp.float32)
-    leaf_arr = jnp.zeros((n_slots,), bool)
-    val_arr = jnp.zeros((n_slots, v_dim), jnp.float32)
-    # per-node split gain and weighted row count — the inputs to impurity-based
-    # featureImportances (Spark TreeEnsembleModel semantics)
-    gain_arr = jnp.zeros((n_slots,), jnp.float32)
-    wgt_arr = jnp.zeros((n_slots,), jnp.float32)
+    state = (
+        jnp.full((n_slots,), -1, jnp.int32),  # feature (-1 = leaf)
+        jnp.zeros((n_slots,), jnp.float32),  # threshold
+        jnp.zeros((n_slots,), bool),  # is_leaf
+        jnp.zeros((n_slots, v_dim), jnp.float32),  # value
+        # per-node split gain and weighted row count — the inputs to impurity-
+        # based featureImportances (Spark TreeEnsembleModel semantics)
+        jnp.zeros((n_slots,), jnp.float32),  # gain
+        jnp.zeros((n_slots,), jnp.float32),  # node weight
+        jnp.zeros((n,), jnp.int32),  # node_id
+        jnp.sum(values, axis=0)[None, :],  # (1, s) root stats
+        key,
+    )
 
-    node_id = jnp.zeros((n,), jnp.int32)
-    T = jnp.sum(values, axis=0)[None, :]  # (1, s) root stats
-
+    step_kw = dict(
+        nbins=nbins, impurity=impurity, k_features=k_features,
+        min_instances=min_instances, min_info_gain=min_info_gain,
+        use_pallas=use_pallas, mesh=mesh,
+    )
     for t in range(max_depth):
-        level_t0 = time.perf_counter() if level_timing is not None else None
-        width = 2**t
-        hist = _histogram(Xb, values, node_id, width, nbins, use_pallas, mesh)  # (w, d, b, s)
-        cum = jnp.cumsum(hist, axis=2)
-        L = cum[:, :, :-1, :]  # split at bin 0..b-2
-        R = T[:, None, None, :] - L
-
-        wT = _stat_weight(T, impurity)  # (w,)
-        wL = _stat_weight(L, impurity)  # (w, d, b-1)
-        wR = _stat_weight(R, impurity)
-        gain = (
-            _impurity_times_w(T, impurity)[:, None, None]
-            - _impurity_times_w(L, impurity)
-            - _impurity_times_w(R, impurity)
-        ) / jnp.maximum(wT, 1e-12)[:, None, None]
-
-        valid = (wL >= min_instances) & (wR >= min_instances)
-        if k_features < d:
-            key, sub = jax.random.split(key)
-            scores = jax.random.uniform(sub, (width, d))
-            kth = jax.lax.top_k(scores, k_features)[0][:, -1]
-            valid = valid & (scores >= kth[:, None])[:, :, None]
-        gain = jnp.where(valid, gain, -jnp.inf)
-
-        flat = gain.reshape(width, -1)
-        best = jnp.argmax(flat, axis=1)
-        best_gain = jnp.take_along_axis(flat, best[:, None], axis=1)[:, 0]
-        best_feat = (best // (nbins - 1)).astype(jnp.int32)
-        best_bin = (best % (nbins - 1)).astype(jnp.int32)
-
-        is_leaf_t = ~(best_gain > min_info_gain)  # also catches all -inf / NaN
-        slots = width + jnp.arange(width)
-        feat_arr = feat_arr.at[slots].set(jnp.where(is_leaf_t, -1, best_feat))
-        thr_arr = thr_arr.at[slots].set(edges[best_feat, best_bin])
-        leaf_arr = leaf_arr.at[slots].set(is_leaf_t)
-        val_arr = val_arr.at[slots].set(_leaf_value(T, impurity))
-        gain_arr = gain_arr.at[slots].set(
-            jnp.where(is_leaf_t, 0.0, jnp.maximum(best_gain, 0.0))
-        )
-        wgt_arr = wgt_arr.at[slots].set(wT)
-
-        # route rows; leaf rows stay in the left child slot (unreachable at predict).
-        # The naive per-row lane gather (take_along_axis by best_feat[node]) is the
-        # slowest op class on TPU — measured 164 ms/level at 4M x 64, w=256. Two
-        # gather-free formulations (both bit-identical to the gather on hardware):
-        #  - matmul route: G=onehot(node) bf16, picked = rowsum((G @ onehot(feat)) * X)
-        #    (23.8 ms measured) — exact while the per-row one-hot sums and the bin
-        #    ids stay <= 256 (bf16 integer range) and G (n x width) fits HBM;
-        #  - row-gather route: A[node] for A=(width,d) one-hot + mask-sum (77 ms) —
-        #    no (n, width) intermediate, used for deep/wide levels.
-        leaf_f = is_leaf_t.astype(jnp.float32)
-        # n * width bound: G is a materialized (n, width) bf16 array — cap it at
-        # ~2.5 GiB so flagship-scale fits (12M rows) fall back to the row-gather
-        # route at deep levels instead of OOMing HBM
-        if width <= 256 and nbins <= 256 and n * width * 2 <= 2_500_000_000:
-            G = jax.nn.one_hot(node_id, width, dtype=jnp.bfloat16)
-            A = jax.nn.one_hot(best_feat, d, dtype=jnp.bfloat16)
-            picked = jnp.sum(
-                jnp.matmul(G, A).astype(jnp.float32) * Xb.astype(jnp.float32), axis=1
-            )
-            thr_r = jnp.matmul(G, best_bin.astype(jnp.bfloat16)[:, None])[:, 0]
-            leaf_r = jnp.matmul(G, leaf_f.astype(jnp.bfloat16)[:, None])[:, 0] > 0.5
-            go_right = (picked > thr_r.astype(jnp.float32)) & ~leaf_r
-        else:
-            A = jax.nn.one_hot(best_feat, d, dtype=jnp.float32)
-            picked = jnp.sum(A[node_id] * Xb.astype(jnp.float32), axis=1)
-            go_right = (picked > best_bin[node_id].astype(jnp.float32)) & ~(
-                is_leaf_t[node_id]
-            )
-        node_id = node_id * 2 + go_right.astype(jnp.int32)
-
-        # children stats carried from the winning split
-        Lbest = cum[jnp.arange(width), best_feat, best_bin, :]  # (w, s)
-        Rbest = T - Lbest
-        T = jnp.stack([Lbest, Rbest], axis=1).reshape(2 * width, s)
         if level_timing is not None:
-            T.block_until_ready()  # the sync exists only in timing mode
-            level_timing.append((t, time.perf_counter() - level_t0))
+            # AOT-compile OUTSIDE the timed window, then time the executable:
+            # otherwise each level's first run per process times trace+compile
+            # (seconds of XLA work) instead of device wall-clock
+            exe = _level_step_jit.lower(
+                state, Xb, values, edges, t=t, **step_kw
+            ).compile()
+            t0 = time.perf_counter()
+            state = exe(state, Xb, values, edges)
+            state[7].block_until_ready()  # T — the sync exists only in timing mode
+            level_timing.append((t, time.perf_counter() - t0))
+        else:
+            state = _level_step(state, Xb, values, edges, t, **step_kw)
+    (feat_arr, thr_arr, leaf_arr, val_arr, gain_arr, wgt_arr, node_id, T, key) = state
 
     # deepest level: all leaves
     width = 2**max_depth

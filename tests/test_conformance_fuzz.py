@@ -550,3 +550,89 @@ def test_streamed_random_configs_match_incore(case, n_devices):
         np.testing.assert_array_equal(
             s.get_model_attributes()["feature"], i.get_model_attributes()["feature"]
         )
+
+
+@pytest.mark.parametrize("case", range(6))
+def test_linreg_fused_gram_random_configs(case, n_devices):
+    """The round-5 fused one-read normal-equation path (pallas_xtwx forced on,
+    interpret mode) against the same sklearn Ridge oracle as the XLA path —
+    random shapes, scales, regs, intercept flags."""
+    from sklearn.linear_model import Ridge
+
+    from spark_rapids_ml_tpu import config as srml_config
+    from spark_rapids_ml_tpu.regression import LinearRegression
+
+    rng = _case_rng(5000 + case)
+    n = int(rng.integers(120, 600))
+    d = int(rng.integers(2, 24))
+    reg = float(rng.choice([0.0, 1e-3, 0.5]))
+    fit_intercept = bool(rng.integers(0, 2))
+    scale = rng.uniform(0.1, 8.0, d)
+    X = (rng.normal(size=(n, d)) * scale).astype(np.float32)
+    y = X @ rng.normal(size=d) + rng.normal(0, 0.01, n) + 0.25
+    df = pd.DataFrame({"features": list(X), "label": y.astype(np.float64)})
+
+    srml_config.set("pallas_xtwx", "1")
+    try:
+        model = LinearRegression(
+            regParam=reg, fitIntercept=fit_intercept, standardization=False
+        ).fit(df)
+    finally:
+        srml_config.unset("pallas_xtwx")
+    sk = Ridge(alpha=max(reg, 1e-12) * n, fit_intercept=fit_intercept).fit(
+        X.astype(np.float64), y
+    )
+    np.testing.assert_allclose(
+        np.asarray(model.coefficients), sk.coef_, rtol=2e-2, atol=2e-2
+    )
+    if fit_intercept:
+        assert model.intercept == pytest.approx(sk.intercept_, rel=5e-2, abs=5e-2)
+
+
+@pytest.mark.parametrize("case", range(6))
+def test_pairwise_oocore_random_configs(case, n_devices):
+    """Out-of-core kNN + DBSCAN (round-5 pairwise_streaming) at random
+    shapes/blocks — kNN against the float64 oracle (id parity vs the in-core
+    twin is pinned tie-tolerantly in tests/test_pairwise_streaming.py), DBSCAN
+    label-for-label vs the in-core twin; mesh-sharded tiles on even cases."""
+    from spark_rapids_ml_tpu.ops.dbscan import dbscan_fit_predict
+    from spark_rapids_ml_tpu.ops.pairwise_streaming import (
+        streaming_dbscan_fit_predict,
+        streaming_exact_knn,
+    )
+    import jax.numpy as jnp
+
+    from spark_rapids_ml_tpu.parallel.mesh import get_mesh
+
+    rng = _case_rng(6000 + case)
+    n = int(rng.integers(300, 1500))
+    d = int(rng.integers(4, 16))
+    k_cl = int(rng.integers(2, 5))
+    centers = rng.normal(0, 12, (k_cl, d)).astype(np.float32)
+    X = (centers[rng.integers(0, k_cl, n)] + rng.normal(0, 0.5, (n, d))).astype(
+        np.float32
+    )
+    qb = int(rng.integers(64, 512))
+    ib = int(rng.integers(64, 700))
+    mesh = get_mesh(n_devices) if case % 2 == 0 else None
+
+    k = int(rng.integers(2, 12))
+    d_s, i_s = streaming_exact_knn(
+        X[:50], X, k, query_block=qb, item_block=ib, mesh=mesh
+    )
+    # FAST-precision ties allow swaps; distances must match the oracle
+    dq = np.sqrt(
+        ((X[:50, None].astype(np.float64) - X[None].astype(np.float64)) ** 2).sum(-1)
+    )
+    kth = np.sort(dq, axis=1)[:, k - 1]
+    for r in range(50):
+        assert (dq[r, i_s[r]] <= kth[r] + 1e-3).all()
+
+    eps = 2.0
+    ref_lbl = np.asarray(
+        dbscan_fit_predict(jnp.asarray(X), jnp.ones((n,), bool), eps, 4)
+    )
+    got_lbl = streaming_dbscan_fit_predict(
+        X, eps, 4, query_block=qb, item_block=ib, mesh=mesh
+    )
+    np.testing.assert_array_equal(got_lbl, ref_lbl)
