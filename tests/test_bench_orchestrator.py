@@ -232,3 +232,44 @@ def test_worker_skip_env_and_deadline_skip(bench, tmp_path, monkeypatch):
     assert state["pca"]["status"] == "deadline_skip"
     line = bench._assemble(str(p), 1.0)
     assert "pca" in line["secondary"]["skipped"]
+
+
+@pytest.mark.slow
+def test_worker_subprocess_flushes_progress_incrementally(tmp_path):
+    """Integration: the REAL worker subprocess on the CPU backend must flush
+    boot + per-unit entries to the progress file and honor SRML_BENCH_SKIP.
+    Only the cheap units run (everything else skipped) so this stays minutes-
+    scale on the 1-core CI box."""
+    progress = tmp_path / "prog.jsonl"
+    env = dict(os.environ)
+    env.update(
+        SRML_BENCH_ROLE="worker",
+        SRML_BENCH_PROGRESS=str(progress),
+        # skip everything except pca (the cheapest family)
+        SRML_BENCH_SKIP=",".join(
+            ["kmeans_headline", "logreg", "linreg", "rf", "umap", "dbscan",
+             "fit_e2e", "knn", "ann", "wide256"]
+        ),
+        SRML_BENCH_DEADLINE_TS=str(time.time() + 900),
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=8",
+        PALLAS_AXON_POOL_IPS="",
+    )
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        env=env, timeout=800, capture_output=True,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    spec = importlib.util.spec_from_file_location(
+        "bench_it", os.path.join(REPO, "bench.py")
+    )
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    state = bench._read_progress(str(progress))
+    assert state["boot"]["status"] == "done"
+    assert state["boot"]["platform"] == "cpu"
+    assert state["pca"]["status"] == "done"
+    assert "pca_cov_rows_per_sec_per_chip" in state["pca"]["result"]
+    # skipped units have no entries at all (the worker never starts them)
+    for u in ("kmeans_headline", "rf", "ann"):
+        assert u not in state
