@@ -356,8 +356,9 @@ class ApproximateNearestNeighbors(_ApproxNNClass, _TpuEstimator, _NNParams):
         encoding passes; CAGRA derives its graph from streamed IVF searches.
         Search then pages in only the probed cells for the IVF indexes
         (ApproximateNearestNeighborsModel.kneighbors picks the streamed search
-        when the cells exceed the stream threshold). Cosine routes in-core with
-        a warning (the build would need a normalized copy of the dataset)."""
+        when the cells exceed the stream threshold). Cosine streams too: the
+        builds normalize per batch (no normalized dataset copy except CAGRA,
+        whose graph search needs unit items resident anyway)."""
         from .. import config as _config
         from ..core.dataset import densify as _densify
         from ..ops.ann_streaming import (
@@ -367,15 +368,14 @@ class ApproximateNearestNeighbors(_ApproxNNClass, _TpuEstimator, _NNParams):
         )
 
         algo = self.getOrDefault("algorithm")
-        if self.getOrDefault("metric") == "cosine" or algo not in (
-            "ivfflat", "ivf_flat", "ivfpq", "ivf_pq", "cagra",
-        ):
+        if algo not in ("ivfflat", "ivf_flat", "ivfpq", "ivf_pq", "cagra"):
             self.logger.warning(
-                "streamed ANN covers euclidean ivfflat/ivfpq/cagra; fitting "
-                "in-core despite stream_threshold_bytes."
+                "streamed ANN covers ivfflat/ivfpq/cagra; fitting in-core "
+                "despite stream_threshold_bytes."
             )
             inputs = self._build_fit_inputs(fd)
             return self._get_tpu_fit_func(None)(inputs)
+        cosine = self.getOrDefault("metric") == "cosine"
         algo_params = self.getOrDefault("algoParams") or {}
         nlist = int(_ap(algo_params, "nlist", "n_lists", default=64))
         seed = int(algo_params.get("seed", 42))
@@ -404,6 +404,7 @@ class ApproximateNearestNeighbors(_ApproxNNClass, _TpuEstimator, _NNParams):
                 nlist=int(_ap(algo_params, "nlist", "n_lists", default=0)),
                 seed=seed,
                 batch_rows=batch_rows,
+                cosine=cosine,
             )
         if algo in ("ivfpq", "ivf_pq"):
             return streaming_ivfpq_build(
@@ -414,6 +415,7 @@ class ApproximateNearestNeighbors(_ApproxNNClass, _TpuEstimator, _NNParams):
                 max_iter=20,
                 seed=seed,
                 batch_rows=batch_rows,
+                cosine=cosine,
             )
         return streaming_ivfflat_build(
             X,
@@ -421,6 +423,7 @@ class ApproximateNearestNeighbors(_ApproxNNClass, _TpuEstimator, _NNParams):
             max_iter=20,
             seed=seed,
             batch_rows=batch_rows,
+            cosine=cosine,
         )
 
     def _create_pyspark_model(self, attrs) -> "ApproximateNearestNeighborsModel":

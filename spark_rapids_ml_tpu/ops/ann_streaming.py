@@ -27,6 +27,14 @@ import jax.numpy as jnp
 import numpy as np
 
 
+def _normalize_batch_or_raise(Xb: np.ndarray) -> np.ndarray:
+    """Cosine-tier batch normalization — one shared zero-row contract
+    (ops/knn.py::normalize_rows_or_raise)."""
+    from .knn import normalize_rows_or_raise
+
+    return normalize_rows_or_raise(Xb)
+
+
 def streaming_ivfflat_build(
     X: np.ndarray,
     nlist: int,
@@ -35,16 +43,26 @@ def streaming_ivfflat_build(
     batch_rows: int,
     sample_rows: int = 1 << 18,
     return_assign: bool = False,
+    cosine: bool = False,
 ) -> Dict[str, np.ndarray]:
     """Build the IVF layout with the dataset host-resident: centers from an
     in-core kmeans on a strided subsample (rows are not assumed shuffled), then
     streamed batch assignment. Returns the same dict shape as ops/knn.py::
-    ivfflat_build but with `cells`/`cell_ids` as HOST arrays."""
+    ivfflat_build but with `cells`/`cell_ids` as HOST arrays.
+
+    `cosine=True` builds the index on the UNIT SPHERE without materializing a
+    normalized copy of the dataset: the subsample, each assignment batch, and
+    the cell layout's gather pass normalize on the fly (the in-core path
+    instead normalizes the whole device array up front,
+    models/knn.py::_normalize_or_raise). Queries must be normalized at search,
+    which the model layer already does for cosine."""
     from .kmeans import kmeans_fit, kmeans_predict
 
     n, d = X.shape
     step = max(1, n // min(n, sample_rows))
     Xs = np.ascontiguousarray(X[::step], dtype=np.float32)
+    if cosine:
+        Xs = _normalize_batch_or_raise(Xs)
     # the coarse kmeans trains on the SUBSAMPLE: k must fit it, not just n
     nlist = min(nlist, len(Xs))
     fitted = kmeans_fit(
@@ -58,17 +76,16 @@ def streaming_ivfflat_build(
     assign = np.empty((n,), np.int32)
     for s in range(0, n, batch_rows):
         e = min(s + batch_rows, n)
-        assign[s:e] = np.asarray(
-            kmeans_predict(
-                jnp.asarray(np.ascontiguousarray(X[s:e], dtype=np.float32)),
-                centers_j,
-            )
-        )
+        Xb = np.ascontiguousarray(X[s:e], dtype=np.float32)
+        if cosine:
+            Xb = _normalize_batch_or_raise(Xb)
+        assign[s:e] = np.asarray(kmeans_predict(jnp.asarray(Xb), centers_j))
 
     from .knn import layout_cells
 
     cells, cell_ids, cell_sizes = layout_cells(
-        np.asarray(X, dtype=np.float32), assign, nlist
+        np.asarray(X, dtype=np.float32), assign, nlist,
+        normalize=cosine,
     )
     out = {
         "centers": centers,
@@ -90,6 +107,7 @@ def streaming_ivfpq_build(
     seed: int,
     batch_rows: int,
     sample_rows: int = 1 << 18,
+    cosine: bool = False,
 ) -> Dict[str, np.ndarray]:
     """Out-of-core IVF-PQ build (cuVS ivf_pq role, reference knn.py:1510-1524,
     under the managed-memory tier utils.py:184-241): coarse cells via the
@@ -107,7 +125,8 @@ def streaming_ivfpq_build(
     sub_d = d // m_subvectors
     n_codes = 2**n_bits
     flat = streaming_ivfflat_build(
-        X, nlist, max_iter, seed, batch_rows, sample_rows, return_assign=True
+        X, nlist, max_iter, seed, batch_rows, sample_rows, return_assign=True,
+        cosine=cosine,
     )
     coarse = np.asarray(flat["centers"])
     assign = flat.pop("assign")
@@ -117,9 +136,10 @@ def streaming_ivfpq_build(
     # in detail but the recall/quality contract is preserved (tested)
     step = max(1, n // min(n, sample_rows))
     sub_idx = np.arange(0, n, step)
-    resid_s = (
-        np.ascontiguousarray(X[sub_idx], np.float32) - coarse[assign[sub_idx]]
-    )
+    X_sub = np.ascontiguousarray(X[sub_idx], np.float32)
+    if cosine:
+        X_sub = _normalize_batch_or_raise(X_sub)
+    resid_s = X_sub - coarse[assign[sub_idx]]
     wv = jnp.ones((len(sub_idx),), jnp.float32)
     codebooks = np.zeros((m_subvectors, n_codes, sub_d), np.float32)
     for m_i in range(m_subvectors):
@@ -140,9 +160,10 @@ def streaming_ivfpq_build(
     codes_flat = np.zeros((n, m_subvectors), np.uint8)
     for s in range(0, n, batch_rows):
         e = min(s + batch_rows, n)
-        resid_b = jnp.asarray(
-            np.ascontiguousarray(X[s:e], np.float32) - coarse[assign[s:e]]
-        )
+        Xb_enc = np.ascontiguousarray(X[s:e], np.float32)
+        if cosine:
+            Xb_enc = _normalize_batch_or_raise(Xb_enc)
+        resid_b = jnp.asarray(Xb_enc - coarse[assign[s:e]])
         for m_i in range(m_subvectors):
             codes_flat[s:e, m_i] = np.asarray(
                 kmeans_predict(
@@ -172,6 +193,7 @@ def streaming_cagra_build(
     seed: int = 42,
     batch_rows: int = 1 << 16,
     sample_rows: int = 1 << 18,
+    cosine: bool = False,
 ) -> Dict[str, np.ndarray]:
     """Out-of-core CAGRA-class graph build (cuVS cagra role, reference
     knn.py:1538-1690): the fixed-degree kNN graph comes from STREAMED IVF
@@ -183,6 +205,11 @@ def streaming_cagra_build(
     from .knn import _optimize_graph_reverse_edges
 
     X = np.ascontiguousarray(np.asarray(X), dtype=np.float32)
+    if cosine:
+        # the graph AND the returned items must live on the unit sphere (the
+        # searcher walks euclidean distances over them) — one normalized copy,
+        # exactly what the in-core estimator materializes before cagra_build
+        X = _normalize_batch_or_raise(X)
     n = X.shape[0]
     deg = min(graph_degree, max(n - 1, 1))
     if nlist <= 0:

@@ -148,18 +148,34 @@ def ivfflat_build(
     return out
 
 
+def normalize_rows_or_raise(Xb: np.ndarray) -> np.ndarray:
+    """Host-side row normalization for the cosine tier; zero-norm rows raise
+    (Spark/cuML cosine semantics). THE single definition of the zero-row
+    contract for host arrays — layout_cells and the streamed ANN builds
+    (ops/ann_streaming.py) all route through it."""
+    norms = np.linalg.norm(Xb, axis=1, keepdims=True)
+    if len(norms) and float(norms.min()) <= 0.0:
+        raise ValueError(
+            "Cosine distance is not defined for zero-length vectors; the input "
+            "contains an all-zero feature row."
+        )
+    return (Xb / np.maximum(norms, 1e-30)).astype(np.float32)
+
+
 def layout_cells(
     Xh: np.ndarray,
     assign: np.ndarray,
     nlist: int,
     valid: "np.ndarray | None" = None,
+    normalize: bool = False,
 ):
     """Dense (nlist, max_cell, d) cell layout with -1 id sentinels — shared by the
     in-core and streamed (ops/ann_streaming.py) IVF builds so the sentinel/offset
     conventions the probe scans depend on cannot diverge. Vectorized: stable-sort
     rows by cell, then each row's slot is its sorted position minus the cell's
     start offset (the former per-row Python loop was O(n) interpreted —
-    disqualifying at 10M items)."""
+    disqualifying at 10M items). `normalize=True` writes unit rows (the cosine
+    tier) into the gather temp that already exists — no extra dataset copy."""
     n, d = Xh.shape
     valid_idx = np.arange(n) if valid is None else np.nonzero(valid)[0]
     cell_sizes = np.bincount(assign[valid_idx], minlength=nlist)
@@ -172,7 +188,10 @@ def layout_cells(
     within = np.arange(len(sorted_rows)) - np.repeat(
         np.concatenate([[0], np.cumsum(cell_sizes)[:-1]]), cell_sizes
     )
-    cells[sorted_cells, within] = Xh[sorted_rows]
+    gathered = Xh[sorted_rows]
+    if normalize:
+        gathered = normalize_rows_or_raise(gathered)
+    cells[sorted_cells, within] = gathered
     cell_ids[sorted_cells, within] = sorted_rows
     return cells, cell_ids, cell_sizes.astype(np.int32)
 

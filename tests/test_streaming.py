@@ -465,7 +465,7 @@ def test_streaming_cagra_build_recall_parity(n_devices):
 
 @pytest.mark.parametrize("algo,params", [
     ("ivfpq", {"nlist": 16, "nprobe": 8, "M": 4, "n_bits": 6}),
-    ("cagra", {"graph_degree": 16, "itopk": 64}),
+    ("cagra", {"graph_degree": 16, "itopk_size": 64}),
 ])
 def test_streaming_ann_estimator_pq_cagra(n_devices, tiny_stream_threshold, algo, params):
     """ANN estimator above the stream threshold for the two newly-streamed
@@ -519,3 +519,48 @@ def test_streaming_pq_refine_matches_incore(n_devices):
     )
     np.testing.assert_array_equal(i_hp, np.asarray(i_dev))
     np.testing.assert_allclose(d_hp, np.asarray(d_dev), rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("algo,params", [
+    ("ivfflat", {"nlist": 16, "nprobe": 16}),
+    ("ivfpq", {"nlist": 16, "nprobe": 16, "M": 4, "n_bits": 6}),
+    ("cagra", {"graph_degree": 16, "itopk_size": 64}),
+])
+def test_streaming_ann_cosine(n_devices, tiny_stream_threshold, algo, params):
+    """Cosine metric through the STREAMED builds (round-5: per-batch
+    normalization instead of a normalized dataset copy): recall@8 against the
+    exact cosine neighbors must stay high, matching the in-core cosine
+    contract (reference knn.py metric translation)."""
+    from spark_rapids_ml_tpu.knn import ApproximateNearestNeighbors
+
+    rng = np.random.default_rng(61)
+    X = rng.normal(size=(1500, 12)).astype(np.float32) + 0.5
+    df = pd.DataFrame({"features": list(X), "id": np.arange(1500)})
+    est = ApproximateNearestNeighbors(
+        k=8, algorithm=algo, algoParams=params, metric="cosine",
+        inputCol="features", idCol="id",
+    )
+    model = est.fit(df)
+    _, _, knn_df = model.kneighbors(
+        pd.DataFrame({"features": list(X[:40]), "id": np.arange(40)})
+    )
+    got = np.stack(knn_df["indices"].to_numpy())
+    Xn = X / np.linalg.norm(X, axis=1, keepdims=True)
+    cos_d = 1.0 - Xn[:40] @ Xn.T
+    exact = np.argsort(cos_d, axis=1)[:, :8]
+    recall = np.mean([len(set(got[i]) & set(exact[i])) / 8.0 for i in range(40)])
+    assert recall > 0.7, (algo, recall)
+
+
+def test_streaming_ann_cosine_zero_row_raises(n_devices, tiny_stream_threshold):
+    from spark_rapids_ml_tpu.knn import ApproximateNearestNeighbors
+
+    rng = np.random.default_rng(67)
+    X = rng.normal(size=(400, 8)).astype(np.float32)
+    X[7] = 0.0
+    df = pd.DataFrame({"features": list(X), "id": np.arange(400)})
+    with pytest.raises(ValueError, match="zero-length"):
+        ApproximateNearestNeighbors(
+            k=4, algorithm="ivfflat", metric="cosine",
+            inputCol="features", idCol="id",
+        ).fit(df)
