@@ -5,6 +5,12 @@ image ships no third-party linters, so the gate is stdlib-only but real:
   * syntax: every file must compile (py_compile)
   * AST checks: unused imports, bare `except:`, mutable default arguments,
     `__all__` names that don't resolve, tabs in indentation
+  * silent exception swallowing: a BROAD handler (`except:` / `except
+    Exception:` / `except BaseException:`) whose body is only `pass`/`...`
+    hides failures the reliability subsystem is supposed to surface — it must
+    at least log. Narrow typed catches (`except StopIteration: pass`) stay
+    legal control flow; the reliability module itself (which implements the
+    handling) and `# noqa: silent-except` lines are exempt.
 
 Exit code 1 on any finding; CI runs this before the test tiers (ci/test.sh).
 """
@@ -21,6 +27,35 @@ TARGETS = ["spark_rapids_ml_tpu", "benchmark", "tests", "bench.py", "__graft_ent
 
 # modules where dynamic re-export makes unused-import analysis meaningless
 UNUSED_IMPORT_EXEMPT = {"__init__.py"}
+
+# the module that IMPLEMENTS exception handling policy is exempt from the
+# silent-swallow check (it must classify and rethrow freely)
+SILENT_SWALLOW_EXEMPT_PARTS = ("reliability",)
+
+_BROAD_EXC_NAMES = {"Exception", "BaseException"}
+
+
+def _is_broad_catch(type_node) -> bool:
+    """True for `except:`, `except Exception:`, `except BaseException:` and
+    tuples containing one of those."""
+    if type_node is None:
+        return True
+    if isinstance(type_node, ast.Name):
+        return type_node.id in _BROAD_EXC_NAMES
+    if isinstance(type_node, ast.Tuple):
+        return any(_is_broad_catch(elt) for elt in type_node.elts)
+    return False
+
+
+def _is_silent_body(body) -> bool:
+    """Handler body that cannot possibly record the failure: only pass/..."""
+    for stmt in body:
+        if isinstance(stmt, ast.Pass):
+            continue
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Constant):
+            continue  # docstring or `...`
+        return False
+    return True
 
 
 def iter_files():
@@ -62,8 +97,28 @@ def check_file(path: Path) -> list:
                 if name == "*":
                     continue
                 imports.setdefault(name, node.lineno)
-        elif isinstance(node, ast.ExceptHandler) and node.type is None:
-            findings.append(f"{path}:{node.lineno}: bare `except:` (catch Exception)")
+        elif isinstance(node, ast.ExceptHandler):
+            if node.type is None:
+                findings.append(
+                    f"{path}:{node.lineno}: bare `except:` (catch Exception)"
+                )
+            if (
+                node.type is not None  # bare except already reported above
+                and _is_broad_catch(node.type)
+                and _is_silent_body(node.body)
+                and not any(part in SILENT_SWALLOW_EXEMPT_PARTS for part in path.parts)
+            ):
+                src_lines = src.splitlines()
+                line = (
+                    src_lines[node.lineno - 1]
+                    if node.lineno - 1 < len(src_lines)
+                    else ""
+                )
+                if "noqa" not in line:
+                    findings.append(
+                        f"{path}:{node.lineno}: silent exception swallowing "
+                        "(broad `except ...: pass` with no logging)"
+                    )
         elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             for default in list(node.args.defaults) + [
                 d for d in node.args.kw_defaults if d is not None

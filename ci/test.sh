@@ -34,7 +34,11 @@ if [ "$MODE" = "nightly" ]; then
     exit 1
   fi
 else
-  python -m pytest tests/ -q
+  # reliability tier first: fault injection at every named site (streamed-fit
+  # checkpoint-resume, barrier retry/degrade) must be green before the full
+  # matrix runs — a broken failure path fails fast here
+  python -m pytest tests/test_reliability.py -q
+  python -m pytest tests/ -q --ignore=tests/test_reliability.py
 fi
 
 # small benchmark smoke (reference runs a small bench pre-merge)

@@ -54,6 +54,18 @@ _DEFAULTS: Dict[str, Any] = {
     # force XLA, "1" = skip the platform check (tests — runs the kernel's
     # interpreter off-TPU)
     "pallas_xtwx": "auto",
+    # reliability subsystem (reliability/): retry/backoff policy, deterministic
+    # fault injection, streamed-fit checkpoint-resume, and the
+    # barrier->collect->CPU degradation ladder (docs/design.md "Reliability")
+    "reliability.enabled": True,
+    "reliability.max_attempts": 3,          # total attempts per retried unit
+    "reliability.backoff_base_s": 0.05,     # exponential backoff base
+    "reliability.backoff_max_s": 2.0,       # backoff cap
+    "reliability.backoff_jitter": 0.1,      # +/- jitter/2, deterministic (hashed)
+    "reliability.deadline_s": None,         # per-stage wall-clock deadline
+    "reliability.checkpoint_batches": 16,   # streamed-fit snapshot cadence
+    "reliability.fault_spec": "",           # fault grammar, reliability/faults.py
+    "reliability.degrade_to_collect": True, # barrier fit failure -> collect mode
 }
 
 _ENV_KEYS: Dict[str, str] = {
@@ -68,6 +80,15 @@ _ENV_KEYS: Dict[str, str] = {
     "fast_math": "SRML_TPU_FAST_MATH",
     "parity_precision": "SRML_TPU_PARITY_PRECISION",
     "pallas_xtwx": "SRML_TPU_PALLAS_XTWX",
+    "reliability.enabled": "SRML_TPU_RELIABILITY_ENABLED",
+    "reliability.max_attempts": "SRML_TPU_MAX_ATTEMPTS",
+    "reliability.backoff_base_s": "SRML_TPU_BACKOFF_BASE_S",
+    "reliability.backoff_max_s": "SRML_TPU_BACKOFF_MAX_S",
+    "reliability.backoff_jitter": "SRML_TPU_BACKOFF_JITTER",
+    "reliability.deadline_s": "SRML_TPU_DEADLINE_S",
+    "reliability.checkpoint_batches": "SRML_TPU_CHECKPOINT_BATCHES",
+    "reliability.fault_spec": "SRML_TPU_FAULT_SPEC",
+    "reliability.degrade_to_collect": "SRML_TPU_DEGRADE_TO_COLLECT",
 }
 
 _overrides: Dict[str, Any] = {}
@@ -77,8 +98,10 @@ def _coerce(key: str, raw: str) -> Any:
     default = _DEFAULTS[key]
     if isinstance(default, bool) or key in ("fallback.enabled", "float32_inputs", "verbose"):
         return raw.strip().lower() in ("1", "true", "yes", "on")
-    if key in ("num_workers", "stream_threshold_bytes", "stream_batch_rows"):
+    if isinstance(default, int) or key == "num_workers":
         return int(raw)
+    if isinstance(default, float) or key == "reliability.deadline_s":
+        return float(raw)
     return raw
 
 

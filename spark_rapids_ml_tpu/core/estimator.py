@@ -447,10 +447,67 @@ class _TpuEstimator(_TpuCaller):
         if self._use_cpu_fallback():
             return self._fallback_fit(dataset)
         if self._spark_fit_wanted(dataset):
+            from .. import config as _config
+            from .. import profiling
             from ..spark.integration import fit_on_spark
 
-            return fit_on_spark(self, dataset, num_hosts=self.num_workers)
-        return self._fit_internal(dataset, None)[0]
+            try:
+                return fit_on_spark(self, dataset, num_hosts=self.num_workers)
+            except Exception as e:
+                # degradation ladder rung 1: the barrier stage already retried
+                # inside fit_on_spark; a still-failing barrier plane degrades to
+                # collect mode (driver materialization) instead of aborting —
+                # slower, never wrong (both planes run the same fit program).
+                # Only stage-class failures degrade: param/programming errors
+                # (ValueError-class) would fail identically in collect mode and
+                # must surface as themselves, not as a mode switch.
+                from ..reliability import is_stage_retryable
+
+                if not (
+                    is_stage_retryable(e)
+                    and bool(_config.get("reliability.enabled"))
+                    and bool(_config.get("reliability.degrade_to_collect"))
+                ):
+                    raise
+                profiling.count("reliability.degrade.barrier_to_collect")
+                self.logger.warning(
+                    "barrier fit plane failed (%s: %s); degrading to collect "
+                    "mode for this fit",
+                    type(e).__name__,
+                    e,
+                )
+        return self._fit_device_or_cpu(dataset)
+
+    def _fit_device_or_cpu(self, dataset: Any) -> "_TpuModel":
+        """Last rungs of the degradation ladder: run the local (or collect-mode)
+        device fit; an UNRECOVERABLE device error — never retried, see
+        reliability.faults.is_device_error — routes into the existing
+        fallback.enabled CPU path instead of raising."""
+        from .. import config as _config
+        from .. import profiling
+        from ..reliability import is_device_error
+
+        try:
+            return self._fit_internal(dataset, None)[0]
+        except Exception as e:
+            if not (
+                is_device_error(e)
+                and bool(_config.get("reliability.enabled"))
+                and self._fallback_enabled
+                and self._fallback_class() is not None
+            ):
+                raise
+            profiling.count("reliability.degrade.device_to_cpu")
+            self.logger.warning(
+                "unrecoverable device error (%s: %s); degrading to the CPU "
+                "fallback path (config fallback.enabled)",
+                type(e).__name__,
+                e,
+            )
+            try:
+                return self._fallback_fit(dataset)
+            except NotImplementedError:
+                raise e from None
 
     def _spark_fit_wanted(self, dataset: Any) -> bool:
         """Whether a Spark-DataFrame fit should fan out as barrier tasks

@@ -26,6 +26,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..reliability import RetryPolicy, fault_point
+
 
 def _normalize_batch_or_raise(Xb: np.ndarray) -> np.ndarray:
     """Cosine-tier batch normalization — one shared zero-row contract
@@ -73,13 +75,21 @@ def streaming_ivfflat_build(
     centers = fitted["cluster_centers"]
     centers_j = jnp.asarray(centers)
 
+    # per-batch retry: each batch writes only assign[s:e] (idempotent), so a
+    # transient fault re-runs just that batch — results are unchanged
+    policy = RetryPolicy.from_config()
     assign = np.empty((n,), np.int32)
-    for s in range(0, n, batch_rows):
+    for bi, s in enumerate(range(0, n, batch_rows)):
         e = min(s + batch_rows, n)
-        Xb = np.ascontiguousarray(X[s:e], dtype=np.float32)
-        if cosine:
-            Xb = _normalize_batch_or_raise(Xb)
-        assign[s:e] = np.asarray(kmeans_predict(jnp.asarray(Xb), centers_j))
+
+        def _assign_batch(s=s, e=e, bi=bi):
+            fault_point("ann_assign", batch=bi)
+            Xb = np.ascontiguousarray(X[s:e], dtype=np.float32)
+            if cosine:
+                Xb = _normalize_batch_or_raise(Xb)
+            assign[s:e] = np.asarray(kmeans_predict(jnp.asarray(Xb), centers_j))
+
+        policy.run(_assign_batch, site="ann_assign")
 
     from .knn import layout_cells
 
@@ -155,21 +165,28 @@ def streaming_ivfpq_build(
             cb[k_eff:] = 1e18  # unused codes: unreachable
         codebooks[m_i] = cb
 
-    # streamed encoding passes: one batch upload covers all m sub-encodings
+    # streamed encoding passes: one batch upload covers all m sub-encodings;
+    # per-batch retry as in the assignment loop (idempotent batch writes)
+    policy = RetryPolicy.from_config()
     cb_j = [jnp.asarray(codebooks[m_i]) for m_i in range(m_subvectors)]
     codes_flat = np.zeros((n, m_subvectors), np.uint8)
-    for s in range(0, n, batch_rows):
+    for bi, s in enumerate(range(0, n, batch_rows)):
         e = min(s + batch_rows, n)
-        Xb_enc = np.ascontiguousarray(X[s:e], np.float32)
-        if cosine:
-            Xb_enc = _normalize_batch_or_raise(Xb_enc)
-        resid_b = jnp.asarray(Xb_enc - coarse[assign[s:e]])
-        for m_i in range(m_subvectors):
-            codes_flat[s:e, m_i] = np.asarray(
-                kmeans_predict(
-                    resid_b[:, m_i * sub_d : (m_i + 1) * sub_d], cb_j[m_i]
-                )
-            ).astype(np.uint8)
+
+        def _encode_batch(s=s, e=e, bi=bi):
+            fault_point("ann_encode", batch=bi)
+            Xb_enc = np.ascontiguousarray(X[s:e], np.float32)
+            if cosine:
+                Xb_enc = _normalize_batch_or_raise(Xb_enc)
+            resid_b = jnp.asarray(Xb_enc - coarse[assign[s:e]])
+            for m_i in range(m_subvectors):
+                codes_flat[s:e, m_i] = np.asarray(
+                    kmeans_predict(
+                        resid_b[:, m_i * sub_d : (m_i + 1) * sub_d], cb_j[m_i]
+                    )
+                ).astype(np.uint8)
+
+        policy.run(_encode_batch, site="ann_encode")
 
     cell_ids = flat["cell_ids"]
     max_cell = cell_ids.shape[1]
@@ -285,16 +302,23 @@ def streaming_ivfflat_search(
 
     out_d = np.full((nq, k_eff), np.inf, np.float32)
     out_i = np.full((nq, k_eff), -1, np.int64)
-    for s in range(0, nq, block):
+    policy = RetryPolicy.from_config()
+    for bi, s in enumerate(range(0, nq, block)):
         e = min(s + block, nq)
-        qb = jnp.asarray(np.ascontiguousarray(Q[s:e], dtype=np.float32))
-        probe = np.asarray(_probe_cells(qb, centers_j, nprobe))  # (bq, nprobe)
-        # the host gather IS the out-of-core page-in
-        probed_items = jnp.asarray(cells[probe])
-        probed_ids = jnp.asarray(cell_ids[probe])
-        dists, ids = _scan_probed(qb, probed_items, probed_ids, k_eff)
-        out_d[s:e] = np.asarray(dists)
-        out_i[s:e] = np.asarray(ids)
+
+        def _search_block(s=s, e=e, bi=bi):
+            fault_point("ann_search", batch=bi)
+            qb = jnp.asarray(np.ascontiguousarray(Q[s:e], dtype=np.float32))
+            probe = np.asarray(_probe_cells(qb, centers_j, nprobe))  # (bq, nprobe)
+            # the host gather IS the out-of-core page-in
+            probed_items = jnp.asarray(cells[probe])
+            probed_ids = jnp.asarray(cell_ids[probe])
+            dists, ids = _scan_probed(qb, probed_items, probed_ids, k_eff)
+            out_d[s:e] = np.asarray(dists)
+            out_i[s:e] = np.asarray(ids)
+
+        # per-block retry: each block only writes out_d/out_i[s:e] (idempotent)
+        policy.run(_search_block, site="ann_search")
     return out_d, out_i
 
 
@@ -327,15 +351,23 @@ def streaming_pq_refine(
     out_i = np.empty((nq, k_eff), np.int64)
     cand_pos = np.maximum(np.asarray(cand_ids_flat), 0)
     cand_ids = np.asarray(cand_item_ids)
-    for s in range(0, nq, block):
+    policy = RetryPolicy.from_config()
+    for bi, s in enumerate(range(0, nq, block)):
         e = min(s + block, nq)
-        vecs = jnp.asarray(flat[cand_pos[s:e]])  # the host page-in
-        d_b, i_b = _refine_exact_tile(
-            jnp.asarray(np.ascontiguousarray(Q[s:e], np.float32)),
-            vecs,
-            jnp.asarray(cand_ids[s:e]),
-            k_eff,
-        )
-        out_d[s:e] = np.asarray(d_b)
-        out_i[s:e] = np.asarray(i_b)
+
+        def _refine_block(s=s, e=e, bi=bi):
+            fault_point("ann_search", batch=bi)
+            vecs = jnp.asarray(flat[cand_pos[s:e]])  # the host page-in
+            d_b, i_b = _refine_exact_tile(
+                jnp.asarray(np.ascontiguousarray(Q[s:e], np.float32)),
+                vecs,
+                jnp.asarray(cand_ids[s:e]),
+                k_eff,
+            )
+            out_d[s:e] = np.asarray(d_b)
+            out_i[s:e] = np.asarray(i_b)
+
+        # per-block retry (idempotent out_d/out_i[s:e] writes), same site as
+        # the paged IVF search — both are search-phase page-ins
+        policy.run(_refine_block, site="ann_search")
     return out_d, out_i

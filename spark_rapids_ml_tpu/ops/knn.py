@@ -28,7 +28,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from ..utils.jax_compat import pvary, shard_map
 
 from ._precision import FAST
 from ..parallel.mesh import DATA_AXIS
@@ -626,8 +626,8 @@ def exact_knn_ring(
         init = (
             x_local,
             valid_local,
-            jax.lax.pvary(jnp.full((nq_local, k_eff), jnp.inf, q_local.dtype), (DATA_AXIS,)),
-            jax.lax.pvary(jnp.full((nq_local, k_eff), -1, jnp.int32), (DATA_AXIS,)),
+            pvary(jnp.full((nq_local, k_eff), jnp.inf, q_local.dtype), (DATA_AXIS,)),
+            pvary(jnp.full((nq_local, k_eff), -1, jnp.int32), (DATA_AXIS,)),
         )
         _, _, best_d2, best_idx = jax.lax.fori_loop(0, n_dev, hop, init)
         return best_d2, best_idx

@@ -36,9 +36,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from jax import shard_map
+from ..utils.jax_compat import shard_map
 from jax.sharding import PartitionSpec as P
 
+from ..reliability import RetryPolicy, fault_point
 from .knn import _block_sq_dists
 from .streaming import _prefetch
 
@@ -57,6 +58,7 @@ def _shard_blocks(X: np.ndarray, block: int, mesh, extras=None):
     def gen():
         for s in range(0, n, block):
             e = min(s + block, n)
+            fault_point("pairwise", batch=s // block)
             xb = np.zeros((block,) + X.shape[1:], np.float32)
             xb[: e - s] = X[s:e]
             devs = [shard_array(xb, mesh)]
@@ -66,7 +68,7 @@ def _shard_blocks(X: np.ndarray, block: int, mesh, extras=None):
                 devs.append(shard_array(ab, mesh))
             yield (s, e - s, *devs)
 
-    return _prefetch(gen(), depth=1)
+    return _prefetch(gen(), depth=1, site="pairwise")
 
 
 @functools.lru_cache(maxsize=8)
@@ -174,6 +176,7 @@ def _device_blocks(X: np.ndarray, block: int, extras=None):
     def gen():
         for s in range(0, n, block):
             e = min(s + block, n)
+            fault_point("pairwise", batch=s // block)
             xb = np.zeros((block,) + X.shape[1:], np.float32)
             xb[: e - s] = X[s:e]
             devs = [jax.device_put(jnp.asarray(xb))]
@@ -183,7 +186,7 @@ def _device_blocks(X: np.ndarray, block: int, extras=None):
                 devs.append(jax.device_put(jnp.asarray(ab)))
             yield (s, e - s, *devs)
 
-    return _prefetch(gen(), depth=1)
+    return _prefetch(gen(), depth=1, site="pairwise")
 
 
 @functools.partial(jax.jit, static_argnames=("k",))
@@ -237,15 +240,22 @@ def streaming_exact_knn(
 
     out_d = np.empty((nq, k_eff), np.float32)
     out_i = np.empty((nq, k_eff), np.int64)
+    policy = RetryPolicy.from_config()
     for qs in range(0, nq, query_block):
         qe = min(qs + query_block, nq)
-        qb = jnp.asarray(np.ascontiguousarray(Q[qs:qe], np.float32))
-        best_d = jnp.full((qe - qs, k_eff), jnp.inf, jnp.float32)
-        best_i = jnp.full((qe - qs, k_eff), -1, jnp.int32)
-        for s, nv, xb in blocks():
-            best_d, best_i = merge(qb, xb, nv, s, best_d, best_i)
-        out_d[qs:qe] = np.sqrt(np.asarray(best_d))
-        out_i[qs:qe] = np.asarray(best_i).astype(np.int64)
+
+        def _scan_query_block(qs=qs, qe=qe):
+            # running state re-initializes per attempt, so a transient tile
+            # failure replays this query block exactly (deterministic merge)
+            qb = jnp.asarray(np.ascontiguousarray(Q[qs:qe], np.float32))
+            best_d = jnp.full((qe - qs, k_eff), jnp.inf, jnp.float32)
+            best_i = jnp.full((qe - qs, k_eff), -1, jnp.int32)
+            for s, nv, xb in blocks():
+                best_d, best_i = merge(qb, xb, nv, s, best_d, best_i)
+            out_d[qs:qe] = np.sqrt(np.asarray(best_d))
+            out_i[qs:qe] = np.asarray(best_i).astype(np.int64)
+
+        policy.run(_scan_query_block, site="pairwise")
     return out_d, out_i
 
 
@@ -293,13 +303,18 @@ def _streamed_min_core_labels(
             return _device_blocks(X, item_block, extras=[labels, core])
 
     mins = np.full((n,), _I32MAX, np.int32)
+    policy = RetryPolicy.from_config()
     for qs in range(0, n, query_block):
         qe = min(qs + query_block, n)
-        qb = jnp.asarray(np.ascontiguousarray(X[qs:qe], np.float32))
-        acc = jnp.full((qe - qs,), _I32MAX, jnp.int32)
-        for s, nv, xb, lb, cb in blocks():
-            acc = jnp.minimum(acc, tile(qb, xb, lb, cb, nv))
-        mins[qs:qe] = np.asarray(acc)
+
+        def _minlabel_query_block(qs=qs, qe=qe):
+            qb = jnp.asarray(np.ascontiguousarray(X[qs:qe], np.float32))
+            acc = jnp.full((qe - qs,), _I32MAX, jnp.int32)
+            for s, nv, xb, lb, cb in blocks():
+                acc = jnp.minimum(acc, tile(qb, xb, lb, cb, nv))
+            mins[qs:qe] = np.asarray(acc)
+
+        policy.run(_minlabel_query_block, site="pairwise")
     return mins
 
 
@@ -355,13 +370,18 @@ def streaming_dbscan_fit_predict(
 
     # pass 1: streamed core mask
     core = np.empty((n,), bool)
+    policy = RetryPolicy.from_config()
     for qs in range(0, n, query_block):
         qe = min(qs + query_block, n)
-        qb = jnp.asarray(np.ascontiguousarray(X[qs:qe], np.float32))
-        acc = jnp.zeros((qe - qs,), jnp.int32)
-        for s, nv, xb in count_blocks():
-            acc = acc + count_tile(qb, xb, nv)
-        core[qs:qe] = np.asarray(acc) >= int(min_samples)
+
+        def _core_query_block(qs=qs, qe=qe):
+            qb = jnp.asarray(np.ascontiguousarray(X[qs:qe], np.float32))
+            acc = jnp.zeros((qe - qs,), jnp.int32)
+            for s, nv, xb in count_blocks():
+                acc = acc + count_tile(qb, xb, nv)
+            core[qs:qe] = np.asarray(acc) >= int(min_samples)
+
+        policy.run(_core_query_block, site="pairwise")
 
     # min-label propagation with host-side hook + pointer jumping
     labels = np.arange(n, dtype=np.int32)

@@ -336,7 +336,7 @@ def _fit_fn(
     from ._precision import pdot
 
     if mesh is not None and mesh.devices.size > 1:
-        from jax import shard_map
+        from ..utils.jax_compat import shard_map
 
         @functools.partial(
             shard_map,

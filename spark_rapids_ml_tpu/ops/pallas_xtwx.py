@@ -246,7 +246,7 @@ def normal_eq_prefix_mask(
     (reference python/src/spark_rapids_ml/regression.py:548-558).
     """
     if mesh is not None and mesh.devices.size > 1:
-        from jax import shard_map
+        from ..utils.jax_compat import shard_map
         from jax.sharding import PartitionSpec as P
 
         from ..parallel.mesh import DATA_AXIS
@@ -305,7 +305,7 @@ def covariance_prefix_mask(
     n_valid per shard is sum(w_local) — an O(n) read of w, ~1% of the X read.
     """
     if mesh is not None and mesh.devices.size > 1:
-        from jax import shard_map
+        from ..utils.jax_compat import shard_map
         from jax.sharding import PartitionSpec as P
 
         from ..parallel.mesh import DATA_AXIS

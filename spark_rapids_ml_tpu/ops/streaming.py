@@ -24,45 +24,76 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..reliability import (
+    StreamBatchError,
+    fault_point,
+    is_device_error,
+    is_transient,
+    resumable_accumulate,
+)
 from ._precision import pdot
 
 
-def _prefetch(iterable, depth: int = 1):
+def _prefetch(iterable, depth: int = 1, site: Optional[str] = None, start_batch: int = 0):
     """Double-buffered batch pipeline: keep `depth` extra batches in flight so the
     host slice/pad/device_put of batch i+1 overlaps the device accumulation of
     batch i (jax dispatch is async; the DMA rides a separate engine on TPU). This
     is the streamed-ingest overlap the reference gets implicitly from UVM
     prefetching. Peak device residency is depth+1 batches — depth=1 is true
     double buffering (the out-of-core batch-size guidance assumes 2 live
-    batches; a larger depth trades HBM for pipeline slack)."""
+    batches; a larger depth trades HBM for pipeline slack).
+
+    Exception transparency: with a `site`, a failure the reliability ladder
+    handles (transient host/I-O errors, device errors) raised while REFILLING
+    the buffer is wrapped in a StreamBatchError carrying the batch ordinal
+    (offset by `start_batch` on resumed streams), so the checkpoint-resume layer
+    (reliability/checkpoint.py) sees where the pipeline broke instead of a bare
+    mid-pipeline exception. Param/programming errors (ValueError-class) keep
+    their original type — they are API surface, not pipeline weather."""
     it = iter(iterable)
     buf: deque = deque()
-    try:
-        for _ in range(depth):
-            buf.append(next(it))
-    except StopIteration:
-        pass
-    while buf:
-        yield buf.popleft()
+    pulled = start_batch
+
+    def _refill() -> bool:
+        nonlocal pulled
         try:
             buf.append(next(it))
         except StopIteration:
-            pass
+            return False
+        except StreamBatchError:
+            raise  # already carries its site/batch context
+        except Exception as e:
+            if site is None or not (is_transient(e) or is_device_error(e)):
+                raise
+            raise StreamBatchError(site, pulled, e) from e
+        pulled += 1
+        return True
+
+    for _ in range(depth):
+        if not _refill():
+            break
+    while buf:
+        yield buf.popleft()
+        _refill()
 
 
-def _batch_stream(n: int, batch_rows: int, mesh, slicer):
+def _batch_stream(n: int, batch_rows: int, mesh, slicer, start_row: int = 0,
+                  site: str = "ingest"):
     """THE out-of-core ingest loop, shared by every streamed fit: `slicer(s, e)`
     returns row-aligned HOST arrays — X first, the weight vector LAST — for rows
     [s, e); this pads to the mesh (zero-weighting pad rows), shards, and yields
     device tuples. The ragged tail keeps its natural size: it compiles one extra
     accumulator entry ONCE and reuses it every pass (padding it to batch_rows
     instead was measured to upload a nearly-all-zeros full batch per pass when
-    n % batch_rows is small)."""
+    n % batch_rows is small). `start_row` (a batch boundary) re-opens the stream
+    mid-pass for checkpoint-resume; `site` names the fault-injection point
+    (reliability/faults.py) planted before each batch is sliced."""
     from ..parallel.mesh import shard_array
     from ..parallel.partition import pad_rows
 
-    for s in range(0, n, batch_rows):
+    for s in range(start_row, n, batch_rows):
         e = min(s + batch_rows, n)
+        fault_point(site, batch=s // batch_rows)
         arrays = slicer(s, e)
         if mesh is not None:
             X_, *extras = arrays
@@ -74,6 +105,24 @@ def _batch_stream(n: int, batch_rows: int, mesh, slicer):
             yield tuple(out)
         else:
             yield tuple(jnp.asarray(a) for a in arrays)
+
+
+def _accumulate_stream(carry, accum, n, batch_rows, mesh, slicer, site: str = "ingest"):
+    """Checkpoint-resumable streamed accumulation, shared by every streamed fit:
+    fold `accum(carry, batch_tuple) -> carry` over the prefetched batch stream,
+    snapshotting (carry, cursor) every reliability.checkpoint_batches batches so
+    a transient batch failure resumes from the last snapshot instead of
+    restarting the pass (reliability/checkpoint.py) — resumed results are
+    bit-identical to the fault-free pass."""
+
+    def factory(start_row: int):
+        return _prefetch(
+            _batch_stream(n, batch_rows, mesh, slicer, start_row=start_row, site=site),
+            site=site,
+            start_batch=start_row // batch_rows,
+        )
+
+    return resumable_accumulate(site, factory, accum, carry, batch_rows, n)
 
 
 @jax.jit
@@ -132,8 +181,9 @@ def streaming_linreg_stats(
             else np.ascontiguousarray(w[s:e], dtype=dt),
         )
 
-    for Xb_j, yb_j, wb_j in _prefetch(_batch_stream(n, batch_rows, mesh, slicer)):
-        carry = _accum_linreg(carry, Xb_j, yb_j, wb_j)
+    carry = _accumulate_stream(
+        carry, lambda c, batch: _accum_linreg(c, *batch), n, batch_rows, mesh, slicer
+    )
     A, b, sx, sy, sw = carry
     return A, b, sx / sw, sy / sw, sw
 
@@ -164,8 +214,9 @@ def streaming_covariance(
             else np.ascontiguousarray(w[s:e], dtype=dt),
         )
 
-    for Xb_j, wb_j in _prefetch(_batch_stream(n, batch_rows, mesh, slicer)):
-        carry = _accum_cov(carry, Xb_j, wb_j)
+    carry = _accumulate_stream(
+        carry, lambda c, batch: _accum_cov(c, *batch), n, batch_rows, mesh, slicer
+    )
     S2, sx, sw = carry
     mean = sx / sw
     cov = (S2 - sw * jnp.outer(mean, mean)) / (sw - 1.0)
@@ -317,15 +368,14 @@ def streaming_logreg_fit(
             else np.ascontiguousarray(w[s:e], dtype=dt),
         )
 
-    def _batches():
-        return _batch_stream(n, batch_rows, mesh, _slicer)
-
     # streamed standardization moments (Spark Summarizer wsum-1 variance,
     # matching ops/linalg.weighted_moments)
     if standardize:
         carry = (jnp.zeros((d,), dt), jnp.zeros((d,), dt), jnp.zeros((), dt))
-        for Xb, _, wb in _prefetch(_batches()):
-            carry = _accum_moments(carry, Xb, wb)
+        carry = _accumulate_stream(
+            carry, lambda c, batch: _accum_moments(c, batch[0], batch[2]),
+            n, batch_rows, mesh, _slicer,
+        )
         sx, sxx, sw_j = carry
         wsum = float(sw_j)
         mean = np.asarray(sx) / wsum
@@ -346,9 +396,9 @@ def streaming_logreg_fit(
 
     def value_and_grad(params_flat: np.ndarray):
         params = jnp.asarray(params_flat.reshape(shape).astype(dt))
-        acc_v = 0.0
-        acc_g = np.zeros(shape, np.float64)
-        for Xb, yb, wb in _prefetch(_batches()):
+
+        def _accum_vg(carry, batch):
+            Xb, yb, wb = batch
             y_enc = (
                 jax.nn.one_hot(yb.astype(jnp.int32), n_classes, dtype=Xb.dtype)
                 * (wb > 0)[:, None]
@@ -358,8 +408,14 @@ def streaming_logreg_fit(
             v, g = _logreg_batch_value_grad(
                 params, Xb, y_enc, wb, scale, bool(fit_intercept), bool(multinomial)
             )
-            acc_v += float(v)
-            acc_g += np.asarray(g, np.float64)
+            # functional host accumulation (new objects, never +=): snapshots in
+            # the resume layer hold references to prior carries
+            return carry[0] + float(v), carry[1] + np.asarray(g, np.float64)
+
+        acc_v, acc_g = _accumulate_stream(
+            (0.0, np.zeros(shape, np.float64)), _accum_vg,
+            n, batch_rows, mesh, _slicer,
+        )
         coef_s = params_flat.reshape(shape)[..., :-1]
         value = acc_v / wsum + 0.5 * reg_l2 * float(np.sum(coef_s * coef_s))
         grad = acc_g / wsum
@@ -374,8 +430,10 @@ def streaming_logreg_fit(
         from .linalg import power_iteration_lmax
 
         carry = (jnp.zeros((d, d), dt), jnp.zeros((d,), dt), jnp.zeros((), dt))
-        for Xb, _, wb in _prefetch(_batches()):
-            carry = _accum_cov(carry, Xb / scale, wb)
+        carry = _accumulate_stream(
+            carry, lambda c, batch: _accum_cov(c, batch[0] / scale, batch[2]),
+            n, batch_rows, mesh, _slicer,
+        )
         S2, _, sw_g = carry
         lmax = float(power_iteration_lmax(S2 / sw_g))
         lipschitz = (0.5 if multinomial else 0.25) * lmax + reg_l2 + 1e-12
@@ -563,8 +621,13 @@ def streaming_kmeans_fit(
             jnp.zeros((k,), dt),
             jnp.zeros((), dt),
         )
-        for Xb_j, wb_j in _prefetch(_batch_stream(n, batch_rows, mesh, _slicer)):
-            carry = _accum_kmeans(carry, centers, Xb_j, wb_j, cosine)
+        carry = _accumulate_stream(
+            carry,
+            lambda c, batch, centers=centers: _accum_kmeans(
+                c, centers, batch[0], batch[1], cosine
+            ),
+            n, batch_rows, mesh, _slicer,
+        )
         sums, counts, inertia_j = carry
         new_centers = jnp.where(
             counts[:, None] > 0,

@@ -73,3 +73,16 @@ def init_process_group(
         coordinator_address,
     )
     _initialized = True
+
+
+def reset_process_group() -> None:
+    """Tear down a (possibly partial) jax.distributed link so a barrier retry can
+    re-initialize against a freshly probed coordinator port (the TOCTOU recovery
+    in spark/integration.py). Best-effort: shutdown failures are logged, never
+    allowed to mask the failure that triggered the reset."""
+    global _initialized
+    try:
+        jax.distributed.shutdown()
+    except Exception as e:
+        get_logger("bootstrap").debug("jax.distributed.shutdown during reset: %s", e)
+    _initialized = False

@@ -14,6 +14,7 @@
 from __future__ import annotations
 
 import contextlib
+import threading
 import time
 from typing import Dict, Iterator, Optional
 
@@ -21,6 +22,10 @@ from .utils import get_logger
 
 _logger = get_logger("profiling")
 _spans: Dict[str, float] = {}
+_counters: Dict[str, int] = {}
+# counters are incremented from concurrent barrier-task threads (the local-mode
+# fit-plane harness); the lock keeps read-modify-write increments exact
+_counters_lock = threading.Lock()
 
 
 @contextlib.contextmanager
@@ -44,6 +49,27 @@ def span_totals() -> Dict[str, float]:
 
 def reset_spans() -> None:
     _spans.clear()
+
+
+def count(name: str, n: int = 1) -> None:
+    """Span-style monotone event counter. The reliability subsystem reports its
+    retry/resume/degrade/fault-firing totals here (`reliability.retry`,
+    `reliability.retry.<site>`, `reliability.resume[.<site>]`,
+    `reliability.degrade.*`, `reliability.fault[.<site>]`) so behavior under
+    faults is observable rather than silent."""
+    with _counters_lock:
+        _counters[name] = _counters.get(name, 0) + n
+
+
+def counter_totals() -> Dict[str, int]:
+    """Accumulated event counts per name since process start (or last reset)."""
+    with _counters_lock:
+        return dict(_counters)
+
+
+def reset_counters() -> None:
+    with _counters_lock:
+        _counters.clear()
 
 
 @contextlib.contextmanager

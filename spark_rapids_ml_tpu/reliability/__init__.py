@@ -1,0 +1,39 @@
+#
+# Reliability subsystem: retry/backoff policy, deterministic fault injection,
+# and checkpoint-resume for the streamed out-of-core fits — plus the exception
+# taxonomy (transient vs stage-retryable vs unrecoverable device error) that
+# drives the barrier->collect->CPU degradation ladder in core/estimator.py and
+# spark/integration.py.
+#
+# Observability: every retry/resume/degrade/fault-firing increments a
+# profiling counter (profiling.counter_totals()) so the behavior under faults
+# is visible, not silent. See docs/design.md "Reliability".
+#
+
+from .checkpoint import resumable_accumulate
+from .faults import (
+    DeviceError,
+    FaultSpec,
+    StreamBatchError,
+    fault_point,
+    is_device_error,
+    is_stage_retryable,
+    is_transient,
+    parse_fault_spec,
+    reset_faults,
+)
+from .policy import RetryPolicy
+
+__all__ = [
+    "DeviceError",
+    "FaultSpec",
+    "RetryPolicy",
+    "StreamBatchError",
+    "fault_point",
+    "is_device_error",
+    "is_stage_retryable",
+    "is_transient",
+    "parse_fault_spec",
+    "reset_faults",
+    "resumable_accumulate",
+]

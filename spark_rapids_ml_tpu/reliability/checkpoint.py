@@ -1,0 +1,93 @@
+#
+# Checkpoint-resume for streamed fits. Every streamed accumulator walks the
+# shape  `for batch in stream: carry = accum(carry, batch)`  where `carry` is a
+# small FUNCTIONAL value (a tuple of device stats arrays or host numpy arrays)
+# and the stream is restartable from any batch boundary. That is exactly the
+# MapReduce-over-JAX decomposition DrJAX (arXiv:2403.07128) shows admits cheap
+# per-round checkpointing: a snapshot is just a REFERENCE to (carry, cursor) —
+# no copy, no serialization — because accumulation never mutates a prior carry.
+#
+# On a transient batch failure (preempted host, dropped connection, one ingest
+# batch OOM) the loop resumes the stream from the last snapshot cursor and
+# replays forward. Replay performs the identical device ops on the identical
+# batches in the identical order, so the resumed fit is BIT-IDENTICAL to the
+# fault-free run (tests/test_reliability.py asserts this for every streamed
+# estimator). Non-transient errors propagate untouched.
+#
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Iterable
+
+from .. import config as _config
+from .. import profiling
+from ..utils import get_logger
+from .faults import is_transient
+from .policy import RetryPolicy
+
+_logger = get_logger("reliability.checkpoint")
+
+
+def resumable_accumulate(
+    site: str,
+    stream_factory: Callable[[int], Iterable[Any]],
+    accum: Callable[[Any, Any], Any],
+    carry: Any,
+    batch_rows: int,
+    n_rows: int,
+    start_row: int = 0,
+) -> Any:
+    """Fold `accum` over every batch of `stream_factory(start_row)`.
+
+    `stream_factory(row)` must yield the batches covering rows [row, n_rows) in
+    order; `accum(carry, batch) -> carry` must be functional (return a new carry,
+    never mutate the old one — all the streamed accumulators already are). Every
+    `reliability.checkpoint_batches` batches the (carry, cursor) pair is
+    snapshotted by reference; a transient failure restores the snapshot and
+    re-opens the stream at the snapshot cursor, bounded by the RetryPolicy.
+    """
+    if not bool(_config.get("reliability.enabled")):
+        for batch in stream_factory(int(start_row)):
+            carry = accum(carry, batch)
+        return carry
+
+    every = max(1, int(_config.get("reliability.checkpoint_batches")))
+    policy = RetryPolicy.from_config()
+    snap_carry, snap_row = carry, int(start_row)
+    failures = 0
+    t0 = time.monotonic()
+    while True:
+        attempt_start_row = snap_row
+        row = snap_row
+        carry = snap_carry
+        try:
+            done = 0
+            for batch in stream_factory(row):
+                carry = accum(carry, batch)
+                row = min(row + batch_rows, n_rows)
+                done += 1
+                if done % every == 0:
+                    snap_carry, snap_row = carry, row
+            return carry
+        except Exception as e:
+            if snap_row > attempt_start_row:
+                # the snapshot advanced since the last restore: this is a NEW
+                # independent fault, not the same one repeating — the attempt
+                # budget bounds retries PER fault, not per multi-hour stream.
+                # (t0 is NOT reset: reliability.deadline_s stays per-stage.)
+                failures = 0
+            failures += 1
+            if not is_transient(e) or policy.give_up(
+                failures, time.monotonic() - t0, site
+            ):
+                raise
+            profiling.count("reliability.resume")
+            profiling.count(f"reliability.resume.{site}")
+            _logger.warning(
+                "transient failure at '%s' (%s: %s); resuming from row %d "
+                "(last snapshot), attempt %d/%d",
+                site, type(e).__name__, e, snap_row, failures + 1,
+                policy.max_attempts,
+            )
+            policy.sleep(failures, site)

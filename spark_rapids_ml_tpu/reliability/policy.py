@@ -1,0 +1,115 @@
+#
+# Retry/backoff policy core. One policy object serves every layer: per-batch
+# retries in the streamed ANN/pairwise tiers, the barrier process-group init
+# rounds (spark/integration.py), whole-stage re-runs (fit_on_spark), and the
+# checkpoint-resume loop (reliability/checkpoint.py).
+#
+# Backoff is exponential with DETERMINISTIC jitter: the jitter fraction comes
+# from a hash of (site, attempt) rather than an RNG, so a failing run replays
+# identically — the property the fault-injection tests (and any production
+# incident reproduction) depend on.
+#
+
+from __future__ import annotations
+
+import hashlib
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Optional
+
+from .. import config as _config
+from .. import profiling
+from ..utils import get_logger
+from .faults import is_transient
+
+_logger = get_logger("reliability.policy")
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """Bounded attempts + exponential backoff with deterministic jitter + an
+    optional per-stage wall-clock deadline."""
+
+    max_attempts: int = 3  # total attempts, first one included
+    backoff_base_s: float = 0.05
+    backoff_multiplier: float = 2.0
+    backoff_max_s: float = 2.0
+    jitter: float = 0.1  # +/- jitter/2 fraction applied to each delay
+    deadline_s: Optional[float] = None  # give up when the next delay would cross it
+
+    @classmethod
+    def from_config(cls) -> "RetryPolicy":
+        # reliability.enabled is the master kill switch: disabled means every
+        # unit gets exactly one attempt — failures surface immediately
+        enabled = bool(_config.get("reliability.enabled"))
+        deadline = _config.get("reliability.deadline_s")
+        return cls(
+            max_attempts=max(1, int(_config.get("reliability.max_attempts")))
+            if enabled
+            else 1,
+            backoff_base_s=float(_config.get("reliability.backoff_base_s")),
+            backoff_max_s=float(_config.get("reliability.backoff_max_s")),
+            jitter=float(_config.get("reliability.backoff_jitter")),
+            deadline_s=float(deadline) if deadline is not None else None,
+        )
+
+    def delay_s(self, failures: int, site: str = "") -> float:
+        """Backoff before attempt `failures + 1` (failures >= 1). Deterministic:
+        the jitter fraction hashes (site, failures)."""
+        base = min(
+            self.backoff_base_s * self.backoff_multiplier ** (failures - 1),
+            self.backoff_max_s,
+        )
+        digest = hashlib.sha256(f"{site}:{failures}".encode()).digest()
+        frac = int.from_bytes(digest[:4], "big") / 0xFFFFFFFF  # [0, 1)
+        return base * (1.0 + self.jitter * (frac - 0.5))
+
+    def give_up(self, failures: int, elapsed_s: float, site: str = "") -> bool:
+        """True when the policy is exhausted: attempt budget spent, or the next
+        backoff would cross the stage deadline."""
+        if failures >= self.max_attempts:
+            return True
+        if self.deadline_s is not None and (
+            elapsed_s + self.delay_s(failures, site) >= self.deadline_s
+        ):
+            return True
+        return False
+
+    def sleep(self, failures: int, site: str = "") -> None:
+        time.sleep(self.delay_s(failures, site))
+
+    def run(
+        self,
+        fn: Callable[[], Any],
+        site: str = "",
+        retryable: Optional[Callable[[BaseException], bool]] = None,
+        on_retry: Optional[Callable[[int, BaseException], None]] = None,
+    ) -> Any:
+        """Call `fn` under this policy: retryable failures (default
+        faults.is_transient) back off and re-run; everything else — and the last
+        exhausted attempt — propagates. Each retry increments the
+        `reliability.retry` / `reliability.retry.<site>` profiling counters."""
+        if retryable is None:
+            retryable = is_transient
+        t0 = time.monotonic()
+        failures = 0
+        while True:
+            try:
+                return fn()
+            except Exception as e:
+                failures += 1
+                if not retryable(e) or self.give_up(
+                    failures, time.monotonic() - t0, site
+                ):
+                    raise
+                profiling.count("reliability.retry")
+                if site:
+                    profiling.count(f"reliability.retry.{site}")
+                _logger.warning(
+                    "transient failure at '%s' (%s: %s); retry %d/%d after backoff",
+                    site or "unnamed", type(e).__name__, e, failures,
+                    self.max_attempts - 1,
+                )
+                if on_retry is not None:
+                    on_retry(failures, e)
+                self.sleep(failures, site)

@@ -35,8 +35,8 @@ def _unpersist(bcasts: Any) -> None:
         if unpersist is not None:
             try:
                 unpersist()
-            except Exception:  # best-effort; a failed release must not fail the scan
-                pass
+            except Exception as e:  # best-effort; a failed release must not fail the scan
+                get_logger("spark.evaluate").debug("broadcast unpersist failed: %s", e)
 
 
 def evaluate_on_spark(evaluator: Any, spark_df: Any) -> float:

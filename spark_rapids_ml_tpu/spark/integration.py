@@ -22,6 +22,7 @@
 from __future__ import annotations
 
 import json
+import threading
 from dataclasses import dataclass
 from typing import Any, Callable, List
 
@@ -64,9 +65,15 @@ def decode_partition_info(payloads: List[str]) -> List[PartitionInfo]:
 
 def _collect_partition(pdf_iter):
     """Concatenate a task's pandas batches into one DataFrame (the reference's
-    executor-side HOT LOOP 1, core.py:906-941)."""
+    executor-side HOT LOOP 1, core.py:906-941). A failure here (fault site
+    `barrier_collect`) cannot be retried in-task — the Arrow iterator is
+    consumed — so it aborts the stage and recovery happens one rung up:
+    fit_on_spark re-runs the whole barrier stage under the RetryPolicy."""
     import pandas as pd
 
+    from ..reliability import fault_point
+
+    fault_point("barrier_collect")
     pdfs = [pdf for pdf in pdf_iter]
     if not pdfs:
         # an empty barrier partition would abort the whole stage with an opaque
@@ -77,6 +84,15 @@ def _collect_partition(pdf_iter):
             "every task holds rows (fewer hosts than rows, avoid skewed keys)."
         )
     return pd.concat(pdfs, ignore_index=True) if len(pdfs) != 1 else pdfs[0]
+
+
+# Serializes the jitted fit program when multiple barrier TASKS share one
+# python process — which only happens in local-mode simulation (the test
+# harness runs tasks as threads); production runs one task per TPU host
+# process, so the lock is uncontended there. Concurrent XLA dispatch from
+# many Python threads has been observed to wedge some jaxlib builds; the
+# control plane (collect, allGather, init retry) stays fully concurrent.
+_DEVICE_PROGRAM_LOCK = threading.Lock()
 
 
 def _barrier_train_udf(estimator_payload: bytes) -> Callable:
@@ -110,37 +126,86 @@ def _barrier_train_udf(estimator_payload: bytes) -> Callable:
 
             fd.features = densify(fd.features, est._float32_inputs)
 
-        # control plane: coordinator + partition sizes in ONE allGather round.
+        # control plane: coordinator + partition sizes in one allGather round,
+        # then a status round after init so every rank agrees on the outcome.
         # rank 0's reachable address comes from Spark's own task info (hostname
-        # resolution can map to loopback); the port is a freshly-probed ephemeral
-        # port, so concurrent jobs on one host don't collide.
-        coordinator = ""
-        if rank == 0:
-            import socket
+        # resolution can map to loopback). The ephemeral port is probed, closed,
+        # and only later bound by init_process_group — a TOCTOU window a
+        # concurrent job can race. Losing the race is no longer fatal: the loop
+        # re-probes a FRESH port and re-gathers under the RetryPolicy, so a
+        # stolen port costs one round instead of the whole barrier stage.
+        from .. import profiling
+        from ..parallel.bootstrap import reset_process_group
+        from ..reliability import RetryPolicy, fault_point
 
-            host = ctx.getTaskInfos()[0].address.split(":")[0]
-            probe = socket.socket()
-            probe.bind(("", 0))
-            port = probe.getsockname()[1]
-            probe.close()
-            coordinator = f"{host}:{port}"
-        payloads = ctx.allGather(
-            encode_partition_info(
-                PartitionInfo(
-                    rank,
-                    fd.n_rows,
-                    coordinator,
-                    nnz=int(fd.features.nnz) if sparse_fit else -1,
-                    ell_width=int(ell_vals.shape[1]) if sparse_fit else 0,
+        import time as _time
+
+        policy = RetryPolicy.from_config()
+        failures = 0
+        init_t0 = _time.monotonic()
+        while True:
+            coordinator = ""
+            if rank == 0:
+                import socket
+
+                host = ctx.getTaskInfos()[0].address.split(":")[0]
+                probe = socket.socket()
+                probe.bind(("", 0))
+                port = probe.getsockname()[1]
+                probe.close()
+                coordinator = f"{host}:{port}"
+            fault_point("barrier_allgather", batch=failures)
+            payloads = ctx.allGather(
+                encode_partition_info(
+                    PartitionInfo(
+                        rank,
+                        fd.n_rows,
+                        coordinator,
+                        nnz=int(fd.features.nnz) if sparse_fit else -1,
+                        ell_width=int(ell_vals.shape[1]) if sparse_fit else 0,
+                    )
                 )
             )
-        )
-        infos = decode_partition_info(payloads)
-        init_process_group(
-            coordinator_address=next(i.coordinator for i in infos if i.coordinator),
-            num_processes=n_tasks,
-            process_id=rank,
-        )
+            infos = decode_partition_info(payloads)
+            err = ""
+            try:
+                fault_point("barrier_init", batch=failures)
+                init_process_group(
+                    coordinator_address=next(
+                        i.coordinator for i in infos if i.coordinator
+                    ),
+                    num_processes=n_tasks,
+                    process_id=rank,
+                )
+            except Exception as e:
+                err = f"rank {rank}: {type(e).__name__}: {e}"
+            # status round: the outcome list is identical on every rank, so all
+            # ranks take the same retry-or-proceed branch (no split-brain). The
+            # deadline check uses the MAX gathered elapsed for the same reason —
+            # per-rank clocks differ (partition collect times vary) and a
+            # rank-local decision could strand peers in the next allGather.
+            statuses = [
+                json.loads(s)
+                for s in ctx.allGather(
+                    json.dumps(
+                        {"err": err, "elapsed": _time.monotonic() - init_t0}
+                    )
+                )
+            ]
+            errors = [s["err"] for s in statuses if s["err"]]
+            if not errors:
+                break
+            failures += 1
+            shared_elapsed = max(s["elapsed"] for s in statuses)
+            if policy.give_up(failures, shared_elapsed, "barrier_init"):
+                raise RuntimeError(
+                    "jax.distributed process-group init failed after "
+                    f"{failures} attempt(s): " + "; ".join(errors)
+                )
+            profiling.count("reliability.retry")
+            profiling.count("reliability.retry.barrier_init")
+            reset_process_group()  # drop any partial link before re-probing
+            policy.sleep(failures, "barrier_init")
 
         # global mesh over the pod; every host pads its rows to the common local
         # size (XLA needs equal shards), real rows marked by the weight vector
@@ -193,7 +258,8 @@ def _barrier_train_udf(estimator_payload: bytes) -> Callable:
             )
 
         # run the estimator's fit program (same SPMD program on every host)
-        attrs = est._get_tpu_fit_func(None)(fit_inputs)
+        with _DEVICE_PROGRAM_LOCK:
+            attrs = est._get_tpu_fit_func(None)(fit_inputs)
 
         if rank == 0:
             import pickle as _p
@@ -294,6 +360,8 @@ def fit_on_spark(estimator: Any, spark_df: Any, num_hosts: int) -> Any:
     topology). Requires pyspark."""
     import pickle
 
+    from ..reliability import RetryPolicy, is_stage_retryable
+
     if num_hosts < 1:
         raise ValueError(f"num_hosts must be >= 1, got {num_hosts}")
     logger = get_logger("spark.integration")
@@ -304,7 +372,15 @@ def fit_on_spark(estimator: Any, spark_df: Any, num_hosts: int) -> Any:
         rdd = apply_stage_level_scheduling(rdd, spark_df.sparkSession)
     except Exception:  # pragma: no cover — never fail a fit over scheduling sugar
         logger.warning("stage-level scheduling unavailable; continuing without")
-    rows = rdd.barrier().mapPartitions(lambda it: it).collect()
+    barrier_rdd = rdd.barrier().mapPartitions(lambda it: it)
+    # whole-stage retry: a dropped barrier task / preempted host fails the stage
+    # as one unit (Spark's own barrier semantics), so recovery re-runs the stage
+    # under the RetryPolicy; param/programming errors propagate immediately.
+    # Exhaustion raises — the caller (core/estimator.py::_fit) owns the next
+    # rung of the degradation ladder (collect mode).
+    rows = RetryPolicy.from_config().run(
+        barrier_rdd.collect, site="barrier_stage", retryable=is_stage_retryable
+    )
     payload = next(r["model"] for r in rows if r["model"] is not None)
     attrs = pickle.loads(bytes(payload))
     model = estimator._create_pyspark_model(attrs)

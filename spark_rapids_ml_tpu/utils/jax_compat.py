@@ -1,0 +1,37 @@
+#
+# jax API compatibility shims. The tree targets current jax (top-level
+# `jax.shard_map`, `check_vma=`), but hermetic CI images may pin an older
+# release where shard_map still lives in jax.experimental and the replication
+# check is spelled `check_rep`. Reliability starts with being runnable: every
+# shard_map call site imports from here so one pinned-version delta doesn't
+# take down the whole suite.
+#
+
+from __future__ import annotations
+
+try:  # current jax: top-level export, check_vma kwarg
+    from jax import shard_map as _shard_map
+
+    _CHECK_KW = "check_vma"
+except ImportError:  # older jax: experimental module, check_rep kwarg
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _CHECK_KW = "check_rep"
+
+
+def shard_map(f, **kwargs):
+    """`jax.shard_map` with the replication-check kwarg translated for the
+    installed jax version. Call sites write `check_vma=` (the current name)."""
+    if "check_vma" in kwargs and _CHECK_KW != "check_vma":
+        kwargs[_CHECK_KW] = kwargs.pop("check_vma")
+    return _shard_map(f, **kwargs)
+
+
+def pvary(x, axis_names):
+    """`jax.lax.pvary` (mark a value as varying over manual mesh axes, needed by
+    the current varying-axes type system) — identity on older jax, whose
+    shard_map with the replication check off never tracks variance."""
+    import jax
+
+    fn = getattr(jax.lax, "pvary", None)
+    return x if fn is None else fn(x, axis_names)

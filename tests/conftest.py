@@ -69,18 +69,30 @@ def _serialize_xla_compiles():
 
     from jax._src import compiler as _jax_compiler
 
-    real = _jax_compiler.backend_compile_and_load
+    # the entry point was renamed across jax releases; lock whichever exists
+    attr = next(
+        (
+            a
+            for a in ("backend_compile_and_load", "backend_compile")
+            if hasattr(_jax_compiler, a)
+        ),
+        None,
+    )
+    if attr is None:  # pragma: no cover — future rename: run unlocked
+        yield
+        return
+    real = getattr(_jax_compiler, attr)
     lock = threading.Lock()
 
     def locked(*a, **kw):
         with lock:
             return real(*a, **kw)
 
-    _jax_compiler.backend_compile_and_load = locked
+    setattr(_jax_compiler, attr, locked)
     try:
         yield
     finally:
-        _jax_compiler.backend_compile_and_load = real
+        setattr(_jax_compiler, attr, real)
 
 
 @pytest.fixture(scope="session")

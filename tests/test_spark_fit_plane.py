@@ -140,6 +140,11 @@ class FakeBarrierRDD:
         of two batches (mirroring Arrow batch streaming) and runs the udf."""
         stage = _Stage(self.n_partitions)
         FakeBarrierTaskContext._stage = stage
+        # a retried stage must get a FRESH assembler stage: an aborted barrier
+        # stays broken forever, which would fail every re-run spuriously
+        reset_asm = getattr(FakeBarrierTaskContext, "_reset_asm", None)
+        if reset_asm is not None:
+            reset_asm(self.n_partitions)
         chunks = np.array_split(np.arange(len(self.pdf)), self.n_partitions)
         rows, errs = [], []
         lock = threading.Lock()
@@ -239,10 +244,15 @@ def barrier_env(monkeypatch):
 
     monkeypatch.setattr(jax, "make_array_from_process_local_data", fake_make)
 
-    def install(n_tasks):
+    def _reset_asm(n_tasks):
         stage = _Stage(n_tasks)
         assembler_holder["asm"] = GlobalAssembler(stage)
         FakeBarrierTaskContext._asm_stage = stage
+
+    monkeypatch.setattr(FakeBarrierTaskContext, "_reset_asm", _reset_asm, raising=False)
+
+    def install(n_tasks):
+        _reset_asm(n_tasks)
         return boot_calls
 
     install.real_make = real_make
@@ -349,3 +359,132 @@ def test_empty_partition_raises_actionable_error(barrier_env):
     pdf = _blob_pdf(n=2)  # 2 rows over 4 partitions -> empty barrier partitions
     with pytest.raises(RuntimeError, match="Repartition the input"):
         fit_on_spark(KMeans(k=2), FakeFitSparkDF(pdf, 4), num_hosts=4)
+
+
+# ------------------------------------------------- reliability: barrier ladder
+
+
+@pytest.fixture
+def reliability_env():
+    """Fast deterministic retry policy + armed fault harness, reset afterwards."""
+    from spark_rapids_ml_tpu import profiling
+    from spark_rapids_ml_tpu.reliability import reset_faults
+
+    srml_config.set("reliability.backoff_base_s", 0.001)
+    srml_config.set("reliability.backoff_max_s", 0.002)
+    profiling.reset_counters()
+    reset_faults()
+    yield
+    for key in (
+        "reliability.fault_spec",
+        "reliability.backoff_base_s",
+        "reliability.backoff_max_s",
+        "reliability.max_attempts",
+        "reliability.degrade_to_collect",
+        "spark_fit_mode",
+    ):
+        srml_config.unset(key)
+    reset_faults()
+
+
+def test_barrier_stage_retries_transient_collect_fault(barrier_env, reliability_env):
+    """One transient OSError during a task's partition collect aborts the stage;
+    fit_on_spark re-runs the whole barrier stage and the model matches the
+    direct fit — with the retry visible in the profiling counters."""
+    from spark_rapids_ml_tpu import profiling
+    from spark_rapids_ml_tpu.clustering import KMeans
+    from spark_rapids_ml_tpu.spark.integration import fit_on_spark
+
+    barrier_env(4)
+    pdf = _blob_pdf(n=256)
+    direct = KMeans(k=2, maxIter=10, seed=7).fit(pdf)
+
+    srml_config.set("reliability.fault_spec", "barrier_collect:raise=OSError")
+    model = fit_on_spark(
+        KMeans(k=2, maxIter=10, seed=7), FakeFitSparkDF(pdf, 4), num_hosts=4
+    )
+    totals = profiling.counter_totals()
+    assert totals.get("reliability.retry.barrier_stage", 0) >= 1
+    assert totals.get("reliability.fault.barrier_collect", 0) == 1
+    np.testing.assert_allclose(
+        np.sort(np.asarray(model.cluster_centers_), axis=0),
+        np.sort(np.asarray(direct.cluster_centers_), axis=0),
+        rtol=1e-5,
+        atol=1e-5,
+    )
+
+
+def test_barrier_init_retries_with_fresh_port(barrier_env, reliability_env):
+    """TOCTOU regression: a failed process-group init (stolen ephemeral port)
+    must NOT abort the stage — every rank re-gathers against a freshly probed
+    coordinator port and the fit completes in the same barrier stage."""
+    from spark_rapids_ml_tpu import profiling
+    from spark_rapids_ml_tpu.clustering import KMeans
+    from spark_rapids_ml_tpu.spark.integration import fit_on_spark
+
+    boot_calls = barrier_env(4)
+    pdf = _blob_pdf(n=256)
+    direct = KMeans(k=2, maxIter=10, seed=7).fit(pdf)
+
+    srml_config.set("reliability.fault_spec", "barrier_init:raise=OSError")
+    model = fit_on_spark(
+        KMeans(k=2, maxIter=10, seed=7), FakeFitSparkDF(pdf, 4), num_hosts=4
+    )
+    totals = profiling.counter_totals()
+    # the init round retried IN-stage (not via a whole-stage re-run)
+    assert totals.get("reliability.retry.barrier_init", 0) >= 1
+    assert totals.get("reliability.retry.barrier_stage", 0) == 0
+    # the retry advertised a FRESH coordinator port (the TOCTOU fix)
+    coords = {c["coordinator_address"] for c in boot_calls}
+    assert len(coords) == 2, coords
+    np.testing.assert_allclose(
+        np.sort(np.asarray(model.cluster_centers_), axis=0),
+        np.sort(np.asarray(direct.cluster_centers_), axis=0),
+        rtol=1e-5,
+        atol=1e-5,
+    )
+
+
+def test_barrier_degrades_to_collect_mode(barrier_env, reliability_env):
+    """A persistently failing barrier plane must degrade the fit to collect mode
+    instead of raising (degradation ladder rung 1), with the degrade counted."""
+    from spark_rapids_ml_tpu import profiling
+    from spark_rapids_ml_tpu.clustering import KMeans
+
+    barrier_env(2)
+    pdf = _blob_pdf(n=128)
+    direct = KMeans(k=2, maxIter=10, seed=7).fit(pdf)
+
+    # every stage attempt faults -> fit_on_spark exhausts its retries
+    srml_config.set("reliability.fault_spec", "barrier_collect:raise=OSError:times=99")
+    srml_config.set("reliability.max_attempts", 2)
+    srml_config.set("spark_fit_mode", "barrier")
+    est = KMeans(k=2, maxIter=10, seed=7)
+    est._num_workers = 2
+    model = est.fit(FakeFitSparkDF(pdf, n_partitions=2))
+
+    totals = profiling.counter_totals()
+    assert totals.get("reliability.degrade.barrier_to_collect", 0) == 1
+    np.testing.assert_allclose(
+        np.sort(np.asarray(model.cluster_centers_), axis=0),
+        np.sort(np.asarray(direct.cluster_centers_), axis=0),
+        rtol=1e-5,
+        atol=1e-5,
+    )
+
+
+def test_barrier_degrade_disabled_raises(barrier_env, reliability_env):
+    """With reliability.degrade_to_collect off, the exhausted barrier failure
+    must propagate (no silent mode switch)."""
+    from spark_rapids_ml_tpu.clustering import KMeans
+
+    barrier_env(2)
+    pdf = _blob_pdf(n=128)
+    srml_config.set("reliability.fault_spec", "barrier_collect:raise=OSError:times=99")
+    srml_config.set("reliability.max_attempts", 2)
+    srml_config.set("reliability.degrade_to_collect", False)
+    srml_config.set("spark_fit_mode", "barrier")
+    est = KMeans(k=2, maxIter=10, seed=7)
+    est._num_workers = 2
+    with pytest.raises(OSError):
+        est.fit(FakeFitSparkDF(pdf, n_partitions=2))
