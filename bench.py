@@ -47,6 +47,7 @@ UNITS = [
     "umap",
     "dbscan",
     "fit_e2e",
+    "cache",
     "knn",
     "ann",
     "wide256",

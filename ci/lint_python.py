@@ -11,6 +11,11 @@ image ships no third-party linters, so the gate is stdlib-only but real:
     at least log. Narrow typed catches (`except StopIteration: pass`) stay
     legal control flow; the reliability module itself (which implements the
     handling) and `# noqa: silent-except` lines are exempt.
+  * uncached multi-pass re-ingest: a direct `_batch_stream(...)` call inside a
+    for/while loop re-uploads every batch on every pass, bypassing the HBM
+    batch cache (ops/device_cache.py). Such call sites must pass a `cache=`
+    handle (the loop replays passes 2..N from HBM) or hoist the stream out of
+    the loop; `# noqa` on the call line exempts.
 
 Exit code 1 on any finding; CI runs this before the test tiers (ci/test.sh).
 """
@@ -73,6 +78,50 @@ def _names_bound_by_import(node):
         yield name, alias
 
 
+class _UncachedStreamVisitor(ast.NodeVisitor):
+    """Flags `_batch_stream(...)` calls lexically inside a for/while loop that
+    do not pass a `cache=` keyword — the multi-pass re-ingest shape the HBM
+    batch cache exists to eliminate (ops/device_cache.py)."""
+
+    def __init__(self, path: Path, src_lines, findings):
+        self.path = path
+        self.src_lines = src_lines
+        self.findings = findings
+        self.loop_depth = 0
+
+    def _visit_loop(self, node):
+        self.loop_depth += 1
+        self.generic_visit(node)
+        self.loop_depth -= 1
+
+    visit_For = visit_AsyncFor = visit_While = _visit_loop
+
+    def visit_Call(self, node):
+        func = node.func
+        name = (
+            func.id
+            if isinstance(func, ast.Name)
+            else func.attr if isinstance(func, ast.Attribute) else ""
+        )
+        if (
+            name == "_batch_stream"
+            and self.loop_depth > 0
+            and not any(kw.arg == "cache" for kw in node.keywords)
+        ):
+            line = (
+                self.src_lines[node.lineno - 1]
+                if node.lineno - 1 < len(self.src_lines)
+                else ""
+            )
+            if "noqa" not in line:
+                self.findings.append(
+                    f"{self.path}:{node.lineno}: _batch_stream call inside a "
+                    "loop without a cache= handle (multi-pass re-ingest "
+                    "bypassing ops/device_cache)"
+                )
+        self.generic_visit(node)
+
+
 def check_file(path: Path) -> list:
     findings = []
     src = path.read_text()
@@ -86,6 +135,8 @@ def check_file(path: Path) -> list:
         stripped = line.lstrip(" ")
         if stripped.startswith("\t"):
             findings.append(f"{path}:{lineno}: tab in indentation")
+
+    _UncachedStreamVisitor(path, src.splitlines(), findings).visit(tree)
 
     # collect import bindings and all referenced names
     imports = {}

@@ -38,7 +38,11 @@ else
   # checkpoint-resume, barrier retry/degrade) must be green before the full
   # matrix runs — a broken failure path fails fast here
   python -m pytest tests/test_reliability.py -q
-  python -m pytest tests/ -q --ignore=tests/test_reliability.py
+  # cache tier next: the HBM batch-cache smoke (cached-replay bit-identity per
+  # streamed estimator + exact hit/miss/eviction counter accounting + zero
+  # pass-2 uploads) — a wrong cache silently corrupts every multi-pass fit
+  python -m pytest tests/test_device_cache.py -q
+  python -m pytest tests/ -q --ignore=tests/test_reliability.py --ignore=tests/test_device_cache.py
 fi
 
 # small benchmark smoke (reference runs a small bench pre-merge)

@@ -134,6 +134,10 @@ object ModelHelper {
       case (_: IntParam, JInt(i)) => Some(i.toInt)
       case (_: IntParam, JDouble(d)) => Some(d.toInt)
       case (_: LongParam, JInt(i)) => Some(i.toLong)
+      // json4s round-trips a long-typed seed as JDouble (3.0): coerce it back
+      // instead of letting the generic fallthrough box a Double into a
+      // Param[Long] (which only failed later, at getSeed time)
+      case (_: LongParam, JDouble(d)) => Some(d.toLong)
       case (_: DoubleParam, JInt(i)) => Some(i.toDouble)
       case (_: DoubleParam, JDouble(d)) => Some(d)
       case (_: FloatParam, JInt(i)) => Some(i.toFloat)
@@ -144,6 +148,17 @@ object ModelHelper {
       case (_: DoubleArrayParam, JArray(a)) =>
         Some(a.map(_.extract[Double]).toArray)
       case (_: IntArrayParam, JArray(a)) => Some(a.map(_.extract[Int]).toArray)
+      // a TYPED param reaching this point holds a JSON value its type cannot
+      // represent: fail AT LOAD with the param name, not later (and not
+      // silently via the untyped fallthroughs below, which would defer the
+      // failure to a ClassCastException at get<Param> time)
+      case (_: IntParam | _: LongParam | _: DoubleParam | _: FloatParam |
+            _: BooleanParam | _: StringArrayParam | _: DoubleArrayParam |
+            _: IntArrayParam, _) =>
+        throw new IllegalArgumentException(
+          s"cannot coerce persisted JSON value $v into param '${p.name}' " +
+            s"(${p.getClass.getSimpleName})")
+      // untyped Param[_]: string-valued params plus the plain-Param numerics
       case (_, JString(s)) => Some(s)
       case (_, JInt(i)) => Some(i.toInt)
       case (_, JDouble(d)) => Some(d)

@@ -304,6 +304,23 @@ class TpuPluginSuite extends AnyFunSuite {
     assert(dst.getMaxIter == 5)
   }
 
+  test("applyParamsJson coerces a double-encoded seed into the long param") {
+    // json4s re-parses a persisted long as JDouble (99.0): the LongParam case
+    // must coerce it at load time — pre-fix the generic JDouble fallthrough
+    // boxed a Double into Param[Long] and getSeed threw ClassCastException
+    val dst = new TpuKMeans()
+    ModelHelper.applyParamsJson(dst, """{"seed": 99.0, "k": 4}""")
+    assert(dst.getSeed == 99L)
+    assert(dst.getK == 4)
+  }
+
+  test("applyParamsJson fails AT LOAD on a non-coercible typed param value") {
+    val dst = new TpuKMeans()
+    intercept[IllegalArgumentException] {
+      ModelHelper.applyParamsJson(dst, """{"seed": "not-a-number"}""")
+    }
+  }
+
   test("applyParamsJson ignores unknown params instead of throwing") {
     val dst = new TpuPCA()
     ModelHelper.applyParamsJson(dst, """{"k": 3, "not_a_param": "x"}""")

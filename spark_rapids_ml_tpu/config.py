@@ -54,6 +54,12 @@ _DEFAULTS: Dict[str, Any] = {
     # force XLA, "1" = skip the platform check (tests — runs the kernel's
     # interpreter off-TPU)
     "pallas_xtwx": "auto",
+    # HBM-resident batch cache (ops/device_cache.py): multi-pass streamed fits
+    # retain pass-1 device batches and replay passes 2..N from HBM (the TPU
+    # analog of the reference's cross-pass cuDF/UVM residency). The budget
+    # bounds cache HBM; datasets above it cache a prefix and stream the tail
+    "cache.enabled": True,
+    "cache.hbm_budget_bytes": 2 << 30,
     # reliability subsystem (reliability/): retry/backoff policy, deterministic
     # fault injection, streamed-fit checkpoint-resume, and the
     # barrier->collect->CPU degradation ladder (docs/design.md "Reliability")
@@ -80,6 +86,8 @@ _ENV_KEYS: Dict[str, str] = {
     "fast_math": "SRML_TPU_FAST_MATH",
     "parity_precision": "SRML_TPU_PARITY_PRECISION",
     "pallas_xtwx": "SRML_TPU_PALLAS_XTWX",
+    "cache.enabled": "SRML_TPU_CACHE_ENABLED",
+    "cache.hbm_budget_bytes": "SRML_TPU_CACHE_BUDGET",
     "reliability.enabled": "SRML_TPU_RELIABILITY_ENABLED",
     "reliability.max_attempts": "SRML_TPU_MAX_ATTEMPTS",
     "reliability.backoff_base_s": "SRML_TPU_BACKOFF_BASE_S",

@@ -346,9 +346,15 @@ class _TpuCaller(_TpuClass, _TpuParams):
                 feature_bytes / 2**20,
                 threshold,
             )
+            # the HBM batch cache lives exactly as long as this fit: pass 1 of a
+            # multi-pass streamed fit retains its device batches, later passes
+            # replay them, and everything frees at fit exit (ops/device_cache.py)
+            from ..ops.device_cache import batch_cache
+
             with trace(_config.get("trace_dir")):
                 with span(f"{type(self).__name__}.fit_streaming", verbose):
-                    return [self._streaming_fit(fd)]
+                    with batch_cache():
+                        return [self._streaming_fit(fd)]
 
         with trace(_config.get("trace_dir")):
             with span(f"{type(self).__name__}.prepare", verbose):

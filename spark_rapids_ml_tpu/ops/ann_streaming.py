@@ -189,8 +189,12 @@ def streaming_ivfpq_build(
         policy.run(_encode_batch, site="ann_encode")
 
     cell_ids = flat["cell_ids"]
-    max_cell = cell_ids.shape[1]
-    codes = np.zeros((nlist, max_cell, m_subvectors), np.uint8)
+    # size codes from the BUILT index, not the requested nlist: the IVF build
+    # clamps nlist to the subsample size (streaming_ivfflat_build), so the
+    # caller's nlist can exceed cell_ids.shape[0] — codes must match the
+    # centers/cell layout actually built (ADVICE round-5 finding)
+    nlist_eff, max_cell = cell_ids.shape
+    codes = np.zeros((nlist_eff, max_cell, m_subvectors), np.uint8)
     pos = cell_ids >= 0
     codes[pos] = codes_flat[cell_ids[pos]]
     return {

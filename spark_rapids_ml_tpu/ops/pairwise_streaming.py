@@ -46,7 +46,17 @@ from .streaming import _prefetch
 _I32MAX = np.iinfo(np.int32).max
 
 
-def _shard_blocks(X: np.ndarray, block: int, mesh, extras=None):
+def _cached_tile(cache, cache_key, batch_index, build):
+    """Item-block flavor of the shared cache-or-upload protocol
+    (device_cache.cached_build): the fault point has already fired — replayed
+    tiles stay fault-injectable."""
+    from .device_cache import cached_build
+
+    return cached_build(cache, cache_key, batch_index, "pairwise", build)
+
+
+def _shard_blocks(X: np.ndarray, block: int, mesh, extras=None, cache=None,
+                  cache_key=None):
     """Mesh variant of `_device_blocks`: each item block is SHARDED over the
     data axis (host->device traffic stays one copy of the data per sweep; the
     per-tile merge rides ICI collectives instead), row-aligned extras shard the
@@ -59,14 +69,18 @@ def _shard_blocks(X: np.ndarray, block: int, mesh, extras=None):
         for s in range(0, n, block):
             e = min(s + block, n)
             fault_point("pairwise", batch=s // block)
-            xb = np.zeros((block,) + X.shape[1:], np.float32)
-            xb[: e - s] = X[s:e]
-            devs = [shard_array(xb, mesh)]
-            for a in extras or ():
-                ab = np.zeros((block,) + a.shape[1:], a.dtype)
-                ab[: e - s] = a[s:e]
-                devs.append(shard_array(ab, mesh))
-            yield (s, e - s, *devs)
+
+            def build(s=s, e=e):
+                xb = np.zeros((block,) + X.shape[1:], np.float32)
+                xb[: e - s] = X[s:e]
+                devs = [shard_array(xb, mesh)]
+                for a in extras or ():
+                    ab = np.zeros((block,) + a.shape[1:], a.dtype)
+                    ab[: e - s] = a[s:e]
+                    devs.append(shard_array(ab, mesh))
+                return (s, e - s, *devs)
+
+            yield _cached_tile(cache, cache_key, s // block, build)
 
     return _prefetch(gen(), depth=1, site="pairwise")
 
@@ -167,7 +181,8 @@ def _round_block(block: int, mesh) -> int:
     return max(n_dev, ((block + n_dev - 1) // n_dev) * n_dev)
 
 
-def _device_blocks(X: np.ndarray, block: int, extras=None):
+def _device_blocks(X: np.ndarray, block: int, extras=None, cache=None,
+                   cache_key=None):
     """Yield (start, n_valid, device_block, *device_extras) with the ragged tail
     zero-padded to `block` (ONE compiled tile shape for the whole stream).
     `extras`: list of row-aligned host arrays uploaded alongside (labels, masks)."""
@@ -177,14 +192,18 @@ def _device_blocks(X: np.ndarray, block: int, extras=None):
         for s in range(0, n, block):
             e = min(s + block, n)
             fault_point("pairwise", batch=s // block)
-            xb = np.zeros((block,) + X.shape[1:], np.float32)
-            xb[: e - s] = X[s:e]
-            devs = [jax.device_put(jnp.asarray(xb))]
-            for a in extras or ():
-                ab = np.zeros((block,) + a.shape[1:], a.dtype)
-                ab[: e - s] = a[s:e]
-                devs.append(jax.device_put(jnp.asarray(ab)))
-            yield (s, e - s, *devs)
+
+            def build(s=s, e=e):
+                xb = np.zeros((block,) + X.shape[1:], np.float32)
+                xb[: e - s] = X[s:e]
+                devs = [jax.device_put(jnp.asarray(xb))]
+                for a in extras or ():
+                    ab = np.zeros((block,) + a.shape[1:], a.dtype)
+                    ab[: e - s] = a[s:e]
+                    devs.append(jax.device_put(jnp.asarray(ab)))
+                return (s, e - s, *devs)
+
+            yield _cached_tile(cache, cache_key, s // block, build)
 
     return _prefetch(gen(), depth=1, site="pairwise")
 
@@ -217,45 +236,66 @@ def streaming_exact_knn(
     FAST-precision distance form) at any dataset size. Device residency is one
     query block + one item block + the (query_block, k) running state. With a
     multi-device `mesh`, item blocks shard over the data axis (one host copy of
-    the data per sweep; the per-tile candidate merge all_gathers over ICI)."""
+    the data per sweep; the per-tile candidate merge all_gathers over ICI).
+
+    The item stream is swept once PER QUERY BLOCK — the HBM batch cache
+    (ops/device_cache.py) retains the tiles the first sweep uploads, so the
+    remaining ceil(nq/query_block)-1 sweeps replay from HBM (prefix-cached when
+    the item set exceeds the budget)."""
+    from .device_cache import batch_cache
+
     n, d = X.shape
     k_eff = min(k, n)
     nq = Q.shape[0]
     mesh = _mesh_or_none(mesh)
-    if mesh is not None:
-        item_block = _round_block(item_block, mesh)
-        tile = _mk_tile_topk_mesh(mesh, item_block, k_eff)
+    with batch_cache() as cache:
+        if mesh is not None:
+            item_block = _round_block(item_block, mesh)
+            ckey = (
+                cache.stream_key((X,), item_block, mesh, site="pairwise")
+                if cache is not None
+                else None
+            )
+            tile = _mk_tile_topk_mesh(mesh, item_block, k_eff)
 
-        def merge(qb, xb, nv, s, bd, bi):
-            return tile(qb, xb, jnp.int32(nv), jnp.int32(s), bd, bi)
+            def merge(qb, xb, nv, s, bd, bi):
+                return tile(qb, xb, jnp.int32(nv), jnp.int32(s), bd, bi)
 
-        def blocks():
-            return _shard_blocks(X, item_block, mesh)
-    else:
-        def merge(qb, xb, nv, s, bd, bi):
-            return _tile_topk_merge(qb, xb, nv, s, bd, bi, k_eff)
+            def blocks():
+                return _shard_blocks(
+                    X, item_block, mesh, cache=cache, cache_key=ckey
+                )
+        else:
+            ckey = (
+                cache.stream_key((X,), item_block, None, site="pairwise")
+                if cache is not None
+                else None
+            )
 
-        def blocks():
-            return _device_blocks(X, item_block)
+            def merge(qb, xb, nv, s, bd, bi):
+                return _tile_topk_merge(qb, xb, nv, s, bd, bi, k_eff)
 
-    out_d = np.empty((nq, k_eff), np.float32)
-    out_i = np.empty((nq, k_eff), np.int64)
-    policy = RetryPolicy.from_config()
-    for qs in range(0, nq, query_block):
-        qe = min(qs + query_block, nq)
+            def blocks():
+                return _device_blocks(X, item_block, cache=cache, cache_key=ckey)
 
-        def _scan_query_block(qs=qs, qe=qe):
-            # running state re-initializes per attempt, so a transient tile
-            # failure replays this query block exactly (deterministic merge)
-            qb = jnp.asarray(np.ascontiguousarray(Q[qs:qe], np.float32))
-            best_d = jnp.full((qe - qs, k_eff), jnp.inf, jnp.float32)
-            best_i = jnp.full((qe - qs, k_eff), -1, jnp.int32)
-            for s, nv, xb in blocks():
-                best_d, best_i = merge(qb, xb, nv, s, best_d, best_i)
-            out_d[qs:qe] = np.sqrt(np.asarray(best_d))
-            out_i[qs:qe] = np.asarray(best_i).astype(np.int64)
+        out_d = np.empty((nq, k_eff), np.float32)
+        out_i = np.empty((nq, k_eff), np.int64)
+        policy = RetryPolicy.from_config()
+        for qs in range(0, nq, query_block):
+            qe = min(qs + query_block, nq)
 
-        policy.run(_scan_query_block, site="pairwise")
+            def _scan_query_block(qs=qs, qe=qe):
+                # running state re-initializes per attempt, so a transient tile
+                # failure replays this query block exactly (deterministic merge)
+                qb = jnp.asarray(np.ascontiguousarray(Q[qs:qe], np.float32))
+                best_d = jnp.full((qe - qs, k_eff), jnp.inf, jnp.float32)
+                best_i = jnp.full((qe - qs, k_eff), -1, jnp.int32)
+                for s, nv, xb in blocks():
+                    best_d, best_i = merge(qb, xb, nv, s, best_d, best_i)
+                out_d[qs:qe] = np.sqrt(np.asarray(best_d))
+                out_i[qs:qe] = np.asarray(best_i).astype(np.int64)
+
+            policy.run(_scan_query_block, site="pairwise")
     return out_d, out_i
 
 
@@ -282,11 +322,19 @@ def _streamed_min_core_labels(
     query_block: int,
     item_block: int,
     mesh=None,
+    cache=None,
 ) -> np.ndarray:
     """One full streamed sweep: per row, min label among its CORE eps-neighbors
     (int32 max where none) — the out-of-core analog of
-    ops/dbscan.py::_min_core_neighbor_labels."""
+    ops/dbscan.py::_min_core_neighbor_labels. The tile key includes the labels/
+    core arrays, so tiles replay across the query blocks of ONE round and the
+    next round's fresh labels naturally LRU-evict them."""
     n = X.shape[0]
+    ckey = (
+        cache.stream_key((X, labels, core), item_block, mesh, site="pairwise")
+        if cache is not None
+        else None
+    )
     if mesh is not None:
         tile_fn = _mk_tile_minlabel_mesh(mesh, item_block)
 
@@ -294,13 +342,19 @@ def _streamed_min_core_labels(
             return tile_fn(qb, xb, lb, cb, jnp.int32(nv), jnp.float32(eps2))
 
         def blocks():
-            return _shard_blocks(X, item_block, mesh, extras=[labels, core])
+            return _shard_blocks(
+                X, item_block, mesh, extras=[labels, core],
+                cache=cache, cache_key=ckey,
+            )
     else:
         def tile(qb, xb, lb, cb, nv):
             return _tile_min_core_label(qb, xb, lb, cb, nv, eps2)
 
         def blocks():
-            return _device_blocks(X, item_block, extras=[labels, core])
+            return _device_blocks(
+                X, item_block, extras=[labels, core],
+                cache=cache, cache_key=ckey,
+            )
 
     mins = np.full((n,), _I32MAX, np.int32)
     policy = RetryPolicy.from_config()
@@ -332,7 +386,24 @@ def streaming_dbscan_fit_predict(
     ops/dbscan.py::dbscan_fit_predict (noise = -1, clusters compacted in
     first-appearance order). The propagation loop is host-driven: each round
     pays one streamed pairwise sweep, then the hook + two pointer-jumping
-    contractions run in numpy (exactly ops/dbscan.py::_hook_and_jump's math)."""
+    contractions run in numpy (exactly ops/dbscan.py::_hook_and_jump's math).
+
+    ONE batch cache spans the whole fit: the core-mask pass and every
+    propagation round sweep the same item tiles per query block, so tiles
+    upload once per (round, labels) key and replay from HBM across that
+    round's query blocks, with LRU eviction as rounds retire their labels."""
+    from .device_cache import batch_cache
+
+    with batch_cache() as cache:
+        return _streaming_dbscan_fit_predict(
+            X, eps, min_samples, metric, max_rounds, query_block, item_block,
+            mesh, cache,
+        )
+
+
+def _streaming_dbscan_fit_predict(
+    X, eps, min_samples, metric, max_rounds, query_block, item_block, mesh, cache,
+):
     from .dbscan import _compact_labels
 
     X = np.ascontiguousarray(np.asarray(X), dtype=np.float32)
@@ -354,19 +425,29 @@ def streaming_dbscan_fit_predict(
     mesh = _mesh_or_none(mesh)
     if mesh is not None:
         item_block = _round_block(item_block, mesh)
+    count_key = (
+        cache.stream_key((X,), item_block, mesh, site="pairwise")
+        if cache is not None
+        else None
+    )
+    if mesh is not None:
         count_fn = _mk_tile_count_mesh(mesh, item_block)
 
         def count_tile(qb, xb, nv):
             return count_fn(qb, xb, jnp.int32(nv), jnp.float32(eps2))
 
         def count_blocks():
-            return _shard_blocks(X, item_block, mesh)
+            return _shard_blocks(
+                X, item_block, mesh, cache=cache, cache_key=count_key
+            )
     else:
         def count_tile(qb, xb, nv):
             return _tile_count(qb, xb, nv, eps2)
 
         def count_blocks():
-            return _device_blocks(X, item_block)
+            return _device_blocks(
+                X, item_block, cache=cache, cache_key=count_key
+            )
 
     # pass 1: streamed core mask
     core = np.empty((n,), bool)
@@ -389,7 +470,8 @@ def streaming_dbscan_fit_predict(
     converged = False
     for _ in range(max_rounds):
         mins = _streamed_min_core_labels(
-            X, labels, core, eps2, query_block, item_block, mesh=mesh
+            X, labels, core, eps2, query_block, item_block, mesh=mesh,
+            cache=cache,
         )
         new = np.where(core, np.minimum(labels, mins), labels).astype(np.int32)
         new = new[new]
@@ -407,7 +489,8 @@ def streaming_dbscan_fit_predict(
         border_min = mins
     else:
         border_min = _streamed_min_core_labels(
-            X, labels, core, eps2, query_block, item_block, mesh=mesh
+            X, labels, core, eps2, query_block, item_block, mesh=mesh,
+            cache=cache,
         )
     out = np.full((n,), -1, dtype=np.int64)
     out[core] = labels[core]

@@ -78,7 +78,7 @@ def _prefetch(iterable, depth: int = 1, site: Optional[str] = None, start_batch:
 
 
 def _batch_stream(n: int, batch_rows: int, mesh, slicer, start_row: int = 0,
-                  site: str = "ingest"):
+                  site: str = "ingest", cache=None, cache_key=None):
     """THE out-of-core ingest loop, shared by every streamed fit: `slicer(s, e)`
     returns row-aligned HOST arrays — X first, the weight vector LAST — for rows
     [s, e); this pads to the mesh (zero-weighting pad rows), shards, and yields
@@ -87,37 +87,56 @@ def _batch_stream(n: int, batch_rows: int, mesh, slicer, start_row: int = 0,
     instead was measured to upload a nearly-all-zeros full batch per pass when
     n % batch_rows is small). `start_row` (a batch boundary) re-opens the stream
     mid-pass for checkpoint-resume; `site` names the fault-injection point
-    (reliability/faults.py) planted before each batch is sliced."""
+    (reliability/faults.py) planted before each batch is sliced.
+
+    With a `cache` (ops/device_cache.py) + `cache_key`, batches already HBM-
+    resident replay without touching the host; fresh batches are retained after
+    upload, budget permitting. The fault point fires BEFORE the cache lookup so
+    replayed batches stay fault-injectable, and every actual upload is counted
+    (`stream.upload_batches`/`stream.upload_bytes`) and timed
+    (`stream.ingest_s.<site>` in span_totals) — the evidence that passes 2..N
+    of a cached fit stop paying host->device ingest."""
     from ..parallel.mesh import shard_array
     from ..parallel.partition import pad_rows
 
+    from .device_cache import cached_build
+
     for s in range(start_row, n, batch_rows):
         e = min(s + batch_rows, n)
-        fault_point(site, batch=s // batch_rows)
-        arrays = slicer(s, e)
-        if mesh is not None:
-            X_, *extras = arrays
-            Xp, pad_w, extras_p = pad_rows(X_, mesh.devices.size, *extras)
-            *mid, wv = extras_p
-            out = [shard_array(Xp, mesh)]
-            out += [shard_array(a, mesh) for a in mid]
-            out.append(shard_array(pad_w * wv, mesh))
-            yield tuple(out)
-        else:
-            yield tuple(jnp.asarray(a) for a in arrays)
+        batch_index = s // batch_rows
+        fault_point(site, batch=batch_index)
+
+        def build(s=s, e=e):
+            arrays = slicer(s, e)
+            if mesh is not None:
+                X_, *extras = arrays
+                Xp, pad_w, extras_p = pad_rows(X_, mesh.devices.size, *extras)
+                *mid, wv = extras_p
+                out = [shard_array(Xp, mesh)]
+                out += [shard_array(a, mesh) for a in mid]
+                out.append(shard_array(pad_w * wv, mesh))
+                return tuple(out)
+            return tuple(jnp.asarray(a) for a in arrays)
+
+        yield cached_build(cache, cache_key, batch_index, site, build)
 
 
-def _accumulate_stream(carry, accum, n, batch_rows, mesh, slicer, site: str = "ingest"):
+def _accumulate_stream(carry, accum, n, batch_rows, mesh, slicer, site: str = "ingest",
+                       cache=None, cache_key=None):
     """Checkpoint-resumable streamed accumulation, shared by every streamed fit:
     fold `accum(carry, batch_tuple) -> carry` over the prefetched batch stream,
     snapshotting (carry, cursor) every reliability.checkpoint_batches batches so
     a transient batch failure resumes from the last snapshot instead of
     restarting the pass (reliability/checkpoint.py) — resumed results are
-    bit-identical to the fault-free pass."""
+    bit-identical to the fault-free pass. `cache`/`cache_key` (multi-pass fits:
+    one cache handle across all passes) replay HBM-resident batches instead of
+    re-uploading; a resumed stream replays hits and re-uploads misses through
+    the same cursor arithmetic."""
 
     def factory(start_row: int):
         return _prefetch(
-            _batch_stream(n, batch_rows, mesh, slicer, start_row=start_row, site=site),
+            _batch_stream(n, batch_rows, mesh, slicer, start_row=start_row, site=site,
+                          cache=cache, cache_key=cache_key),
             site=site,
             start_batch=start_row // batch_rows,
         )
@@ -125,7 +144,12 @@ def _accumulate_stream(carry, accum, n, batch_rows, mesh, slicer, site: str = "i
     return resumable_accumulate(site, factory, accum, carry, batch_rows, n)
 
 
-@jax.jit
+# Every streamed accumulator donates its carry (argnum 0): the per-batch carry
+# update then reuses the old stats buffers instead of allocating a fresh set
+# per batch. Batch operands are NEVER donated — cached batches (device_cache)
+# must survive the call to replay on later passes. The checkpoint-resume layer
+# snapshots carry COPIES for the same reason (reliability/checkpoint.py).
+@functools.partial(jax.jit, donate_argnums=(0,))
 def _accum_linreg(carry, X, y, w):
     A, b, sx, sy, sw = carry
     Xw = X * w[:, None]
@@ -138,7 +162,7 @@ def _accum_linreg(carry, X, y, w):
     )
 
 
-@jax.jit
+@functools.partial(jax.jit, donate_argnums=(0,))
 def _accum_cov(carry, X, w):
     S2, sx, sw = carry
     return (
@@ -223,12 +247,36 @@ def streaming_covariance(
     return cov, mean, sw
 
 
-@functools.partial(jax.jit, static_argnames=("fit_intercept", "multinomial"))
-def _logreg_batch_value_grad(params, X, y_enc, w, scale, fit_intercept, multinomial):
-    """UNNORMALIZED batch cross-entropy value+grad (no /Σw, no penalty): batches
-    accumulate exactly; the caller normalizes and adds the L2 term once. The
-    per-batch loss form mirrors ops/logistic._binomial_loss_fn /
-    _multinomial_loss_fn so the streamed objective is the in-core objective."""
+def _kahan_add(acc, comp, term):
+    """One compensated-summation step: returns (acc', comp') with the low-order
+    bits the naive add would drop carried in `comp`. Accumulation error stays
+    O(1) ulps over ANY number of batches instead of growing O(n_batches) —
+    float32 device accumulation then matches the effective precision of the
+    pre-donation float64 HOST accumulation it replaced (the per-batch terms
+    were always float32; only their summation ever benefited from float64).
+    XLA does not reassociate IEEE float ops, so the cancellation survives jit."""
+    y = term - comp
+    t = acc + y
+    return t, (t - acc) - y
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("fit_intercept", "multinomial"),
+    donate_argnums=(0, 1, 2, 3),
+)
+def _logreg_accum_value_grad(
+    acc_v, comp_v, acc_g, comp_g, params, X, y_enc, w, scale, fit_intercept,
+    multinomial,
+):
+    """One batch of the UNNORMALIZED cross-entropy value+grad folded into the
+    running device accumulators (no /Σw, no penalty — the caller normalizes and
+    adds the L2 term once). The per-batch loss form mirrors
+    ops/logistic._binomial_loss_fn / _multinomial_loss_fn so the streamed
+    objective is the in-core objective. The whole carry (accumulators + Kahan
+    compensations) is donated: each batch update reuses the buffers in place of
+    a fresh allocation, and the running loss/grad never round-trips to host
+    mid-pass."""
 
     def f(p):
         if multinomial:
@@ -239,10 +287,13 @@ def _logreg_batch_value_grad(params, X, y_enc, w, scale, fit_intercept, multinom
         z = pdot(X, coef_s / scale) + jnp.where(fit_intercept, b, 0.0)
         return jnp.sum(w * (jax.nn.softplus(z) - y_enc * z))
 
-    return jax.value_and_grad(f)(params)
+    v, g = jax.value_and_grad(f)(params)
+    acc_v, comp_v = _kahan_add(acc_v, comp_v, v)
+    acc_g, comp_g = _kahan_add(acc_g, comp_g, g)
+    return acc_v, comp_v, acc_g, comp_g
 
 
-@jax.jit
+@functools.partial(jax.jit, donate_argnums=(0,))
 def _accum_moments(carry, X, w):
     sx, sxx, sw = carry
     return (sx + pdot(w, X), sxx + pdot(w, X * X), sw + jnp.sum(w))
@@ -351,13 +402,36 @@ def streaming_logreg_fit(
     Pass counts (docs/performance.md): L-BFGS costs 1 + ~2-4 streamed passes per
     iteration (one per line-search objective evaluation); FISTA costs exactly
     1 + n_iter passes plus one Gram pass (+1 moments pass when standardizing).
-    Every batch is re-uploaded per pass — that is the out-of-core contract; the
-    ragged tail batch compiles one extra accumulator entry once and reuses it
-    every pass."""
+    ONE batch cache (ops/device_cache.py) spans every pass of the fit — the
+    moments/Gram passes populate it and each value_and_grad evaluation replays
+    from HBM, so only pass 1 (plus whatever exceeds the cache budget) pays
+    host->device ingest; with the cache disabled every batch re-uploads per
+    pass, the original out-of-core contract. The ragged tail batch compiles one
+    extra accumulator entry once and reuses it every pass."""
+    from .device_cache import batch_cache
+
+    with batch_cache() as cache:
+        return _streaming_logreg_fit(
+            X, y, w, n_classes, reg, l1_ratio, fit_intercept, standardize,
+            max_iter, tol, multinomial, batch_rows, mesh, float32, cache,
+        )
+
+
+def _streaming_logreg_fit(
+    X, y, w, n_classes, reg, l1_ratio, fit_intercept, standardize, max_iter,
+    tol, multinomial, batch_rows, mesh, float32, cache,
+):
     dt = np.float32 if float32 else np.float64
     n, d = X.shape
     reg_l1 = reg * l1_ratio
     reg_l2 = reg * (1.0 - l1_ratio)
+    ckey = (
+        cache.stream_key(
+            tuple(a for a in (X, y, w) if a is not None), batch_rows, mesh
+        )
+        if cache is not None
+        else None
+    )
 
     def _slicer(s, e):
         return (
@@ -374,7 +448,7 @@ def streaming_logreg_fit(
         carry = (jnp.zeros((d,), dt), jnp.zeros((d,), dt), jnp.zeros((), dt))
         carry = _accumulate_stream(
             carry, lambda c, batch: _accum_moments(c, batch[0], batch[2]),
-            n, batch_rows, mesh, _slicer,
+            n, batch_rows, mesh, _slicer, cache=cache, cache_key=ckey,
         )
         sx, sxx, sw_j = carry
         wsum = float(sw_j)
@@ -405,20 +479,26 @@ def streaming_logreg_fit(
                 if multinomial
                 else yb
             )
-            v, g = _logreg_batch_value_grad(
-                params, Xb, y_enc, wb, scale, bool(fit_intercept), bool(multinomial)
+            # Kahan-compensated device accumulation with the carry DONATED
+            # (buffer reuse per batch); functional from the caller's view — the
+            # resume layer's snapshots are copies (reliability/checkpoint.py),
+            # never aliases of a buffer a later batch will donate
+            return _logreg_accum_value_grad(
+                *carry, params, Xb, y_enc, wb, scale,
+                bool(fit_intercept), bool(multinomial),
             )
-            # functional host accumulation (new objects, never +=): snapshots in
-            # the resume layer hold references to prior carries
-            return carry[0] + float(v), carry[1] + np.asarray(g, np.float64)
 
-        acc_v, acc_g = _accumulate_stream(
-            (0.0, np.zeros(shape, np.float64)), _accum_vg,
-            n, batch_rows, mesh, _slicer,
+        acc_v, _, acc_g, _ = _accumulate_stream(
+            (
+                jnp.zeros((), dt), jnp.zeros((), dt),
+                jnp.zeros(shape, dt), jnp.zeros(shape, dt),
+            ),
+            _accum_vg,
+            n, batch_rows, mesh, _slicer, cache=cache, cache_key=ckey,
         )
         coef_s = params_flat.reshape(shape)[..., :-1]
-        value = acc_v / wsum + 0.5 * reg_l2 * float(np.sum(coef_s * coef_s))
-        grad = acc_g / wsum
+        value = float(acc_v) / wsum + 0.5 * reg_l2 * float(np.sum(coef_s * coef_s))
+        grad = np.asarray(acc_g, np.float64) / wsum
         grad[..., :-1] += reg_l2 * coef_s
         return value, grad.reshape(-1)
 
@@ -432,7 +512,7 @@ def streaming_logreg_fit(
         carry = (jnp.zeros((d, d), dt), jnp.zeros((d,), dt), jnp.zeros((), dt))
         carry = _accumulate_stream(
             carry, lambda c, batch: _accum_cov(c, batch[0] / scale, batch[2]),
-            n, batch_rows, mesh, _slicer,
+            n, batch_rows, mesh, _slicer, cache=cache, cache_key=ckey,
         )
         S2, _, sw_g = carry
         lmax = float(power_iteration_lmax(S2 / sw_g))
@@ -539,7 +619,7 @@ def _finish_logreg(x, shape, scale_h, fit_intercept, multinomial, n_iter, fx):
     }
 
 
-@functools.partial(jax.jit, static_argnames=("cosine",))
+@functools.partial(jax.jit, static_argnames=("cosine",), donate_argnums=(0,))
 def _accum_kmeans(carry, centers, X, w, cosine: bool = False):
     """One batch of a streamed Lloyd iteration: accumulate per-cluster weighted sums,
     counts and inertia against FIXED centers."""
@@ -576,19 +656,36 @@ def streaming_kmeans_fit(
     """Out-of-core EXACT Lloyd: each iteration streams every batch through the device
     against fixed centers and accumulates (Σ one-hotᵀWX, counts, inertia); centers
     update once per full pass, so iterates match in-core Lloyd on the same init
-    (not a minibatch approximation). Device residency is one batch + (k, d) stats —
-    the KMeans analog of the reference's UVM/SAM large-dataset path
+    (not a minibatch approximation). Device residency is one batch + (k, d) stats
+    plus whatever the HBM batch cache retains: ONE cache (ops/device_cache.py)
+    spans every Lloyd iteration, so iteration 1 uploads and iterations 2..N
+    replay from HBM (prefix-cached when the dataset exceeds the budget) — the
+    KMeans analog of the reference's UVM/SAM large-dataset path
     (reference utils.py:184-241). Initialization runs in-core k-means|| on a row
     subsample bounded by `init_sample_rows`."""
+    from .device_cache import batch_cache
+
+    with batch_cache() as cache:
+        return _streaming_kmeans_fit(
+            X, w, k, max_iter, tol, seed, batch_rows, mesh, metric,
+            init_sample_rows, float32, cache,
+        )
+
+
+def _streaming_kmeans_fit(
+    X, w, k, max_iter, tol, seed, batch_rows, mesh, metric, init_sample_rows,
+    float32, cache,
+):
     from .kmeans import _normalize_rows, kmeans_init
-    from ..parallel.mesh import shard_array
-    from ..parallel.partition import pad_rows
 
     dt = np.float32 if float32 else np.float64
     n, d = X.shape
     cosine = metric == "cosine"
     if w is None:
         w = np.ones((n,), dt)
+    ckey = (
+        cache.stream_key((X, w), batch_rows, mesh) if cache is not None else None
+    )
 
     # init on a subsample (rows are not assumed shuffled: use a strided sample)
     step = max(1, n // min(n, init_sample_rows))
@@ -626,7 +723,7 @@ def streaming_kmeans_fit(
             lambda c, batch, centers=centers: _accum_kmeans(
                 c, centers, batch[0], batch[1], cosine
             ),
-            n, batch_rows, mesh, _slicer,
+            n, batch_rows, mesh, _slicer, cache=cache, cache_key=ckey,
         )
         sums, counts, inertia_j = carry
         new_centers = jnp.where(

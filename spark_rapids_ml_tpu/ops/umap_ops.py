@@ -430,16 +430,41 @@ def _minkowski_knn(
 
 
 def _dense_knn_graph(
-    Xj, k: int, metric: str, metric_kwds, build_algo: str, build_kwds, seed: int
+    X, k: int, metric: str, metric_kwds, build_algo: str, build_kwds, seed: int
 ) -> Tuple[np.ndarray, np.ndarray]:
     """kNN graph of a dense matrix under the requested metric. Euclidean-family and
     cosine ride the MXU matmul path; manhattan/minkowski use the blocked VPU scan.
     build_algo='nn_descent' (cuML's approximate graph build) maps to the IVF-Flat
     approximate index — same role: an approximate kNN graph much faster than brute
-    force at large n (reference umap.py:114-137 `build_algo`/`build_kwds`)."""
+    force at large n (reference umap.py:114-137 `build_algo`/`build_kwds`).
+
+    Above stream_threshold_bytes the euclidean-family exact graph goes OUT OF
+    CORE through the blocked pairwise scan (ops/pairwise_streaming.py): the
+    dataset stays host-resident and the HBM batch cache replays the item tiles
+    across query-block sweeps instead of re-uploading the matrix
+    ceil(n/query_block) times — same neighbors rank-for-rank (the streamed scan
+    shares `_block_sq_dists` with the in-core one)."""
     from .knn import exact_knn_single, ivfflat_build, ivfflat_search
     import jax.numpy as jnp
 
+    from .. import config as _config
+
+    Xh = np.asarray(X, dtype=np.float32)
+    if (
+        metric in ("euclidean", "l2", "sqeuclidean")
+        and build_algo != "nn_descent"
+        and int(_config.get("stream_threshold_bytes") or 0)
+        and Xh.nbytes > int(_config.get("stream_threshold_bytes"))
+    ):
+        from .pairwise_streaming import streaming_exact_knn
+
+        dists, ids = streaming_exact_knn(Xh, Xh, k)
+        dists = dists.astype(np.float32)
+        if metric == "sqeuclidean":
+            dists = dists**2
+        return ids, dists
+
+    Xj = jnp.asarray(Xh)
     n = Xj.shape[0]
     valid = jnp.ones((n,), bool)
     if build_algo == "nn_descent" and metric not in (
@@ -560,7 +585,7 @@ def umap_fit(
             )
     else:
         knn_ids, knn_dists = _dense_knn_graph(
-            jnp.asarray(X), k, metric, metric_kwds, build_algo, build_kwds, seed
+            np.asarray(X), k, metric, metric_kwds, build_algo, build_kwds, seed
         )
 
     heads, tails, weights = fuzzy_simplicial_set(

@@ -42,6 +42,15 @@ def span(name: str, verbose: bool = False) -> Iterator[None]:
         _logger.info("%s: %.3fs", name, dt)
 
 
+def add_time(name: str, seconds: float) -> None:
+    """Accumulate seconds under a span name WITHOUT the TraceAnnotation
+    machinery — the per-batch path (streamed ingest timing, ops/streaming.py)
+    calls this once per batch, where importing jax.profiler per call would
+    cost more than the slice being measured. Shows up in span_totals()
+    alongside the context-manager spans."""
+    _spans[name] = _spans.get(name, 0.0) + seconds
+
+
 def span_totals() -> Dict[str, float]:
     """Accumulated seconds per span name since process start (or last reset)."""
     return dict(_spans)
@@ -56,7 +65,12 @@ def count(name: str, n: int = 1) -> None:
     retry/resume/degrade/fault-firing totals here (`reliability.retry`,
     `reliability.retry.<site>`, `reliability.resume[.<site>]`,
     `reliability.degrade.*`, `reliability.fault[.<site>]`) so behavior under
-    faults is observable rather than silent."""
+    faults is observable rather than silent. The streamed-ingest tier reports
+    `stream.upload_batches` / `stream.upload_bytes` (every host->device batch
+    upload) and the HBM batch cache reports `cache.hits` / `cache.misses` /
+    `cache.evictions` plus the `cache.bytes_resident` gauge (negative
+    increments on eviction/close), so "pass 2 re-uploaded nothing" is an
+    assertable fact, not an inference from wall-clock."""
     with _counters_lock:
         _counters[name] = _counters.get(name, 0) + n
 

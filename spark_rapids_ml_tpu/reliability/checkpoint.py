@@ -29,6 +29,23 @@ from .policy import RetryPolicy
 _logger = get_logger("reliability.checkpoint")
 
 
+def _copy_carry(carry: Any) -> Any:
+    """Snapshot-safe copy of a carry: device-array leaves are copied, host
+    leaves pass through by reference. The streamed accumulators DONATE their
+    carry argument (ops/streaming.py) so the device buffers are reused in
+    place batch to batch — a snapshot that merely aliased the carry would be
+    invalidated by the very next accumulation and a resume would touch deleted
+    buffers. Host leaves stay reference-snapshots: the host accumulators are
+    functional (new objects, never +=), the original snapshot contract."""
+    try:
+        import jax
+    except ImportError:  # pragma: no cover - jax is a hard dep everywhere else
+        return carry
+    return jax.tree_util.tree_map(
+        lambda leaf: leaf.copy() if isinstance(leaf, jax.Array) else leaf, carry
+    )
+
+
 def resumable_accumulate(
     site: str,
     stream_factory: Callable[[int], Iterable[Any]],
@@ -54,13 +71,16 @@ def resumable_accumulate(
 
     every = max(1, int(_config.get("reliability.checkpoint_batches")))
     policy = RetryPolicy.from_config()
-    snap_carry, snap_row = carry, int(start_row)
+    # snapshots (and the restore below) COPY device leaves: the accumulators
+    # donate their carry, so an aliased snapshot would be deleted by the next
+    # batch's buffer reuse (see _copy_carry)
+    snap_carry, snap_row = _copy_carry(carry), int(start_row)
     failures = 0
     t0 = time.monotonic()
     while True:
         attempt_start_row = snap_row
         row = snap_row
-        carry = snap_carry
+        carry = _copy_carry(snap_carry)
         try:
             done = 0
             for batch in stream_factory(row):
@@ -68,7 +88,7 @@ def resumable_accumulate(
                 row = min(row + batch_rows, n_rows)
                 done += 1
                 if done % every == 0:
-                    snap_carry, snap_row = carry, row
+                    snap_carry, snap_row = _copy_carry(carry), row
             return carry
         except Exception as e:
             if snap_row > attempt_start_row:

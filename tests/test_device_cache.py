@@ -1,0 +1,409 @@
+"""HBM-resident batch cache (ops/device_cache.py): multi-pass streamed fits
+retain pass-1 device batches and replay passes 2..N from HBM.
+
+The load-bearing contracts (ISSUE acceptance):
+  * for every multi-pass streamed estimator, pass 2+ performs ZERO host->device
+    batch uploads when the dataset fits `cache.hbm_budget_bytes` — asserted via
+    the `stream.upload_*` / `cache.*` profiling counters, not wall-clock,
+  * cached-replay results are BIT-IDENTICAL to the pure-streaming path
+    (assert_array_equal, the same bar as the checkpoint-resume tests),
+    including under fault injection + checkpoint-resume mixing cached and
+    streamed batches,
+  * over budget, a PREFIX stays resident and the tail streams every pass
+    (still saving that fraction of uploads), with LRU eviction across streams
+    and exact hit/miss/eviction accounting.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_ml_tpu import config, profiling
+from spark_rapids_ml_tpu.ops.device_cache import (
+    DeviceBatchCache,
+    active_cache,
+    batch_cache,
+)
+from spark_rapids_ml_tpu.reliability import reset_faults
+
+
+@pytest.fixture(autouse=True)
+def cache_env():
+    profiling.reset_counters()
+    reset_faults()
+    yield
+    for key in (
+        "cache.enabled",
+        "cache.hbm_budget_bytes",
+        "stream_threshold_bytes",
+        "stream_batch_rows",
+        "reliability.fault_spec",
+        "reliability.checkpoint_batches",
+        "reliability.backoff_base_s",
+        "reliability.backoff_max_s",
+    ):
+        config.unset(key)
+    reset_faults()
+
+
+@pytest.fixture
+def tiny_stream(n_devices):
+    config.set("stream_threshold_bytes", 1024)
+    config.set("stream_batch_rows", 64)
+    yield
+
+
+def _counters(prefix=("cache.", "stream.")):
+    return {
+        k: v for k, v in profiling.counter_totals().items()
+        if k.startswith(prefix)
+    }
+
+
+# ------------------------------------------------------------- cache unit core
+
+
+def test_cache_unit_prefix_budget_and_exact_counters():
+    """Whole-batch granularity under a byte budget: a stream larger than the
+    budget caches a PREFIX (never evicts its own batches), streams the tail,
+    and hits/misses/evictions/bytes_resident account exactly."""
+    import jax.numpy as jnp
+
+    cache = DeviceBatchCache(budget_bytes=3 * 400)
+    X = np.zeros((8, 100), np.float32)
+    key = cache.stream_key((X,), 1, None)
+    batches = [(jnp.zeros((100,), jnp.float32),) for _ in range(8)]  # 400 B each
+
+    # pass 1: all misses; only the first 3 fit the budget
+    for i in range(8):
+        assert cache.get(key, i) is None
+        cache.put(key, i, batches[i])
+    assert cache.resident_batches() == 3
+    assert cache.bytes_resident == 3 * 400
+
+    # pass 2: prefix hits, tail misses
+    hits = sum(cache.get(key, i) is not None for i in range(8))
+    assert hits == 3
+    totals = _counters()
+    assert totals["cache.misses"] == 8 + 5
+    assert totals["cache.hits"] == 3
+    assert totals.get("cache.evictions", 0) == 0
+    assert totals["cache.bytes_resident"] == 3 * 400
+
+    cache.close()
+    assert profiling.counter_totals()["cache.bytes_resident"] == 0
+    # lifecycle frees are not evictions
+    assert profiling.counter_totals().get("cache.evictions", 0) == 0
+
+
+def test_cache_unit_lru_eviction_across_streams():
+    """A second stream under budget pressure LRU-evicts the first stream's
+    entries (but a stream never evicts itself); eviction counts are exact."""
+    import jax.numpy as jnp
+
+    cache = DeviceBatchCache(budget_bytes=4 * 400)
+    A = np.zeros((4, 1), np.float32)
+    B = np.zeros((4, 2), np.float32)
+    key_a = cache.stream_key((A,), 1, None)
+    key_b = cache.stream_key((B,), 1, None)
+    assert key_a != key_b
+
+    for i in range(4):
+        cache.put(key_a, i, (jnp.zeros((100,), jnp.float32),))
+    assert cache.resident_batches() == 4
+
+    # touch A batches 2,3 so batches 0,1 are LRU
+    assert cache.get(key_a, 2) is not None
+    assert cache.get(key_a, 3) is not None
+    for i in range(2):
+        cache.put(key_b, i, (jnp.zeros((100,), jnp.float32),))
+    totals = _counters()
+    assert totals["cache.evictions"] == 2
+    assert cache.get(key_a, 0) is None  # LRU victim
+    assert cache.get(key_a, 1) is None  # LRU victim
+    assert cache.get(key_a, 2) is not None  # recently-used survivor
+    assert cache.get(key_b, 0) is not None
+    assert cache.bytes_resident == 4 * 400
+    cache.close()
+
+
+def test_batch_cache_scope_nesting_and_disable():
+    """The outermost scope owns the cache; nested scopes reuse it; disabling
+    yields None (pure streaming)."""
+    with batch_cache() as outer:
+        assert outer is not None and active_cache() is outer
+        with batch_cache() as inner:
+            assert inner is outer
+        assert active_cache() is outer  # nested exit must not close the owner
+    assert active_cache() is None
+
+    config.set("cache.enabled", False)
+    with batch_cache() as c:
+        assert c is None
+    config.unset("cache.enabled")
+    config.set("cache.hbm_budget_bytes", 0)
+    with batch_cache() as c:
+        assert c is None
+
+
+# --------------------------------------- streamed estimators: zero pass-2 uploads
+
+
+def test_streamed_kmeans_pass2_zero_uploads_and_bit_identity(tiny_stream):
+    """Streamed KMeans (multi-pass Lloyd) through the ESTIMATOR path: one
+    upload per batch total — every later Lloyd pass replays from HBM — and the
+    cached fit is bit-identical to the cache-disabled pure-streaming fit."""
+    from spark_rapids_ml_tpu.clustering import KMeans
+
+    rng = np.random.default_rng(3)
+    X = np.concatenate(
+        [rng.normal(-3, 0.5, (250, 5)), rng.normal(3, 0.5, (250, 5))]
+    ).astype(np.float32)
+    df = pd.DataFrame({"features": list(X)})
+
+    cached = KMeans(k=2, seed=1, maxIter=10).fit(df).get_model_attributes()
+    totals = _counters()
+    n_batches = -(-500 // 64)
+    passes = int(cached["n_iter"])
+    assert passes >= 2  # the test is vacuous on a single-pass fit
+    assert totals["stream.upload_batches"] == n_batches
+    assert totals["cache.misses"] == n_batches
+    assert totals["cache.hits"] == (passes - 1) * n_batches
+    # estimator lifecycle: the cache died with the fit
+    assert totals["cache.bytes_resident"] == 0
+    assert active_cache() is None
+
+    config.set("cache.enabled", False)
+    profiling.reset_counters()
+    streamed = KMeans(k=2, seed=1, maxIter=10).fit(df).get_model_attributes()
+    totals = _counters()
+    assert totals["stream.upload_batches"] == passes * n_batches
+    assert "cache.hits" not in totals
+
+    for key in ("cluster_centers", "inertia", "n_iter"):
+        np.testing.assert_array_equal(
+            np.asarray(cached[key]), np.asarray(streamed[key]), err_msg=key
+        )
+
+
+def test_streamed_logreg_pass2_zero_uploads_and_bit_identity(tiny_stream):
+    """Streamed LogisticRegression: ONE cache spans every L-BFGS
+    value_and_grad pass, so total uploads == one pass worth of batches no
+    matter how many evaluations the line search spends."""
+    from spark_rapids_ml_tpu.classification import LogisticRegression
+
+    rng = np.random.default_rng(17)
+    X = rng.normal(size=(400, 6)).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float64)
+    df = pd.DataFrame({"features": list(X), "label": y})
+    kw = dict(regParam=0.05, maxIter=25, tol=1e-7)
+
+    cached = LogisticRegression(**kw).fit(df).get_model_attributes()
+    totals = _counters()
+    n_batches = -(-400 // 64)
+    assert totals["stream.upload_batches"] == n_batches
+    assert totals["cache.misses"] == n_batches
+    assert totals["cache.hits"] >= n_batches  # >= one full replayed pass
+    assert totals["cache.hits"] % n_batches == 0  # whole passes, no partials
+    assert totals["cache.bytes_resident"] == 0
+
+    config.set("cache.enabled", False)
+    profiling.reset_counters()
+    streamed = LogisticRegression(**kw).fit(df).get_model_attributes()
+    assert _counters()["stream.upload_batches"] > n_batches
+
+    for key in ("coefficients", "intercepts", "n_iter", "objective"):
+        np.testing.assert_array_equal(
+            np.asarray(cached[key]), np.asarray(streamed[key]), err_msg=key
+        )
+
+
+def test_streamed_logreg_fista_shares_one_cache(tiny_stream):
+    """Elastic-net (streamed FISTA): the Gram/Lipschitz pass populates the
+    same cache the iteration passes replay — still one upload per batch."""
+    from spark_rapids_ml_tpu.classification import LogisticRegression
+
+    rng = np.random.default_rng(9)
+    X = rng.normal(size=(300, 6)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float64)
+    df = pd.DataFrame({"features": list(X), "label": y})
+    LogisticRegression(
+        regParam=0.5, elasticNetParam=0.5, maxIter=30, tol=1e-9
+    ).fit(df)
+    totals = _counters()
+    n_batches = -(-300 // 64)
+    assert totals["stream.upload_batches"] == n_batches
+    assert totals["cache.hits"] > 0
+
+
+# -------------------------------------------- budget fall-through + eviction
+
+
+def test_budget_fallthrough_prefix_cached_tail_streamed(n_devices):
+    """Dataset over budget: the prefix stays resident, the tail re-uploads
+    every pass, and the result is still bit-identical to pure streaming."""
+    from spark_rapids_ml_tpu.ops.streaming import streaming_kmeans_fit
+
+    rng = np.random.default_rng(5)
+    X = np.concatenate(
+        [rng.normal(-3, 0.5, (250, 6)), rng.normal(3, 0.5, (250, 6))]
+    ).astype(np.float32)
+    w = np.ones((500,), np.float32)
+    # full batch tuple = 64*6*4 + 64*4 = 1792 B; 8 batches/pass. Budget fits 3.
+    config.set("cache.hbm_budget_bytes", 3 * 1792 + 100)
+    kw = dict(k=2, max_iter=6, tol=0.0, seed=1, batch_rows=64)
+
+    cached = streaming_kmeans_fit(X, w, **kw)
+    totals = _counters()
+    passes = int(cached["n_iter"])
+    assert passes >= 2
+    n_batches = 8
+    # per pass 2..N: 3 hits, 5 re-uploads
+    assert totals["cache.hits"] == (passes - 1) * 3
+    assert totals["stream.upload_batches"] == n_batches + (passes - 1) * 5
+    assert totals.get("cache.evictions", 0) == 0  # a stream never self-evicts
+
+    config.set("cache.enabled", False)
+    profiling.reset_counters()
+    streamed = streaming_kmeans_fit(X, w, **kw)
+    for key in ("cluster_centers", "inertia", "n_iter"):
+        np.testing.assert_array_equal(
+            np.asarray(cached[key]), np.asarray(streamed[key]), err_msg=key
+        )
+
+
+# ------------------------------------- reliability: faults on replayed batches
+
+
+def test_fault_on_replayed_batch_resumes_mixing_cached_and_streamed(n_devices):
+    """Fault injection on a REPLAYED (cache-hit) batch: the fault point fires
+    before the cache lookup, checkpoint-resume restarts from the snapshot
+    replaying cached batches and re-uploading streamed ones, and the result is
+    bit-identical to the fault-free cached fit. The budget admits only a
+    prefix, so the resumed pass really mixes hits and uploads."""
+    from spark_rapids_ml_tpu.ops.device_cache import batch_cache
+    from spark_rapids_ml_tpu.ops.streaming import streaming_kmeans_fit
+
+    rng = np.random.default_rng(7)
+    X = np.concatenate(
+        [rng.normal(-3, 0.5, (250, 6)), rng.normal(3, 0.5, (250, 6))]
+    ).astype(np.float32)
+    w = np.ones((500,), np.float32)
+    config.set("cache.hbm_budget_bytes", 3 * 1792 + 100)
+    config.set("reliability.checkpoint_batches", 2)
+    config.set("reliability.backoff_base_s", 0.001)
+    config.set("reliability.backoff_max_s", 0.002)
+    kw = dict(k=2, max_iter=4, tol=0.0, seed=1, batch_rows=64)
+
+    clean = streaming_kmeans_fit(X, w, **kw)
+
+    # same X/w objects + an explicit outer scope => the second fit replays the
+    # first fit's cache; the fault then fires on a CACHED batch ordinal
+    with batch_cache() as cache:
+        assert cache is not None
+        warm = streaming_kmeans_fit(X, w, **kw)
+        profiling.reset_counters()
+        config.set("reliability.fault_spec", "ingest:batch=1:raise=OSError")
+        reset_faults()
+        faulted = streaming_kmeans_fit(X, w, **kw)
+        totals = profiling.counter_totals()
+        assert totals.get("reliability.fault.ingest", 0) == 1
+        assert totals.get("reliability.resume.ingest", 0) == 1
+        assert totals["cache.hits"] > 0  # the resumed pass replayed from HBM
+        assert totals["stream.upload_batches"] > 0  # ...and streamed the tail
+
+    for key in ("cluster_centers", "inertia", "n_iter"):
+        np.testing.assert_array_equal(
+            np.asarray(clean[key]), np.asarray(warm[key]), err_msg=key
+        )
+        np.testing.assert_array_equal(
+            np.asarray(clean[key]), np.asarray(faulted[key]), err_msg=key
+        )
+
+
+def test_streamed_fit_resume_bit_identical_with_cache(tiny_stream):
+    """The PR-1 resume contract survives the cache: estimator fit with an
+    injected ingest fault still bit-matches the fault-free fit, with the cache
+    enabled on both sides (counters prove the cache was actually in play)."""
+    from spark_rapids_ml_tpu.clustering import KMeans
+
+    config.set("reliability.checkpoint_batches", 2)
+    config.set("reliability.backoff_base_s", 0.001)
+    config.set("reliability.backoff_max_s", 0.002)
+    rng = np.random.default_rng(19)
+    X = np.concatenate(
+        [rng.normal(-3, 0.5, (200, 5)), rng.normal(3, 0.5, (200, 5))]
+    ).astype(np.float32)
+    df = pd.DataFrame({"features": list(X)})
+
+    def fit():
+        return KMeans(k=2, seed=3, maxIter=10).fit(df).get_model_attributes()
+
+    clean = fit()
+    assert _counters()["cache.hits"] > 0
+    config.set("reliability.fault_spec", "ingest:batch=3:raise=OSError")
+    reset_faults()
+    faulted = fit()
+    totals = profiling.counter_totals()
+    assert totals.get("reliability.fault.ingest", 0) == 1
+    assert totals.get("reliability.resume.ingest", 0) >= 1
+    for key, value in clean.items():
+        if value is None:
+            assert faulted[key] is None
+            continue
+        np.testing.assert_array_equal(
+            np.asarray(value), np.asarray(faulted[key]), err_msg=key
+        )
+
+
+# ----------------------------------------------------- pairwise tile reuse
+
+
+def test_pairwise_exact_knn_tile_reuse(n_devices):
+    """streaming_exact_knn sweeps the item stream once per query block: tiles
+    upload on the first sweep only, later sweeps replay from HBM, and results
+    bit-match the uncached scan."""
+    from spark_rapids_ml_tpu.ops.pairwise_streaming import streaming_exact_knn
+
+    rng = np.random.default_rng(37)
+    X = rng.normal(size=(900, 8)).astype(np.float32)
+    Q = X[:256]
+    d0, i0 = streaming_exact_knn(Q, X, k=5, query_block=64, item_block=256)
+    totals = _counters()
+    n_tiles = -(-900 // 256)
+    n_sweeps = -(-256 // 64)
+    assert totals["stream.upload_batches"] == n_tiles
+    assert totals["cache.hits"] == (n_sweeps - 1) * n_tiles
+
+    config.set("cache.enabled", False)
+    d1, i1 = streaming_exact_knn(Q, X, k=5, query_block=64, item_block=256)
+    np.testing.assert_array_equal(i0, i1)
+    np.testing.assert_array_equal(d0, d1)
+
+
+def test_pairwise_dbscan_rounds_evict_lru(n_devices):
+    """DBSCAN propagation rounds key tiles by (X, labels, core): each round
+    reuses tiles across its query blocks, and retired rounds' tiles are the
+    LRU victims once the budget binds — labels still match the uncached run."""
+    from spark_rapids_ml_tpu.ops.pairwise_streaming import (
+        streaming_dbscan_fit_predict,
+    )
+
+    rng = np.random.default_rng(41)
+    X = np.concatenate(
+        [rng.normal(0, 0.25, (150, 4)), rng.normal(4, 0.25, (150, 4))]
+    ).astype(np.float32)
+    config.set("cache.hbm_budget_bytes", 20_000)
+    labels0 = streaming_dbscan_fit_predict(
+        X, eps=0.8, min_samples=5, query_block=64, item_block=128
+    )
+    totals = _counters()
+    assert totals["cache.hits"] > 0
+    assert totals["cache.evictions"] > 0  # round keys rotated through the LRU
+    assert totals["cache.bytes_resident"] == 0
+
+    config.set("cache.enabled", False)
+    labels1 = streaming_dbscan_fit_predict(
+        X, eps=0.8, min_samples=5, query_block=64, item_block=128
+    )
+    np.testing.assert_array_equal(labels0, labels1)
