@@ -458,7 +458,23 @@ def _worker_main() -> None:
         _flush_progress(progress, {"unit": name, "status": "start"})
         t0 = time.time()
         try:
-            result = run_unit(name)
+            # one observability run per scenario: the BENCH json gains
+            # per-stage span attribution (`<unit>_stage_s`) and, with
+            # SRML_TPU_METRICS_DIR set, each unit appends a full structured
+            # run report to fit_reports.jsonl (observability/export.py)
+            from spark_rapids_ml_tpu.observability import fit_run
+
+            with fit_run(algo=name, site="bench") as obs_run:
+                result = run_unit(name)
+            if obs_run is not None:
+                stage_s = sorted(
+                    obs_run.report()["metrics"]["spans"].items(),
+                    key=lambda kv: -kv[1],
+                )[:8]
+                if stage_s:
+                    result[f"{name}_stage_s"] = {
+                        k: round(v, 4) for k, v in stage_s
+                    }
             result[f"{name}_bench_secs"] = round(time.time() - t0, 1)
             _flush_progress(
                 progress,

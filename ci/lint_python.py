@@ -16,6 +16,12 @@ image ships no third-party linters, so the gate is stdlib-only but real:
     batch cache (ops/device_cache.py). Such call sites must pass a `cache=`
     handle (the loop replays passes 2..N from HBM) or hoist the stream out of
     the loop; `# noqa` on the call line exempts.
+  * profiling internals poking: any reference to `profiling._counters` /
+    `profiling._spans` outside the observability package. Those dicts no
+    longer exist — profiling.py is a compat shim over the typed registry
+    (observability/registry.py) — and historically direct mutation was how
+    scoped FitRun accounting got silently corrupted. Go through the public
+    surface (count/add_time/counter_totals/...) or the observability API.
 
 Exit code 1 on any finding; CI runs this before the test tiers (ci/test.sh).
 """
@@ -36,6 +42,11 @@ UNUSED_IMPORT_EXEMPT = {"__init__.py"}
 # the module that IMPLEMENTS exception handling policy is exempt from the
 # silent-swallow check (it must classify and rethrow freely)
 SILENT_SWALLOW_EXEMPT_PARTS = ("reliability",)
+
+# the observability package (and the shim module itself) may touch profiling
+# internals; everyone else goes through the public surface
+PROFILING_INTERNALS = {"_counters", "_spans"}
+PROFILING_INTERNALS_EXEMPT_PARTS = ("observability", "profiling.py")
 
 _BROAD_EXC_NAMES = {"Exception", "BaseException"}
 
@@ -137,6 +148,27 @@ def check_file(path: Path) -> list:
             findings.append(f"{path}:{lineno}: tab in indentation")
 
     _UncachedStreamVisitor(path, src.splitlines(), findings).visit(tree)
+
+    if not any(part in PROFILING_INTERNALS_EXEMPT_PARTS for part in path.parts):
+        src_lines = src.splitlines()
+        for node in ast.walk(tree):
+            if (
+                isinstance(node, ast.Attribute)
+                and node.attr in PROFILING_INTERNALS
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "profiling"
+            ):
+                line = (
+                    src_lines[node.lineno - 1]
+                    if node.lineno - 1 < len(src_lines)
+                    else ""
+                )
+                if "noqa" not in line:
+                    findings.append(
+                        f"{path}:{node.lineno}: direct use of profiling."
+                        f"{node.attr} (the dict no longer exists — go through "
+                        "the profiling/observability public surface)"
+                    )
 
     # collect import bindings and all referenced names
     imports = {}

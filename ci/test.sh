@@ -42,7 +42,39 @@ else
   # streamed estimator + exact hit/miss/eviction counter accounting + zero
   # pass-2 uploads) — a wrong cache silently corrupts every multi-pass fit
   python -m pytest tests/test_device_cache.py -q
-  python -m pytest tests/ -q --ignore=tests/test_reliability.py --ignore=tests/test_device_cache.py
+  # observability tier: registry/FitRun/exporter units, then an end-to-end
+  # smoke — a streamed KMeans fit must append a parseable JSONL run report
+  # whose counters prove pass 2+ uploaded ZERO bytes (the cache-tier
+  # assertion, migrated onto the report path: what production dashboards
+  # will read is what CI verifies)
+  python -m pytest tests/test_observability.py -q
+  SRML_OBS_SMOKE_DIR="$(mktemp -d)"
+  SRML_TPU_METRICS_DIR="$SRML_OBS_SMOKE_DIR" \
+  SRML_TPU_STREAM_THRESHOLD_BYTES=1024 SRML_TPU_STREAM_BATCH_ROWS=64 \
+  python - <<'PY'
+import os
+import numpy as np, pandas as pd
+from spark_rapids_ml_tpu.clustering import KMeans
+from spark_rapids_ml_tpu.observability import load_run_reports
+from spark_rapids_ml_tpu.observability.export import iter_spans
+
+rng = np.random.default_rng(0)
+X = np.concatenate(
+    [rng.normal(-3, 1, (192, 8)), rng.normal(3, 1, (192, 8))]
+).astype(np.float32)
+KMeans(k=2, maxIter=6, seed=5).fit(pd.DataFrame({"features": list(X)}))
+rep = load_run_reports(os.environ["SRML_TPU_METRICS_DIR"])[-1]
+assert rep["status"] == "ok" and rep["algo"] == "KMeans", rep["status"]
+c = rep["metrics"]["counters"]
+n_batches = -(-X.shape[0] // 64)
+assert c["stream.upload_batches"] == n_batches, c  # pass 2+ uploaded zero
+steps = [s for s in iter_spans(rep) if s["name"] == "kmeans.step"]
+assert len(steps) >= 2 and c["cache.hits"] == (len(steps) - 1) * n_batches, c
+assert rep["metrics"]["gauges"]["cache.bytes_resident"] == 0
+print("OBSERVABILITY SMOKE OK: report parses, pass-2 uploads == 0")
+PY
+  rm -rf "$SRML_OBS_SMOKE_DIR"
+  python -m pytest tests/ -q --ignore=tests/test_reliability.py --ignore=tests/test_device_cache.py --ignore=tests/test_observability.py
 fi
 
 # small benchmark smoke (reference runs a small bench pre-merge)

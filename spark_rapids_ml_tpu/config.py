@@ -72,6 +72,12 @@ _DEFAULTS: Dict[str, Any] = {
     "reliability.checkpoint_batches": 16,   # streamed-fit snapshot cadence
     "reliability.fault_spec": "",           # fault grammar, reliability/faults.py
     "reliability.degrade_to_collect": True, # barrier fit failure -> collect mode
+    # observability subsystem (observability/): typed metrics registry, per-fit
+    # FitRun trace trees (model.fit_report_), driver-side aggregation of
+    # barrier-worker metrics, JSONL + Prometheus exporters (docs/design.md §6d)
+    "observability.enabled": True,          # FitRun scopes + trace collection
+    "observability.metrics_dir": None,      # JSONL fit_reports.jsonl directory
+    "observability.max_spans": 1024,        # trace-tree node cap per run
 }
 
 _ENV_KEYS: Dict[str, str] = {
@@ -97,6 +103,9 @@ _ENV_KEYS: Dict[str, str] = {
     "reliability.checkpoint_batches": "SRML_TPU_CHECKPOINT_BATCHES",
     "reliability.fault_spec": "SRML_TPU_FAULT_SPEC",
     "reliability.degrade_to_collect": "SRML_TPU_DEGRADE_TO_COLLECT",
+    "observability.enabled": "SRML_TPU_OBSERVABILITY_ENABLED",
+    "observability.metrics_dir": "SRML_TPU_METRICS_DIR",
+    "observability.max_spans": "SRML_TPU_MAX_SPANS",
 }
 
 _overrides: Dict[str, Any] = {}

@@ -437,10 +437,25 @@ class _TpuEstimator(_TpuCaller):
         return models
 
     def _fit(self, dataset: Any) -> "_TpuModel":
-        # validate on the DRIVER before any dispatch: a bad param must fail here,
-        # not inside a launched barrier stage (_TpuModel.transform performs the
-        # same driver-side check for the transform plane)
+        # validate on the DRIVER before any dispatch — BEFORE the run scope
+        # opens: a bad param is API surface, not a fit worth a report
+        # (_TpuModel.transform performs the same driver-side check for the
+        # transform plane)
         self._validate_param_bounds()
+        from ..observability import fit_run
+
+        # one FitRun spans the whole degradation ladder (barrier -> collect ->
+        # CPU): every span/counter/event fired anywhere below — including
+        # barrier-worker snapshots merged by fit_on_spark — lands in one
+        # structured report, attached to the trained model as
+        # `model.fit_report_` (docs/design.md §6d)
+        with fit_run(algo=type(self).__name__) as run:
+            model = self._fit_dispatch(dataset)
+        if run is not None:
+            model.fit_report_ = run.report()
+        return model
+
+    def _fit_dispatch(self, dataset: Any) -> "_TpuModel":
         armed = getattr(self, "_fallback_requested_params", set())
         if armed and not self._fallback_enabled:
             # silent wrong results are worse than a clear error: with fallback
@@ -476,6 +491,12 @@ class _TpuEstimator(_TpuCaller):
                 ):
                     raise
                 profiling.count("reliability.degrade.barrier_to_collect")
+                from ..observability import event as _obs_event
+
+                _obs_event(
+                    "degrade", rung="barrier_to_collect",
+                    error=type(e).__name__,
+                )
                 self.logger.warning(
                     "barrier fit plane failed (%s: %s); degrading to collect "
                     "mode for this fit",
@@ -504,6 +525,9 @@ class _TpuEstimator(_TpuCaller):
             ):
                 raise
             profiling.count("reliability.degrade.device_to_cpu")
+            from ..observability import event as _obs_event
+
+            _obs_event("degrade", rung="device_to_cpu", error=type(e).__name__)
             self.logger.warning(
                 "unrecoverable device error (%s: %s); degrading to the CPU "
                 "fallback path (config fallback.enabled)",

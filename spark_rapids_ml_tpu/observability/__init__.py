@@ -1,0 +1,74 @@
+#
+# Observability subsystem: typed metrics registry, per-fit trace trees, driver-
+# side aggregation across the barrier fit plane, and exporters
+# (docs/design.md §6d). `profiling.py` is a thin compat shim over this package;
+# new instrumentation should import from here directly.
+#
+#   registry.py  Counter / Gauge / Histogram / MetricsRegistry (+ merge)
+#   runs.py      write fan-out, structured spans, events, FitRun, worker_scope
+#   export.py    JSONL run reports + Prometheus textfile
+#
+
+from .registry import (
+    DEFAULT_TIME_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    label_key,
+    split_label_key,
+)
+from .runs import (
+    PROCESS_TOKEN,
+    FitRun,
+    WorkerScope,
+    add_span_total,
+    counter_inc,
+    current_run,
+    event,
+    fit_run,
+    gauge_dec,
+    gauge_inc,
+    gauge_set,
+    global_registry,
+    legacy_count,
+    observe,
+    span,
+    worker_scope,
+)
+from .export import (
+    load_run_reports,
+    render_prometheus,
+    write_prometheus_textfile,
+    write_run_report,
+)
+
+__all__ = [
+    "DEFAULT_TIME_BUCKETS",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "label_key",
+    "split_label_key",
+    "PROCESS_TOKEN",
+    "FitRun",
+    "WorkerScope",
+    "add_span_total",
+    "counter_inc",
+    "current_run",
+    "event",
+    "fit_run",
+    "gauge_dec",
+    "gauge_inc",
+    "gauge_set",
+    "global_registry",
+    "legacy_count",
+    "observe",
+    "span",
+    "worker_scope",
+    "load_run_reports",
+    "render_prometheus",
+    "write_prometheus_textfile",
+    "write_run_report",
+]

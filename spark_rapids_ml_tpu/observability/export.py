@@ -1,0 +1,158 @@
+#
+# Exporters: JSONL run reports + Prometheus textfile — the egress half of the
+# observability subsystem (docs/design.md §6d).
+#
+#   * JSONL: one line per finished FitRun, appended to
+#     `<metrics_dir>/fit_reports.jsonl` (config `observability.metrics_dir` /
+#     env SRML_TPU_METRICS_DIR). Reports are plain JSON and round-trip through
+#     `load_run_reports` — CI's observability smoke tier asserts on the file
+#     (ci/test.sh) instead of on process-global counters.
+#   * Prometheus: the standard node_exporter textfile-collector handshake —
+#     render a registry snapshot in text exposition format and atomically
+#     replace `<path>`; a scraper picks it up on its next pass. No server, no
+#     new dependency.
+#
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import tempfile
+from typing import Any, Dict, Iterator, List, Mapping, Optional
+
+from .registry import MetricsRegistry, split_label_key
+
+RUN_REPORT_FILENAME = "fit_reports.jsonl"
+
+_NAME_OK = re.compile(r"[^a-zA-Z0-9_:]")
+_PROM_PREFIX = "srml_tpu_"
+
+
+def write_run_report(report: Mapping[str, Any], metrics_dir: str) -> str:
+    """Append one run report as a JSON line; returns the file path. Creates the
+    directory; the append+flush is a single write so concurrent fits from one
+    process interleave whole lines."""
+    os.makedirs(metrics_dir, exist_ok=True)
+    path = os.path.join(metrics_dir, RUN_REPORT_FILENAME)
+    line = json.dumps(report, default=_json_fallback)
+    with open(path, "a") as f:
+        f.write(line + "\n")
+        f.flush()
+    return path
+
+
+def _json_fallback(obj: Any) -> Any:
+    """Numpy scalars and other number-likes that reach a report (histogram sums
+    accumulated from device timings) serialize as plain floats."""
+    for attr in ("item",):
+        fn = getattr(obj, attr, None)
+        if callable(fn):
+            return fn()
+    return str(obj)
+
+
+def load_run_reports(path_or_dir: str) -> List[Dict[str, Any]]:
+    """Parse a fit_reports.jsonl (or the directory holding one) back to report
+    dicts — the round-trip half the acceptance tests assert."""
+    path = (
+        os.path.join(path_or_dir, RUN_REPORT_FILENAME)
+        if os.path.isdir(path_or_dir)
+        else path_or_dir
+    )
+    reports: List[Dict[str, Any]] = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                reports.append(json.loads(line))
+    return reports
+
+
+def _prom_name(name: str) -> str:
+    return _PROM_PREFIX + _NAME_OK.sub("_", name)
+
+
+def _prom_labels(labels: Mapping[str, str], extra: Optional[str] = None) -> str:
+    parts = [f'{_NAME_OK.sub("_", k)}="{v}"' for k, v in sorted(labels.items())]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+def render_prometheus(snapshot: Mapping[str, Any]) -> str:
+    """Registry snapshot -> Prometheus text exposition format. Counters and
+    gauges map directly; histograms emit the classic cumulative _bucket/_sum/
+    _count triplet; legacy span totals export as `*_span_seconds_total`."""
+    lines: List[str] = []
+    seen_types: Dict[str, str] = {}
+
+    def _typed(name: str, kind: str) -> None:
+        if seen_types.get(name) != kind:
+            lines.append(f"# TYPE {name} {kind}")
+            seen_types[name] = kind
+
+    for key, v in sorted((snapshot.get("counters") or {}).items()):
+        name, labels = split_label_key(key)
+        pname = _prom_name(name) + "_total"
+        _typed(pname, "counter")
+        lines.append(f"{pname}{_prom_labels(labels)} {v}")
+    for key, v in sorted((snapshot.get("gauges") or {}).items()):
+        name, labels = split_label_key(key)
+        pname = _prom_name(name)
+        _typed(pname, "gauge")
+        lines.append(f"{pname}{_prom_labels(labels)} {v}")
+    for name, secs in sorted((snapshot.get("spans") or {}).items()):
+        pname = _prom_name(name) + "_span_seconds_total"
+        _typed(pname, "counter")
+        lines.append(f"{pname} {secs}")
+    for key, st in sorted((snapshot.get("histograms") or {}).items()):
+        name, labels = split_label_key(key)
+        pname = _prom_name(name)
+        _typed(pname, "histogram")
+        bounds = list(st.get("bounds") or [])
+        cum = 0
+        for i, c in enumerate(st["buckets"]):
+            cum += c
+            le = repr(float(bounds[i])) if i < len(bounds) else "+Inf"
+            le_label = 'le="%s"' % le
+            lines.append(f"{pname}_bucket{_prom_labels(labels, le_label)} {cum}")
+        lines.append(f"{pname}_sum{_prom_labels(labels)} {st['sum']}")
+        lines.append(f"{pname}_count{_prom_labels(labels)} {st['count']}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+def write_prometheus_textfile(path: str,
+                              registry: Optional[MetricsRegistry] = None) -> str:
+    """Atomically replace `path` with the registry's current state in text
+    exposition format (default: the process-global registry). Atomic because a
+    textfile collector may scrape mid-write; write-then-rename means it only
+    ever sees whole files."""
+    if registry is None:
+        from .runs import global_registry
+
+        registry = global_registry()
+    text = render_prometheus(registry.snapshot())
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, prefix=".prom_")
+    try:
+        with os.fdopen(fd, "w") as f:
+            f.write(text)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    return path
+
+
+def iter_spans(report: Mapping[str, Any]) -> Iterator[Dict[str, Any]]:
+    """Depth-first walk of a report's trace tree (report helpers for tests/CI)."""
+    stack = list(report.get("trace") or [])
+    while stack:
+        node = stack.pop()
+        yield node
+        stack.extend(node.get("children") or [])

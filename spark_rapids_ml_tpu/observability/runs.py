@@ -1,0 +1,470 @@
+#
+# Per-fit run scopes, trace trees, and the fan-out write path — the collection
+# half of the observability subsystem (docs/design.md §6d).
+#
+# Write path: every instrumentation call (`counter_inc`, `gauge_*`, `observe`,
+# `add_span_total`, `span`, `event`) fans out to every active SINK:
+#
+#   * the process-global registry    — always; backs profiling.counter_totals()
+#   * each open FitRun's registry    — process-global scope: barrier tasks run
+#     as THREADS in the local-mode fit plane, and their metrics belong to the
+#     driver thread's run
+#   * this thread's worker_scope()   — thread-local: one barrier task's private
+#     delta, serialized to the driver alongside the fit result
+#
+# A FitRun additionally collects a structured TRACE TREE (parent/child span
+# nodes from the thread-local span stack) and an EVENT LOG (retries, fault
+# firings, cache evictions, degradations) instead of the flat name-keyed sums
+# profiling.py kept — arXiv:1612.01437's point that per-stage attribution, not
+# end-to-end wall clock, is what localizes distributed-fit bottlenecks.
+#
+# Process identity: each snapshot carries (pid, boot token). The driver merges
+# a worker snapshot into its own registries ONLY when the identity differs —
+# in the threaded local-mode harness the worker already wrote through the
+# fan-out path and a second merge would double-count; under a real multi-host
+# fit the executor's counters never touched the driver process and the merge
+# is exactly the fix for counter_totals() being silently process-local.
+#
+
+from __future__ import annotations
+
+import contextlib
+import itertools
+import os
+import threading
+import time
+import uuid
+from typing import Any, Dict, Iterator, List, Mapping, Optional, Sequence
+
+from .. import config as _config
+from ..utils import get_logger
+from .registry import DEFAULT_TIME_BUCKETS, MetricsRegistry
+
+_logger = get_logger("observability")
+
+# identity of THIS process's metric stream (pid alone collides across hosts)
+PROCESS_TOKEN = f"{os.getpid()}:{uuid.uuid4().hex[:12]}"
+
+_GLOBAL = MetricsRegistry()
+
+_span_ids = itertools.count(1)
+_run_ids = itertools.count(1)
+
+_state_lock = threading.RLock()
+_active_runs: List["FitRun"] = []
+
+_tls = threading.local()
+
+
+def global_registry() -> MetricsRegistry:
+    return _GLOBAL
+
+
+def _worker_scopes() -> List["WorkerScope"]:
+    scopes = getattr(_tls, "worker_scopes", None)
+    if scopes is None:
+        scopes = _tls.worker_scopes = []
+    return scopes
+
+
+def _span_stack() -> List["SpanNode"]:
+    stack = getattr(_tls, "span_stack", None)
+    if stack is None:
+        stack = _tls.span_stack = []
+    return stack
+
+
+def _sink_registries() -> List[MetricsRegistry]:
+    regs = [_GLOBAL]
+    with _state_lock:
+        regs.extend(run.registry for run in _active_runs)
+    regs.extend(scope.registry for scope in _worker_scopes())
+    return regs
+
+
+# --------------------------------------------------------------- write fan-out
+
+
+def counter_inc(name: str, n: int = 1, **labels: Any) -> None:
+    for reg in _sink_registries():
+        reg.counter(name).inc(n, **labels)
+
+
+def legacy_count(name: str, n: int) -> None:
+    """Signed fan-out for the legacy profiling.count() surface (see
+    MetricsRegistry.legacy_count): kind is discovered from usage per sink."""
+    for reg in _sink_registries():
+        reg.legacy_count(name, n)
+
+
+def gauge_set(name: str, value: Any, **labels: Any) -> None:
+    for reg in _sink_registries():
+        reg.gauge(name).set(value, **labels)
+
+
+def gauge_inc(name: str, n: Any = 1, **labels: Any) -> None:
+    for reg in _sink_registries():
+        reg.gauge(name).inc(n, **labels)
+
+
+def gauge_dec(name: str, n: Any = 1, **labels: Any) -> None:
+    gauge_inc(name, -n, **labels)
+
+
+def observe(name: str, value: float,
+            buckets: Sequence[float] = DEFAULT_TIME_BUCKETS, **labels: Any) -> None:
+    for reg in _sink_registries():
+        reg.histogram(name, buckets=buckets).observe(value, **labels)
+
+
+def add_span_total(name: str, seconds: float) -> None:
+    """Legacy flat accumulation (profiling.add_time) PLUS a same-named
+    exponential latency histogram: every per-batch `add_time` call site gains a
+    distribution for free, not just a sum."""
+    for reg in _sink_registries():
+        reg.add_span_total(name, seconds)
+        reg.histogram(name).observe(seconds)
+
+
+def event(kind: str, **fields: Any) -> None:
+    """Append a structured event (retry, fault, cache_evict, degrade, ...) to
+    every open FitRun and this thread's worker scopes. No-op otherwise — events
+    have no meaning outside a run context."""
+    entry: Optional[Dict[str, Any]] = None
+    stack = _span_stack()
+    with _state_lock:
+        targets: List[Any] = list(_active_runs)
+    targets.extend(_worker_scopes())
+    for t in targets:
+        if entry is None:
+            entry = {
+                "ts": round(time.time(), 6),
+                "kind": kind,
+                "span_id": stack[-1].span_id if stack else None,
+                **fields,
+            }
+        t.add_event(entry)
+
+
+# ----------------------------------------------------------------- trace spans
+
+
+class SpanNode:
+    """One node of a run's trace tree. Identity is process-unique so nodes from
+    any thread link into the same tree; parentage comes from the thread-local
+    span stack (a span opened inside another ON THE SAME THREAD is its child;
+    a barrier-task thread's top-level spans become roots of that task's own
+    subtree in the run)."""
+
+    __slots__ = ("span_id", "parent_id", "name", "attrs", "t0", "start_ts",
+                 "duration_s", "status", "thread")
+
+    def __init__(self, name: str, attrs: Optional[Mapping[str, Any]], parent_id):
+        self.span_id = next(_span_ids)
+        self.parent_id = parent_id
+        self.name = name
+        self.attrs = dict(attrs) if attrs else {}
+        self.start_ts = time.time()
+        self.t0 = time.perf_counter()
+        self.duration_s: Optional[float] = None
+        self.status = "ok"
+        self.thread = threading.current_thread().name
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "name": self.name,
+            "start_ts": round(self.start_ts, 6),
+            "duration_s": self.duration_s,
+            "status": self.status,
+            "thread": self.thread,
+            **({"attrs": self.attrs} if self.attrs else {}),
+        }
+
+
+@contextlib.contextmanager
+def span(name: str, attrs: Optional[Mapping[str, Any]] = None) -> Iterator[None]:
+    """Cheap structured span: perf_counter + thread-local parent linkage, no
+    jax import anywhere near it. Failure-safe by construction (try/finally):
+    a span whose body raises records its elapsed time with status='error' and
+    counts toward `span.errors` — the exact timing the old profiling.span()
+    dropped on the floor when a pass failed."""
+    node = SpanNode(name, attrs, parent_id=(
+        _span_stack()[-1].span_id if _span_stack() else None
+    ))
+    _span_stack().append(node)
+    try:
+        yield
+    except BaseException:
+        node.status = "error"
+        raise
+    finally:
+        node.duration_s = time.perf_counter() - node.t0
+        stack = _span_stack()
+        if stack and stack[-1] is node:
+            stack.pop()
+        else:  # defensive: mis-nested exit must not corrupt the stack
+            try:
+                stack.remove(node)
+            except ValueError:
+                pass
+        for reg in _sink_registries():
+            reg.add_span_total(name, node.duration_s)
+            reg.histogram(name).observe(node.duration_s, status=node.status)
+        if node.status == "error":
+            counter_inc("span.errors", 1, span=name)
+        with _state_lock:
+            runs = list(_active_runs)
+        for run in runs:
+            run.add_span(node)
+        for scope in _worker_scopes():
+            scope.add_span(node)
+
+
+def _tree(nodes: Sequence[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """Assemble flat span dicts into a nested tree (children sorted by start)."""
+    by_id = {n["span_id"]: dict(n, children=[]) for n in nodes}
+    roots: List[Dict[str, Any]] = []
+    for n in by_id.values():
+        parent = by_id.get(n["parent_id"])
+        if parent is not None:
+            parent["children"].append(n)
+        else:
+            roots.append(n)
+    for n in by_id.values():
+        n["children"].sort(key=lambda c: c["start_ts"])
+    roots.sort(key=lambda c: c["start_ts"])
+    return roots
+
+
+# ------------------------------------------------------------------- run scope
+
+
+class FitRun:
+    """One fit's observability scope: a scoped MetricsRegistry delta, a trace
+    tree, an event log, and the per-worker snapshots the driver folds in from
+    the barrier plane. Opened by core/estimator.py::_fit around the whole
+    degradation ladder; the finished report attaches to the trained model as
+    `model.fit_report_` and (when `observability.metrics_dir` is set) appends
+    to the JSONL run log (observability/export.py)."""
+
+    def __init__(self, algo: str, site: str = "driver",
+                 max_spans: Optional[int] = None):
+        self.algo = algo
+        self.site = site
+        self.run_id = f"fit-{next(_run_ids)}-{uuid.uuid4().hex[:8]}"
+        self.registry = MetricsRegistry()
+        self.max_spans = (
+            int(_config.get("observability.max_spans"))
+            if max_spans is None
+            else int(max_spans)
+        )
+        self._lock = threading.Lock()
+        self._spans: List[Dict[str, Any]] = []
+        self._dropped_spans = 0
+        self._events: List[Dict[str, Any]] = []
+        # events are bounded like spans: an eviction-heavy fit (dataset far
+        # over the cache budget) fires a cache_evict per cross-stream eviction
+        # per pass and must not grow run memory / snapshot size without limit
+        self.max_events = max(self.max_spans, 1024)
+        self._dropped_events = 0
+        self._workers: List[Dict[str, Any]] = []
+        self.started_ts: Optional[float] = None
+        self.duration_s: Optional[float] = None
+        self.status = "ok"
+        self._t0: Optional[float] = None
+        self._root: Optional[Any] = None
+
+    # ---- sink surface (runs.py fan-out calls these) ----
+
+    def add_span(self, node: SpanNode) -> None:
+        with self._lock:
+            if len(self._spans) >= self.max_spans:
+                self._dropped_spans += 1
+                return
+            self._spans.append(node.as_dict())
+
+    def add_event(self, entry: Dict[str, Any]) -> None:
+        with self._lock:
+            if len(self._events) >= self.max_events:
+                self._dropped_events += 1
+                return
+            self._events.append(entry)
+
+    # ---- worker aggregation (spark/integration.py) ----
+
+    def add_worker_snapshot(self, worker: Mapping[str, Any]) -> None:
+        """Fold one barrier worker's serialized scope into this run. Foreign-
+        process snapshots merge into the run AND global registries (their
+        counters never flowed through this process's fan-out); same-process
+        snapshots (threaded local-mode harness) are recorded for the per-worker
+        breakdown only — their writes already landed here live."""
+        foreign = worker.get("process") != PROCESS_TOKEN
+        with self._lock:
+            self._workers.append(
+                {
+                    "rank": worker.get("rank"),
+                    "process": worker.get("process"),
+                    "merged": foreign,
+                    "metrics": worker.get("metrics") or {},
+                    "events": worker.get("events") or [],
+                    "spans": worker.get("spans") or [],
+                }
+            )
+        if foreign:
+            snap = worker.get("metrics") or {}
+            self.registry.merge_snapshot(snap)
+            _GLOBAL.merge_snapshot(snap)
+            for entry in worker.get("events") or []:
+                self.add_event(dict(entry, worker_rank=worker.get("rank")))
+
+    # ---- lifecycle ----
+
+    def __enter__(self) -> "FitRun":
+        self.started_ts = time.time()
+        self._t0 = time.perf_counter()
+        # root trace node: named `.fit_run` (not `.fit`) so the legacy
+        # span_totals entry for the estimator's own `{Algo}.fit` kernel span
+        # is not double-counted by its enclosing run scope
+        self._root = span(f"{self.algo}.fit_run", {"site": self.site})
+        with _state_lock:
+            _active_runs.append(self)
+        self._root.__enter__()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        try:
+            self._root.__exit__(exc_type, exc, tb)
+        finally:
+            with _state_lock:
+                try:
+                    _active_runs.remove(self)
+                except ValueError:
+                    pass
+            self.duration_s = time.perf_counter() - (self._t0 or time.perf_counter())
+            if exc_type is not None:
+                self.status = "error"
+            metrics_dir = _config.get("observability.metrics_dir")
+            if metrics_dir:
+                from .export import write_run_report
+
+                try:
+                    write_run_report(self.report(), metrics_dir)
+                except OSError as e:
+                    _logger.warning("could not write fit report: %s", e)
+
+    def report(self) -> Dict[str, Any]:
+        """The structured fit report (finalized numbers after __exit__; callable
+        mid-run for a live view)."""
+        with self._lock:
+            spans = list(self._spans)
+            events = list(self._events)
+            workers = [
+                {k: v for k, v in w.items() if k != "spans"} for w in self._workers
+            ]
+            dropped = self._dropped_spans
+            dropped_events = self._dropped_events
+        return {
+            "schema": 1,
+            "run_id": self.run_id,
+            "algo": self.algo,
+            "site": self.site,
+            "process": PROCESS_TOKEN,
+            "started_ts": self.started_ts,
+            "duration_s": (
+                self.duration_s
+                if self.duration_s is not None
+                else (time.perf_counter() - self._t0 if self._t0 else None)
+            ),
+            "status": self.status,
+            "trace": _tree(spans),
+            "dropped_spans": dropped,
+            "events": events,
+            "dropped_events": dropped_events,
+            "metrics": self.registry.snapshot(),
+            "workers": workers,
+        }
+
+
+def current_run() -> Optional[FitRun]:
+    """The most recently opened still-active FitRun, if any."""
+    with _state_lock:
+        return _active_runs[-1] if _active_runs else None
+
+
+@contextlib.contextmanager
+def fit_run(algo: str, site: str = "driver") -> Iterator[Optional[FitRun]]:
+    """FitRun gated on `observability.enabled`: yields None (and collects
+    nothing run-scoped) when the subsystem is off — the global registry keeps
+    accumulating either way, so the legacy counter surface never degrades."""
+    if not bool(_config.get("observability.enabled")):
+        yield None
+        return
+    with FitRun(algo, site=site) as run:
+        yield run
+
+
+# ---------------------------------------------------------------- worker scope
+
+
+class WorkerScope:
+    """One barrier task's thread-local metric delta: everything this thread
+    writes while the scope is open, snapshot-able to the payload shipped to the
+    driver (spark/integration.py serializes it next to the fit result)."""
+
+    def __init__(self, rank: Optional[int] = None, max_spans: int = 256,
+                 max_events: int = 512):
+        self.rank = rank
+        self.registry = MetricsRegistry()
+        self.max_spans = max_spans
+        self.max_events = max_events
+        self._lock = threading.Lock()
+        self._events: List[Dict[str, Any]] = []
+        self._dropped_events = 0
+        self._spans: List[Dict[str, Any]] = []
+        self._dropped_spans = 0
+
+    def add_event(self, entry: Dict[str, Any]) -> None:
+        with self._lock:
+            if len(self._events) >= self.max_events:
+                self._dropped_events += 1
+                return
+            self._events.append(entry)
+
+    def add_span(self, node: SpanNode) -> None:
+        with self._lock:
+            if len(self._spans) >= self.max_spans:
+                self._dropped_spans += 1
+                return
+            self._spans.append(node.as_dict())
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "schema": 1,
+                "process": PROCESS_TOKEN,
+                "rank": self.rank,
+                "metrics": self.registry.snapshot(),
+                "events": list(self._events),
+                "dropped_events": self._dropped_events,
+                "spans": list(self._spans),
+                "dropped_spans": self._dropped_spans,
+            }
+
+
+@contextlib.contextmanager
+def worker_scope(rank: Optional[int] = None) -> Iterator[WorkerScope]:
+    """Open a thread-local capture scope (stackable; inner scopes see the same
+    writes). The barrier UDF wraps its whole body in one so each task's metric
+    delta travels to the driver regardless of which process it ran in."""
+    scope = WorkerScope(rank=rank)
+    _worker_scopes().append(scope)
+    try:
+        yield scope
+    finally:
+        scopes = _worker_scopes()
+        try:
+            scopes.remove(scope)
+        except ValueError:
+            pass

@@ -37,11 +37,16 @@
 # `batch_cache()` themselves and transparently reuse the estimator's scope
 # when one is active (direct ops calls get a fit-local cache instead).
 #
-# Observability (profiling.counter_totals()): `cache.hits`, `cache.misses`,
-# `cache.evictions` are monotone; `cache.bytes_resident` is a gauge (negative
-# increments on eviction/close). Host->device uploads are counted by the
-# stream itself (`stream.upload_batches` / `stream.upload_bytes`), so "pass
-# 2+ performs zero uploads" is directly assertable.
+# Observability (observability/ registry; legacy profiling.counter_totals()
+# still surfaces everything): `cache.hits`, `cache.misses`, `cache.evictions`
+# are monotone Counters; `cache.bytes_resident` is a REAL Gauge (inc on
+# retain, dec on evict/close — it was negative counter increments before the
+# typed registry existed, where a missed decrement was undetectable by type).
+# Evictions also land as structured `cache_evict` events in the active FitRun.
+# Host->device uploads are counted by the stream itself
+# (`stream.upload_batches` / `stream.upload_bytes`) and each upload appears as
+# a `stream.ingest` span in the fit trace tree, so "pass 2+ performs zero
+# uploads" is directly assertable from a fit report.
 #
 
 from __future__ import annotations
@@ -52,6 +57,7 @@ from collections import OrderedDict
 from typing import Any, Dict, Iterator, Optional, Sequence, Tuple
 
 from .. import config as _config
+from .. import observability as _obs
 from .. import profiling
 from ..utils import get_logger
 
@@ -125,14 +131,15 @@ class DeviceBatchCache:
             self._evict(victim)
         self._entries[(stream_key, batch_index)] = (batch, nbytes)
         self.bytes_resident += nbytes
-        profiling.count("cache.bytes_resident", nbytes)
+        _obs.gauge_inc("cache.bytes_resident", nbytes)
         return True
 
     def _evict(self, entry_key: _EntryKey) -> None:
         _, nbytes = self._entries.pop(entry_key)
         self.bytes_resident -= nbytes
         profiling.count("cache.evictions")
-        profiling.count("cache.bytes_resident", -nbytes)
+        _obs.gauge_dec("cache.bytes_resident", nbytes)
+        _obs.event("cache_evict", nbytes=nbytes, site=str(entry_key[0][0]))
 
     def resident_batches(self) -> int:
         return len(self._entries)
@@ -142,7 +149,7 @@ class DeviceBatchCache:
         release their last use) and unpin the sources. Not counted as
         evictions — lifecycle frees are not budget pressure."""
         if self.bytes_resident:
-            profiling.count("cache.bytes_resident", -self.bytes_resident)
+            _obs.gauge_dec("cache.bytes_resident", self.bytes_resident)
         self.bytes_resident = 0
         self._entries.clear()
         self._key_pins.clear()
@@ -166,7 +173,11 @@ def cached_build(cache: Optional[DeviceBatchCache], cache_key: Any,
         if hit is not None:
             return hit
     t0 = time.perf_counter()
-    batch = build()
+    # structured span: each actual upload is a `stream.ingest` node in the fit
+    # trace tree (child of the pass that triggered it), on top of the legacy
+    # per-site totals + per-batch latency histogram add_time feeds below
+    with _obs.span("stream.ingest", {"site": site, "batch": batch_index}):
+        batch = build()
     profiling.add_time(f"stream.ingest_s.{site}", time.perf_counter() - t0)
     profiling.count("stream.upload_batches")
     profiling.count(

@@ -39,6 +39,7 @@ import numpy as np
 from ..utils.jax_compat import shard_map
 from jax.sharding import PartitionSpec as P
 
+from ..observability import span as obs_span
 from ..reliability import RetryPolicy, fault_point
 from .knn import _block_sq_dists
 from .streaming import _prefetch
@@ -295,7 +296,13 @@ def streaming_exact_knn(
                 out_d[qs:qe] = np.sqrt(np.asarray(best_d))
                 out_i[qs:qe] = np.asarray(best_i).astype(np.int64)
 
-            policy.run(_scan_query_block, site="pairwise")
+            # one trace span per query-block sweep over the item stream: the
+            # per-fit report then attributes time to sweeps (with any item-tile
+            # `stream.ingest` uploads as children) instead of one opaque scan
+            with obs_span(
+                "pairwise.query_block", {"start": qs, "rows": qe - qs}
+            ):
+                policy.run(_scan_query_block, site="pairwise")
     return out_d, out_i
 
 

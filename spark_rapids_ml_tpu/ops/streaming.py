@@ -24,6 +24,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..observability import span as obs_span
 from ..reliability import (
     StreamBatchError,
     fault_point,
@@ -446,10 +447,11 @@ def _streaming_logreg_fit(
     # matching ops/linalg.weighted_moments)
     if standardize:
         carry = (jnp.zeros((d,), dt), jnp.zeros((d,), dt), jnp.zeros((), dt))
-        carry = _accumulate_stream(
-            carry, lambda c, batch: _accum_moments(c, batch[0], batch[2]),
-            n, batch_rows, mesh, _slicer, cache=cache, cache_key=ckey,
-        )
+        with obs_span("logreg.moments"):
+            carry = _accumulate_stream(
+                carry, lambda c, batch: _accum_moments(c, batch[0], batch[2]),
+                n, batch_rows, mesh, _slicer, cache=cache, cache_key=ckey,
+            )
         sx, sxx, sw_j = carry
         wsum = float(sw_j)
         mean = np.asarray(sx) / wsum
@@ -468,7 +470,17 @@ def _streaming_logreg_fit(
     else:
         shape = (d + 1,)
 
+    _step_no = [0]
+
     def value_and_grad(params_flat: np.ndarray):
+        # one objective/gradient evaluation == one full streamed pass: a
+        # `logreg.step` span per pass in the fit trace, with its per-batch
+        # `stream.ingest` uploads (if any) as children
+        _step_no[0] += 1
+        with obs_span("logreg.step", {"pass": _step_no[0]}):
+            return _value_and_grad(params_flat)
+
+    def _value_and_grad(params_flat: np.ndarray):
         params = jnp.asarray(params_flat.reshape(shape).astype(dt))
 
         def _accum_vg(carry, batch):
@@ -510,10 +522,11 @@ def _streaming_logreg_fit(
         from .linalg import power_iteration_lmax
 
         carry = (jnp.zeros((d, d), dt), jnp.zeros((d,), dt), jnp.zeros((), dt))
-        carry = _accumulate_stream(
-            carry, lambda c, batch: _accum_cov(c, batch[0] / scale, batch[2]),
-            n, batch_rows, mesh, _slicer, cache=cache, cache_key=ckey,
-        )
+        with obs_span("logreg.gram"):
+            carry = _accumulate_stream(
+                carry, lambda c, batch: _accum_cov(c, batch[0] / scale, batch[2]),
+                n, batch_rows, mesh, _slicer, cache=cache, cache_key=ckey,
+            )
         S2, _, sw_g = carry
         lmax = float(power_iteration_lmax(S2 / sw_g))
         lipschitz = (0.5 if multinomial else 0.25) * lmax + reg_l2 + 1e-12
@@ -688,16 +701,17 @@ def _streaming_kmeans_fit(
     )
 
     # init on a subsample (rows are not assumed shuffled: use a strided sample)
-    step = max(1, n // min(n, init_sample_rows))
-    Xs = np.ascontiguousarray(X[::step], dtype=dt)
-    ws = np.ascontiguousarray(w[::step], dtype=dt)
-    Xs_j = jnp.asarray(Xs if not cosine else np.asarray(
-        Xs / np.maximum(np.linalg.norm(Xs, axis=1, keepdims=True), 1e-30)))
-    centers = jnp.asarray(
-        kmeans_init(Xs_j, jnp.asarray(ws), k, "k-means||", 2, seed)
-    )
-    if cosine:
-        centers = _normalize_rows(centers)
+    with obs_span("kmeans.init", {"sample_rows": min(n, init_sample_rows)}):
+        step = max(1, n // min(n, init_sample_rows))
+        Xs = np.ascontiguousarray(X[::step], dtype=dt)
+        ws = np.ascontiguousarray(w[::step], dtype=dt)
+        Xs_j = jnp.asarray(Xs if not cosine else np.asarray(
+            Xs / np.maximum(np.linalg.norm(Xs, axis=1, keepdims=True), 1e-30)))
+        centers = jnp.asarray(
+            kmeans_init(Xs_j, jnp.asarray(ws), k, "k-means||", 2, seed)
+        )
+        if cosine:
+            centers = _normalize_rows(centers)
 
     def _slicer(s, e):
         Xb = np.ascontiguousarray(X[s:e], dtype=dt)
@@ -718,13 +732,17 @@ def _streaming_kmeans_fit(
             jnp.zeros((k,), dt),
             jnp.zeros((), dt),
         )
-        carry = _accumulate_stream(
-            carry,
-            lambda c, batch, centers=centers: _accum_kmeans(
-                c, centers, batch[0], batch[1], cosine
-            ),
-            n, batch_rows, mesh, _slicer, cache=cache, cache_key=ckey,
-        )
+        # one Lloyd iteration == one full streamed pass: a `kmeans.step` span
+        # per pass (pass 1 carries the jit compile of the batch accumulator),
+        # with any `stream.ingest` uploads it triggered as child spans
+        with obs_span("kmeans.step", {"pass": it + 1, "compile": it == 0}):
+            carry = _accumulate_stream(
+                carry,
+                lambda c, batch, centers=centers: _accum_kmeans(
+                    c, centers, batch[0], batch[1], cosine
+                ),
+                n, batch_rows, mesh, _slicer, cache=cache, cache_key=ckey,
+            )
         sums, counts, inertia_j = carry
         new_centers = jnp.where(
             counts[:, None] > 0,

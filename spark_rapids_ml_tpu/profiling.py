@@ -1,89 +1,112 @@
 #
-# Tracing/profiling hooks — SURVEY.md §5.1 notes the reference has none beyond timed
-# logging (with_benchmark wall-clock wrapper) and flags JAX profiler integration as
-# the cheap win for the TPU build. This module provides:
-#   * span(name): wall-clock span that ALSO shows up on the device timeline via
-#     jax.profiler.TraceAnnotation (visible in xplane/tensorboard traces)
-#   * start_trace/stop_trace: programmatic xplane capture around a fit
-#   * fit-time logging is wired through _TpuCaller when `verbose` is set
+# Compat shims over the observability subsystem (observability/ — docs/design.md
+# §6d). This module USED to own two flat process-global dicts (span seconds,
+# event counts); it now forwards every call to the typed metrics registry and
+# run-scope fan-out in `observability/`, keeping the historical surface —
+# span / add_time / span_totals / reset_spans / count / counter_totals /
+# reset_counters / trace — byte-compatible for every existing call site and
+# test. New instrumentation should import `spark_rapids_ml_tpu.observability`
+# directly (Counter/Gauge/Histogram with labels, structured spans, events).
 #
-# Enable capture with SRML_TPU_TRACE_DIR=/path (see config.py): every fit is then
-# traced automatically.
+# Behavior fixes that ride the migration:
+#   * span() records its timing even when the body RAISES (try/finally; the old
+#     implementation updated the totals after the `with TraceAnnotation` block,
+#     so a failed pass — exactly when the timing matters — recorded nothing).
+#     A failed span lands with status=error in the run trace and increments the
+#     `span.errors` counter.
+#   * jax.profiler resolves ONCE through a module-level lazy cache instead of
+#     per call — span() is now cheap enough for per-batch paths (add_time()'s
+#     old excuse for existing).
+#
+# Enable xplane capture with SRML_TPU_TRACE_DIR=/path (see config.py): every
+# fit is then traced automatically.
 #
 
 from __future__ import annotations
 
 import contextlib
-import threading
-import time
+import time as _time
 from typing import Dict, Iterator, Optional
 
+from . import observability as _obs
 from .utils import get_logger
 
 _logger = get_logger("profiling")
-_spans: Dict[str, float] = {}
-_counters: Dict[str, int] = {}
-# counters are incremented from concurrent barrier-task threads (the local-mode
-# fit-plane harness); the lock keeps read-modify-write increments exact
-_counters_lock = threading.Lock()
+
+# lazy once-per-process jax.profiler resolution: False = not yet resolved,
+# None = unavailable (never retried), module otherwise
+_jax_profiler = False
+
+
+def _get_jax_profiler():
+    global _jax_profiler
+    if _jax_profiler is False:
+        try:
+            import jax.profiler as jp
+        except Exception:  # pragma: no cover — jax is a hard dep everywhere else
+            jp = None
+        _jax_profiler = jp
+    return _jax_profiler
 
 
 @contextlib.contextmanager
 def span(name: str, verbose: bool = False) -> Iterator[None]:
-    """Wall-clock + device-timeline span."""
-    import jax.profiler
-
-    t0 = time.perf_counter()
-    with jax.profiler.TraceAnnotation(name):
-        yield
-    dt = time.perf_counter() - t0
-    _spans[name] = _spans.get(name, 0.0) + dt
-    if verbose:
-        _logger.info("%s: %.3fs", name, dt)
+    """Wall-clock + device-timeline span: the observability structured span
+    (trace-tree node + span totals + latency histogram) nested inside a
+    jax.profiler.TraceAnnotation so it still shows on xplane timelines."""
+    jp = _get_jax_profiler()
+    annotation = jp.TraceAnnotation(name) if jp is not None else contextlib.nullcontext()
+    with _obs.span(name):
+        t0 = _time.perf_counter()
+        try:
+            with annotation:
+                yield
+        finally:
+            if verbose:
+                _logger.info("%s: %.3fs", name, _time.perf_counter() - t0)
 
 
 def add_time(name: str, seconds: float) -> None:
-    """Accumulate seconds under a span name WITHOUT the TraceAnnotation
-    machinery — the per-batch path (streamed ingest timing, ops/streaming.py)
-    calls this once per batch, where importing jax.profiler per call would
-    cost more than the slice being measured. Shows up in span_totals()
-    alongside the context-manager spans."""
-    _spans[name] = _spans.get(name, 0.0) + seconds
+    """Accumulate seconds under a span name WITHOUT the TraceAnnotation or
+    trace-node machinery — the per-batch fallback for call sites that already
+    timed themselves. Also feeds the same-named latency histogram, so every
+    add_time site gains a per-batch distribution for free."""
+    _obs.add_span_total(name, seconds)
 
 
 def span_totals() -> Dict[str, float]:
     """Accumulated seconds per span name since process start (or last reset)."""
-    return dict(_spans)
+    return _obs.global_registry().span_totals()
 
 
 def reset_spans() -> None:
-    _spans.clear()
+    _obs.global_registry().reset_spans()
 
 
 def count(name: str, n: int = 1) -> None:
-    """Span-style monotone event counter. The reliability subsystem reports its
-    retry/resume/degrade/fault-firing totals here (`reliability.retry`,
-    `reliability.retry.<site>`, `reliability.resume[.<site>]`,
-    `reliability.degrade.*`, `reliability.fault[.<site>]`) so behavior under
-    faults is observable rather than silent. The streamed-ingest tier reports
-    `stream.upload_batches` / `stream.upload_bytes` (every host->device batch
-    upload) and the HBM batch cache reports `cache.hits` / `cache.misses` /
-    `cache.evictions` plus the `cache.bytes_resident` gauge (negative
-    increments on eviction/close), so "pass 2 re-uploaded nothing" is an
-    assertable fact, not an inference from wall-clock."""
-    with _counters_lock:
-        _counters[name] = _counters.get(name, 0) + n
+    """Monotone event counter (legacy flat surface). The reliability subsystem
+    reports retry/resume/degrade/fault totals here, the streamed-ingest tier
+    reports `stream.upload_batches`/`stream.upload_bytes`, and the HBM batch
+    cache reports `cache.hits`/`cache.misses`/`cache.evictions`
+    (`cache.bytes_resident` is a real observability Gauge now — see
+    ops/device_cache.py — surfaced through counter_totals() for compat).
+    This surface never distinguished counters from gauges, so kind is
+    discovered from usage: a name's first negative increment retypes it to a
+    gauge carrying its accumulated value — any straggler gauge-as-counter
+    call site keeps its arithmetic instead of crashing
+    (MetricsRegistry.legacy_count)."""
+    _obs.legacy_count(name, n)
 
 
 def counter_totals() -> Dict[str, int]:
-    """Accumulated event counts per name since process start (or last reset)."""
-    with _counters_lock:
-        return dict(_counters)
+    """Accumulated event counts per name since process start (or last reset);
+    includes gauges (by current value) — the historical surface reported
+    gauges through this dict as signed increments."""
+    return _obs.global_registry().counter_totals()
 
 
 def reset_counters() -> None:
-    with _counters_lock:
-        _counters.clear()
+    _obs.global_registry().reset_counters()
 
 
 @contextlib.contextmanager

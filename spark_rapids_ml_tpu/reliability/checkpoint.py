@@ -104,6 +104,12 @@ def resumable_accumulate(
                 raise
             profiling.count("reliability.resume")
             profiling.count(f"reliability.resume.{site}")
+            from ..observability import event as _obs_event
+
+            _obs_event(
+                "resume", site=site, row=snap_row, attempt=failures,
+                error=type(e).__name__,
+            )
             _logger.warning(
                 "transient failure at '%s' (%s: %s); resuming from row %d "
                 "(last snapshot), attempt %d/%d",

@@ -173,6 +173,9 @@ def fault_point(site: str, batch: Optional[int] = None) -> None:
         return
     profiling.count("reliability.fault")
     profiling.count(f"reliability.fault.{site}")
+    from ..observability import event as _obs_event
+
+    _obs_event("fault", site=site, batch=batch, exc=fire.exc.__name__)
     _logger.warning(
         "fault injection: raising %s at site '%s'%s (%d firings left)",
         fire.exc.__name__, site,

@@ -105,6 +105,12 @@ class RetryPolicy:
                 profiling.count("reliability.retry")
                 if site:
                     profiling.count(f"reliability.retry.{site}")
+                from ..observability import event as _obs_event
+
+                _obs_event(
+                    "retry", site=site or "unnamed", attempt=failures,
+                    error=type(e).__name__,
+                )
                 _logger.warning(
                     "transient failure at '%s' (%s: %s); retry %d/%d after backoff",
                     site or "unnamed", type(e).__name__, e, failures,

@@ -95,14 +95,26 @@ def _collect_partition(pdf_iter):
 _DEVICE_PROGRAM_LOCK = threading.Lock()
 
 
+# schema of the barrier fit stage's output rows: rank 0 carries the pickled
+# model attributes; EVERY rank carries its serialized observability snapshot
+# (counters/gauges/histograms/spans/events captured by the task's
+# worker_scope), which the driver merges into the fit report —
+# `counter_totals()` on the driver is otherwise silently process-local under a
+# real multi-host fit (observability/runs.py)
+BARRIER_FIT_SCHEMA = "model binary, metrics binary"
+
+
 def _barrier_train_udf(estimator_payload: bytes) -> Callable:
     """Build the barrier mapInPandas UDF. Runs on executors; requires pyspark."""
     import pickle
 
     def train_udf(pdf_iter):
+        import json as _json
+
         import pandas as pd
         from pyspark import BarrierTaskContext
 
+        from ..observability import span as _obs_span, worker_scope
         from ..parallel.bootstrap import init_process_group
         from ..parallel.mesh import get_mesh
 
@@ -111,165 +123,189 @@ def _barrier_train_udf(estimator_payload: bytes) -> Callable:
         rank = ctx.partitionId()
         n_tasks = ctx.getTaskInfos().__len__()
 
-        # column resolution/casting goes through the SAME prep as the local path
-        # (_use_label gate, float32 handling, idCol — core/estimator.py)
-        fd = est._pre_process_data(_collect_partition(pdf_iter))
-        sparse_fit = est._sparse_fit_wanted(fd)
-        ell_vals = ell_idx = None
-        if sparse_fit:
-            from ..ops.sparse import csr_to_ell
-
-            ell_vals, ell_idx = csr_to_ell(fd.features, float32=est._float32_inputs)
-        elif fd.is_sparse:
-            # no sparse kernel for this estimator: densify locally as usual
-            from ..core.dataset import densify
-
-            fd.features = densify(fd.features, est._float32_inputs)
-
-        # control plane: coordinator + partition sizes in one allGather round,
-        # then a status round after init so every rank agrees on the outcome.
-        # rank 0's reachable address comes from Spark's own task info (hostname
-        # resolution can map to loopback). The ephemeral port is probed, closed,
-        # and only later bound by init_process_group — a TOCTOU window a
-        # concurrent job can race. Losing the race is no longer fatal: the loop
-        # re-probes a FRESH port and re-gathers under the RetryPolicy, so a
-        # stolen port costs one round instead of the whole barrier stage.
-        from .. import profiling
-        from ..parallel.bootstrap import reset_process_group
-        from ..reliability import RetryPolicy, fault_point
-
-        import time as _time
-
-        policy = RetryPolicy.from_config()
-        failures = 0
-        init_t0 = _time.monotonic()
-        while True:
-            coordinator = ""
-            if rank == 0:
-                import socket
-
-                host = ctx.getTaskInfos()[0].address.split(":")[0]
-                probe = socket.socket()
-                probe.bind(("", 0))
-                port = probe.getsockname()[1]
-                probe.close()
-                coordinator = f"{host}:{port}"
-            fault_point("barrier_allgather", batch=failures)
-            payloads = ctx.allGather(
-                encode_partition_info(
-                    PartitionInfo(
-                        rank,
-                        fd.n_rows,
-                        coordinator,
-                        nnz=int(fd.features.nnz) if sparse_fit else -1,
-                        ell_width=int(ell_vals.shape[1]) if sparse_fit else 0,
-                    )
-                )
+        with worker_scope(rank=rank) as wscope:
+            attrs = _barrier_task_body(
+                est, ctx, rank, n_tasks, pdf_iter, init_process_group, get_mesh,
+                _obs_span,
             )
-            infos = decode_partition_info(payloads)
-            err = ""
-            try:
-                fault_point("barrier_init", batch=failures)
-                init_process_group(
-                    coordinator_address=next(
-                        i.coordinator for i in infos if i.coordinator
-                    ),
-                    num_processes=n_tasks,
-                    process_id=rank,
-                )
-            except Exception as e:
-                err = f"rank {rank}: {type(e).__name__}: {e}"
-            # status round: the outcome list is identical on every rank, so all
-            # ranks take the same retry-or-proceed branch (no split-brain). The
-            # deadline check uses the MAX gathered elapsed for the same reason —
-            # per-rank clocks differ (partition collect times vary) and a
-            # rank-local decision could strand peers in the next allGather.
-            statuses = [
-                json.loads(s)
-                for s in ctx.allGather(
-                    json.dumps(
-                        {"err": err, "elapsed": _time.monotonic() - init_t0}
-                    )
-                )
-            ]
-            errors = [s["err"] for s in statuses if s["err"]]
-            if not errors:
-                break
-            failures += 1
-            shared_elapsed = max(s["elapsed"] for s in statuses)
-            if policy.give_up(failures, shared_elapsed, "barrier_init"):
-                raise RuntimeError(
-                    "jax.distributed process-group init failed after "
-                    f"{failures} attempt(s): " + "; ".join(errors)
-                )
-            profiling.count("reliability.retry")
-            profiling.count("reliability.retry.barrier_init")
-            reset_process_group()  # drop any partial link before re-probing
-            policy.sleep(failures, "barrier_init")
-
-        # global mesh over the pod; every host pads its rows to the common local
-        # size (XLA needs equal shards), real rows marked by the weight vector
-        import jax
-
-        mesh = get_mesh()
-        from jax.sharding import NamedSharding, PartitionSpec as P
-
-        max_rows = max(i.n_rows for i in infos)
-        local_devices = jax.local_device_count()
-        pad_to = -(-max_rows // (8 * local_devices)) * (8 * local_devices)
-        w_local = np.zeros((pad_to,), np.float32)
-        w_local[: fd.n_rows] = 1.0 if fd.weight is None else fd.weight
-        total_rows = sum(i.n_rows for i in infos)
-
-        sharding2 = NamedSharding(mesh, P("data", None))
-        sharding1 = NamedSharding(mesh, P("data"))
-        w_global = jax.make_array_from_process_local_data(sharding1, w_local)
-        label_global = None
-        if fd.label is not None:
-            y_local = np.zeros((pad_to,), np.float32)
-            y_local[: fd.n_rows] = fd.label
-            label_global = jax.make_array_from_process_local_data(sharding1, y_local)
-
-        if sparse_fit:
-            # pad the local ELL width to the GLOBAL max so every host contributes
-            # equally-shaped shards, then assemble the global sparse arrays
-            r_global = max(i.ell_width for i in infos)
-            v_local = np.zeros((pad_to, r_global), ell_vals.dtype)
-            i_local = np.zeros((pad_to, r_global), ell_idx.dtype)
-            v_local[: fd.n_rows, : ell_vals.shape[1]] = ell_vals
-            i_local[: fd.n_rows, : ell_idx.shape[1]] = ell_idx
-            values_global = jax.make_array_from_process_local_data(sharding2, v_local)
-            indices_global = jax.make_array_from_process_local_data(sharding2, i_local)
-            fit_inputs = est._build_sparse_fit_inputs_from_global(
-                values_global, indices_global, w_global, label_global, total_rows,
-                fd.n_cols, mesh,
-                rank_rows=[i.n_rows for i in infos],
-                nnz=sum(i.nnz for i in infos if i.nnz > 0),
-                unit_weight=fd.weight is None,
-            )
-        else:
-            X_local = np.zeros((pad_to, fd.n_cols), np.float32)
-            X_local[: fd.n_rows] = np.asarray(fd.features, dtype=np.float32)
-            X_global = jax.make_array_from_process_local_data(sharding2, X_local)
-            fit_inputs = est._build_fit_inputs_from_global(
-                X_global, w_global, label_global, total_rows, mesh,
-                rank_rows=[i.n_rows for i in infos],
-                unit_weight=fd.weight is None,
-            )
-
-        # run the estimator's fit program (same SPMD program on every host)
-        with _DEVICE_PROGRAM_LOCK:
-            attrs = est._get_tpu_fit_func(None)(fit_inputs)
-
-        if rank == 0:
-            import pickle as _p
-
-            yield pd.DataFrame({"model": [_p.dumps(attrs)]})
-        # rank != 0 yields NOTHING: an empty object-dtype DataFrame against the
-        # 'model binary' Arrow schema is a type-inference crash; mapInPandas
-        # generators may legitimately emit zero batches
+        # every rank yields exactly one row: rank 0 the model payload, everyone
+        # their metrics snapshot. A None in the binary `model` column is a null
+        # to Arrow — unlike the empty-DataFrame-against-a-schema case, which is
+        # a type-inference crash (the pre-observability rank!=0 behavior was to
+        # yield nothing at all for that reason).
+        yield pd.DataFrame(
+            {
+                "model": [pickle.dumps(attrs) if rank == 0 else None],
+                "metrics": [_json.dumps(wscope.snapshot()).encode()],
+            }
+        )
 
     return train_udf
+
+
+def _barrier_task_body(est, ctx, rank, n_tasks, pdf_iter, init_process_group,
+                       get_mesh, _obs_span):
+    """One barrier task's work, returning the fit-attribute dict (meaningful on
+    rank 0). Split from the generator so the task's worker_scope closes — with a
+    complete metrics snapshot — before any output row is yielded."""
+    # column resolution/casting goes through the SAME prep as the local path
+    # (_use_label gate, float32 handling, idCol — core/estimator.py)
+    with _obs_span("barrier.collect", {"rank": rank}):
+        fd = est._pre_process_data(_collect_partition(pdf_iter))
+    sparse_fit = est._sparse_fit_wanted(fd)
+    ell_vals = ell_idx = None
+    if sparse_fit:
+        from ..ops.sparse import csr_to_ell
+
+        ell_vals, ell_idx = csr_to_ell(fd.features, float32=est._float32_inputs)
+    elif fd.is_sparse:
+        # no sparse kernel for this estimator: densify locally as usual
+        from ..core.dataset import densify
+
+        fd.features = densify(fd.features, est._float32_inputs)
+
+    # control plane: coordinator + partition sizes in one allGather round,
+    # then a status round after init so every rank agrees on the outcome.
+    # rank 0's reachable address comes from Spark's own task info (hostname
+    # resolution can map to loopback). The ephemeral port is probed, closed,
+    # and only later bound by init_process_group — a TOCTOU window a
+    # concurrent job can race. Losing the race is no longer fatal: the loop
+    # re-probes a FRESH port and re-gathers under the RetryPolicy, so a
+    # stolen port costs one round instead of the whole barrier stage.
+    from .. import profiling
+    from ..parallel.bootstrap import reset_process_group
+    from ..reliability import RetryPolicy, fault_point
+
+    import time as _time
+
+    policy = RetryPolicy.from_config()
+    failures = 0
+    init_t0 = _time.monotonic()
+    while True:
+        coordinator = ""
+        if rank == 0:
+            import socket
+
+            host = ctx.getTaskInfos()[0].address.split(":")[0]
+            probe = socket.socket()
+            probe.bind(("", 0))
+            port = probe.getsockname()[1]
+            probe.close()
+            coordinator = f"{host}:{port}"
+        fault_point("barrier_allgather", batch=failures)
+        payloads = ctx.allGather(
+            encode_partition_info(
+                PartitionInfo(
+                    rank,
+                    fd.n_rows,
+                    coordinator,
+                    nnz=int(fd.features.nnz) if sparse_fit else -1,
+                    ell_width=int(ell_vals.shape[1]) if sparse_fit else 0,
+                )
+            )
+        )
+        infos = decode_partition_info(payloads)
+        err = ""
+        try:
+            fault_point("barrier_init", batch=failures)
+            init_process_group(
+                coordinator_address=next(
+                    i.coordinator for i in infos if i.coordinator
+                ),
+                num_processes=n_tasks,
+                process_id=rank,
+            )
+        except Exception as e:
+            err = f"rank {rank}: {type(e).__name__}: {e}"
+        # status round: the outcome list is identical on every rank, so all
+        # ranks take the same retry-or-proceed branch (no split-brain). The
+        # deadline check uses the MAX gathered elapsed for the same reason —
+        # per-rank clocks differ (partition collect times vary) and a
+        # rank-local decision could strand peers in the next allGather.
+        statuses = [
+            json.loads(s)
+            for s in ctx.allGather(
+                json.dumps(
+                    {"err": err, "elapsed": _time.monotonic() - init_t0}
+                )
+            )
+        ]
+        errors = [s["err"] for s in statuses if s["err"]]
+        if not errors:
+            break
+        failures += 1
+        shared_elapsed = max(s["elapsed"] for s in statuses)
+        if policy.give_up(failures, shared_elapsed, "barrier_init"):
+            raise RuntimeError(
+                "jax.distributed process-group init failed after "
+                f"{failures} attempt(s): " + "; ".join(errors)
+            )
+        profiling.count("reliability.retry")
+        profiling.count("reliability.retry.barrier_init")
+        from ..observability import event as _obs_event
+
+        _obs_event(
+            "retry", site="barrier_init", attempt=failures,
+            errors=len(errors),
+        )
+        reset_process_group()  # drop any partial link before re-probing
+        policy.sleep(failures, "barrier_init")
+
+    # global mesh over the pod; every host pads its rows to the common local
+    # size (XLA needs equal shards), real rows marked by the weight vector
+    import jax
+
+    mesh = get_mesh()
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    max_rows = max(i.n_rows for i in infos)
+    local_devices = jax.local_device_count()
+    pad_to = -(-max_rows // (8 * local_devices)) * (8 * local_devices)
+    w_local = np.zeros((pad_to,), np.float32)
+    w_local[: fd.n_rows] = 1.0 if fd.weight is None else fd.weight
+    total_rows = sum(i.n_rows for i in infos)
+
+    sharding2 = NamedSharding(mesh, P("data", None))
+    sharding1 = NamedSharding(mesh, P("data"))
+    w_global = jax.make_array_from_process_local_data(sharding1, w_local)
+    label_global = None
+    if fd.label is not None:
+        y_local = np.zeros((pad_to,), np.float32)
+        y_local[: fd.n_rows] = fd.label
+        label_global = jax.make_array_from_process_local_data(sharding1, y_local)
+
+    if sparse_fit:
+        # pad the local ELL width to the GLOBAL max so every host contributes
+        # equally-shaped shards, then assemble the global sparse arrays
+        r_global = max(i.ell_width for i in infos)
+        v_local = np.zeros((pad_to, r_global), ell_vals.dtype)
+        i_local = np.zeros((pad_to, r_global), ell_idx.dtype)
+        v_local[: fd.n_rows, : ell_vals.shape[1]] = ell_vals
+        i_local[: fd.n_rows, : ell_idx.shape[1]] = ell_idx
+        values_global = jax.make_array_from_process_local_data(sharding2, v_local)
+        indices_global = jax.make_array_from_process_local_data(sharding2, i_local)
+        fit_inputs = est._build_sparse_fit_inputs_from_global(
+            values_global, indices_global, w_global, label_global, total_rows,
+            fd.n_cols, mesh,
+            rank_rows=[i.n_rows for i in infos],
+            nnz=sum(i.nnz for i in infos if i.nnz > 0),
+            unit_weight=fd.weight is None,
+        )
+    else:
+        X_local = np.zeros((pad_to, fd.n_cols), np.float32)
+        X_local[: fd.n_rows] = np.asarray(fd.features, dtype=np.float32)
+        X_global = jax.make_array_from_process_local_data(sharding2, X_local)
+        fit_inputs = est._build_fit_inputs_from_global(
+            X_global, w_global, label_global, total_rows, mesh,
+            rank_rows=[i.n_rows for i in infos],
+            unit_weight=fd.weight is None,
+        )
+
+    # run the estimator's fit program (same SPMD program on every host)
+    with _DEVICE_PROGRAM_LOCK, _obs_span("barrier.fit_program", {"rank": rank}):
+        attrs = est._get_tpu_fit_func(None)(fit_inputs)
+
+    return attrs
 
 
 def skip_stage_level_scheduling(spark_version: str, conf: Any) -> bool:
@@ -351,6 +387,41 @@ def apply_stage_level_scheduling(rdd: Any, session: Any) -> Any:
     return rdd.withResources(rp)
 
 
+def _merge_worker_metrics(rows: Any) -> None:
+    """Driver-side aggregation: fold each barrier worker's serialized metrics
+    snapshot into the active FitRun (per-worker breakdown + merged totals) and
+    into the process-global registry for FOREIGN-process snapshots — on a real
+    multi-host fit the executors' counters never touched the driver, which is
+    exactly why driver `counter_totals()` used to under-report. Same-process
+    snapshots (the threaded local-mode harness) already flowed through the live
+    fan-out and are recorded for the breakdown only (observability/runs.py)."""
+    from ..observability import PROCESS_TOKEN, current_run, global_registry
+
+    logger = get_logger("spark.integration")
+    run = current_run()
+    for r in rows:
+        try:
+            blob = r["metrics"]
+        except (KeyError, IndexError, TypeError):
+            continue  # a foreign/legacy row shape carries no snapshot
+        if blob is None:
+            continue
+        try:
+            snap = json.loads(bytes(blob).decode())
+            if run is not None:
+                run.add_worker_snapshot(snap)
+            elif snap.get("process") != PROCESS_TOKEN:
+                global_registry().merge_snapshot(snap.get("metrics") or {})
+        except Exception as e:
+            # a mis-shaped/version-skewed snapshot (bad JSON, missing keys, a
+            # kind conflict with the driver registry) must never fail a fit
+            # whose expensive barrier stage already SUCCEEDED — log and move on
+            logger.warning(
+                "skipping unusable worker metrics snapshot (%s: %s)",
+                type(e).__name__, e,
+            )
+
+
 def fit_on_spark(estimator: Any, spark_df: Any, num_hosts: int) -> Any:
     """Driver-side: run a TPU estimator's fit as barrier tasks on a Spark cluster.
 
@@ -367,7 +438,7 @@ def fit_on_spark(estimator: Any, spark_df: Any, num_hosts: int) -> Any:
     logger = get_logger("spark.integration")
     df = spark_df.repartition(num_hosts)
     udf = _barrier_train_udf(pickle.dumps(estimator))
-    rdd = df.mapInPandas(udf, schema="model binary").rdd
+    rdd = df.mapInPandas(udf, schema=BARRIER_FIT_SCHEMA).rdd
     try:
         rdd = apply_stage_level_scheduling(rdd, spark_df.sparkSession)
     except Exception:  # pragma: no cover — never fail a fit over scheduling sugar
@@ -383,6 +454,7 @@ def fit_on_spark(estimator: Any, spark_df: Any, num_hosts: int) -> Any:
     )
     payload = next(r["model"] for r in rows if r["model"] is not None)
     attrs = pickle.loads(bytes(payload))
+    _merge_worker_metrics(rows)
     model = estimator._create_pyspark_model(attrs)
     model._num_workers = estimator._num_workers
     model._float32_inputs = estimator._float32_inputs

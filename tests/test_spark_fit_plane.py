@@ -204,7 +204,9 @@ class FakeFitSparkDF:
         return FakeFitSparkDF(self._pdf, n)
 
     def mapInPandas(self, udf, schema):
-        assert schema == "model binary"
+        from spark_rapids_ml_tpu.spark.integration import BARRIER_FIT_SCHEMA
+
+        assert schema == BARRIER_FIT_SCHEMA
         return _MappedDF(FakeBarrierRDD(udf, self._pdf, self._n_partitions))
 
     # transform-plane surface, so model.transform on the fake frame also works
@@ -349,6 +351,35 @@ def test_estimator_fit_routes_to_barrier_plane(barrier_env):
         np.asarray(model.coefficients), np.asarray(direct.coefficients),
         rtol=1e-4, atol=1e-4,
     )
+
+
+def test_fit_report_aggregates_barrier_workers(barrier_env):
+    """Driver-side aggregation (observability subsystem): every barrier task
+    ships its metrics snapshot alongside the fit result, and the driver's
+    FitRun folds them into one report — per-worker breakdown with rank + the
+    task's own barrier spans, merged=False in the threaded harness (same
+    process: its writes already flowed through the live fan-out)."""
+    from spark_rapids_ml_tpu.clustering import KMeans
+    from spark_rapids_ml_tpu.observability.export import iter_spans
+
+    barrier_env(4)
+    pdf = _blob_pdf(n=256)
+    srml_config.set("spark_fit_mode", "barrier")
+    try:
+        est = KMeans(k=2, maxIter=5, seed=7)
+        est._num_workers = 4
+        model = est.fit(FakeFitSparkDF(pdf, n_partitions=4))
+    finally:
+        srml_config.unset("spark_fit_mode")
+    rep = model.fit_report_
+    assert sorted(w["rank"] for w in rep["workers"]) == [0, 1, 2, 3]
+    assert all(w["merged"] is False for w in rep["workers"])
+    for w in rep["workers"]:
+        assert "barrier.collect" in w["metrics"]["spans"]
+        assert "barrier.fit_program" in w["metrics"]["spans"]
+    # the run trace saw every task's spans too (process-global fan-out)
+    names = [s["name"] for s in iter_spans(rep)]
+    assert names.count("barrier.fit_program") == 4
 
 
 def test_empty_partition_raises_actionable_error(barrier_env):
