@@ -449,6 +449,31 @@ def _worker_main() -> None:
             return unit_wide256()
         return family_fns[name](ctx)
 
+    def _transform_latency(report):
+        """p50/p95/p99 transform latency per histogram from a unit's run report
+        (observability/inference.py populates transform.batch_s/predict_s;
+        quantiles interpolate within the exponential buckets)."""
+        from spark_rapids_ml_tpu.observability.registry import (
+            interpolate_quantile, split_label_key,
+        )
+
+        out = {}
+        for key, st in (report["metrics"].get("histograms") or {}).items():
+            hname, labels = split_label_key(key)
+            if hname not in ("transform.batch_s", "transform.predict_s"):
+                continue
+            bounds = st.get("bounds") or []
+            tag = hname.split(".")[-1]
+            if labels.get("model"):
+                tag += f"_{labels['model']}"
+            out[tag] = {
+                "count": st["count"],
+                "p50": round(interpolate_quantile(st, 0.50, bounds), 6),
+                "p95": round(interpolate_quantile(st, 0.95, bounds), 6),
+                "p99": round(interpolate_quantile(st, 0.99, bounds), 6),
+            }
+        return out
+
     for name in UNITS:
         if name in skip:
             continue
@@ -467,14 +492,18 @@ def _worker_main() -> None:
             with fit_run(algo=name, site="bench") as obs_run:
                 result = run_unit(name)
             if obs_run is not None:
+                obs_report = obs_run.report()
                 stage_s = sorted(
-                    obs_run.report()["metrics"]["spans"].items(),
+                    obs_report["metrics"]["spans"].items(),
                     key=lambda kv: -kv[1],
                 )[:8]
                 if stage_s:
                     result[f"{name}_stage_s"] = {
                         k: round(v, 4) for k, v in stage_s
                     }
+                tlat = _transform_latency(obs_report)
+                if tlat:
+                    result[f"{name}_transform_latency_s"] = tlat
             result[f"{name}_bench_secs"] = round(time.time() - t0, 1)
             _flush_progress(
                 progress,

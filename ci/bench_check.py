@@ -1,0 +1,191 @@
+#!/usr/bin/env python
+"""Bench regression gate: compare per-scenario wall times across the two newest
+recorded benchmark rounds and fail on a >25% regression.
+
+Inputs are the repo's recorded bench artifacts:
+
+  * `BENCH_r*.json` — driver-captured rounds. Each holds the bench.py JSON line
+    (sometimes only as a truncated stdout `tail`), whose `secondary` carries one
+    `<scenario>_bench_secs` wall time per benchmark unit (bench.py flushes one
+    per completed unit). Scenario times are extracted by regex over the raw
+    file text, so a truncated tail still yields every scenario it mentions.
+  * `BENCH_TPU_SESSION*.json` — real-TPU session captures, same extraction;
+    included when present so a TPU-vs-TPU comparison uses real numbers.
+
+Rules:
+  * Only rounds measured on the SAME platform compare (a cpu-fallback round vs
+    a TPU round is tunnel health, not a regression) — mismatches report and
+    pass.
+  * A scenario regresses when `new > old * (1 + threshold)`; default threshold
+    0.25. Scenarios present in only one round are listed, never failed on.
+  * Exit 1 on any regression — unless SRML_BENCH_CHECK_ADVISORY=1, which
+    prints the same per-scenario table and always exits 0. ci/test.sh wires
+    this gate in as an ADVISORY tier (wall times vary with tunnel health);
+    export SRML_BENCH_CHECK_ADVISORY=0 to enforce it strictly.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import sys
+from typing import Dict, List, Optional, Tuple
+
+DEFAULT_THRESHOLD = 0.25
+
+# optional backslashes before the quotes: inside an artifact whose wrapper JSON
+# is truncated (unparseable), the bench line's quotes appear escaped (\") and
+# the regex must still sweep the raw text
+_SECS_RE = re.compile(r'\\?"(\w+)_bench_secs\\?"\s*:\s*([0-9]+(?:\.[0-9]+)?)')
+_PLATFORM_RE = re.compile(r'\\?"platform\\?"\s*:\s*\\?"(\w+)\\?"')
+
+
+def _round_key(path: str) -> Tuple[int, str]:
+    m = re.search(r"BENCH_r(\d+)\.json$", path)
+    return (int(m.group(1)) if m else -1, path)
+
+
+def discover(root: str) -> List[str]:
+    """Newest-last list of comparable bench artifacts: all BENCH_r*.json by
+    round number, then any BENCH_TPU_SESSION*.json (by name) as the most
+    trusted real-hardware captures."""
+    rounds = sorted(glob.glob(os.path.join(root, "BENCH_r*.json")), key=_round_key)
+    sessions = sorted(glob.glob(os.path.join(root, "BENCH_TPU_SESSION*.json")))
+    return rounds + sessions
+
+
+def extract(path: str) -> Dict[str, object]:
+    """Scenario wall times + platform of one bench artifact. Prefers the
+    structured `parsed.secondary` when the file carries one; falls back to a
+    regex sweep of the raw text (the stdout tail can be truncated mid-line)."""
+    with open(path) as f:
+        raw = f.read()
+    scenarios: Dict[str, float] = {}
+    platform: Optional[str] = None
+    try:
+        doc = json.loads(raw)
+    except json.JSONDecodeError:
+        doc = {}
+    parsed = doc.get("parsed") if isinstance(doc, dict) else None
+    secondary = (parsed or {}).get("secondary") or {}
+    for k, v in secondary.items():
+        if k.endswith("_bench_secs") and isinstance(v, (int, float)):
+            scenarios[k[: -len("_bench_secs")]] = float(v)
+    if isinstance(secondary.get("platform"), str):
+        platform = secondary["platform"]
+    # fall back to regex over DECODED text: inside the artifact the bench line
+    # usually lives in the `tail` string field, where every quote is escaped —
+    # scanning the raw file would miss it
+    texts = [raw]
+    if isinstance(doc, dict) and isinstance(doc.get("tail"), str):
+        texts.insert(0, doc["tail"])
+    for text in texts:
+        if scenarios:
+            break
+        for name, secs in _SECS_RE.findall(text):
+            scenarios[name] = float(secs)
+    if platform is None:
+        for text in texts:
+            m = _PLATFORM_RE.findall(text)
+            if m:
+                platform = m[-1]
+                break
+    return {
+        "path": path,
+        "name": os.path.basename(path),
+        "platform": platform,
+        "scenarios": scenarios,
+    }
+
+
+def compare(old: Dict[str, object], new: Dict[str, object],
+            threshold: float = DEFAULT_THRESHOLD) -> List[Dict[str, object]]:
+    """Per-scenario comparison rows, worst regression first."""
+    rows: List[Dict[str, object]] = []
+    old_s: Dict[str, float] = old["scenarios"]  # type: ignore[assignment]
+    new_s: Dict[str, float] = new["scenarios"]  # type: ignore[assignment]
+    for name in sorted(set(old_s) | set(new_s)):
+        o, n = old_s.get(name), new_s.get(name)
+        if o is None or n is None:
+            rows.append({"scenario": name, "old_s": o, "new_s": n,
+                         "ratio": None, "verdict": "only-one-round"})
+            continue
+        ratio = n / o if o > 0 else float("inf")
+        verdict = "REGRESSED" if ratio > 1.0 + threshold else (
+            "improved" if ratio < 1.0 - threshold else "ok"
+        )
+        rows.append({"scenario": name, "old_s": o, "new_s": n,
+                     "ratio": ratio, "verdict": verdict})
+    rows.sort(key=lambda r: -(r["ratio"] or 0.0))
+    return rows
+
+
+def render_table(rows: List[Dict[str, object]]) -> str:
+    lines = [f"{'scenario':<22} {'old_s':>9} {'new_s':>9} {'ratio':>7}  verdict"]
+    for r in rows:
+        o = f"{r['old_s']:.1f}" if r["old_s"] is not None else "-"
+        n = f"{r['new_s']:.1f}" if r["new_s"] is not None else "-"
+        ratio = f"{r['ratio']:.2f}" if r["ratio"] is not None else "-"
+        lines.append(
+            f"{r['scenario']:<22} {o:>9} {n:>9} {ratio:>7}  {r['verdict']}"
+        )
+    return "\n".join(lines)
+
+
+def check(root: str, threshold: float = DEFAULT_THRESHOLD,
+          advisory: bool = False) -> int:
+    artifacts = [extract(p) for p in discover(root)]
+    artifacts = [a for a in artifacts if a["scenarios"]]
+    if len(artifacts) < 2:
+        print(
+            "bench_check: fewer than two bench artifacts carry per-scenario "
+            f"wall times ({len(artifacts)} found) — nothing to compare, passing."
+        )
+        return 0
+    old, new = artifacts[-2], artifacts[-1]
+    print(
+        f"bench_check: comparing {old['name']} (platform={old['platform']}) "
+        f"-> {new['name']} (platform={new['platform']}), "
+        f"threshold +{threshold:.0%}"
+    )
+    if old["platform"] != new["platform"]:
+        print(
+            "bench_check: platform mismatch — wall times are not comparable "
+            "across backends (tunnel health, not code); passing."
+        )
+        return 0
+    rows = compare(old, new, threshold)
+    print(render_table(rows))
+    regressed = [r for r in rows if r["verdict"] == "REGRESSED"]
+    if not regressed:
+        print("bench_check: OK — no scenario regressed beyond the threshold")
+        return 0
+    names = ", ".join(r["scenario"] for r in regressed)
+    if advisory:
+        print(
+            f"bench_check: ADVISORY — {len(regressed)} scenario(s) regressed "
+            f">{threshold:.0%} ({names}); not failing "
+            "(SRML_BENCH_CHECK_ADVISORY=1; set 0 to enforce)"
+        )
+        return 0
+    print(
+        f"bench_check: FAIL — {len(regressed)} scenario(s) regressed "
+        f">{threshold:.0%}: {names}"
+    )
+    return 1
+
+
+def main(argv: List[str]) -> int:
+    root = argv[1] if len(argv) > 1 else os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), os.pardir
+    )
+    threshold = float(os.environ.get("SRML_BENCH_CHECK_THRESHOLD",
+                                     str(DEFAULT_THRESHOLD)))
+    advisory = os.environ.get("SRML_BENCH_CHECK_ADVISORY", "") == "1"
+    return check(os.path.abspath(root), threshold=threshold, advisory=advisory)
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

@@ -22,6 +22,11 @@ image ships no third-party linters, so the gate is stdlib-only but real:
     (observability/registry.py) — and historically direct mutation was how
     scoped FitRun accounting got silently corrupted. Go through the public
     surface (count/add_time/counter_totals/...) or the observability API.
+  * uninstrumented model predict: any `jax.jit` use inside
+    spark_rapids_ml_tpu/models/*.py. Model-layer predict calls must route
+    through `observability.inference.predict_dispatch` (uniform metric names,
+    shape-bucket/recompile-sentinel telemetry); jitted kernels belong in ops/,
+    where the dispatch helper wraps them. `# noqa` on the line exempts.
 
 Exit code 1 on any finding; CI runs this before the test tiers (ci/test.sh).
 """
@@ -148,6 +153,43 @@ def check_file(path: Path) -> list:
             findings.append(f"{path}:{lineno}: tab in indentation")
 
     _UncachedStreamVisitor(path, src.splitlines(), findings).visit(tree)
+
+    # models/ may not call jax.jit directly: predict kernels live in ops/ and
+    # route through observability.inference.predict_dispatch so every family
+    # reports the same transform metrics + recompile-sentinel telemetry
+    if "models" in path.parts and "spark_rapids_ml_tpu" in path.parts:
+        src_lines = src.splitlines()
+        for node in ast.walk(tree):
+            hit = None
+            if (
+                isinstance(node, ast.Attribute)
+                and node.attr == "jit"
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "jax"
+            ):
+                hit = "jax.jit"
+            elif (
+                # `from jax import jit` (any alias) bypasses the attribute
+                # form above and must not slip past the gate
+                isinstance(node, ast.ImportFrom)
+                and node.module
+                and node.module.split(".")[0] == "jax"
+                and any(alias.name == "jit" for alias in node.names)
+            ):
+                hit = "from jax import jit"
+            if hit is None:
+                continue
+            line = (
+                src_lines[node.lineno - 1]
+                if node.lineno - 1 < len(src_lines)
+                else ""
+            )
+            if "noqa" not in line:
+                findings.append(
+                    f"{path}:{node.lineno}: {hit} in models/ — route "
+                    "predict calls through observability.inference."
+                    "predict_dispatch (jitted kernels belong in ops/)"
+                )
 
     if not any(part in PROFILING_INTERNALS_EXEMPT_PARTS for part in path.parts):
         src_lines = src.splitlines()

@@ -47,7 +47,7 @@ else
   # whose counters prove pass 2+ uploaded ZERO bytes (the cache-tier
   # assertion, migrated onto the report path: what production dashboards
   # will read is what CI verifies)
-  python -m pytest tests/test_observability.py -q
+  python -m pytest tests/test_observability.py tests/test_transform_observability.py -q
   SRML_OBS_SMOKE_DIR="$(mktemp -d)"
   SRML_TPU_METRICS_DIR="$SRML_OBS_SMOKE_DIR" \
   SRML_TPU_STREAM_THRESHOLD_BYTES=1024 SRML_TPU_STREAM_BATCH_ROWS=64 \
@@ -73,13 +73,67 @@ assert len(steps) >= 2 and c["cache.hits"] == (len(steps) - 1) * n_batches, c
 assert rep["metrics"]["gauges"]["cache.bytes_resident"] == 0
 print("OBSERVABILITY SMOKE OK: report parses, pass-2 uploads == 0")
 PY
+  # inference-plane smoke (docs/design.md §6e): a fit + transform must export
+  # BOTH fit_reports.jsonl and transform_reports.jsonl; the recompile sentinel
+  # must fire under deliberately ragged batch sizes and stay silent under
+  # bucketed ones — all asserted from the exported JSONL, like a dashboard would
+  SRML_TPU_METRICS_DIR="$SRML_OBS_SMOKE_DIR" \
+  SRML_TPU_RECOMPILE_WARN_THRESHOLD=4 \
+  python - <<'PY'
+import os
+import numpy as np, pandas as pd
+from spark_rapids_ml_tpu.clustering import KMeans
+from spark_rapids_ml_tpu.observability.export import (
+    load_run_reports, load_transform_reports)
+from spark_rapids_ml_tpu.observability.inference import reset_shape_buckets
+
+d = os.environ["SRML_TPU_METRICS_DIR"]
+rng = np.random.default_rng(0)
+X = np.concatenate(
+    [rng.normal(-3, 1, (128, 8)), rng.normal(3, 1, (128, 8))]
+).astype(np.float32)
+pdf = pd.DataFrame({"features": list(X)})
+model = KMeans(k=2, maxIter=6, seed=5).fit(pdf)
+
+def storms(reports):
+    return sum(
+        v for r in reports
+        for k, v in r["metrics"]["counters"].items()
+        if k.startswith("transform.recompile_storm")
+    )
+
+# bucketed: fixed batch size -> few shape signatures -> sentinel silent
+reset_shape_buckets()
+for i in range(0, len(pdf), 64):
+    model.transform(pdf.iloc[i : i + 64])
+bucketed = load_transform_reports(d)
+assert storms(bucketed) == 0, "sentinel fired under bucketed batches"
+hist = bucketed[-1]["metrics"]["histograms"]
+assert any(k.startswith("transform.batch_s") and v["count"] >= 1
+           for k, v in hist.items()), hist
+# ragged: every batch a new (rows, cols, dtype) signature -> storm fires
+reset_shape_buckets()
+n_before = len(bucketed)
+for n in (7, 11, 13, 17, 19, 23):  # 6 distinct sigs > threshold 4
+    model.transform(pdf.head(n))
+ragged = load_transform_reports(d)[n_before:]
+assert storms(ragged) >= 1, "sentinel silent under ragged batches"
+assert len(load_run_reports(d)) >= 1  # fit report exported too
+print("INFERENCE SMOKE OK: both JSONLs exported; sentinel fires only on ragged")
+PY
   rm -rf "$SRML_OBS_SMOKE_DIR"
-  python -m pytest tests/ -q --ignore=tests/test_reliability.py --ignore=tests/test_device_cache.py --ignore=tests/test_observability.py
+  python -m pytest tests/ -q --ignore=tests/test_reliability.py --ignore=tests/test_device_cache.py --ignore=tests/test_observability.py --ignore=tests/test_transform_observability.py
 fi
 
 # small benchmark smoke (reference runs a small bench pre-merge)
 python benchmark/benchmark_runner.py kmeans --num_rows 2000 --num_cols 32 --k 5 --no_cpu
 python benchmark/benchmark_runner.py pca --num_rows 2000 --num_cols 32 --k 3 --no_cpu
+
+# bench regression gate (ci/bench_check.py): per-scenario wall times of the two
+# newest recorded bench rounds, >25% is a regression. ADVISORY by default —
+# wall times track tunnel health as much as code — export
+# SRML_BENCH_CHECK_ADVISORY=0 to enforce it as a hard premerge gate
+SRML_BENCH_CHECK_ADVISORY="${SRML_BENCH_CHECK_ADVISORY:-1}" python ci/bench_check.py
 
 # JVM half: attempt compile+test where a Scala toolchain exists; always record
 # the outcome (ci/jvm_build_status.json) — reference CI runs run_plugin_test.sh

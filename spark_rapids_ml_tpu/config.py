@@ -78,6 +78,20 @@ _DEFAULTS: Dict[str, Any] = {
     "observability.enabled": True,          # FitRun scopes + trace collection
     "observability.metrics_dir": None,      # JSONL fit_reports.jsonl directory
     "observability.max_spans": 1024,        # trace-tree node cap per run
+    # inference plane (observability/inference.py): TransformRun scopes, the
+    # instrumented predict dispatch, and the recompile sentinel — warn (and
+    # count transform.recompile_storm) once one model's predict has seen more
+    # distinct (rows, cols, dtype) shape signatures than this; un-bucketed
+    # pandas-UDF batch sizes silently force one XLA compile per batch
+    "observability.recompile_warn_threshold": 8,
+    # fraction of transform batches whose latency lands in the
+    # transform.batch_s/predict_s histograms (counters always count); lower it
+    # on hot serving paths where even histogram writes show up in profiles
+    "observability.transform_sample_rate": 1.0,
+    # JSONL report rotation (observability/export.py): rotate the live file at
+    # max_report_bytes, keep max_report_files rotated generations
+    "observability.max_report_bytes": 32 << 20,
+    "observability.max_report_files": 4,
 }
 
 _ENV_KEYS: Dict[str, str] = {
@@ -106,6 +120,10 @@ _ENV_KEYS: Dict[str, str] = {
     "observability.enabled": "SRML_TPU_OBSERVABILITY_ENABLED",
     "observability.metrics_dir": "SRML_TPU_METRICS_DIR",
     "observability.max_spans": "SRML_TPU_MAX_SPANS",
+    "observability.recompile_warn_threshold": "SRML_TPU_RECOMPILE_WARN_THRESHOLD",
+    "observability.transform_sample_rate": "SRML_TPU_TRANSFORM_SAMPLE_RATE",
+    "observability.max_report_bytes": "SRML_TPU_MAX_REPORT_BYTES",
+    "observability.max_report_files": "SRML_TPU_MAX_REPORT_FILES",
 }
 
 _overrides: Dict[str, Any] = {}

@@ -714,19 +714,35 @@ class _TpuModel(_TpuClass, _TpuParams):
             from ..spark.transform import transform_on_spark
 
             return transform_on_spark(self, dataset)
-        input_col, input_cols = self._input_col_for_transform()
-        fd = extract_feature_data(
-            dataset,
-            input_col=input_col,
-            input_cols=input_cols,
-            float32=self._float32_inputs,
-        )
-        if fd.is_sparse and self._supports_sparse_transform():
-            outputs = self._transform_sparse(fd.features)
-        else:
-            X = densify(fd.features, float32=self._float32_inputs)
-            outputs = self._transform_arrays(X)
-        return append_output_columns(dataset, outputs)
+        # inference-plane scope: one TransformRun per USER call (suppressed for
+        # the per-batch recursion inside the distributed plane's UDF — there the
+        # driver's run is the scope and this local call is the per-batch unit).
+        # transform_batch is the single place rows/batches/latency are counted,
+        # so local and distributed totals share one definition (§6e).
+        from ..observability.inference import transform_batch, transform_run
+
+        try:
+            n_rows = len(dataset)
+        except TypeError:
+            n_rows = 0
+        with transform_run(type(self).__name__) as run:
+            with transform_batch(self, n_rows):
+                input_col, input_cols = self._input_col_for_transform()
+                fd = extract_feature_data(
+                    dataset,
+                    input_col=input_col,
+                    input_cols=input_cols,
+                    float32=self._float32_inputs,
+                )
+                if fd.is_sparse and self._supports_sparse_transform():
+                    outputs = self._transform_sparse(fd.features)
+                else:
+                    X = densify(fd.features, float32=self._float32_inputs)
+                    outputs = self._transform_arrays(X)
+                out = append_output_columns(dataset, outputs)
+        if run is not None:
+            self.transform_report_ = run.report()
+        return out
 
     def _supports_sparse_transform(self) -> bool:
         """Whether this model predicts on CSR input without densifying (ops/sparse

@@ -557,6 +557,8 @@ class LogisticRegressionModel(
         )
 
     def _margins(self, X: np.ndarray) -> np.ndarray:
+        from ..observability.inference import predict_dispatch
+
         coef = self._model_attributes["coefficients"].astype(np.float32)
         icpt = self._model_attributes["intercepts"].astype(np.float32)
         # guard degenerate single-label ±inf intercepts on the host path
@@ -565,7 +567,9 @@ class LogisticRegressionModel(
                 return np.broadcast_to(icpt, (X.shape[0], icpt.shape[0])).copy()
             return np.broadcast_to(icpt[0], (X.shape[0],)).copy()
         return np.asarray(
-            logreg_decision(X, coef, icpt, self._is_multinomial_layout)
+            predict_dispatch(
+                self, logreg_decision, X, coef, icpt, self._is_multinomial_layout
+            )
         )
 
     def _supports_sparse_transform(self) -> bool:
@@ -587,12 +591,18 @@ class LogisticRegressionModel(
             else:
                 z = np.broadcast_to(icpt[0], (n,)).copy()
             return self._outputs_from_margins(z)
+        from ..observability.inference import predict_dispatch
+
         values, indices = csr_to_ell(csr, float32=True)
         vj, ij = jnp.asarray(values), jnp.asarray(indices)
         if self._is_multinomial_layout:
-            z = np.asarray(ell_matmat(vj, ij, jnp.asarray(coef.T))) + icpt
+            z = np.asarray(
+                predict_dispatch(self, ell_matmat, vj, ij, jnp.asarray(coef.T))
+            ) + icpt
         else:
-            z = np.asarray(ell_matvec(vj, ij, jnp.asarray(coef[0]))) + icpt[0]
+            z = np.asarray(
+                predict_dispatch(self, ell_matvec, vj, ij, jnp.asarray(coef[0]))
+            ) + icpt[0]
         return self._outputs_from_margins(z)
 
     def _transform_arrays(self, X: np.ndarray) -> Dict[str, np.ndarray]:

@@ -384,14 +384,28 @@ class KMeansModel(_KMeansClass, _TpuModelWithPredictionCol, _KMeansParams):
 
     def predict(self, value: np.ndarray) -> int:
         """Single-vector prediction (Spark API)."""
+        from ..observability.inference import predict_dispatch
+
         X = np.asarray(value, dtype=np.float32).reshape(1, -1)
-        return int(np.asarray(kmeans_predict(X, self.cluster_centers_, self._cosine))[0])
+        return int(
+            np.asarray(
+                predict_dispatch(
+                    self, kmeans_predict, X, self.cluster_centers_, self._cosine
+                )
+            )[0]
+        )
 
     def _transform_arrays(self, X: np.ndarray) -> Dict[str, np.ndarray]:
+        from ..observability.inference import predict_dispatch
+
         if self._cosine and not np.all(np.linalg.norm(X, axis=1) > 0):
             raise ValueError(
                 "Cosine distance is not defined for zero-length vectors; the input "
                 "contains an all-zero feature row."
             )
-        pred = np.asarray(kmeans_predict(X, self.cluster_centers_, self._cosine))
+        pred = np.asarray(
+            predict_dispatch(
+                self, kmeans_predict, X, self.cluster_centers_, self._cosine
+            )
+        )
         return {self.getOrDefault("predictionCol"): pred.astype(np.int32)}

@@ -184,7 +184,11 @@ class DBSCANModel(_DBSCANClass, _TpuModel, _DBSCANParams):
                 X.nbytes / 2**20,
                 threshold,
             )
-            labels = streaming_dbscan_fit_predict(
+            from ..observability.inference import predict_dispatch
+
+            labels = predict_dispatch(
+                self,
+                streaming_dbscan_fit_predict,
                 X,
                 eps=self.getOrDefault("eps"),
                 min_samples=self.getOrDefault("min_samples"),
@@ -192,11 +196,15 @@ class DBSCANModel(_DBSCANClass, _TpuModel, _DBSCANParams):
                 mesh=get_mesh(self.num_workers),
             )
             return {self.getOrDefault("predictionCol"): labels}
+        from ..observability.inference import predict_dispatch
+
         mesh = get_mesh(self.num_workers)
         Xp, valid, _ = pad_rows(X, mesh.devices.size)
         Xd = shard_array(Xp, mesh)
         vd = shard_array(valid > 0, mesh)
-        labels = dbscan_fit_predict(
+        labels = predict_dispatch(
+            self,
+            dbscan_fit_predict,
             Xd,
             vd,
             eps=self.getOrDefault("eps"),

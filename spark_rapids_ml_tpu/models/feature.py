@@ -218,7 +218,13 @@ class PCAModel(_PCAClass, _TpuModelWithColumns, _PCAParams):
         return self._model_attributes["singular_values"]
 
     def _transform_arrays(self, X: np.ndarray) -> Dict[str, np.ndarray]:
-        out = np.asarray(pca_transform(X, self._model_attributes["components"]))
+        from ..observability.inference import predict_dispatch
+
+        out = np.asarray(
+            predict_dispatch(
+                self, pca_transform, X, self._model_attributes["components"]
+            )
+        )
         return {self.getOrDefault("outputCol"): out}
 
     def cpu(self):

@@ -178,8 +178,11 @@ class NearestNeighborsModel(_NearestNeighborsClass, _TpuModel, _NNParams):
                 items.nbytes / 2**20,
                 threshold,
             )
-            dists, gidx = streaming_exact_knn(
-                Q, np.asarray(items), k, mesh=get_mesh(self.num_workers)
+            from ..observability.inference import predict_dispatch
+
+            dists, gidx = predict_dispatch(
+                self, streaming_exact_knn,
+                Q, np.asarray(items), k, mesh=get_mesh(self.num_workers),
             )
             ids = item_ids[gidx]
             knn_df = pd.DataFrame(
@@ -199,12 +202,22 @@ class NearestNeighborsModel(_NearestNeighborsClass, _TpuModel, _NNParams):
             # around the ring (ops/knn.exact_knn_ring) — nothing global materializes
             from ..ops.knn import exact_knn_ring
 
+            from ..observability.inference import predict_dispatch
+
             Qp, qvalid, _ = pad_rows(Q, mesh.devices.size)
             Qd = shard_array(Qp, mesh)
-            dists, gidx = exact_knn_ring(mesh, Qd, Xd, vd, k)
+            # the query block is not the leading arg here: shape_of pins the
+            # recompile-sentinel signature to the PADDED query shard
+            dists, gidx = predict_dispatch(
+                self, exact_knn_ring, mesh, Qd, Xd, vd, k, shape_of=Qd
+            )
             dists, gidx = dists[: len(Q)], gidx[: len(Q)]
         else:
-            dists, gidx = exact_knn_distributed(mesh, Q, Xd, vd, k)
+            from ..observability.inference import predict_dispatch
+
+            dists, gidx = predict_dispatch(
+                self, exact_knn_distributed, mesh, Q, Xd, vd, k, shape_of=Q
+            )
         ids = item_ids[gidx]  # padded positions never win (inf distance)
 
         knn_df = pd.DataFrame(
@@ -520,11 +533,14 @@ class ApproximateNearestNeighborsModel(_ApproxNNClass, _TpuModel, _NNParams):
                 _normalize_or_raise(jnp.asarray(Q), jnp.ones(len(Q)))
             )
 
+        from ..observability.inference import predict_dispatch
+
         if self._brute_items is not None:
             from ..ops.knn import exact_knn_single
 
             items = self._brute_items
-            d2, idx = exact_knn_single(
+            d2, idx = predict_dispatch(
+                self, exact_knn_single,
                 jnp.asarray(Q), jnp.asarray(items),
                 jnp.ones((items.shape[0],), bool), min(k, items.shape[0]),
             )
@@ -534,7 +550,8 @@ class ApproximateNearestNeighborsModel(_ApproxNNClass, _TpuModel, _NNParams):
             from ..ops.knn import cagra_search
 
             algo_params = self.getOrDefault("algoParams") or {}
-            dists_j, ids_j = cagra_search(
+            dists_j, ids_j = predict_dispatch(
+                self, cagra_search,
                 jnp.asarray(Q),
                 jnp.asarray(self._model_attributes["items"]),
                 jnp.asarray(self._model_attributes["graph"]),
@@ -557,7 +574,8 @@ class ApproximateNearestNeighborsModel(_ApproxNNClass, _TpuModel, _NNParams):
                 from ..ops.knn import pq_refine
 
                 refine_ratio = int(algo_params.get("refine_ratio", 2))
-                dists_j, ids_j, flat_pos = ivfpq_search(
+                dists_j, ids_j, flat_pos = predict_dispatch(
+                    self, ivfpq_search,
                     jnp.asarray(Q),
                     jnp.asarray(self._model_attributes["centers"]),
                     jnp.asarray(self._model_attributes["codebooks"]),
@@ -610,12 +628,14 @@ class ApproximateNearestNeighborsModel(_ApproxNNClass, _TpuModel, _NNParams):
                         "searching with host-resident cells",
                         cells_np.nbytes / 2**20,
                     )
-                    dists_j, ids_j = streaming_ivfflat_search(
+                    dists_j, ids_j = predict_dispatch(
+                        self, streaming_ivfflat_search,
                         np.asarray(Q), self._model_attributes, k=k,
                         nprobe=min(nprobe, nlist),
                     )
                 else:
-                    dists_j, ids_j = ivfflat_search(
+                    dists_j, ids_j = predict_dispatch(
+                        self, ivfflat_search,
                         jnp.asarray(Q),
                         jnp.asarray(self._model_attributes["centers"]),
                         jnp.asarray(cells_np),

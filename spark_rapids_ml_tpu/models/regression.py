@@ -443,8 +443,16 @@ class LinearRegressionModel(
         return sk
 
     def predict(self, value: np.ndarray) -> float:
+        from ..observability.inference import predict_dispatch
+
         X = np.asarray(value, dtype=np.float32).reshape(1, -1)
-        return float(np.asarray(linreg_predict(X, self.coefficients, self.intercept))[0])
+        return float(
+            np.asarray(
+                predict_dispatch(
+                    self, linreg_predict, X, self.coefficients, self.intercept
+                )
+            )[0]
+        )
 
     def _combine(self, models: List["LinearRegressionModel"]) -> "LinearRegressionModel":
         """Stack models fitted by fitMultiple for CV transform-evaluate
@@ -454,7 +462,14 @@ class LinearRegressionModel(
         return first
 
     def _transform_arrays(self, X: np.ndarray) -> Dict[str, np.ndarray]:
-        pred = np.asarray(linreg_predict(X, self.coefficients, np.float32(self.intercept)))
+        from ..observability.inference import predict_dispatch
+
+        pred = np.asarray(
+            predict_dispatch(
+                self, linreg_predict, X, self.coefficients,
+                np.float32(self.intercept),
+            )
+        )
         return {self.getOrDefault("predictionCol"): pred}
 
     def _supports_sparse_transform(self) -> bool:
@@ -464,12 +479,15 @@ class LinearRegressionModel(
         """Predict on CSR queries without densifying (ELL gather matvec)."""
         import jax.numpy as jnp
 
+        from ..observability.inference import predict_dispatch
         from ..ops.sparse import csr_to_ell, ell_matvec
 
         values, indices = csr_to_ell(csr, float32=True)
         pred = (
             np.asarray(
-                ell_matvec(
+                predict_dispatch(
+                    self,
+                    ell_matvec,
                     jnp.asarray(values),
                     jnp.asarray(indices),
                     jnp.asarray(np.asarray(self.coefficients, np.float32)),

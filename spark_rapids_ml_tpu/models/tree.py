@@ -665,9 +665,13 @@ class _RandomForestModel(_RandomForestClass, _TpuModelWithPredictionCol, _Random
         return [_DecisionTreeView(self, i) for i in range(self.getNumTrees())]
 
     def _forest_outputs(self, X: np.ndarray) -> np.ndarray:
+        from ..observability.inference import predict_dispatch
+
         a = self._model_attributes
         return np.asarray(
-            predict_forest(
+            predict_dispatch(
+                self,
+                predict_forest,
                 X.astype(np.float32),
                 a["feature"],
                 a["threshold"],

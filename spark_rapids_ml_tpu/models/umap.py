@@ -385,11 +385,15 @@ class UMAPModel(_UMAPClass, _TpuModelWithColumns, _UMAPParams):
         return self._model_attributes["raw_data"]
 
     def _transform_arrays(self, X: np.ndarray) -> Dict[str, np.ndarray]:
+        from ..observability.inference import predict_dispatch
+
         attrs = self._model_attributes
         # cuML/umap-learn transform refines new points for fit_epochs // 3 SGD
         # epochs against the frozen reference embedding
         fit_epochs = int(attrs.get("n_epochs", 200))
-        out = umap_transform(
+        out = predict_dispatch(
+            self,
+            umap_transform,
             X,
             attrs["raw_data"],
             attrs["embedding"],

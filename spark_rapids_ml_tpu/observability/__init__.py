@@ -1,12 +1,14 @@
 #
-# Observability subsystem: typed metrics registry, per-fit trace trees, driver-
-# side aggregation across the barrier fit plane, and exporters
-# (docs/design.md §6d). `profiling.py` is a thin compat shim over this package;
-# new instrumentation should import from here directly.
+# Observability subsystem: typed metrics registry, per-fit trace trees, the
+# inference-plane mirror (TransformRun + predict dispatch + recompile
+# sentinel), driver-side aggregation across the barrier fit plane, and
+# exporters (docs/design.md §6d/§6e). `profiling.py` is a thin compat shim over
+# this package; new instrumentation should import from here directly.
 #
-#   registry.py  Counter / Gauge / Histogram / MetricsRegistry (+ merge)
-#   runs.py      write fan-out, structured spans, events, FitRun, worker_scope
-#   export.py    JSONL run reports + Prometheus textfile
+#   registry.py   Counter / Gauge / Histogram (+ quantile) / MetricsRegistry
+#   runs.py       write fan-out, structured spans, events, FitRun, worker_scope
+#   inference.py  TransformRun, predict_dispatch, shape buckets + sentinel
+#   export.py     JSONL run/transform reports (rotating) + Prometheus textfile
 #
 
 from .registry import (
@@ -15,6 +17,7 @@ from .registry import (
     Gauge,
     Histogram,
     MetricsRegistry,
+    interpolate_quantile,
     label_key,
     split_label_key,
 )
@@ -26,6 +29,7 @@ from .runs import (
     counter_inc,
     current_run,
     event,
+    find_run,
     fit_run,
     gauge_dec,
     gauge_inc,
@@ -36,8 +40,19 @@ from .runs import (
     span,
     worker_scope,
 )
+from .inference import (
+    TransformRun,
+    deliver_partition_snapshot,
+    predict_dispatch,
+    reset_shape_buckets,
+    suppress_transform_runs,
+    transform_batch,
+    transform_run,
+)
 from .export import (
     load_run_reports,
+    load_transform_partials,
+    load_transform_reports,
     render_prometheus,
     write_prometheus_textfile,
     write_run_report,
@@ -49,6 +64,7 @@ __all__ = [
     "Gauge",
     "Histogram",
     "MetricsRegistry",
+    "interpolate_quantile",
     "label_key",
     "split_label_key",
     "PROCESS_TOKEN",
@@ -58,6 +74,7 @@ __all__ = [
     "counter_inc",
     "current_run",
     "event",
+    "find_run",
     "fit_run",
     "gauge_dec",
     "gauge_inc",
@@ -67,7 +84,16 @@ __all__ = [
     "observe",
     "span",
     "worker_scope",
+    "TransformRun",
+    "deliver_partition_snapshot",
+    "predict_dispatch",
+    "reset_shape_buckets",
+    "suppress_transform_runs",
+    "transform_batch",
+    "transform_run",
     "load_run_reports",
+    "load_transform_partials",
+    "load_transform_reports",
     "render_prometheus",
     "write_prometheus_textfile",
     "write_run_report",

@@ -1,12 +1,16 @@
 #
 # Exporters: JSONL run reports + Prometheus textfile — the egress half of the
-# observability subsystem (docs/design.md §6d).
+# observability subsystem (docs/design.md §6d/§6e).
 #
-#   * JSONL: one line per finished FitRun, appended to
-#     `<metrics_dir>/fit_reports.jsonl` (config `observability.metrics_dir` /
-#     env SRML_TPU_METRICS_DIR). Reports are plain JSON and round-trip through
-#     `load_run_reports` — CI's observability smoke tier asserts on the file
-#     (ci/test.sh) instead of on process-global counters.
+#   * JSONL: one line per finished run, appended to
+#     `<metrics_dir>/fit_reports.jsonl` (FitRun) or
+#     `<metrics_dir>/transform_reports.jsonl` (TransformRun) — config
+#     `observability.metrics_dir` / env SRML_TPU_METRICS_DIR. Reports are plain
+#     JSON and round-trip through `load_run_reports` — CI's observability smoke
+#     tiers assert on the files (ci/test.sh) instead of on process-global
+#     counters. Files rotate by size (`observability.max_report_bytes`,
+#     `observability.max_report_files`) via atomic renames: a serving process
+#     transforming forever must not grow one JSONL without bound.
 #   * Prometheus: the standard node_exporter textfile-collector handshake —
 #     render a registry snapshot in text exposition format and atomically
 #     replace `<path>`; a scraper picks it up on its next pass. No server, no
@@ -21,23 +25,86 @@ import re
 import tempfile
 from typing import Any, Dict, Iterator, List, Mapping, Optional
 
+from .. import config as _config
 from .registry import MetricsRegistry, split_label_key
 
 RUN_REPORT_FILENAME = "fit_reports.jsonl"
+TRANSFORM_REPORT_FILENAME = "transform_reports.jsonl"
+TRANSFORM_PARTIALS_FILENAME = "transform_partials.jsonl"
 
 _NAME_OK = re.compile(r"[^a-zA-Z0-9_:]")
 _PROM_PREFIX = "srml_tpu_"
 
 
-def write_run_report(report: Mapping[str, Any], metrics_dir: str) -> str:
+def _rotate_if_needed(path: str) -> None:
+    """Size-based JSONL rotation: when the live file reaches
+    `observability.max_report_bytes`, shift `path.i` -> `path.(i+1)` (dropping
+    the one past `observability.max_report_files`) and `path` -> `path.1`.
+    Every step is an atomic rename, so a concurrent `load_run_reports` sees
+    whole files; suffix .1 is the newest rotated file, .N the oldest."""
+    try:
+        size = os.path.getsize(path)
+    except OSError:
+        return  # no live file yet
+    max_bytes = int(_config.get("observability.max_report_bytes") or 0)
+    if max_bytes <= 0 or size < max_bytes:
+        return
+    max_files = max(1, int(_config.get("observability.max_report_files")))
+    oldest = f"{path}.{max_files}"
+    try:
+        os.unlink(oldest)
+    except OSError:
+        pass
+    for i in range(max_files - 1, 0, -1):
+        src = f"{path}.{i}"
+        if os.path.exists(src):
+            os.replace(src, f"{path}.{i + 1}")
+    os.replace(path, f"{path}.1")
+
+
+def _rotated_paths(path: str) -> List[str]:
+    """All report files for `path`, OLDEST FIRST (…, .2, .1, live) — the order
+    that keeps loaded reports chronological across rotations."""
+    suffixes = []
+    d, base = os.path.split(path)
+    prefix = base + "."
+    try:
+        for name in os.listdir(d or "."):
+            if name.startswith(prefix) and name[len(prefix):].isdigit():
+                suffixes.append(int(name[len(prefix):]))
+    except OSError:
+        pass
+    paths = [f"{path}.{i}" for i in sorted(suffixes, reverse=True)]
+    if os.path.exists(path):
+        paths.append(path)
+    return paths
+
+
+def write_run_report(report: Mapping[str, Any], metrics_dir: str,
+                     filename: Optional[str] = None) -> str:
     """Append one run report as a JSON line; returns the file path. Creates the
-    directory; the append+flush is a single write so concurrent fits from one
-    process interleave whole lines."""
+    directory and rotates by size first; the append+flush is a single write so
+    concurrent runs from one process interleave whole lines."""
     os.makedirs(metrics_dir, exist_ok=True)
-    path = os.path.join(metrics_dir, RUN_REPORT_FILENAME)
+    path = os.path.join(metrics_dir, filename or RUN_REPORT_FILENAME)
+    _rotate_if_needed(path)
     line = json.dumps(report, default=_json_fallback)
     with open(path, "a") as f:
         f.write(line + "\n")
+        f.flush()
+    return path
+
+
+def append_transform_partial(entry: Mapping[str, Any], metrics_dir: str) -> str:
+    """Durable sidecar for transform partition snapshots that could not reach a
+    live driver-side run (real lazy plane: the partition executes after
+    transform_on_spark returned, often in another process). One JSON line per
+    partition, tagged with the run id (observability/inference.py)."""
+    os.makedirs(metrics_dir, exist_ok=True)
+    path = os.path.join(metrics_dir, TRANSFORM_PARTIALS_FILENAME)
+    _rotate_if_needed(path)
+    with open(path, "a") as f:
+        f.write(json.dumps(entry, default=_json_fallback) + "\n")
         f.flush()
     return path
 
@@ -52,21 +119,43 @@ def _json_fallback(obj: Any) -> Any:
     return str(obj)
 
 
-def load_run_reports(path_or_dir: str) -> List[Dict[str, Any]]:
+def load_run_reports(path_or_dir: str,
+                     filename: Optional[str] = None) -> List[Dict[str, Any]]:
     """Parse a fit_reports.jsonl (or the directory holding one) back to report
-    dicts — the round-trip half the acceptance tests assert."""
+    dicts — the round-trip half the acceptance tests assert. Rotated files
+    (`*.jsonl.N`) are read oldest-first before the live file, so report order
+    survives rotation."""
     path = (
-        os.path.join(path_or_dir, RUN_REPORT_FILENAME)
+        os.path.join(path_or_dir, filename or RUN_REPORT_FILENAME)
         if os.path.isdir(path_or_dir)
         else path_or_dir
     )
+    paths = _rotated_paths(path)
+    if not paths:
+        # preserve the pre-rotation contract: a missing file raises
+        paths = [path]
     reports: List[Dict[str, Any]] = []
-    with open(path) as f:
-        for line in f:
-            line = line.strip()
-            if line:
-                reports.append(json.loads(line))
+    for p in paths:
+        with open(p) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    reports.append(json.loads(line))
     return reports
+
+
+def load_transform_reports(path_or_dir: str) -> List[Dict[str, Any]]:
+    """`load_run_reports` for the transform plane's JSONL."""
+    if os.path.isdir(path_or_dir):
+        return load_run_reports(path_or_dir, filename=TRANSFORM_REPORT_FILENAME)
+    return load_run_reports(path_or_dir)
+
+
+def load_transform_partials(path_or_dir: str) -> List[Dict[str, Any]]:
+    """Partition-snapshot sidecar lines (see append_transform_partial)."""
+    if os.path.isdir(path_or_dir):
+        return load_run_reports(path_or_dir, filename=TRANSFORM_PARTIALS_FILENAME)
+    return load_run_reports(path_or_dir)
 
 
 def _prom_name(name: str) -> str:

@@ -172,6 +172,14 @@ class Histogram(_Metric):
                 "buckets": list(st["buckets"]),
             }
 
+    def quantile(self, q: float, **labels: Any) -> float:
+        """Estimated q-quantile with exponential-bucket interpolation (see
+        interpolate_quantile). NaN when no observations exist."""
+        st = self.state(**labels)
+        if st is None:
+            return math.nan
+        return interpolate_quantile(st, q, self.bounds)
+
 
 class MetricsRegistry:
     """Thread-safe collection of typed metrics + legacy span totals.
@@ -324,6 +332,40 @@ class MetricsRegistry:
                     ]
                 else:  # mismatched bucket layouts: keep count/sum, drop shape
                     mine["buckets"][-1] += sum(theirs)
+
+
+def interpolate_quantile(state: Mapping[str, Any], q: float,
+                         bounds: Sequence[float]) -> float:
+    """Quantile estimate with WITHIN-bucket interpolation, matched to the
+    exponential bucket layout: mass inside a bucket is assumed log-uniform, so
+    the estimate is `lo * (hi/lo)**frac` (geometric interpolation — a straight
+    linear blend would systematically overestimate low quantiles when bucket
+    widths double). The first bucket interpolates linearly from 0; the +inf
+    bucket clamps to the largest finite bound (nothing sane to extrapolate to).
+    Exact edge semantics: when q*count lands exactly on a bucket's cumulative
+    boundary the estimate is that bucket's upper bound — the same
+    upper-inclusive convention the buckets themselves use (`v <= le`)."""
+    total = state["count"]
+    if total <= 0:
+        return math.nan
+    q = min(max(float(q), 0.0), 1.0)
+    target = q * total
+    bounds = [float(b) for b in bounds]
+    seen = 0.0
+    for i, c in enumerate(state["buckets"]):
+        if c <= 0:
+            continue
+        if seen + c >= target - 1e-12:
+            frac = 0.0 if c == 0 else min(max((target - seen) / c, 0.0), 1.0)
+            if i >= len(bounds):  # +inf bucket
+                return bounds[-1] if bounds else math.nan
+            hi = bounds[i]
+            lo = bounds[i - 1] if i > 0 else 0.0
+            if lo <= 0.0:
+                return hi * frac
+            return lo * (hi / lo) ** frac
+        seen += c
+    return bounds[-1] if bounds else math.nan
 
 
 def quantile_from_state(state: Mapping[str, Any], q: float,

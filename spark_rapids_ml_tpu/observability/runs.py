@@ -247,13 +247,23 @@ class FitRun:
     the barrier plane. Opened by core/estimator.py::_fit around the whole
     degradation ladder; the finished report attaches to the trained model as
     `model.fit_report_` and (when `observability.metrics_dir` is set) appends
-    to the JSONL run log (observability/export.py)."""
+    to the JSONL run log (observability/export.py).
+
+    The class attributes below are the subclass surface: TransformRun
+    (observability/inference.py) reuses the whole scope/fan-out/aggregation
+    machinery for the inference plane and only swaps identity + export file."""
+
+    kind = "fit"
+    _id_prefix = "fit"
+    _root_suffix = "fit_run"
+    # None -> the exporter's default (fit_reports.jsonl); subclasses override
+    _report_filename: Optional[str] = None
 
     def __init__(self, algo: str, site: str = "driver",
                  max_spans: Optional[int] = None):
         self.algo = algo
         self.site = site
-        self.run_id = f"fit-{next(_run_ids)}-{uuid.uuid4().hex[:8]}"
+        self.run_id = f"{self._id_prefix}-{next(_run_ids)}-{uuid.uuid4().hex[:8]}"
         self.registry = MetricsRegistry()
         self.max_spans = (
             int(_config.get("observability.max_spans"))
@@ -327,7 +337,7 @@ class FitRun:
         # root trace node: named `.fit_run` (not `.fit`) so the legacy
         # span_totals entry for the estimator's own `{Algo}.fit` kernel span
         # is not double-counted by its enclosing run scope
-        self._root = span(f"{self.algo}.fit_run", {"site": self.site})
+        self._root = span(f"{self.algo}.{self._root_suffix}", {"site": self.site})
         with _state_lock:
             _active_runs.append(self)
         self._root.__enter__()
@@ -350,9 +360,11 @@ class FitRun:
                 from .export import write_run_report
 
                 try:
-                    write_run_report(self.report(), metrics_dir)
+                    write_run_report(
+                        self.report(), metrics_dir, filename=self._report_filename
+                    )
                 except OSError as e:
-                    _logger.warning("could not write fit report: %s", e)
+                    _logger.warning("could not write %s report: %s", self.kind, e)
 
     def report(self) -> Dict[str, Any]:
         """The structured fit report (finalized numbers after __exit__; callable
@@ -367,6 +379,7 @@ class FitRun:
             dropped_events = self._dropped_events
         return {
             "schema": 1,
+            "kind": self.kind,
             "run_id": self.run_id,
             "algo": self.algo,
             "site": self.site,
@@ -391,6 +404,17 @@ def current_run() -> Optional[FitRun]:
     """The most recently opened still-active FitRun, if any."""
     with _state_lock:
         return _active_runs[-1] if _active_runs else None
+
+
+def find_run(run_id: str) -> Optional[FitRun]:
+    """A still-active run by id — how a transform partition's metrics sidecar
+    finds its driver-side run when both execute in one process (the eager
+    local-mode plane; observability/inference.py)."""
+    with _state_lock:
+        for run in _active_runs:
+            if run.run_id == run_id:
+                return run
+    return None
 
 
 @contextlib.contextmanager

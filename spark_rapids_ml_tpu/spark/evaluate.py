@@ -22,7 +22,7 @@ from typing import Any, List, Sequence
 import pandas as pd
 
 from ..utils import get_logger
-from .transform import _broadcast_chunked, _worker_model
+from .transform import _broadcast_chunked, _without_reports, _worker_model
 
 
 def _unpersist(bcasts: Any) -> None:
@@ -42,29 +42,44 @@ def _unpersist(bcasts: Any) -> None:
 def evaluate_on_spark(evaluator: Any, spark_df: Any) -> float:
     """Distributed `evaluator.evaluate` over an ALREADY-TRANSFORMED Spark frame
     (prediction columns present): per-partition partials, driver merge. Requires
-    `evaluator.supportsPartialAggregation()`."""
+    `evaluator.supportsPartialAggregation()`.
+
+    Observability (§6e): the driver-side scan runs under an `evaluate.scan`
+    span; each partition records `evaluate.rows`/`evaluate.partitions` counters
+    and an `evaluate.partition` span — the scan is eager (toPandas), so under
+    an open Fit/Transform/CV run in this process they land in its trace live."""
+    from ..observability import counter_inc as _count, span as _span
+
     sc = spark_df.sparkSession.sparkContext
     bcasts = _broadcast_chunked(sc, pickle.dumps(evaluator))
+    ev_name = type(evaluator).__name__
 
     def partial_udf(pdf_iter):
+        from ..observability import counter_inc, span
+
         ev = _worker_model(bcasts)
         acc = None
-        for pdf in pdf_iter:
-            if len(pdf) == 0:
-                continue
-            p = ev._partial(pdf)
-            acc = p if acc is None else acc.merge(p)
+        with span("evaluate.partition", {"evaluator": type(ev).__name__}):
+            counter_inc("evaluate.partitions", 1)
+            for pdf in pdf_iter:
+                if len(pdf) == 0:
+                    continue
+                counter_inc("evaluate.rows", len(pdf))
+                p = ev._partial(pdf)
+                acc = p if acc is None else acc.merge(p)
         if acc is not None:
             yield pd.DataFrame({"partial": [pickle.dumps(acc)]})
 
     try:
-        out = spark_df.mapInPandas(partial_udf, schema="partial binary").toPandas()
+        with _span("evaluate.scan", {"evaluator": ev_name}):
+            out = spark_df.mapInPandas(partial_udf, schema="partial binary").toPandas()
     finally:
         # always release the chunked broadcasts — an executor failure mid-scan
         # must not leak broadcast blocks on the cluster
         _unpersist(bcasts)
     if len(out) == 0:
         raise RuntimeError("Distributed evaluate produced no partials (empty input?).")
+    _count("evaluate.partials", len(out))
     return float(
         evaluator._evaluate_partials(
             [pickle.loads(bytes(b)) for b in out["partial"]]
@@ -82,20 +97,28 @@ def transform_evaluate_on_spark(
     to the collect path instead."""
     logger = get_logger("spark.evaluate")
     sc = spark_df.sparkSession.sparkContext
-    bcasts = _broadcast_chunked(sc, pickle.dumps((list(models), evaluator)))
+    with _without_reports(list(models)):
+        bcasts = _broadcast_chunked(sc, pickle.dumps((list(models), evaluator)))
     n_models = len(models)
 
     def evaluate_udf(pdf_iter):
         from ..core.estimator import model_eval_frames
+        from ..observability import counter_inc, span
 
         ms, ev = _worker_model(bcasts)
         partials = [None] * len(ms)
-        for pdf in pdf_iter:
-            if len(pdf) == 0:
-                continue
-            for i, frame in enumerate(model_eval_frames(ms, pdf, ev)):
-                p = ev._partial(frame)
-                partials[i] = p if partials[i] is None else partials[i].merge(p)
+        with span(
+            "evaluate.partition",
+            {"evaluator": type(ev).__name__, "models": len(ms)},
+        ):
+            counter_inc("evaluate.partitions", 1)
+            for pdf in pdf_iter:
+                if len(pdf) == 0:
+                    continue
+                counter_inc("evaluate.rows", len(pdf))
+                for i, frame in enumerate(model_eval_frames(ms, pdf, ev)):
+                    p = ev._partial(frame)
+                    partials[i] = p if partials[i] is None else partials[i].merge(p)
         # one row per model per partition: the scan's whole output is
         # O(n_partitions * n_models) tiny blobs
         rows = [
@@ -114,16 +137,23 @@ def transform_evaluate_on_spark(
     logger.info(
         "distributed transform+evaluate: %d model(s), partial-merge scan", n_models
     )
+    from ..observability import counter_inc as _count, span as _span
+
     try:
-        out = spark_df.mapInPandas(
-            evaluate_udf, schema="model_index bigint, partial binary"
-        ).toPandas()
+        with _span(
+            "evaluate.scan",
+            {"evaluator": type(evaluator).__name__, "models": n_models},
+        ):
+            out = spark_df.mapInPandas(
+                evaluate_udf, schema="model_index bigint, partial binary"
+            ).toPandas()
     finally:
         _unpersist(bcasts)
     if len(out) == 0:
         raise RuntimeError(
             "Distributed evaluate produced no partials (empty input?)."
         )
+    _count("evaluate.partials", len(out))
     scores: List[float] = []
     for i in range(n_models):
         # every non-empty partition emits a partial for ALL models, so the outer

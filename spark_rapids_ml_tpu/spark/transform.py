@@ -17,6 +17,7 @@
 
 from __future__ import annotations
 
+import contextlib
 from typing import Any, Dict
 
 import numpy as np
@@ -44,6 +45,27 @@ def _broadcast_chunked(sc: Any, payload: bytes) -> list:
         sc.broadcast(payload[i : i + BROADCAST_CHUNK_BYTES])
         for i in range(0, len(payload), BROADCAST_CHUNK_BYTES)
     ]
+
+
+@contextlib.contextmanager
+def _without_reports(models: list):
+    """Strip observability reports off models for the duration of a pickle:
+    `fit_report_`/`transform_report_` are driver-side OUTPUT (trace trees,
+    events, per-worker breakdowns) and would otherwise ride every executor
+    broadcast — pure payload for the workers, who produce their own metrics."""
+    stripped = []
+    for m in models:
+        s = {
+            k: m.__dict__.pop(k)
+            for k in ("fit_report_", "transform_report_")
+            if k in m.__dict__
+        }
+        stripped.append((m, s))
+    try:
+        yield
+    finally:
+        for m, s in stripped:
+            m.__dict__.update(s)
 
 
 def _broadcast_key(b: Any) -> Any:
@@ -124,8 +146,22 @@ def infer_ddl_schema(pdf: pd.DataFrame) -> str:
 def transform_on_spark(model: Any, spark_df: Any) -> Any:
     """Run `model.transform` over a Spark DataFrame as a streaming per-partition
     pandas UDF (reference core.py:1846-1899). The input is never collected to the
-    driver; only ONE row is, to infer the output schema."""
+    driver; only ONE row is, to infer the output schema.
+
+    Inference-plane observability (docs/design.md §6e): the call runs under a
+    driver-side TransformRun; each partition's UDF body opens a worker scope
+    whose snapshot — rows/bytes/batches counters, per-batch latency histograms,
+    predict shape-bucket telemetry — is delivered back as a metrics sidecar.
+    When the partition executes in the driver process while the run is still
+    open (the eager protocol-mock plane, local mode), it folds in through the
+    same process-aware merge as barrier fit workers; otherwise it lands in the
+    executor's global registry live and in the `transform_partials.jsonl`
+    sidecar when a metrics dir is configured."""
     import pickle
+
+    from .. import config as _config
+    from ..observability import PROCESS_TOKEN
+    from ..observability.inference import suppress_transform_runs, transform_run
 
     logger = get_logger("spark.transform")
     sample = spark_df.limit(1).toPandas()
@@ -134,18 +170,68 @@ def transform_on_spark(model: Any, spark_df: Any) -> Any:
             "Cannot transform an empty DataFrame: the output schema is inferred from "
             "a one-row probe and no rows exist."
         )
-    out_sample = model.transform(sample)
+    with suppress_transform_runs():
+        # the one-row probe is plumbing, not serving traffic: no run of its own,
+        # and its rows stay out of the distributed run's totals
+        out_sample = model.transform(sample)
     schema = infer_ddl_schema(out_sample)
 
     sc = spark_df.sparkSession.sparkContext
-    bcasts = _broadcast_chunked(sc, pickle.dumps(model))
+    with _without_reports([model]):
+        bcasts = _broadcast_chunked(sc, pickle.dumps(model))
+    metrics_dir = _config.get("observability.metrics_dir")
 
-    def transform_udf(pdf_iter):
-        m = _worker_model(bcasts)
-        for pdf in pdf_iter:
-            if len(pdf) == 0:
-                continue
-            yield m.transform(pdf)
+    with transform_run(type(model).__name__, site="spark") as run:
+        # the closure must stay picklable for real executors: primitives only,
+        # never the run object itself
+        run_id = run.run_id if run is not None else None
+        driver_token = PROCESS_TOKEN
 
-    logger.info("distributed transform: schema inferred as [%s]", schema)
-    return spark_df.mapInPandas(transform_udf, schema=schema)
+        def transform_udf(pdf_iter):
+            from ..observability import worker_scope
+            from ..observability.inference import (
+                deliver_partition_snapshot,
+                partition_rank,
+                suppress_transform_runs as _suppress,
+            )
+            from ..observability.runs import counter_inc, span as _span
+
+            m = _worker_model(bcasts)
+            mname = type(m).__name__
+            rank = partition_rank()
+            with worker_scope(rank=rank) as wscope, _suppress():
+                # delivery rides a finally: an early generator close (downstream
+                # limit()) or a mid-partition transform error must still ship
+                # the partial scope — the error case is exactly when the
+                # telemetry matters most
+                try:
+                    with _span(
+                        "transform.partition", {"model": mname, "rank": rank}
+                    ):
+                        for pdf in pdf_iter:
+                            if len(pdf) == 0:
+                                continue
+                            counter_inc(
+                                "transform.bytes",
+                                int(
+                                    pdf.memory_usage(
+                                        index=False, deep=False
+                                    ).sum()
+                                ),
+                                model=mname,
+                            )
+                            # rows/batches/latency are counted by the nested
+                            # local transform (core/estimator.py::
+                            # transform_batch) — one definition, no double count
+                            yield m.transform(pdf)
+                finally:
+                    deliver_partition_snapshot(
+                        run_id, driver_token, wscope.snapshot(),
+                        metrics_dir=metrics_dir,
+                    )
+
+        logger.info("distributed transform: schema inferred as [%s]", schema)
+        result = spark_df.mapInPandas(transform_udf, schema=schema)
+    if run is not None:
+        model.transform_report_ = run.report()
+    return result

@@ -53,10 +53,14 @@ class ParamGridBuilder:
         ]
 
 
-def _evaluate_fold(models: List[Any], test: Any, evaluator: Any) -> List[float]:
+def _evaluate_fold(models: List[Any], test: Any, evaluator: Any,
+                   fold: Optional[int] = None) -> List[float]:
     """Evaluate a fold's models in ONE transform scan when they all support the fused
     path (reference one-scan transform+evaluate with model_index, core.py:1572-1693);
-    per-model two-step otherwise."""
+    per-model two-step otherwise. Eval spans carry the fold/candidate labels so a
+    CV parent run's trace attributes time per trial (docs/design.md §6e)."""
+    from .observability import span as _obs_span
+
     fused = (
         models
         and all(
@@ -67,8 +71,15 @@ def _evaluate_fold(models: List[Any], test: Any, evaluator: Any) -> List[float]:
     if fused:
         from .core.estimator import transform_evaluate_multi
 
-        return transform_evaluate_multi(models, test, evaluator)
-    return [evaluator.evaluate(m.transform(test)) for m in models]
+        with _obs_span(
+            "cv.eval_fused", {"fold": fold, "candidates": len(models)}
+        ):
+            return transform_evaluate_multi(models, test, evaluator)
+    scores: List[float] = []
+    for i, m in enumerate(models):
+        with _obs_span("cv.eval_candidate", {"fold": fold, "candidate": i}):
+            scores.append(evaluator.evaluate(m.transform(test)))
+    return scores
 
 
 class _CrossValidatorParams(HasSeed, HasParallelism, HasCollectSubModels):
@@ -179,34 +190,92 @@ class CrossValidator(_CrossValidatorParams):
             raise ValueError(
                 f"Param numFolds={self.getNumFolds()} must be >= 2."
             )
+        import time as _time
+
+        from .observability import fit_run, span as _obs_span
+
         n_models = len(maps)
         metrics = np.zeros((n_models,), dtype=np.float64)
         sub_models: Optional[List[List[Any]]] = (
             [] if self.getOrDefault("collectSubModels") else None
         )
+        trials: List[Dict[str, Any]] = []
 
-        for train, test in self._kFold(dataset):
-            fold_models: List[Any] = [None] * n_models
-            # ONE fit pass per fold when the estimator supports it (fitMultiple)
-            for index, model in est.fitMultiple(train, maps):
-                fold_models[index] = model
-            metrics += np.asarray(_evaluate_fold(fold_models, test, evaluator))
-            if sub_models is not None:
-                sub_models.append(fold_models)
+        # parent run over the whole search: every per-fold fit/eval span — and
+        # the nested per-candidate FitRuns' spans — land in ONE trace, exported
+        # like any fit report (algo=CrossValidator); the structured per-trial
+        # summary attaches to the fitted model as `cv_report_` (§6e)
+        with fit_run(algo=type(self).__name__) as run:
+            for fold, (train, test) in enumerate(self._kFold(dataset)):
+                fold_models: List[Any] = [None] * n_models
+                cand_fit_s: List[Optional[float]] = [None] * n_models
+                with _obs_span("cv.fold", {"fold": fold}):
+                    t0 = _time.perf_counter()
+                    with _obs_span(
+                        "cv.fit", {"fold": fold, "candidates": n_models}
+                    ):
+                        # ONE fit pass per fold when the estimator supports it
+                        # (fitMultiple). Per-candidate wall times come from the
+                        # iterator pulls; in single-pass mode the first pull
+                        # carries the shared data pass (deliberately honest —
+                        # that IS where the time goes).
+                        it = iter(est.fitMultiple(train, maps))
+                        while True:
+                            t_c = _time.perf_counter()
+                            try:
+                                index, model = next(it)
+                            except StopIteration:
+                                break
+                            cand_fit_s[index] = _time.perf_counter() - t_c
+                            fold_models[index] = model
+                    fit_s = _time.perf_counter() - t0
+                    t1 = _time.perf_counter()
+                    scores = _evaluate_fold(fold_models, test, evaluator, fold=fold)
+                    eval_s = _time.perf_counter() - t1
+                metrics += np.asarray(scores)
+                trials.append(
+                    {
+                        "fold": fold,
+                        "fit_s": round(fit_s, 6),
+                        "eval_s": round(eval_s, 6),
+                        "candidate_fit_s": [
+                            round(s, 6) if s is not None else None
+                            for s in cand_fit_s
+                        ],
+                        "scores": [float(s) for s in scores],
+                    }
+                )
+                if sub_models is not None:
+                    sub_models.append(fold_models)
 
-        metrics /= self.getNumFolds()
-        best_index = (
-            int(np.argmax(metrics))
-            if evaluator.isLargerBetter()
-            else int(np.argmin(metrics))
-        )
-        self.logger.info(
-            "CrossValidator metrics=%s best_index=%d", metrics.tolist(), best_index
-        )
-        best_model = est.fit(dataset, maps[best_index])
+            metrics /= self.getNumFolds()
+            best_index = (
+                int(np.argmax(metrics))
+                if evaluator.isLargerBetter()
+                else int(np.argmin(metrics))
+            )
+            self.logger.info(
+                "CrossValidator metrics=%s best_index=%d", metrics.tolist(), best_index
+            )
+            with _obs_span("cv.refit", {"candidate": best_index}):
+                best_model = est.fit(dataset, maps[best_index])
         cv_model = CrossValidatorModel(
             best_model, metrics.tolist(), sub_models=sub_models
         )
+        cv_model.cv_report_ = {
+            "schema": 1,
+            "kind": "cv",
+            "run_id": run.run_id if run is not None else None,
+            "estimator": type(est).__name__,
+            "evaluator": type(evaluator).__name__,
+            "num_folds": self.getNumFolds(),
+            "num_candidates": n_models,
+            "avg_metrics": metrics.tolist(),
+            "best_index": best_index,
+            "trials": trials,
+            # the winning refit's full trace — the "best candidate" drill-down
+            "best_fit_report": getattr(best_model, "fit_report_", None),
+        }
         cv_model._resetUid(self.uid)
         self._copyValues(cv_model)
         return cv_model
