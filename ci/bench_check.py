@@ -39,6 +39,10 @@ DEFAULT_THRESHOLD = 0.25
 # is truncated (unparseable), the bench line's quotes appear escaped (\") and
 # the regex must still sweep the raw text
 _SECS_RE = re.compile(r'\\?"(\w+)_bench_secs\\?"\s*:\s*([0-9]+(?:\.[0-9]+)?)')
+# selection-plane stage times (bench_knn/bench_ann emit `<unit>_select_s`):
+# gated like scenario wall times so a selection regression can't hide inside
+# a unit whose total time moved for other reasons
+_SELECT_RE = re.compile(r'\\?"(\w+)_select_s\\?"\s*:\s*([0-9]+(?:\.[0-9]+)?)')
 _PLATFORM_RE = re.compile(r'\\?"platform\\?"\s*:\s*\\?"(\w+)\\?"')
 
 
@@ -73,6 +77,8 @@ def extract(path: str) -> Dict[str, object]:
     for k, v in secondary.items():
         if k.endswith("_bench_secs") and isinstance(v, (int, float)):
             scenarios[k[: -len("_bench_secs")]] = float(v)
+        elif k.endswith("_select_s") and isinstance(v, (int, float)):
+            scenarios[k[: -len("_s")]] = float(v)
     if isinstance(secondary.get("platform"), str):
         platform = secondary["platform"]
     # fall back to regex over DECODED text: inside the artifact the bench line
@@ -86,6 +92,8 @@ def extract(path: str) -> Dict[str, object]:
             break
         for name, secs in _SECS_RE.findall(text):
             scenarios[name] = float(secs)
+        for name, secs in _SELECT_RE.findall(text):
+            scenarios[f"{name}_select"] = float(secs)
     if platform is None:
         for text in texts:
             m = _PLATFORM_RE.findall(text)

@@ -27,6 +27,13 @@ image ships no third-party linters, so the gate is stdlib-only but real:
     through `observability.inference.predict_dispatch` (uniform metric names,
     shape-bucket/recompile-sentinel telemetry); jitted kernels belong in ops/,
     where the dispatch helper wraps them. `# noqa` on the line exempts.
+  * off-plane top-k: any direct `jax.lax.top_k` / `jax.lax.approx_max_k` (or
+    `lax.top_k`, or `from jax.lax import top_k` spellings) inside
+    spark_rapids_ml_tpu/ops/ outside ops/selection.py. Every search-plane
+    top-k must route through ops/selection.py (select_topk / merge_topk /
+    top_k_max) so the strategy knob, the invalid-sentinel convention, and the
+    selection telemetry can never be bypassed (mirrors the jax.jit-in-models
+    ban). `# noqa` on the line exempts.
 
 Exit code 1 on any finding; CI runs this before the test tiers (ci/test.sh).
 """
@@ -54,6 +61,9 @@ PROFILING_INTERNALS = {"_counters", "_spans"}
 PROFILING_INTERNALS_EXEMPT_PARTS = ("observability", "profiling.py")
 
 _BROAD_EXC_NAMES = {"Exception", "BaseException"}
+
+# top-k primitives whose only legal home under ops/ is ops/selection.py
+_TOPK_PRIMS = {"top_k", "approx_max_k"}
 
 
 def _is_broad_catch(type_node) -> bool:
@@ -189,6 +199,54 @@ def check_file(path: Path) -> list:
                     f"{path}:{node.lineno}: {hit} in models/ — route "
                     "predict calls through observability.inference."
                     "predict_dispatch (jitted kernels belong in ops/)"
+                )
+
+    # ops/ may not call the top-k primitives directly: selection lives in
+    # ops/selection.py (strategy knob + invalid-sentinel + telemetry); every
+    # other kernel routes through select_topk/merge_topk/top_k_max
+    if (
+        "ops" in path.parts
+        and "spark_rapids_ml_tpu" in path.parts
+        and path.name != "selection.py"
+    ):
+        src_lines = src.splitlines()
+        for node in ast.walk(tree):
+            hit = None
+            if (
+                isinstance(node, ast.Attribute)
+                and node.attr in _TOPK_PRIMS
+                and (
+                    # jax.lax.top_k
+                    (
+                        isinstance(node.value, ast.Attribute)
+                        and node.value.attr == "lax"
+                    )
+                    # lax.top_k (from jax import lax)
+                    or (
+                        isinstance(node.value, ast.Name)
+                        and node.value.id == "lax"
+                    )
+                )
+            ):
+                hit = f"direct {node.attr}"
+            elif (
+                isinstance(node, ast.ImportFrom)
+                and node.module == "jax.lax"
+                and any(alias.name in _TOPK_PRIMS for alias in node.names)
+            ):
+                hit = "from jax.lax import top_k/approx_max_k"
+            if hit is None:
+                continue
+            line = (
+                src_lines[node.lineno - 1]
+                if node.lineno - 1 < len(src_lines)
+                else ""
+            )
+            if "noqa" not in line:
+                findings.append(
+                    f"{path}:{node.lineno}: {hit} in ops/ — route top-k "
+                    "through ops/selection.py (select_topk/merge_topk/"
+                    "top_k_max)"
                 )
 
     if not any(part in PROFILING_INTERNALS_EXEMPT_PARTS for part in path.parts):

@@ -129,6 +129,41 @@ fi
 python benchmark/benchmark_runner.py kmeans --num_rows 2000 --num_cols 32 --k 5 --no_cpu
 python benchmark/benchmark_runner.py pca --num_rows 2000 --num_cols 32 --k 3 --no_cpu
 
+# selection-plane smoke (perf tier): the three strategies must agree — tiled
+# bit-for-bit with full, approx (+ parity re-rank) above the recall target
+# with exact distances — and the strategy/span telemetry must actually land
+python - <<'PY'
+import numpy as np, jax.numpy as jnp
+from spark_rapids_ml_tpu import config
+from spark_rapids_ml_tpu.ops.knn import exact_knn_single
+from spark_rapids_ml_tpu.profiling import counter_totals
+
+rng = np.random.default_rng(0)
+X = jnp.asarray(rng.normal(size=(5000, 24)).astype(np.float32))
+Q, ones = X[:64], jnp.ones((5000,), bool)
+res = {}
+# pin the tile BELOW n: the CPU auto-tile (max(8192, n/4)) would degrade
+# exact_tiled to exact_full at this size and make the parity check vacuous
+config.set("knn.select_tile", 512)
+for s in ("exact_full", "exact_tiled", "approx"):
+    config.set("knn.selection", s)
+    try:
+        res[s] = [np.asarray(a) for a in exact_knn_single(Q, X, ones, 10)]
+    finally:
+        config.unset("knn.selection")
+config.unset("knn.select_tile")
+np.testing.assert_array_equal(res["exact_full"][1], res["exact_tiled"][1])
+np.testing.assert_array_equal(res["exact_full"][0], res["exact_tiled"][0])
+ef, ea = res["exact_full"][1], res["approx"][1]
+recall = float((ea[:, :, None] == ef[:, None, :]).any(-1).mean())
+assert recall >= float(config.get("knn.recall_target")), recall
+d2_ref = ((np.asarray(Q)[:, None] - np.asarray(X)[ea]) ** 2).sum(-1)
+np.testing.assert_allclose(res["approx"][0], d2_ref, rtol=1e-5, atol=1e-5)
+tot = counter_totals()
+assert any(k.startswith("knn.select_strategy") for k in tot), tot
+print(f"SELECTION SMOKE OK: tiled==full bitwise; approx recall {recall:.3f}")
+PY
+
 # bench regression gate (ci/bench_check.py): per-scenario wall times of the two
 # newest recorded bench rounds, >25% is a regression. ADVISORY by default —
 # wall times track tunnel health as much as code — export

@@ -54,6 +54,19 @@ _DEFAULTS: Dict[str, Any] = {
     # force XLA, "1" = skip the platform check (tests — runs the kernel's
     # interpreter off-TPU)
     "pallas_xtwx": "auto",
+    # selection plane (ops/selection.py): THE top-k strategy for the whole
+    # search family (exact kNN, IVF-Flat/PQ, CAGRA, streamed ANN, pairwise
+    # sweeps). auto = approx on TPU (native approximate-selection unit +
+    # parity re-rank keeps returned distances exact), exact_tiled elsewhere
+    # (bit-for-bit equal to exact_full; two-stage vectorized select)
+    "knn.selection": "auto",  # auto | exact_full | exact_tiled | approx
+    # per-element expected recall of the approx strategy's winner pool
+    # (jax.lax.approx_max_k recall_target); exact modes ignore it
+    "knn.recall_target": 0.95,
+    # exact_tiled tile width; 0 = platform auto (TPU: 2048 — small fixed tiles
+    # vectorize on the VPU; CPU: max(8192, n/4) — the XLA CPU TopK custom call
+    # is per-call-overhead-bound, so few large tiles win)
+    "knn.select_tile": 0,
     # HBM-resident batch cache (ops/device_cache.py): multi-pass streamed fits
     # retain pass-1 device batches and replay passes 2..N from HBM (the TPU
     # analog of the reference's cross-pass cuDF/UVM residency). The budget
@@ -106,6 +119,9 @@ _ENV_KEYS: Dict[str, str] = {
     "fast_math": "SRML_TPU_FAST_MATH",
     "parity_precision": "SRML_TPU_PARITY_PRECISION",
     "pallas_xtwx": "SRML_TPU_PALLAS_XTWX",
+    "knn.selection": "SRML_TPU_KNN_SELECTION",
+    "knn.recall_target": "SRML_TPU_KNN_RECALL_TARGET",
+    "knn.select_tile": "SRML_TPU_KNN_SELECT_TILE",
     "cache.enabled": "SRML_TPU_CACHE_ENABLED",
     "cache.hbm_budget_bytes": "SRML_TPU_CACHE_BUDGET",
     "reliability.enabled": "SRML_TPU_RELIABILITY_ENABLED",

@@ -109,16 +109,23 @@ class NearestNeighbors(_NearestNeighborsClass, _TpuEstimator, _NNParams):
         return NearestNeighborsModel(**attrs)
 
     def _fit(self, dataset: Any) -> "NearestNeighborsModel":
-        # no compute at fit time (reference knn.py:347-367)
+        # no heavy compute at fit time (reference knn.py:347-367) — but the
+        # item-norm term Σ X² IS computed once here and cached on the model,
+        # so no kneighbors query block ever recomputes it (selection-plane
+        # norm hoist; a refit builds a fresh model, which IS the invalidation)
+        from ..ops.knn import center_norms_sq
+
         dataset = self._ensureIdCol(dataset)
         fd = self._pre_process_data(dataset)
+        items = np.asarray(fd.features)
         model = NearestNeighborsModel(
-            item_features=np.asarray(fd.features),
+            item_features=items,
             item_ids=(
                 fd.row_id
                 if fd.row_id is not None
                 else np.arange(fd.n_rows, dtype=np.int64)
             ),
+            item_norms_sq=center_norms_sq(items),
             item_df=dataset,
         )
         model._num_workers = self._num_workers
@@ -137,8 +144,14 @@ class NearestNeighborsModel(_NearestNeighborsClass, _TpuModel, _NNParams):
         item_features: np.ndarray,
         item_ids: np.ndarray,
         item_df: Any = None,
+        item_norms_sq: "np.ndarray | None" = None,
     ) -> None:
-        super().__init__(item_features=item_features, item_ids=item_ids)
+        attrs = dict(item_features=item_features, item_ids=item_ids)
+        if item_norms_sq is not None:
+            # cached Σ X² — searched-for with .get() so directly-constructed
+            # models (no fit) still work, just without the hoisted norm
+            attrs["item_norms_sq"] = np.asarray(item_norms_sq)
+        super().__init__(**attrs)
         self._item_df = item_df
         self._setDefault(k=5)
 
@@ -197,6 +210,16 @@ class NearestNeighborsModel(_NearestNeighborsClass, _TpuModel, _NNParams):
         Xp, valid, _ = pad_rows(items, mesh.devices.size)
         Xd = shard_array(Xp, mesh)
         vd = shard_array(valid, mesh)
+        # cached item norms (computed once at fit) shard alongside the items —
+        # no query block recomputes Σ X² (padding rows are invalid-masked, so
+        # their zero norm never participates)
+        x2 = self._model_attributes.get("item_norms_sq")
+        if x2 is not None:
+            x2p = np.zeros((Xp.shape[0],), np.float32)
+            x2p[: len(items)] = np.asarray(x2)
+            x2d = shard_array(x2p, mesh)
+        else:
+            x2d = None
         if len(Q) >= _RING_QUERY_THRESHOLD and mesh.devices.size > 1:
             # large query sets shard over the mesh too and the item shards rotate
             # around the ring (ops/knn.exact_knn_ring) — nothing global materializes
@@ -209,14 +232,16 @@ class NearestNeighborsModel(_NearestNeighborsClass, _TpuModel, _NNParams):
             # the query block is not the leading arg here: shape_of pins the
             # recompile-sentinel signature to the PADDED query shard
             dists, gidx = predict_dispatch(
-                self, exact_knn_ring, mesh, Qd, Xd, vd, k, shape_of=Qd
+                self, exact_knn_ring, mesh, Qd, Xd, vd, k,
+                x2_sharded=x2d, shape_of=Qd,
             )
             dists, gidx = dists[: len(Q)], gidx[: len(Q)]
         else:
             from ..observability.inference import predict_dispatch
 
             dists, gidx = predict_dispatch(
-                self, exact_knn_distributed, mesh, Q, Xd, vd, k, shape_of=Q
+                self, exact_knn_distributed, mesh, Q, Xd, vd, k,
+                x2_sharded=x2d, shape_of=Q,
             )
         ids = item_ids[gidx]  # padded positions never win (inf distance)
 
@@ -309,7 +334,7 @@ class ApproximateNearestNeighbors(_ApproxNNClass, _TpuEstimator, _NNParams):
         self._set_params(**kwargs)
 
     def _out_schema(self) -> List[str]:
-        return ["centers", "cells", "cell_ids", "cell_sizes"]
+        return ["centers", "center_norms", "cells", "cell_ids", "cell_sizes"]
 
     def _get_tpu_fit_func(self, extra_params: Optional[List[Dict[str, Any]]] = None):
         algo_params = self.getOrDefault("algoParams") or {}
@@ -460,6 +485,9 @@ class ApproximateNearestNeighbors(_ApproxNNClass, _TpuEstimator, _NNParams):
                     _normalize_or_raise(jnp.asarray(items), jnp.ones(len(items)))
                 )
             model._brute_items = items
+            from ..ops.knn import center_norms_sq
+
+            model._brute_norms = center_norms_sq(items)
         else:
             model = self._fit_internal(dataset, None)[0]
         model._item_row_ids = (
@@ -488,10 +516,14 @@ class ApproximateNearestNeighborsModel(_ApproxNNClass, _TpuModel, _NNParams):
         codes: Optional[np.ndarray] = None,
         items: Optional[np.ndarray] = None,
         graph: Optional[np.ndarray] = None,
+        center_norms: Optional[np.ndarray] = None,
+        item_norms_sq: Optional[np.ndarray] = None,
     ) -> None:
         if graph is not None:
             # CAGRA-class graph index (ops/knn.py cagra_build)
             attrs = dict(items=np.asarray(items), graph=np.asarray(graph))
+            if item_norms_sq is not None:
+                attrs["item_norms_sq"] = np.asarray(item_norms_sq)
         else:
             attrs = dict(
                 centers=np.asarray(centers),
@@ -499,12 +531,17 @@ class ApproximateNearestNeighborsModel(_ApproxNNClass, _TpuModel, _NNParams):
                 cell_ids=np.asarray(cell_ids),
                 cell_sizes=np.asarray(cell_sizes),
             )
+            if center_norms is not None:
+                # cached Σ centers² from the build — probe scans never
+                # recompute it (rebuilt on refit with the index itself)
+                attrs["center_norms"] = np.asarray(center_norms)
         if codebooks is not None:
             attrs["codebooks"] = np.asarray(codebooks)
             attrs["codes"] = np.asarray(codes)
         super().__init__(**attrs)
         self._setDefault(k=5, algorithm="ivfflat", metric="euclidean", algoParams=None)
         self._brute_items: Optional[np.ndarray] = None
+        self._brute_norms: Optional[np.ndarray] = None
         self._item_row_ids: Optional[np.ndarray] = None
         self._item_df: Any = None
         self.logger = get_logger(self.__class__)
@@ -539,10 +576,13 @@ class ApproximateNearestNeighborsModel(_ApproxNNClass, _TpuModel, _NNParams):
             from ..ops.knn import exact_knn_single
 
             items = self._brute_items
+            x2b = self._brute_norms
             d2, idx = predict_dispatch(
                 self, exact_knn_single,
                 jnp.asarray(Q), jnp.asarray(items),
                 jnp.ones((items.shape[0],), bool), min(k, items.shape[0]),
+                x2=jnp.asarray(x2b) if x2b is not None else None,
+                model_name=type(self).__name__,
             )
             dists = np.sqrt(np.asarray(d2))
             pos = np.asarray(idx)
@@ -550,6 +590,7 @@ class ApproximateNearestNeighborsModel(_ApproxNNClass, _TpuModel, _NNParams):
             from ..ops.knn import cagra_search
 
             algo_params = self.getOrDefault("algoParams") or {}
+            x2g = self._model_attributes.get("item_norms_sq")
             dists_j, ids_j = predict_dispatch(
                 self, cagra_search,
                 jnp.asarray(Q),
@@ -561,6 +602,8 @@ class ApproximateNearestNeighborsModel(_ApproxNNClass, _TpuModel, _NNParams):
                 # width>1 batches the neighbor gathers: ~2.5x faster at equal
                 # recall on this kernel (cuVS search_width)
                 search_width=int(algo_params.get("search_width", 4)),
+                x2=jnp.asarray(x2g) if x2g is not None else None,
+                model_name=type(self).__name__,
             )
             dists = np.asarray(dists_j)
             pos = np.asarray(ids_j)
@@ -570,6 +613,8 @@ class ApproximateNearestNeighborsModel(_ApproxNNClass, _TpuModel, _NNParams):
             nprobe = int(
                 _ap(algo_params, "nprobe", "n_probes", default=max(1, nlist // 8))
             )
+            cn = self._model_attributes.get("center_norms")
+            cn_j = jnp.asarray(cn) if cn is not None else None
             if "codebooks" in self._model_attributes:
                 from ..ops.knn import pq_refine
 
@@ -583,6 +628,8 @@ class ApproximateNearestNeighborsModel(_ApproxNNClass, _TpuModel, _NNParams):
                     jnp.asarray(self._model_attributes["cell_ids"]),
                     k=k * max(refine_ratio, 1),
                     nprobe=min(nprobe, nlist),
+                    center_norms=cn_j,
+                    model_name=type(self).__name__,
                 )
                 if refine_ratio > 1:
                     # exact re-rank of the ADC candidates (reference knn.py:1642-1666)
@@ -606,13 +653,16 @@ class ApproximateNearestNeighborsModel(_ApproxNNClass, _TpuModel, _NNParams):
                             np.asarray(flat_pos), np.asarray(ids_j), k=k,
                         )
                     else:
-                        dists_j, ids_j = pq_refine(
-                            jnp.asarray(Q),
-                            jnp.asarray(cells_np),
-                            flat_pos,
-                            ids_j,
-                            k=k,
-                        )
+                        from ..observability import span as _obs_span
+
+                        with _obs_span("knn.rerank", {"k": k}):
+                            dists_j, ids_j = pq_refine(
+                                jnp.asarray(Q),
+                                jnp.asarray(cells_np),
+                                flat_pos,
+                                ids_j,
+                                k=k,
+                            )
             else:
                 from .. import config as _config
 
@@ -642,6 +692,8 @@ class ApproximateNearestNeighborsModel(_ApproxNNClass, _TpuModel, _NNParams):
                         jnp.asarray(self._model_attributes["cell_ids"]),
                         k=k,
                         nprobe=min(nprobe, nlist),
+                        center_norms=cn_j,
+                        model_name=type(self).__name__,
                     )
             dists = np.asarray(dists_j)
             pos = np.asarray(ids_j)

@@ -26,7 +26,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..observability import span as obs_span
 from ..reliability import RetryPolicy, fault_point
+from . import selection as _sel
+from .selection import mask_invalid, merge_topk, select_topk
 
 
 def _normalize_batch_or_raise(Xb: np.ndarray) -> np.ndarray:
@@ -97,8 +100,11 @@ def streaming_ivfflat_build(
         np.asarray(X, dtype=np.float32), assign, nlist,
         normalize=cosine,
     )
+    from .knn import center_norms_sq
+
     out = {
         "centers": centers,
+        "center_norms": center_norms_sq(centers),
         "cells": cells,
         "cell_ids": cell_ids,
         "cell_sizes": cell_sizes,
@@ -199,6 +205,7 @@ def streaming_ivfpq_build(
     codes[pos] = codes_flat[cell_ids[pos]]
     return {
         "centers": coarse,
+        "center_norms": flat["center_norms"],
         "codebooks": codebooks,
         "codes": codes,
         "cell_ids": cell_ids,
@@ -254,33 +261,44 @@ def streaming_cagra_build(
     graph = np.take_along_axis(idx, order, axis=1)[:, :deg].astype(np.int32)
     graph = np.maximum(graph, 0)  # any -1 from an undersized probe -> node 0
     graph = _optimize_graph_reverse_edges(X, graph, deg)
-    return {"items": X, "graph": graph}
+    from .knn import center_norms_sq
+
+    return {"items": X, "graph": graph, "item_norms_sq": center_norms_sq(X)}
 
 
 @functools.partial(jax.jit, static_argnames=("nprobe",))
-def _probe_cells(Q: jax.Array, centers: jax.Array, nprobe: int):
+def _probe_cells(
+    Q: jax.Array, centers: jax.Array, nprobe: int, center_norms=None
+):
     from .knn import _block_sq_dists
 
-    cd2 = _block_sq_dists(Q, centers)
-    _, probe = jax.lax.top_k(-cd2, nprobe)
+    cd2 = _block_sq_dists(Q, centers, center_norms)
+    # coarse probe stays exact: nprobe already bounds recall; an approximate
+    # probe would compound with the candidate-select approximation
+    _, probe = select_topk(cd2, nprobe, strategy="exact_full")
     return probe
 
 
-@functools.partial(jax.jit, static_argnames=("k",))
-def _scan_probed(qb, probed_items, probed_ids, k):
+@functools.partial(
+    jax.jit, static_argnames=("k", "strategy", "tile", "recall_target")
+)
+def _scan_probed(qb, probed_items, probed_ids, k, strategy, tile, recall_target):
     """(bq, nprobe, max_cell, d) probed cells -> per-query top-k. EXACT f32
     difference-form distances, matching ops/knn.py::ivfflat_search's in-core
     cell scan rank-for-rank (the candidate set per query is small, so the exact
     form costs nothing; the expanded bf16 form was observed to reorder
-    near-duplicate candidates vs the in-core path)."""
+    near-duplicate candidates vs the in-core path). The configured selection
+    strategy applies to the candidate width; distances stay exact either way."""
     bq, nprobe, max_cell, d = probed_items.shape
     flat = probed_items.reshape(bq, nprobe * max_cell, d)
     flat_ids = probed_ids.reshape(bq, nprobe * max_cell)
     d2 = jnp.sum((flat - qb[:, None, :]) ** 2, axis=2)
-    d2 = jnp.where(flat_ids >= 0, d2, jnp.inf)
-    neg, pos = jax.lax.top_k(-d2, k)
+    d2 = mask_invalid(d2, flat_ids >= 0)
+    d2_sel, pos = select_topk(
+        d2, k, strategy=strategy, tile=tile, recall_target=recall_target
+    )
     ids = jnp.take_along_axis(flat_ids, pos, axis=1)
-    dists = jnp.sqrt(jnp.maximum(-neg, 0.0))
+    dists = jnp.sqrt(d2_sel)
     return jnp.where(ids >= 0, dists, jnp.inf), ids
 
 
@@ -298,11 +316,18 @@ def streaming_ivfflat_search(
     id -1 where fewer than k found — the SAME width contract as the in-core
     ivfflat_search, so results are byte-identical across the threshold."""
     centers_j = jnp.asarray(index["centers"])
+    center_norms = index.get("center_norms")
+    cn_j = jnp.asarray(center_norms) if center_norms is not None else None
     cells = index["cells"]
     cell_ids = index["cell_ids"]
     nlist, max_cell, d = cells.shape
     nq = Q.shape[0]
     k_eff = min(k, nprobe * max_cell)
+    strategy, tile, rt = _sel.resolve(nprobe * max_cell, k_eff, None)
+    _sel.record_selection(strategy, site="ann_streaming_search")
+    from .knn import _count_x2
+
+    _count_x2(cn_j, "ann_streaming_search", False)
 
     out_d = np.full((nq, k_eff), np.inf, np.float32)
     out_i = np.full((nq, k_eff), -1, np.int64)
@@ -313,11 +338,19 @@ def streaming_ivfflat_search(
         def _search_block(s=s, e=e, bi=bi):
             fault_point("ann_search", batch=bi)
             qb = jnp.asarray(np.ascontiguousarray(Q[s:e], dtype=np.float32))
-            probe = np.asarray(_probe_cells(qb, centers_j, nprobe))  # (bq, nprobe)
+            probe = np.asarray(
+                _probe_cells(qb, centers_j, nprobe, cn_j)
+            )  # (bq, nprobe)
             # the host gather IS the out-of-core page-in
             probed_items = jnp.asarray(cells[probe])
             probed_ids = jnp.asarray(cell_ids[probe])
-            dists, ids = _scan_probed(qb, probed_items, probed_ids, k_eff)
+            # span covers the fused scan+select kernel — named for what it
+            # times (the standalone `knn.select`/`knn.rerank` spans are
+            # reserved for separately-dispatched selection/re-rank programs)
+            with obs_span("ann.scan_select", {"start": s, "rows": e - s}):
+                dists, ids = _scan_probed(
+                    qb, probed_items, probed_ids, k_eff, strategy, tile, rt
+                )
             out_d[s:e] = np.asarray(dists)
             out_i[s:e] = np.asarray(ids)
 
@@ -328,11 +361,11 @@ def streaming_ivfflat_search(
 
 @functools.partial(jax.jit, static_argnames=("k",))
 def _refine_exact_tile(qb, vecs, item_ids, k: int):
+    """Exact re-rank tile (always exact_full — this IS the re-rank stage)."""
     d2 = jnp.sum((vecs - qb[:, None, :]) ** 2, axis=-1)
-    d2 = jnp.where(item_ids >= 0, d2, jnp.inf)
-    neg, pos = jax.lax.top_k(-d2, k)
-    ids = jnp.take_along_axis(item_ids, pos, axis=1)
-    dists = jnp.sqrt(jnp.maximum(-neg, 0.0))
+    d2 = mask_invalid(d2, item_ids >= 0)
+    d2_sel, ids = merge_topk(d2, item_ids, k)
+    dists = jnp.sqrt(d2_sel)
     return jnp.where(ids >= 0, dists, jnp.inf), ids
 
 
@@ -362,12 +395,13 @@ def streaming_pq_refine(
         def _refine_block(s=s, e=e, bi=bi):
             fault_point("ann_search", batch=bi)
             vecs = jnp.asarray(flat[cand_pos[s:e]])  # the host page-in
-            d_b, i_b = _refine_exact_tile(
-                jnp.asarray(np.ascontiguousarray(Q[s:e], np.float32)),
-                vecs,
-                jnp.asarray(cand_ids[s:e]),
-                k_eff,
-            )
+            with obs_span("knn.rerank", {"start": s, "rows": e - s}):
+                d_b, i_b = _refine_exact_tile(
+                    jnp.asarray(np.ascontiguousarray(Q[s:e], np.float32)),
+                    vecs,
+                    jnp.asarray(cand_ids[s:e]),
+                    k_eff,
+                )
             out_d[s:e] = np.asarray(d_b)
             out_i[s:e] = np.asarray(i_b)
 

@@ -29,13 +29,16 @@ from .knn import _block_sq_dists
 def _core_mask(
     X: jax.Array, valid: jax.Array, eps2: float, min_samples: int, block: int = 512
 ) -> jax.Array:
-    """Bool mask of core points (eps-neighbor count incl. self >= min_samples)."""
+    """Bool mask of core points (eps-neighbor count incl. self >= min_samples).
+    The item-norm term is hoisted out of the per-block scan (computed once,
+    not once per lax.map iteration — the selection-plane norm hoist)."""
     n = X.shape[0]
     pad = (-n) % block
     Xp = jnp.pad(X, ((0, pad), (0, 0)))
+    x2 = jnp.sum(X * X, axis=1)
 
     def count_block(qb):
-        d2 = _block_sq_dists(qb, X)
+        d2 = _block_sq_dists(qb, X, x2)
         return jnp.sum((d2 <= eps2) & valid[None, :], axis=1)
 
     counts = jax.lax.map(count_block, Xp.reshape(-1, block, X.shape[1]))
@@ -51,9 +54,10 @@ def _min_core_neighbor_labels(
     pad = (-n) % block
     Xp = jnp.pad(X, ((0, pad), (0, 0)))
     big = jnp.iinfo(jnp.int32).max
+    x2 = jnp.sum(X * X, axis=1)  # hoisted out of the per-block scan
 
     def min_label_block(qb):
-        d2 = _block_sq_dists(qb, X)
+        d2 = _block_sq_dists(qb, X, x2)
         neigh = (d2 <= eps2) & core[None, :]
         return jnp.min(jnp.where(neigh, labels[None, :], big), axis=1)
 

@@ -31,6 +31,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ._precision import FAST, pdot
+from .selection import top_k_max
 
 
 @functools.partial(jax.jit, static_argnames=("fast",))
@@ -131,7 +132,7 @@ def _random_real_rows(
     """Pick n_pick distinct real (w>0) rows via Gumbel-top-k on the mask."""
     g = jax.random.gumbel(key, (X.shape[0],), dtype=X.dtype)
     score = jnp.where(w > 0, g, -jnp.inf)
-    _, idx = jax.lax.top_k(score, n_pick)
+    _, idx = top_k_max(score, n_pick)  # exact: seeded init determinism
     return X[idx]
 
 
@@ -144,7 +145,7 @@ def _sample_by_d2(
     d2 = jnp.min(_sq_dists(X, centers), axis=1)
     logits = jnp.where(w > 0, jnp.log(d2 + 1e-30), -jnp.inf)
     g = jax.random.gumbel(key, logits.shape, dtype=X.dtype)
-    _, idx = jax.lax.top_k(logits + g, n_pick)
+    _, idx = top_k_max(logits + g, n_pick)  # exact: seeded sampling
     return X[idx]
 
 
@@ -166,7 +167,7 @@ def _oversample_rounds(
         key, sub = jax.random.split(key)
         logits = jnp.where(w > 0, jnp.log(d2 + 1e-30), -jnp.inf)
         g = jax.random.gumbel(sub, logits.shape, dtype=X.dtype)
-        _, idx = jax.lax.top_k(logits + g, l)
+        _, idx = top_k_max(logits + g, l)  # exact: seeded sampling
         newc = X[idx]
         buf = jax.lax.dynamic_update_slice(buf, newc, (1 + r * l, 0))
         d2 = jnp.minimum(d2, jnp.min(_sq_dists(X, newc), axis=1))

@@ -18,11 +18,21 @@
 # cell size (static shapes); search probes the nprobe nearest cells with a masked
 # distance scan — the cuVS ivf_flat equivalent re-expressed as dense gathers+matmuls.
 #
+# Selection plane: EVERY top-k below routes through ops/selection.py
+# (exact_full | exact_tiled | approx behind `knn.selection`; merges stay
+# exact). Invalid candidates mask to the large-finite INVALID_D2 sentinel, not
+# inf (inf − inf in a downstream recomputation is a NaN factory); the -1-id /
+# inf-distance OUTPUT contract of the search entry points is restored at the
+# boundary from the id mask. Item norms (x2 = Σ X²) are hoisted out of the
+# per-block scans: computed once per kernel invocation, or passed in
+# precomputed (models cache them on the fitted model / built index).
+#
 
 from __future__ import annotations
 
+import contextlib
 import functools
-from typing import Dict, Tuple
+from typing import Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -32,34 +42,129 @@ from ..utils.jax_compat import pvary, shard_map
 
 from ._precision import FAST
 from ..parallel.mesh import DATA_AXIS
+from . import selection as _sel
+from .selection import INVALID_D2, mask_invalid, merge_topk, select_topk
 
 
-def _block_sq_dists(Q: jax.Array, X: jax.Array) -> jax.Array:
+def _block_sq_dists(
+    Q: jax.Array, X: jax.Array, x2: Optional[jax.Array] = None
+) -> jax.Array:
     """(nq, n) squared euclidean distances (FAST precision: ranking tolerates bf16
-    passes; exact distances are recomputed at parity precision only for the winners)."""
+    passes; exact distances are recomputed at parity precision only for the winners).
+    `x2` is the precomputed item-norm term Σ X² — pass it to keep the norm out
+    of a per-block scan (fit/build time caches it; kernels compute it once)."""
     q2 = jnp.sum(Q * Q, axis=1, keepdims=True)
-    x2 = jnp.sum(X * X, axis=1)
+    if x2 is None:
+        x2 = jnp.sum(X * X, axis=1)
     d2 = q2 - 2.0 * jnp.matmul(Q, X.T, precision=FAST) + x2
     return jnp.maximum(d2, 0.0)
 
 
-@functools.partial(jax.jit, static_argnames=("k", "block"))
-def exact_knn_single(
-    Q: jax.Array, X: jax.Array, valid: jax.Array, k: int, block: int = 1024
+def _span_or_null(name: str, attrs, tracing: bool):
+    """Host-side selection/re-rank spans; no-op inside a trace (a trace-time
+    span would record compile-time, not search time)."""
+    if tracing:
+        return contextlib.nullcontext()
+    from .. import observability as _obs
+
+    return _obs.span(name, attrs)
+
+
+def _count_x2(x2, site: str, tracing: bool) -> None:
+    """Norm-hoist telemetry: did this search recompute the item-norm term or
+    ride a cached one? (tests assert refit invalidation + zero per-block
+    recomputation from these counters)"""
+    if tracing:
+        return
+    from .. import observability as _obs
+
+    _obs.counter_inc(
+        "knn.x2_cached" if x2 is not None else "knn.x2_recompute", 1, site=site
+    )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("k", "block", "strategy", "tile", "recall_target")
+)
+def _exact_knn_scan(
+    Q: jax.Array,
+    X: jax.Array,
+    valid: jax.Array,
+    x2: Optional[jax.Array],
+    k: int,
+    block: int,
+    strategy: str,
+    tile: int,
+    recall_target: float,
 ) -> Tuple[jax.Array, jax.Array]:
-    """Single-shard exact kNN: blocked scan, returns (distances², indices)."""
+    """Blocked exact-kNN scan: FAST-precision distances + the configured
+    selection per query block. x2 is hoisted out of the per-block scan —
+    computed once here when the caller holds no cache."""
     nq = Q.shape[0]
+    if x2 is None:
+        x2 = jnp.sum(X * X, axis=1)
     pad = (-nq) % block
     Qp = jnp.pad(Q, ((0, pad), (0, 0)))
 
     def scan_block(qb):
-        d2 = _block_sq_dists(qb, X)
-        d2 = jnp.where(valid[None, :], d2, jnp.inf)
-        neg, idx = jax.lax.top_k(-d2, k)
-        return -neg, idx
+        d2 = _block_sq_dists(qb, X, x2)
+        d2 = mask_invalid(d2, valid[None, :])
+        return select_topk(
+            d2, k, strategy=strategy, tile=tile, recall_target=recall_target
+        )
 
     d2b, idxb = jax.lax.map(scan_block, Qp.reshape(-1, block, Q.shape[1]))
     return d2b.reshape(-1, k)[:nq], idxb.reshape(-1, k)[:nq]
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def parity_rerank_sq(
+    Q: jax.Array, X: jax.Array, valid: jax.Array, cand_idx: jax.Array, k: int
+) -> Tuple[jax.Array, jax.Array]:
+    """Parity-precision re-rank of a winner pool: gather the candidate
+    vectors, recompute SQUARED distances exactly (full-f32 difference form —
+    no bf16 passes, no expansion cancellation), exact top-k. The approx
+    selection strategy pairs with this so returned distances stay exact while
+    only the id set is approximate (recall >= knn.recall_target)."""
+    vecs = X[cand_idx]  # (nq, kc, d)
+    d2 = jnp.sum((vecs - Q[:, None, :]) ** 2, axis=-1)
+    d2 = mask_invalid(d2, valid[cand_idx])
+    return merge_topk(d2, cand_idx, k)
+
+
+def exact_knn_single(
+    Q: jax.Array,
+    X: jax.Array,
+    valid: jax.Array,
+    k: int,
+    block: int = 1024,
+    *,
+    x2: Optional[jax.Array] = None,
+    strategy: Optional[str] = None,
+    model_name: Optional[str] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Single-shard exact kNN: blocked scan, returns (distances², indices).
+
+    Selection strategy comes from `knn.selection` (resolved HERE, outside the
+    trace, so a config change can never be baked stale into a cached trace).
+    Under `approx`, the scan selects a winner pool with approx_max_k and a
+    parity-precision re-rank restores exact distances — the id set carries the
+    recall target, the values don't."""
+    n = X.shape[0]
+    k = min(int(k), n)
+    strategy, tile, rt = _sel.resolve(n, k, strategy)
+    tracing = _sel.is_tracing(Q, X, valid)
+    if not tracing:
+        _sel.record_selection(strategy, site="exact_knn", model=model_name)
+    _count_x2(x2, "exact_knn", tracing)
+    if strategy == "approx":
+        with _span_or_null("knn.select", {"strategy": strategy, "k": k}, tracing):
+            _, idx = _exact_knn_scan(
+                Q, X, valid, x2, k, block, strategy, tile, rt
+            )
+        with _span_or_null("knn.rerank", {"k": k}, tracing):
+            return parity_rerank_sq(Q, X, valid, idx, k)
+    return _exact_knn_scan(Q, X, valid, x2, k, block, strategy, tile, rt)
 
 
 def exact_knn_distributed(
@@ -68,6 +173,7 @@ def exact_knn_distributed(
     X_sharded: jax.Array,
     valid_sharded: jax.Array,
     k: int,
+    x2_sharded: Optional[jax.Array] = None,
 ) -> Tuple[np.ndarray, np.ndarray]:
     """Distributed exact kNN over the mesh: local shard scans + all_gather top-k merge.
 
@@ -80,36 +186,53 @@ def exact_knn_distributed(
     # a shard can hold fewer than k rows; the all-gathered candidate pool
     # (n_dev * k_local >= min(k_eff, n_total)) still covers the global top-k
     k_local = min(k_eff, shard_rows)
+    # telemetry fires HERE: the per-shard exact_knn_single runs inside the
+    # shard_map trace, where host-side counters are suppressed
+    _sel.record_selection(
+        _sel.resolve(shard_rows, k_local, None)[0], site="exact_knn_distributed"
+    )
+    _count_x2(x2_sharded, "exact_knn_distributed", False)
 
-    merge = _knn_local_then_merge_fn(mesh, shard_rows, k_local, k_eff)
-    d2, gidx = merge(jnp.asarray(Q), X_sharded, valid_sharded)
+    merge = _knn_local_then_merge_fn(
+        mesh, shard_rows, k_local, k_eff, with_x2=x2_sharded is not None
+    )
+    if x2_sharded is not None:
+        d2, gidx = merge(jnp.asarray(Q), X_sharded, valid_sharded, x2_sharded)
+    else:
+        d2, gidx = merge(jnp.asarray(Q), X_sharded, valid_sharded)
     return np.sqrt(np.asarray(d2)), np.asarray(gidx)
 
 
-def _knn_local_then_merge_fn(mesh: Mesh, shard_rows: int, k_local: int, k_eff: int):
+def _knn_local_then_merge_fn(
+    mesh: Mesh, shard_rows: int, k_local: int, k_eff: int, with_x2: bool = False
+):
     """The shard-mapped local-topk + all_gather merge step, exposed so tests can
     lower it and assert the compiled collective structure (one gather batch, no
-    quadratic exchange)."""
+    quadratic exchange). The candidate MERGE stays exact (merge_topk); the
+    per-shard selection inherits the configured strategy via exact_knn_single."""
+    in_specs = (P(), P(DATA_AXIS, None), P(DATA_AXIS))
+    if with_x2:
+        in_specs = in_specs + (P(DATA_AXIS),)
 
     @functools.partial(
         shard_map,
         mesh=mesh,
-        in_specs=(P(), P(DATA_AXIS, None), P(DATA_AXIS)),
+        in_specs=in_specs,
         out_specs=P(),
         check_vma=False,  # post-all_gather results are replicated; size-1 aux axes
         # defeat the static replication checker
     )
-    def _local_then_merge(q, x_local, valid_local):
+    def _local_then_merge(q, x_local, valid_local, *maybe_x2):
         rank = jax.lax.axis_index(DATA_AXIS)
-        d2, idx = exact_knn_single(q, x_local, valid_local, k_local)
+        x2_local = maybe_x2[0] if maybe_x2 else None
+        d2, idx = exact_knn_single(q, x_local, valid_local, k_local, x2=x2_local)
         gidx = idx + rank * shard_rows
         # all-to-all candidate exchange over ICI (the UCX replacement)
         d2_all = jax.lax.all_gather(d2, DATA_AXIS, axis=1)  # (nq, n_dev, k_local)
         gidx_all = jax.lax.all_gather(gidx, DATA_AXIS, axis=1)
         d2_all = d2_all.reshape(d2.shape[0], -1)
         gidx_all = gidx_all.reshape(d2.shape[0], -1)
-        neg, pos = jax.lax.top_k(-d2_all, k_eff)
-        return -neg, jnp.take_along_axis(gidx_all, pos, axis=1)
+        return merge_topk(d2_all, gidx_all, k_eff)
 
     return _local_then_merge
 
@@ -117,6 +240,16 @@ def _knn_local_then_merge_fn(mesh: Mesh, shard_rows: int, k_local: int, k_eff: i
 # ---------------------------------------------------------------------------
 # IVF-Flat / IVF-PQ
 # ---------------------------------------------------------------------------
+
+
+def center_norms_sq(centers) -> np.ndarray:
+    """Σ centers² computed ON DEVICE with the same reduce the probe kernels
+    use, so a cached norm is bitwise the value the kernel would recompute.
+    Cached on built IVF layouts (the norm-hoist satellite: built once per
+    build, invalidated by construction on refit since every build emits a
+    fresh dict)."""
+    c = jnp.asarray(np.asarray(centers, dtype=np.float32))
+    return np.asarray(jnp.sum(c * c, axis=1))
 
 
 def ivfflat_build(
@@ -139,6 +272,7 @@ def ivfflat_build(
     cells, cell_ids, cell_sizes = layout_cells(np.asarray(X), assign, nlist, valid)
     out = {
         "centers": centers,
+        "center_norms": center_norms_sq(centers),
         "cells": cells,
         "cell_ids": cell_ids,
         "cell_sizes": cell_sizes,
@@ -259,6 +393,7 @@ def ivfpq_build(
     codes[pos] = codes_flat[cell_ids[pos]]
     return {
         "centers": coarse,
+        "center_norms": flat["center_norms"],
         "codebooks": codebooks,
         "codes": codes,
         "cell_ids": cell_ids,
@@ -267,22 +402,24 @@ def ivfpq_build(
     }
 
 
-@functools.partial(jax.jit, static_argnames=("k", "nprobe", "block"))
-def ivfpq_search(
+@functools.partial(
+    jax.jit,
+    static_argnames=("k", "nprobe", "block", "strategy", "tile", "recall_target"),
+)
+def _ivfpq_search_impl(
     Q: jax.Array,
-    centers: jax.Array,  # (nlist, d)
-    codebooks: jax.Array,  # (m, n_codes, sub_d)
-    codes: jax.Array,  # (nlist, max_cell, m) uint8
-    cell_ids: jax.Array,  # (nlist, max_cell)
+    centers: jax.Array,
+    codebooks: jax.Array,
+    codes: jax.Array,
+    cell_ids: jax.Array,
+    center_norms: Optional[jax.Array],
     k: int,
     nprobe: int,
-    block: int = 256,
+    block: int,
+    strategy: str,
+    tile: int,
+    recall_target: float,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    """Asymmetric-distance (ADC) probe search: per query, build the (m, n_codes)
-    lookup table of residual-subvector distances to each probed cell's center, then
-    score codes by LUT gathers. The LUT uses the ‖a‖²-2ab+‖b‖² expansion (no
-    (…, n_codes, sub_d) broadcast intermediate) and queries run in blocks to bound
-    HBM. Returns (approx euclidean distances, item ids, flat candidate positions)."""
     nlist, max_cell, m = codes.shape
     n_codes, sub_d = codebooks.shape[1], codebooks.shape[2]
     nq, d = Q.shape
@@ -291,8 +428,8 @@ def ivfpq_search(
 
     def search_block(qb):
         bq = qb.shape[0]
-        cd2 = _block_sq_dists(qb, centers)  # (bq, nlist)
-        _, probe = jax.lax.top_k(-cd2, nprobe)  # (bq, nprobe)
+        cd2 = _block_sq_dists(qb, centers, center_norms)  # (bq, nlist)
+        _, probe = select_topk(cd2, nprobe, strategy="exact_full")  # (bq, nprobe)
 
         qres = qb[:, None, :] - centers[probe]  # (bq, nprobe, d)
         qsub = qres.reshape(bq, nprobe, m, sub_d)
@@ -309,10 +446,13 @@ def ivfpq_search(
 
         probed_ids = cell_ids[probe]
         flat_ids = probed_ids.reshape(bq, -1)
-        flat_d2 = jnp.where(flat_ids >= 0, d2.reshape(bq, -1), jnp.inf)
-        neg, pos = jax.lax.top_k(-flat_d2, k_eff)
+        flat_d2 = mask_invalid(d2.reshape(bq, -1), flat_ids >= 0)
+        d2_sel, pos = select_topk(
+            flat_d2, k_eff, strategy=strategy, tile=tile,
+            recall_target=recall_target,
+        )
         ids = jnp.take_along_axis(flat_ids, pos, axis=1)
-        dists = jnp.sqrt(jnp.maximum(-neg, 0.0))
+        dists = jnp.sqrt(d2_sel)
         probe_of_pos = jnp.take_along_axis(probe, pos // max_cell, axis=1)
         flat_pos = probe_of_pos * max_cell + pos % max_cell
         return jnp.where(ids >= 0, dists, jnp.inf), ids, flat_pos
@@ -327,6 +467,40 @@ def ivfpq_search(
     )
 
 
+def ivfpq_search(
+    Q: jax.Array,
+    centers: jax.Array,  # (nlist, d)
+    codebooks: jax.Array,  # (m, n_codes, sub_d)
+    codes: jax.Array,  # (nlist, max_cell, m) uint8
+    cell_ids: jax.Array,  # (nlist, max_cell)
+    k: int,
+    nprobe: int,
+    block: int = 256,
+    *,
+    center_norms: Optional[jax.Array] = None,
+    strategy: Optional[str] = None,
+    model_name: Optional[str] = None,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Asymmetric-distance (ADC) probe search: per query, build the (m, n_codes)
+    lookup table of residual-subvector distances to each probed cell's center, then
+    score codes by LUT gathers. The LUT uses the ‖a‖²-2ab+‖b‖² expansion (no
+    (…, n_codes, sub_d) broadcast intermediate) and queries run in blocks to bound
+    HBM. The candidate select (width nprobe·max_cell) takes the configured
+    selection strategy; distances are ADC approximations either way, so the
+    exact refine (pq_refine) remains the accuracy stage.
+    Returns (approx euclidean distances, item ids, flat candidate positions)."""
+    max_cell = codes.shape[1]
+    k_eff = min(k, nprobe * max_cell)
+    strategy, tile, rt = _sel.resolve(nprobe * max_cell, k_eff, strategy)
+    if not _sel.is_tracing(Q, centers, codes):
+        _sel.record_selection(strategy, site="ivfpq_search", model=model_name)
+        _count_x2(center_norms, "ivfpq_search", False)
+    return _ivfpq_search_impl(
+        Q, centers, codebooks, codes, cell_ids, center_norms,
+        k, nprobe, block, strategy, tile, rt,
+    )
+
+
 @functools.partial(jax.jit, static_argnames=("k",))
 def pq_refine(
     Q: jax.Array,
@@ -337,20 +511,63 @@ def pq_refine(
 ) -> Tuple[jax.Array, jax.Array]:
     """Exact re-ranking of the ADC candidates (the reference's ivf_pq refine step,
     knn.py:1642-1666): gather the raw vectors of the top candidates, recompute true
-    euclidean distances, take the final top-k."""
+    euclidean distances, take the final top-k (always exact — this IS the
+    re-rank stage)."""
     nq, kc = cand_item_ids.shape
     flat_items = cells.reshape(-1, cells.shape[-1])
     vecs = flat_items[jnp.maximum(cand_ids_flat, 0)]  # (nq, kc, d)
     d2 = jnp.sum((vecs - Q[:, None, :]) ** 2, axis=-1)
-    d2 = jnp.where(cand_item_ids >= 0, d2, jnp.inf)
+    d2 = mask_invalid(d2, cand_item_ids >= 0)
     k_eff = min(k, kc)
-    neg, pos = jax.lax.top_k(-d2, k_eff)
-    ids = jnp.take_along_axis(cand_item_ids, pos, axis=1)
-    dists = jnp.sqrt(jnp.maximum(-neg, 0.0))
+    d2_sel, ids = merge_topk(d2, cand_item_ids, k_eff)
+    dists = jnp.sqrt(d2_sel)
     return jnp.where(ids >= 0, dists, jnp.inf), ids
 
 
-@functools.partial(jax.jit, static_argnames=("k", "nprobe", "block"))
+@functools.partial(
+    jax.jit,
+    static_argnames=("k", "nprobe", "block", "strategy", "tile", "recall_target"),
+)
+def _ivfflat_search_impl(
+    Q: jax.Array,
+    centers: jax.Array,
+    cells: jax.Array,
+    cell_ids: jax.Array,
+    center_norms: Optional[jax.Array],
+    k: int,
+    nprobe: int,
+    block: int,
+    strategy: str,
+    tile: int,
+    recall_target: float,
+) -> Tuple[jax.Array, jax.Array]:
+    nlist, max_cell, d = cells.shape
+    nq = Q.shape[0]
+    k_eff = min(k, nprobe * max_cell)
+
+    def search_block(qb):
+        bq = qb.shape[0]
+        cd2 = _block_sq_dists(qb, centers, center_norms)  # (bq, nlist)
+        _, probe = select_topk(cd2, nprobe, strategy="exact_full")  # (bq, nprobe)
+        probed_items = cells[probe]  # (bq, nprobe, max_cell, d)
+        probed_ids = cell_ids[probe]
+        flat_items = probed_items.reshape(bq, nprobe * max_cell, d)
+        flat_ids = probed_ids.reshape(bq, nprobe * max_cell)
+        d2 = jnp.sum((flat_items - qb[:, None, :]) ** 2, axis=-1)
+        d2 = mask_invalid(d2, flat_ids >= 0)
+        d2_sel, pos = select_topk(
+            d2, k_eff, strategy=strategy, tile=tile, recall_target=recall_target
+        )
+        ids = jnp.take_along_axis(flat_ids, pos, axis=1)
+        dists = jnp.sqrt(d2_sel)
+        return jnp.where(ids >= 0, dists, jnp.inf), ids
+
+    pad = (-nq) % block
+    Qp = jnp.pad(Q, ((0, pad), (0, 0)))
+    db, ib = jax.lax.map(search_block, Qp.reshape(-1, block, d))
+    return db.reshape(-1, k_eff)[:nq], ib.reshape(-1, k_eff)[:nq]
+
+
 def ivfflat_search(
     Q: jax.Array,
     centers: jax.Array,
@@ -359,35 +576,27 @@ def ivfflat_search(
     k: int,
     nprobe: int,
     block: int = 64,
+    *,
+    center_norms: Optional[jax.Array] = None,
+    strategy: Optional[str] = None,
+    model_name: Optional[str] = None,
 ) -> Tuple[jax.Array, jax.Array]:
-    """Probe the nprobe nearest cells per query; masked scan + top-k. Queries run in
-    fixed-size blocks (lax.map) so the probed-cell gather is (block, nprobe,
-    max_cell, d) — without blocking, a skewed cell layout at large nq is an HBM
-    blowup (the pre-fix path materialized the whole (nq, ...) gather at once).
+    """Probe the nprobe nearest cells per query; masked scan + configured
+    selection over the nprobe·max_cell candidate width (the cell scan keeps
+    the exact f32 difference-form distances, so approx here only approximates
+    the id set, never the returned values). Queries run in fixed-size blocks
+    (lax.map) so the probed-cell gather is (block, nprobe, max_cell, d).
     Returns (euclidean distances, item ids), id -1 where fewer than k found."""
-    nlist, max_cell, d = cells.shape
-    nq = Q.shape[0]
+    max_cell = cells.shape[1]
     k_eff = min(k, nprobe * max_cell)
-
-    def search_block(qb):
-        bq = qb.shape[0]
-        cd2 = _block_sq_dists(qb, centers)  # (bq, nlist)
-        _, probe = jax.lax.top_k(-cd2, nprobe)  # (bq, nprobe)
-        probed_items = cells[probe]  # (bq, nprobe, max_cell, d)
-        probed_ids = cell_ids[probe]
-        flat_items = probed_items.reshape(bq, nprobe * max_cell, d)
-        flat_ids = probed_ids.reshape(bq, nprobe * max_cell)
-        d2 = jnp.sum((flat_items - qb[:, None, :]) ** 2, axis=-1)
-        d2 = jnp.where(flat_ids >= 0, d2, jnp.inf)
-        neg, pos = jax.lax.top_k(-d2, k_eff)
-        ids = jnp.take_along_axis(flat_ids, pos, axis=1)
-        dists = jnp.sqrt(jnp.maximum(-neg, 0.0))
-        return jnp.where(ids >= 0, dists, jnp.inf), ids
-
-    pad = (-nq) % block
-    Qp = jnp.pad(Q, ((0, pad), (0, 0)))
-    db, ib = jax.lax.map(search_block, Qp.reshape(-1, block, d))
-    return db.reshape(-1, k_eff)[:nq], ib.reshape(-1, k_eff)[:nq]
+    strategy, tile, rt = _sel.resolve(nprobe * max_cell, k_eff, strategy)
+    if not _sel.is_tracing(Q, centers, cells):
+        _sel.record_selection(strategy, site="ivfflat_search", model=model_name)
+        _count_x2(center_norms, "ivfflat_search", False)
+    return _ivfflat_search_impl(
+        Q, centers, cells, cell_ids, center_norms,
+        k, nprobe, block, strategy, tile, rt,
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -400,8 +609,8 @@ def ivfflat_search(
 # candidate pool per query; each iteration expands the best unvisited node, gathers
 # its fixed-degree adjacency row, scores the neighbors (gather + fused distance), and
 # re-top-ks the pool. Duplicate ids are neutralized by a sort-adjacent-compare pass
-# (they get distance=inf + visited=True so they neither rank nor re-expand). All
-# iterations are a lax.fori_loop over purely dense ops — no dynamic frontier.
+# (they get distance=INVALID_D2 + visited=True so they neither rank nor re-expand).
+# All iterations are a lax.fori_loop over purely dense ops — no dynamic frontier.
 
 
 def cagra_build(
@@ -412,9 +621,10 @@ def cagra_build(
     seed: int = 42,
     exact_threshold: int = 32768,
 ) -> Dict[str, np.ndarray]:
-    """Build the fixed-degree neighbor graph. Returns {"items", "graph"} over the
-    COMPACTED valid rows (padding rows are dropped so graph node ids align 1:1 with
-    the caller's item row positions)."""
+    """Build the fixed-degree neighbor graph. Returns {"items", "graph",
+    "item_norms_sq"} over the COMPACTED valid rows (padding rows are dropped so
+    graph node ids align 1:1 with the caller's item row positions). The cached
+    item norms feed cagra_search so queries never recompute Σ items²."""
     valid = np.asarray(w) > 0
     Xv = np.asarray(X)[valid].astype(np.float32)
     n_real = Xv.shape[0]
@@ -436,6 +646,7 @@ def cagra_build(
             jnp.asarray(index["cell_ids"]),
             k=deg + 1,
             nprobe=max(2, nlist // 8),
+            center_norms=jnp.asarray(index["center_norms"]),
         )
         idx = np.asarray(idx)
 
@@ -447,7 +658,7 @@ def cagra_build(
     graph = np.take_along_axis(idx, order, axis=1)[:, :deg].astype(np.int32)
     graph = np.maximum(graph, 0)  # any -1 from an undersized IVF probe -> node 0
     graph = _optimize_graph_reverse_edges(Xv, graph, deg)
-    return {"items": Xv, "graph": graph}
+    return {"items": Xv, "graph": graph, "item_norms_sq": center_norms_sq(Xv)}
 
 
 def _optimize_graph_reverse_edges(
@@ -486,28 +697,31 @@ def _optimize_graph_reverse_edges(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("k", "itopk", "iterations", "search_width")
+    jax.jit,
+    static_argnames=(
+        "k", "itopk", "iterations", "search_width", "strategy", "tile",
+        "recall_target",
+    ),
 )
-def cagra_search(
+def _cagra_search_impl(
     Q: jax.Array,
-    items: jax.Array,  # (n, d)
-    graph: jax.Array,  # (n, deg) int32
+    items: jax.Array,
+    graph: jax.Array,
+    x2: Optional[jax.Array],
     k: int,
-    itopk: int = 64,
-    iterations: int = 32,
-    search_width: int = 1,
+    itopk: int,
+    iterations: int,
+    search_width: int,
+    strategy: str,
+    tile: int,
+    recall_target: float,
 ) -> Tuple[jax.Array, jax.Array]:
-    """Greedy beam search over the neighbor graph. `search_width` (cuVS param of
-    the same name) expands the W best unvisited pool entries per iteration — the
-    gathers batch W*deg neighbors, so width converts iteration latency into MXU/
-    gather throughput at equal total expansions.
-
-    Returns (euclidean distances, item ids), shapes (nq, min(k, itopk))."""
     n, d = items.shape
     deg = graph.shape[1]
     nq = Q.shape[0]
     itopk_eff = min(itopk, n)
-    x2 = jnp.sum(items * items, axis=1)
+    if x2 is None:
+        x2 = jnp.sum(items * items, axis=1)
 
     def dists_to(ids):  # ids (nq, m) -> squared distances (nq, m)
         vecs = items[ids]  # gather
@@ -525,9 +739,11 @@ def cagra_search(
 
     def body(_, state):
         ids, d2, visited = state
-        # expand the `width` best unvisited pool entries
-        expand_key = jnp.where(visited, jnp.inf, d2)
-        _, best = jax.lax.top_k(-expand_key, width)  # (nq, width)
+        # expand the `width` best unvisited pool entries (exact select: the
+        # pool is the loop-carried state — an approximate pick here compounds
+        # per iteration, which no recall target bounds)
+        expand_key = mask_invalid(d2, ~visited)
+        _, best = select_topk(expand_key, width, strategy="exact_full")
         visited = visited | (
             jnp.sum(jax.nn.one_hot(best, itopk_eff, dtype=jnp.int32), axis=1) > 0
         )
@@ -542,7 +758,7 @@ def cagra_search(
         )
 
         # duplicate suppression: sort by id; any entry equal to its left neighbor is
-        # a duplicate -> inf distance (never ranks) + visited (never re-expands).
+        # a duplicate -> INVALID_D2 (never ranks) + visited (never re-expands).
         # Stable sort keeps the pool's copy (with its visited flag) first.
         order = jnp.argsort(all_ids, axis=1, stable=True)
         sid = jnp.take_along_axis(all_ids, order, axis=1)
@@ -551,19 +767,55 @@ def cagra_search(
         dup = jnp.concatenate(
             [jnp.zeros((nq, 1), bool), sid[:, 1:] == sid[:, :-1]], axis=1
         )
-        sd2 = jnp.where(dup, jnp.inf, sd2)
+        sd2 = jnp.where(dup, INVALID_D2, sd2)
         svis = svis | dup
 
-        neg, pos = jax.lax.top_k(-sd2, itopk_eff)
+        new_d2, pos = select_topk(sd2, itopk_eff, strategy="exact_full")
         new_ids = jnp.take_along_axis(sid, pos, axis=1)
         new_vis = jnp.take_along_axis(svis, pos, axis=1)
-        return new_ids, -neg, new_vis
+        return new_ids, new_d2, new_vis
 
     ids, d2, _ = jax.lax.fori_loop(0, iterations, body, (ids0, d20, visited0))
     k_eff = min(k, itopk_eff)
-    neg, pos = jax.lax.top_k(-d2, k_eff)
+    d2_sel, pos = select_topk(
+        d2, k_eff, strategy=strategy, tile=tile, recall_target=recall_target
+    )
     out_ids = jnp.take_along_axis(ids, pos, axis=1)
-    return jnp.sqrt(jnp.maximum(-neg, 0.0)), out_ids
+    return jnp.sqrt(d2_sel), out_ids
+
+
+def cagra_search(
+    Q: jax.Array,
+    items: jax.Array,  # (n, d)
+    graph: jax.Array,  # (n, deg) int32
+    k: int,
+    itopk: int = 64,
+    iterations: int = 32,
+    search_width: int = 1,
+    *,
+    x2: Optional[jax.Array] = None,
+    strategy: Optional[str] = None,
+    model_name: Optional[str] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Greedy beam search over the neighbor graph. `search_width` (cuVS param of
+    the same name) expands the W best unvisited pool entries per iteration — the
+    gathers batch W*deg neighbors, so width converts iteration latency into MXU/
+    gather throughput at equal total expansions. The in-loop pool maintenance
+    selects exactly (loop-carried state); the configured strategy applies to
+    the final k-of-itopk select. Cached `x2` (built index item norms) keeps
+    Σ items² out of the per-search recompute.
+
+    Returns (euclidean distances, item ids), shapes (nq, min(k, itopk))."""
+    itopk_eff = min(itopk, items.shape[0])
+    k_eff = min(k, itopk_eff)
+    strategy, tile, rt = _sel.resolve(itopk_eff, k_eff, strategy)
+    if not _sel.is_tracing(Q, items, graph):
+        _sel.record_selection(strategy, site="cagra_search", model=model_name)
+        _count_x2(x2, "cagra_search", False)
+    return _cagra_search_impl(
+        Q, items, graph, x2, k, itopk, iterations, search_width,
+        strategy, tile, rt,
+    )
 
 
 def exact_knn_ring(
@@ -572,6 +824,7 @@ def exact_knn_ring(
     X_sharded: jax.Array,  # (n_padded, d) row-sharded items
     valid_sharded: jax.Array,  # (n_padded,) bool
     k: int,
+    x2_sharded: Optional[jax.Array] = None,
 ) -> Tuple[np.ndarray, np.ndarray]:
     """Ring-allreduce exact kNN: BOTH queries and items stay sharded. Each device
     keeps its query block resident and the item shards rotate around the ring via
@@ -581,6 +834,12 @@ def exact_knn_ring(
     for query sets too large to replicate (the structural analog of cuML NN-MG's
     UCX block exchange, reference knn.py:763-774, laid onto the ICI ring).
 
+    The item-norm term rotates WITH the shard (computed once pre-loop when no
+    cache is passed), so no hop recomputes it; per-hop candidate selection
+    takes the configured strategy (with a per-hop parity re-rank under approx
+    — the shard is resident, so exactness costs one small gather), and the
+    running merge stays exact.
+
     Returns host (distances, global item indices) for the real (unpadded) rows."""
     n_total = X_sharded.shape[0]
     n_dev = mesh.devices.size
@@ -589,48 +848,74 @@ def exact_knn_ring(
     # a shard may hold fewer than k rows; per-hop candidates are capped at the
     # shard size and the running pool still converges to the global top-k
     k_hop = min(k_eff, shard_rows)
+    strategy, tile, rt = _sel.resolve(shard_rows, k_hop, None)
+    _sel.record_selection(strategy, site="exact_knn_ring")
+    _count_x2(x2_sharded, "exact_knn_ring", False)
+
+    in_specs = (P(DATA_AXIS, None), P(DATA_AXIS, None), P(DATA_AXIS))
+    if x2_sharded is not None:
+        in_specs = in_specs + (P(DATA_AXIS),)
 
     @functools.partial(
         shard_map,
         mesh=mesh,
-        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS, None), P(DATA_AXIS)),
+        in_specs=in_specs,
         out_specs=(P(DATA_AXIS, None), P(DATA_AXIS, None)),
     )
-    def _ring(q_local, x_local, valid_local):
+    def _ring(q_local, x_local, valid_local, *maybe_x2):
         rank = jax.lax.axis_index(DATA_AXIS)
         nq_local = q_local.shape[0]
         perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
+        # the norm term is computed ONCE (or passed in cached) and rotates
+        # with its shard — no hop recomputes Σ x²
+        x2_local = (
+            maybe_x2[0] if maybe_x2 else jnp.sum(x_local * x_local, axis=1)
+        )
 
         def hop(h, state):
-            x_cur, valid_cur, best_d2, best_idx = state
+            x_cur, valid_cur, x2_cur, best_d2, best_idx = state
             # owner rank of the shard currently held: it started at `rank` and has
             # moved h hops along the ring
             owner = (rank - h) % n_dev
-            d2 = _block_sq_dists(q_local, x_cur)
-            d2 = jnp.where(valid_cur[None, :], d2, jnp.inf)
-            neg, idx = jax.lax.top_k(-d2, k_hop)
+            d2 = _block_sq_dists(q_local, x_cur, x2_cur)
+            d2 = mask_invalid(d2, valid_cur[None, :])
+            hop_d2, idx = select_topk(
+                d2, k_hop, strategy=strategy, tile=tile, recall_target=rt
+            )
+            if strategy == "approx":
+                # the shard is resident: restore exact distances for the
+                # approx winner pool before it enters the running merge
+                hop_d2, idx = parity_rerank_sq(
+                    q_local, x_cur, valid_cur, idx, k_hop
+                )
             gidx = idx + owner * shard_rows
-            # merge the hop's candidates into the running top-k
-            cat_d2 = jnp.concatenate([best_d2, -neg], axis=1)
+            # merge the hop's candidates into the running top-k (always exact)
+            cat_d2 = jnp.concatenate([best_d2, hop_d2], axis=1)
             cat_idx = jnp.concatenate([best_idx, gidx], axis=1)
-            mneg, mpos = jax.lax.top_k(-cat_d2, k_eff)
-            best_d2 = -mneg
-            best_idx = jnp.take_along_axis(cat_idx, mpos, axis=1)
+            best_d2, best_idx = merge_topk(cat_d2, cat_idx, k_eff)
             # rotate the item shard one hop along the ring
             x_next = jax.lax.ppermute(x_cur, DATA_AXIS, perm)
             valid_next = jax.lax.ppermute(valid_cur, DATA_AXIS, perm)
-            return x_next, valid_next, best_d2, best_idx
+            x2_next = jax.lax.ppermute(x2_cur, DATA_AXIS, perm)
+            return x_next, valid_next, x2_next, best_d2, best_idx
 
         # the running top-k derives from axis_index (varying over the mesh axis);
         # mark the literal init values varying too so the loop carry types agree
         init = (
             x_local,
             valid_local,
-            pvary(jnp.full((nq_local, k_eff), jnp.inf, q_local.dtype), (DATA_AXIS,)),
+            x2_local,
+            pvary(
+                jnp.full((nq_local, k_eff), INVALID_D2, q_local.dtype),
+                (DATA_AXIS,),
+            ),
             pvary(jnp.full((nq_local, k_eff), -1, jnp.int32), (DATA_AXIS,)),
         )
-        _, _, best_d2, best_idx = jax.lax.fori_loop(0, n_dev, hop, init)
+        _, _, _, best_d2, best_idx = jax.lax.fori_loop(0, n_dev, hop, init)
         return best_d2, best_idx
 
-    d2, gidx = _ring(Q_sharded, X_sharded, valid_sharded)
+    if x2_sharded is not None:
+        d2, gidx = _ring(Q_sharded, X_sharded, valid_sharded, x2_sharded)
+    else:
+        d2, gidx = _ring(Q_sharded, X_sharded, valid_sharded)
     return np.sqrt(np.maximum(np.asarray(d2), 0.0)), np.asarray(gidx)

@@ -39,12 +39,25 @@ import numpy as np
 from ..utils.jax_compat import shard_map
 from jax.sharding import PartitionSpec as P
 
-from ..observability import span as obs_span
+from ..observability import counter_inc as obs_counter_inc, span as obs_span
 from ..reliability import RetryPolicy, fault_point
+from . import selection as _sel
 from .knn import _block_sq_dists
+from .selection import INVALID_D2, mask_invalid, merge_topk, select_topk
 from .streaming import _prefetch
 
 _I32MAX = np.iinfo(np.int32).max
+
+
+@jax.jit
+def _tile_norms(xb: jax.Array) -> jax.Array:
+    """Σ x² of one item tile — computed ONCE at tile upload (and retained in
+    the HBM batch cache alongside the tile), with the same reduce the distance
+    kernels use, so cached replays are bitwise the in-kernel value. This is
+    the streamed half of the norm hoist: no query-block sweep recomputes it
+    (`knn.x2_tile_computes` counts actual computations; cached tiles add
+    none)."""
+    return jnp.sum(xb * xb, axis=1)
 
 
 def _cached_tile(cache, cache_key, batch_index, build):
@@ -74,7 +87,9 @@ def _shard_blocks(X: np.ndarray, block: int, mesh, extras=None, cache=None,
             def build(s=s, e=e):
                 xb = np.zeros((block,) + X.shape[1:], np.float32)
                 xb[: e - s] = X[s:e]
-                devs = [shard_array(xb, mesh)]
+                xd = shard_array(xb, mesh)
+                obs_counter_inc("knn.x2_tile_computes")
+                devs = [xd, _tile_norms(xd)]  # norm rides the cached tuple
                 for a in extras or ():
                     ab = np.zeros((block,) + a.shape[1:], a.dtype)
                     ab[: e - s] = a[s:e]
@@ -87,9 +102,11 @@ def _shard_blocks(X: np.ndarray, block: int, mesh, extras=None, cache=None,
 
 
 @functools.lru_cache(maxsize=8)
-def _mk_tile_topk_mesh(mesh, block: int, k: int):
-    """Sharded-items tile merge: local top-k per shard, all_gather the candidate
-    pools over ICI, fold into the replicated running top-k — the same
+def _mk_tile_topk_mesh(mesh, block: int, k: int, strategy: str, tile: int,
+                       recall_target: float):
+    """Sharded-items tile merge: local top-k per shard (configured selection
+    strategy), all_gather the candidate pools over ICI, fold into the
+    replicated running top-k (always exact — merge_topk) — the same
     local-then-merge shape as ops/knn.py::_knn_local_then_merge_fn."""
     from ..parallel.mesh import DATA_AXIS
 
@@ -101,23 +118,24 @@ def _mk_tile_topk_mesh(mesh, block: int, k: int):
     @functools.partial(
         shard_map,
         mesh=mesh,
-        in_specs=(P(), P(DATA_AXIS, None), P(), P(), P(), P()),
+        in_specs=(P(), P(DATA_AXIS, None), P(DATA_AXIS), P(), P(), P(), P()),
         out_specs=(P(), P()),
         check_vma=False,
     )
-    def f(qb, xb_local, nv, base, best_d, best_i):
+    def f(qb, xb_local, x2_local, nv, base, best_d, best_i):
         rank = jax.lax.axis_index(DATA_AXIS)
         grow = rank * shard_rows + jnp.arange(shard_rows, dtype=jnp.int32)
-        d2 = _block_sq_dists(qb, xb_local)
-        d2 = jnp.where((grow < nv)[None, :], d2, jnp.inf)
-        neg, pos = jax.lax.top_k(-d2, k_loc)
+        d2 = _block_sq_dists(qb, xb_local, x2_local)
+        d2 = mask_invalid(d2, (grow < nv)[None, :])
+        d2_sel, pos = select_topk(
+            d2, k_loc, strategy=strategy, tile=tile, recall_target=recall_target
+        )
         ids = base + grow[pos]
-        d_all = jax.lax.all_gather(-neg, DATA_AXIS, axis=1)
+        d_all = jax.lax.all_gather(d2_sel, DATA_AXIS, axis=1)
         i_all = jax.lax.all_gather(ids, DATA_AXIS, axis=1)
         cat_d = jnp.concatenate([best_d, d_all.reshape(qb.shape[0], -1)], axis=1)
         cat_i = jnp.concatenate([best_i, i_all.reshape(qb.shape[0], -1)], axis=1)
-        neg2, pos2 = jax.lax.top_k(-cat_d, k)
-        return -neg2, jnp.take_along_axis(cat_i, pos2, axis=1)
+        return merge_topk(cat_d, cat_i, k)
 
     return f
 
@@ -133,14 +151,14 @@ def _mk_tile_count_mesh(mesh, block: int):
     @functools.partial(
         shard_map,
         mesh=mesh,
-        in_specs=(P(), P(DATA_AXIS, None), P(), P()),
+        in_specs=(P(), P(DATA_AXIS, None), P(DATA_AXIS), P(), P()),
         out_specs=P(),
         check_vma=False,
     )
-    def f(qb, xb_local, nv, eps2):
+    def f(qb, xb_local, x2_local, nv, eps2):
         rank = jax.lax.axis_index(DATA_AXIS)
         grow = rank * shard_rows + jnp.arange(shard_rows, dtype=jnp.int32)
-        d2 = _block_sq_dists(qb, xb_local)
+        d2 = _block_sq_dists(qb, xb_local, x2_local)
         cnt = jnp.sum((d2 <= eps2) & (grow < nv)[None, :], axis=1).astype(jnp.int32)
         return jax.lax.psum(cnt, DATA_AXIS)
 
@@ -158,14 +176,17 @@ def _mk_tile_minlabel_mesh(mesh, block: int):
     @functools.partial(
         shard_map,
         mesh=mesh,
-        in_specs=(P(), P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS), P(), P()),
+        in_specs=(
+            P(), P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),
+            P(), P(),
+        ),
         out_specs=P(),
         check_vma=False,
     )
-    def f(qb, xb_local, labels_local, core_local, nv, eps2):
+    def f(qb, xb_local, x2_local, labels_local, core_local, nv, eps2):
         rank = jax.lax.axis_index(DATA_AXIS)
         grow = rank * shard_rows + jnp.arange(shard_rows, dtype=jnp.int32)
-        d2 = _block_sq_dists(qb, xb_local)
+        d2 = _block_sq_dists(qb, xb_local, x2_local)
         neigh = (d2 <= eps2) & core_local[None, :] & (grow < nv)[None, :]
         m = jnp.min(jnp.where(neigh, labels_local[None, :], _I32MAX), axis=1)
         return jax.lax.pmin(m, DATA_AXIS)
@@ -197,7 +218,9 @@ def _device_blocks(X: np.ndarray, block: int, extras=None, cache=None,
             def build(s=s, e=e):
                 xb = np.zeros((block,) + X.shape[1:], np.float32)
                 xb[: e - s] = X[s:e]
-                devs = [jax.device_put(jnp.asarray(xb))]
+                xd = jax.device_put(jnp.asarray(xb))
+                obs_counter_inc("knn.x2_tile_computes")
+                devs = [xd, _tile_norms(xd)]  # norm rides the cached tuple
                 for a in extras or ():
                     ab = np.zeros((block,) + a.shape[1:], a.dtype)
                     ab[: e - s] = a[s:e]
@@ -209,19 +232,26 @@ def _device_blocks(X: np.ndarray, block: int, extras=None, cache=None,
     return _prefetch(gen(), depth=1, site="pairwise")
 
 
-@functools.partial(jax.jit, static_argnames=("k",))
-def _tile_topk_merge(qb, xb, nv_items, base_id, best_d, best_i, k: int):
-    """Merge one (qb, xb) tile into the per-query running top-k."""
-    d2 = _block_sq_dists(qb, xb)
+@functools.partial(
+    jax.jit, static_argnames=("k", "strategy", "tile", "recall_target")
+)
+def _tile_topk_merge(qb, xb, x2b, nv_items, base_id, best_d, best_i, k: int,
+                     strategy: str, tile: int, recall_target: float):
+    """Merge one (qb, xb) tile into the per-query running top-k: configured
+    selection over the tile's candidates (the wide axis — where the strategy
+    wins), then an exact fold into the carried pool (an approximate fold
+    would drop carried candidates, compounding per tile)."""
+    d2 = _block_sq_dists(qb, xb, x2b)
     iv = jnp.arange(xb.shape[0]) < nv_items
-    d2 = jnp.where(iv[None, :], d2, jnp.inf)
-    ids = (base_id + jnp.arange(xb.shape[0], dtype=jnp.int32))[None, :]
-    cat_d = jnp.concatenate([best_d, d2], axis=1)
-    cat_i = jnp.concatenate(
-        [best_i, jnp.broadcast_to(ids, d2.shape)], axis=1
+    d2 = mask_invalid(d2, iv[None, :])
+    cand_d, pos = select_topk(
+        d2, min(k, xb.shape[0]), strategy=strategy, tile=tile,
+        recall_target=recall_target,
     )
-    neg, pos = jax.lax.top_k(-cat_d, k)
-    return -neg, jnp.take_along_axis(cat_i, pos, axis=1)
+    cand_i = base_id + pos
+    cat_d = jnp.concatenate([best_d, cand_d], axis=1)
+    cat_i = jnp.concatenate([best_i, cand_i], axis=1)
+    return merge_topk(cat_d, cat_i, k)
 
 
 def streaming_exact_knn(
@@ -249,6 +279,8 @@ def streaming_exact_knn(
     k_eff = min(k, n)
     nq = Q.shape[0]
     mesh = _mesh_or_none(mesh)
+    strategy, sel_tile, rt = _sel.resolve(min(item_block, n), k_eff, None)
+    _sel.record_selection(strategy, site="pairwise_knn")
     with batch_cache() as cache:
         if mesh is not None:
             item_block = _round_block(item_block, mesh)
@@ -257,10 +289,12 @@ def streaming_exact_knn(
                 if cache is not None
                 else None
             )
-            tile = _mk_tile_topk_mesh(mesh, item_block, k_eff)
+            tile = _mk_tile_topk_mesh(
+                mesh, item_block, k_eff, strategy, sel_tile, rt
+            )
 
-            def merge(qb, xb, nv, s, bd, bi):
-                return tile(qb, xb, jnp.int32(nv), jnp.int32(s), bd, bi)
+            def merge(qb, xb, x2b, nv, s, bd, bi):
+                return tile(qb, xb, x2b, jnp.int32(nv), jnp.int32(s), bd, bi)
 
             def blocks():
                 return _shard_blocks(
@@ -273,8 +307,10 @@ def streaming_exact_knn(
                 else None
             )
 
-            def merge(qb, xb, nv, s, bd, bi):
-                return _tile_topk_merge(qb, xb, nv, s, bd, bi, k_eff)
+            def merge(qb, xb, x2b, nv, s, bd, bi):
+                return _tile_topk_merge(
+                    qb, xb, x2b, nv, s, bd, bi, k_eff, strategy, sel_tile, rt
+                )
 
             def blocks():
                 return _device_blocks(X, item_block, cache=cache, cache_key=ckey)
@@ -289,12 +325,31 @@ def streaming_exact_knn(
                 # running state re-initializes per attempt, so a transient tile
                 # failure replays this query block exactly (deterministic merge)
                 qb = jnp.asarray(np.ascontiguousarray(Q[qs:qe], np.float32))
-                best_d = jnp.full((qe - qs, k_eff), jnp.inf, jnp.float32)
+                best_d = jnp.full((qe - qs, k_eff), INVALID_D2, jnp.float32)
                 best_i = jnp.full((qe - qs, k_eff), -1, jnp.int32)
-                for s, nv, xb in blocks():
-                    best_d, best_i = merge(qb, xb, nv, s, best_d, best_i)
-                out_d[qs:qe] = np.sqrt(np.asarray(best_d))
-                out_i[qs:qe] = np.asarray(best_i).astype(np.int64)
+                for s, nv, xb, x2b in blocks():
+                    best_d, best_i = merge(qb, xb, x2b, nv, s, best_d, best_i)
+                ids = np.asarray(best_i).astype(np.int64)
+                if strategy == "approx":
+                    # the re-rank invariant (design.md §5b) holds out-of-core
+                    # too: the winner pool's FAST expansion distances are
+                    # replaced by exact f32 distances recomputed against the
+                    # HOST items (the pool is (block, k) — the gather is tiny
+                    # next to the sweep), then re-sorted
+                    with obs_span(
+                        "knn.rerank", {"start": qs, "rows": qe - qs}
+                    ):
+                        qh = np.ascontiguousarray(Q[qs:qe], np.float32)
+                        vecs = X[ids].astype(np.float32, copy=False)
+                        d2 = ((qh[:, None, :] - vecs) ** 2).sum(-1)
+                        order = np.argsort(d2, axis=1, kind="stable")
+                        ids = np.take_along_axis(ids, order, axis=1)
+                        out_d[qs:qe] = np.sqrt(
+                            np.take_along_axis(d2, order, axis=1)
+                        )
+                else:
+                    out_d[qs:qe] = np.sqrt(np.asarray(best_d))
+                out_i[qs:qe] = ids
 
             # one trace span per query-block sweep over the item stream: the
             # per-fit report then attributes time to sweeps (with any item-tile
@@ -307,15 +362,15 @@ def streaming_exact_knn(
 
 
 @jax.jit
-def _tile_count(qb, xb, nv_items, eps2):
-    d2 = _block_sq_dists(qb, xb)
+def _tile_count(qb, xb, x2b, nv_items, eps2):
+    d2 = _block_sq_dists(qb, xb, x2b)
     iv = jnp.arange(xb.shape[0]) < nv_items
     return jnp.sum((d2 <= eps2) & iv[None, :], axis=1).astype(jnp.int32)
 
 
 @jax.jit
-def _tile_min_core_label(qb, xb, labels_b, core_b, nv_items, eps2):
-    d2 = _block_sq_dists(qb, xb)
+def _tile_min_core_label(qb, xb, x2b, labels_b, core_b, nv_items, eps2):
+    d2 = _block_sq_dists(qb, xb, x2b)
     iv = jnp.arange(xb.shape[0]) < nv_items
     neigh = (d2 <= eps2) & core_b[None, :] & iv[None, :]
     return jnp.min(jnp.where(neigh, labels_b[None, :], _I32MAX), axis=1)
@@ -345,8 +400,8 @@ def _streamed_min_core_labels(
     if mesh is not None:
         tile_fn = _mk_tile_minlabel_mesh(mesh, item_block)
 
-        def tile(qb, xb, lb, cb, nv):
-            return tile_fn(qb, xb, lb, cb, jnp.int32(nv), jnp.float32(eps2))
+        def tile(qb, xb, x2b, lb, cb, nv):
+            return tile_fn(qb, xb, x2b, lb, cb, jnp.int32(nv), jnp.float32(eps2))
 
         def blocks():
             return _shard_blocks(
@@ -354,8 +409,8 @@ def _streamed_min_core_labels(
                 cache=cache, cache_key=ckey,
             )
     else:
-        def tile(qb, xb, lb, cb, nv):
-            return _tile_min_core_label(qb, xb, lb, cb, nv, eps2)
+        def tile(qb, xb, x2b, lb, cb, nv):
+            return _tile_min_core_label(qb, xb, x2b, lb, cb, nv, eps2)
 
         def blocks():
             return _device_blocks(
@@ -371,8 +426,8 @@ def _streamed_min_core_labels(
         def _minlabel_query_block(qs=qs, qe=qe):
             qb = jnp.asarray(np.ascontiguousarray(X[qs:qe], np.float32))
             acc = jnp.full((qe - qs,), _I32MAX, jnp.int32)
-            for s, nv, xb, lb, cb in blocks():
-                acc = jnp.minimum(acc, tile(qb, xb, lb, cb, nv))
+            for s, nv, xb, x2b, lb, cb in blocks():
+                acc = jnp.minimum(acc, tile(qb, xb, x2b, lb, cb, nv))
             mins[qs:qe] = np.asarray(acc)
 
         policy.run(_minlabel_query_block, site="pairwise")
@@ -440,16 +495,16 @@ def _streaming_dbscan_fit_predict(
     if mesh is not None:
         count_fn = _mk_tile_count_mesh(mesh, item_block)
 
-        def count_tile(qb, xb, nv):
-            return count_fn(qb, xb, jnp.int32(nv), jnp.float32(eps2))
+        def count_tile(qb, xb, x2b, nv):
+            return count_fn(qb, xb, x2b, jnp.int32(nv), jnp.float32(eps2))
 
         def count_blocks():
             return _shard_blocks(
                 X, item_block, mesh, cache=cache, cache_key=count_key
             )
     else:
-        def count_tile(qb, xb, nv):
-            return _tile_count(qb, xb, nv, eps2)
+        def count_tile(qb, xb, x2b, nv):
+            return _tile_count(qb, xb, x2b, nv, eps2)
 
         def count_blocks():
             return _device_blocks(
@@ -465,8 +520,8 @@ def _streaming_dbscan_fit_predict(
         def _core_query_block(qs=qs, qe=qe):
             qb = jnp.asarray(np.ascontiguousarray(X[qs:qe], np.float32))
             acc = jnp.zeros((qe - qs,), jnp.int32)
-            for s, nv, xb in count_blocks():
-                acc = acc + count_tile(qb, xb, nv)
+            for s, nv, xb, x2b in count_blocks():
+                acc = acc + count_tile(qb, xb, x2b, nv)
             core[qs:qe] = np.asarray(acc) >= int(min_samples)
 
         policy.run(_core_query_block, site="pairwise")

@@ -183,7 +183,9 @@ def _level_step(
     if k_features < d:
         key, sub = jax.random.split(key)
         scores = jax.random.uniform(sub, (width, d))
-        kth = jax.lax.top_k(scores, k_features)[0][:, -1]
+        from .selection import top_k_max
+
+        kth = top_k_max(scores, k_features)[0][:, -1]
         valid = valid & (scores >= kth[:, None])[:, :, None]
     gain = jnp.where(valid, gain, -jnp.inf)
 

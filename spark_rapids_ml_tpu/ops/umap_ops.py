@@ -29,6 +29,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .selection import (
+    INVALID_D2 as _INVALID_D2,
+    mask_invalid as _mask_invalid,
+    merge_topk as _merge_topk,
+)
+
 
 def find_ab_params(spread: float = 1.0, min_dist: float = 0.1) -> Tuple[float, float]:
     """Fit the (a, b) of the rational output kernel 1/(1+a d^{2b}) to the desired
@@ -405,16 +411,15 @@ def _minkowski_knn(
             xc, ids_c, valid_c = chunk
             diff = jnp.abs(qb[:, None, :] - xc[None, :, :])  # (qblock, xblock, d)
             dist = jnp.sum(diff if p == 1.0 else diff**p, axis=-1)
-            dist = jnp.where(valid_c[None, :], dist, jnp.inf)
+            dist = _mask_invalid(dist, valid_c[None, :])
             cat_d = jnp.concatenate([best_d, dist], axis=1)
             cat_i = jnp.concatenate(
                 [best_i, jnp.broadcast_to(ids_c[None, :], dist.shape)], axis=1
             )
-            neg, pos = jax.lax.top_k(-cat_d, k)
-            return (-neg, jnp.take_along_axis(cat_i, pos, axis=1)), None
+            return _merge_topk(cat_d, cat_i, k), None
 
         init = (
-            jnp.full((qb.shape[0], k), jnp.inf),
+            jnp.full((qb.shape[0], k), _INVALID_D2),
             jnp.zeros((qb.shape[0], k), jnp.int32),
         )
         (bd, bi), _ = jax.lax.scan(
@@ -499,6 +504,7 @@ def _dense_knn_graph(
         d, ids = ivfflat_search(
             Xj, jnp.asarray(idx["centers"]), jnp.asarray(idx["cells"]),
             jnp.asarray(idx["cell_ids"]), k=k, nprobe=min(nprobe, nlist),
+            center_norms=jnp.asarray(idx["center_norms"]),
         )
         dists = np.asarray(d).astype(np.float32)
         ids_h = np.asarray(ids)

@@ -156,7 +156,13 @@ def test_knn_model_routes_streamed(monkeypatch):
     for a, b in zip(ref["indices"], got["indices"]):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
     for a, b in zip(ref["distances"], got["distances"]):
-        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-5)
+        # the in-core path rides the model-cached item norms while the
+        # streamed path computes per-tile norms (a different XLA program):
+        # ulp-level reassociation in Σx² lands on the expansion-form
+        # cancellation, whose noise floor in d² is ~eps·‖x‖² ≈ 1e-5 — after
+        # sqrt that is ~3e-3 absolute near zero (self-distances), so compare
+        # above that floor; ids above asserted EQUAL, which is the contract
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=5e-3)
 
 
 def test_streaming_knn_mesh_sharded_matches_single(n_devices):

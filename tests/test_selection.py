@@ -1,0 +1,316 @@
+"""Selection-plane tests (ops/selection.py + the search-family rewiring):
+exact_tiled bit-parity with exact_full under ties/padding/masks, approx +
+parity re-rank recall and distance exactness, the large-finite invalid
+sentinel (no NaN from all-invalid shards), and the item-norm cache
+(model/index persistence + zero per-block recomputation, counter-asserted)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import jax.numpy as jnp
+
+from spark_rapids_ml_tpu import config as srml_config
+from spark_rapids_ml_tpu.ops import selection as sel
+from spark_rapids_ml_tpu.ops.knn import exact_knn_single
+from spark_rapids_ml_tpu.profiling import counter_totals
+
+
+def _counters(prefix):
+    return {k: v for k, v in counter_totals().items() if k.startswith(prefix)}
+
+
+def _delta(before, after):
+    return {k: after.get(k, 0) - before.get(k, 0) for k in after if
+            after.get(k, 0) != before.get(k, 0)}
+
+
+# --------------------------------------------------------------- select_topk
+
+
+def test_tiled_equals_full_bitwise_property():
+    """Property loop (hypothesis-style): exact_tiled == exact_full bit-for-bit
+    — values AND indices, so tie order too — under quantized ties, partial and
+    all-invalid masks, k up to n, and tiles that don't divide n."""
+    rng = np.random.default_rng(0)
+    for trial in range(60):
+        n = int(rng.integers(1, 400))
+        nq = int(rng.integers(1, 6))
+        k = int(rng.integers(1, min(n, 24) + 1))
+        tile = int(rng.integers(1, n + 8))
+        # quantized values force heavy ties; occasional inf exercises the clamp
+        d2 = rng.integers(0, 5, (nq, n)).astype(np.float32)
+        if trial % 7 == 0:
+            d2[rng.random((nq, n)) < 0.1] = np.inf
+        mask_p = rng.choice([0.0, 0.3, 1.0])
+        valid = rng.random((n,)) >= mask_p  # 1.0 -> all-invalid
+        d2j = sel.mask_invalid(jnp.asarray(d2), jnp.asarray(valid)[None, :])
+        vf, idxf = sel.select_topk(d2j, k, strategy="exact_full")
+        vt, idxt = sel.select_topk(d2j, k, strategy="exact_tiled", tile=tile)
+        np.testing.assert_array_equal(
+            np.asarray(idxf), np.asarray(idxt),
+            err_msg=f"trial={trial} n={n} k={k} tile={tile} mask_p={mask_p}",
+        )
+        np.testing.assert_array_equal(np.asarray(vf), np.asarray(vt))
+
+
+def test_select_topk_clamps_inf_to_sentinel():
+    """inf inputs never escape: outputs are finite (the large-finite sentinel)
+    and rank after every real candidate."""
+    d2 = jnp.asarray(np.array([[np.inf, 2.0, np.inf, 1.0]], np.float32))
+    v, idx = sel.select_topk(d2, 3, strategy="exact_full")
+    assert np.isfinite(np.asarray(v)).all()
+    np.testing.assert_array_equal(np.asarray(idx)[0], [3, 1, 0])
+    assert np.asarray(v)[0, 2] == sel.INVALID_D2
+
+
+def test_merge_topk_and_top_k_max():
+    pool_d = jnp.asarray(np.array([[3.0, 1.0, 2.0, 1.0]], np.float32))
+    pool_i = jnp.asarray(np.array([[7, 9, 5, 4]], np.int32))
+    d, i = sel.merge_topk(pool_d, pool_i, 2)
+    np.testing.assert_array_equal(np.asarray(i)[0], [9, 4])  # tie: lower pos
+    scores = jnp.asarray(np.array([[0.1, 0.9, 0.5]], np.float32))
+    v, i = sel.top_k_max(scores, 2)
+    np.testing.assert_array_equal(np.asarray(i)[0], [1, 2])
+    np.testing.assert_allclose(np.asarray(v)[0], [0.9, 0.5])
+
+
+def test_resolve_degrades_small_widths_and_validates():
+    # a single-tile width must fall back to the fused exact path
+    assert sel.resolve(100, 10, "exact_tiled", tile=128)[0] == "exact_full"
+    assert sel.resolve(100_000, 10, "exact_tiled", tile=2048)[0] == "exact_tiled"
+    assert sel.resolve(100_000, 10, "approx")[0] == "approx"
+    # approx must NOT degrade on the tile width (the platform auto-tile can
+    # exceed the data; an approx request within 4x of k is still honored) —
+    # otherwise the approx+re-rank path is silently untestable off-TPU
+    assert sel.resolve(500, 6, "approx")[0] == "approx"
+    assert sel.resolve(30, 10, "approx")[0] == "exact_full"  # n <= 4k
+    with pytest.raises(ValueError, match="knn.selection"):
+        sel.resolve(100, 10, "nope")
+    srml_config.set("knn.recall_target", 1.5)
+    try:
+        with pytest.raises(ValueError, match="recall_target"):
+            sel.resolve(100_000, 10, "approx")
+    finally:
+        srml_config.unset("knn.recall_target")
+
+
+# ------------------------------------------------------- approx + parity rerank
+
+
+def test_approx_rerank_meets_recall_target_with_exact_distances():
+    """approx + parity re-rank on a seeded corpus: id recall >= the config
+    target AND returned distances are the exact f32 distances of the returned
+    ids (the re-rank invariant — values are never approximate)."""
+    rng = np.random.default_rng(7)
+    X = rng.normal(size=(6000, 16)).astype(np.float32)
+    Q = X[:80]
+    Xj, Qj = jnp.asarray(X), jnp.asarray(Q)
+    ones = jnp.ones((len(X),), bool)
+    _, exact_ids = exact_knn_single(Qj, Xj, ones, 10, strategy="exact_full")
+    d2a, ids_a = exact_knn_single(Qj, Xj, ones, 10, strategy="approx")
+    exact_ids, ids_a, d2a = map(np.asarray, (exact_ids, ids_a, d2a))
+    recall = (ids_a[:, :, None] == exact_ids[:, None, :]).any(-1).mean()
+    assert recall >= float(srml_config.get("knn.recall_target")), recall
+    d2_ref = ((Q[:, None] - X[ids_a]) ** 2).sum(-1)
+    np.testing.assert_allclose(d2a, d2_ref, rtol=1e-5, atol=1e-5)
+    # distances ascend (re-rank re-sorts the winner pool)
+    assert (np.diff(d2a, axis=1) >= 0).all()
+
+
+def test_streamed_knn_approx_reranks_to_exact_distances():
+    """The re-rank invariant holds OUT-OF-CORE too: streaming_exact_knn under
+    `approx` returns exact f32 distances for its (recall-bounded) id set,
+    sorted ascending — not the FAST tile-expansion values."""
+    from spark_rapids_ml_tpu.ops.pairwise_streaming import streaming_exact_knn
+
+    rng = np.random.default_rng(12)
+    X = rng.normal(size=(3000, 12)).astype(np.float32)
+    Q = X[:64]
+    srml_config.set("knn.selection", "approx")
+    try:
+        d_a, i_a = streaming_exact_knn(Q, X, 8, query_block=32, item_block=1024)
+    finally:
+        srml_config.unset("knn.selection")
+    d_ref, i_ref = exact_knn_single(
+        jnp.asarray(Q), jnp.asarray(X), jnp.ones((3000,), bool), 8,
+        strategy="exact_full",
+    )
+    i_ref = np.asarray(i_ref)
+    recall = (i_a[:, :, None] == i_ref[:, None, :]).any(-1).mean()
+    assert recall >= float(srml_config.get("knn.recall_target")), recall
+    d_exact = np.sqrt(((Q[:, None] - X[i_a]) ** 2).sum(-1))
+    np.testing.assert_allclose(d_a, d_exact, rtol=1e-5, atol=1e-5)
+    assert (np.diff(d_a, axis=1) >= -1e-7).all()
+
+
+def test_strategy_counter_labels():
+    before = _counters("knn.select_strategy")
+    rng = np.random.default_rng(1)
+    X = jnp.asarray(rng.normal(size=(256, 8)).astype(np.float32))
+    ones = jnp.ones((256,), bool)
+    for s in ("exact_full", "exact_tiled"):
+        exact_knn_single(X[:4], X, ones, 3, strategy=s)
+    delta = _delta(before, _counters("knn.select_strategy"))
+    # width 256 degrades tiled -> exact_full: both calls land on exact_full
+    key = "knn.select_strategy{site=exact_knn,strategy=exact_full}"
+    assert delta.get(key, 0) >= 2, delta
+
+
+# ------------------------------------------------------------ invalid sentinel
+
+
+def test_all_invalid_shards_no_nan(n_devices):
+    """Regression (the inf->sentinel satellite): item counts far below the
+    mesh width leave entire shards invalid; the merge paths must stay
+    NaN-free and return only real ids — under BOTH merge architectures."""
+    from sklearn.neighbors import NearestNeighbors as SkNN
+
+    from spark_rapids_ml_tpu.ops.knn import exact_knn_distributed, exact_knn_ring
+    from spark_rapids_ml_tpu.parallel.mesh import get_mesh, shard_array
+    from spark_rapids_ml_tpu.parallel.partition import pad_rows
+
+    rng = np.random.default_rng(3)
+    items = rng.normal(size=(10, 6)).astype(np.float32)  # 10 rows, 8 devices
+    queries = rng.normal(size=(16, 6)).astype(np.float32)
+    mesh = get_mesh()
+    Xp, valid, _ = pad_rows(items, mesh.devices.size)
+    assert (np.asarray(valid).reshape(mesh.devices.size, -1).sum(1) == 0).any(), (
+        "test setup must leave at least one shard fully invalid"
+    )
+    Xd = shard_array(Xp, mesh)
+    vd = shard_array(valid > 0, mesh)
+    d_ag, i_ag = exact_knn_distributed(mesh, queries, Xd, vd, k=5)
+    Qp, _, _ = pad_rows(queries, mesh.devices.size)
+    d_ring, i_ring = exact_knn_ring(
+        mesh, shard_array(Qp, mesh), Xd, vd, k=5
+    )
+    d_ring, i_ring = d_ring[: len(queries)], i_ring[: len(queries)]
+    sk_d, sk_idx = SkNN(n_neighbors=5).fit(items).kneighbors(queries)
+    for d, i in ((d_ag, i_ag), (d_ring, i_ring)):
+        assert not np.isnan(d).any()
+        assert (i >= 0).all() and (i < len(items)).all()
+        np.testing.assert_allclose(d, sk_d, atol=1e-4)
+    # fully-invalid input: finite sentinel distances, never NaN
+    d2i, _ = exact_knn_single(
+        jnp.asarray(queries), jnp.asarray(items), jnp.zeros((10,), bool), 3
+    )
+    assert np.isfinite(np.asarray(d2i)).all()
+
+
+# ------------------------------------------------------------- norm hoisting
+
+
+def test_exact_knn_model_caches_item_norms():
+    """Fit caches Σ X² on the model; kneighbors rides it (knn.x2_cached, zero
+    recompute); a REFIT rebuilds it from the new items (invalidation)."""
+    from spark_rapids_ml_tpu.knn import NearestNeighbors
+
+    rng = np.random.default_rng(5)
+    X = rng.normal(size=(300, 8)).astype(np.float32)
+    df = pd.DataFrame({"features": list(X)})
+    qdf = pd.DataFrame({"features": list(X[:9])})
+    model = NearestNeighbors(k=4, inputCol="features").fit(df)
+    x2 = model._model_attributes.get("item_norms_sq")
+    assert x2 is not None and x2.shape == (300,)
+    np.testing.assert_allclose(x2, (X * X).sum(1), rtol=1e-5)
+
+    before = _counters("knn.x2_")
+    model.kneighbors(qdf)
+    delta = _delta(before, _counters("knn.x2_"))
+    # the cached counter must actually FIRE (a dark path would make the
+    # no-recompute assertion below vacuous) and nothing may recompute
+    assert delta.get("knn.x2_cached{site=exact_knn_distributed}", 0) >= 1, delta
+    assert not any("recompute" in k for k in delta), delta
+
+    X2 = X * 2.0
+    model2 = NearestNeighbors(k=4, inputCol="features").fit(
+        pd.DataFrame({"features": list(X2)})
+    )
+    np.testing.assert_allclose(
+        model2._model_attributes["item_norms_sq"], (X2 * X2).sum(1), rtol=1e-5
+    )
+
+
+def test_ivf_build_caches_center_norms_and_model_threads_them():
+    from spark_rapids_ml_tpu.knn import ApproximateNearestNeighbors
+    from spark_rapids_ml_tpu.ops.knn import ivfflat_build
+
+    rng = np.random.default_rng(6)
+    X = rng.normal(size=(400, 8)).astype(np.float32)
+    index = ivfflat_build(
+        jnp.asarray(X), jnp.ones((400,), np.float32), nlist=8, max_iter=4, seed=0
+    )
+    np.testing.assert_allclose(
+        index["center_norms"], (index["centers"] ** 2).sum(1), rtol=1e-5
+    )
+    model = ApproximateNearestNeighbors(
+        k=4, inputCol="features", algoParams={"nlist": 8, "nprobe": 8}
+    ).fit(pd.DataFrame({"features": list(X)}))
+    assert "center_norms" in model._model_attributes
+    before = _counters("knn.x2_")
+    model.kneighbors(pd.DataFrame({"features": list(X[:7])}))
+    delta = _delta(before, _counters("knn.x2_"))
+    assert delta.get("knn.x2_cached{site=ivfflat_search}", 0) >= 1, delta
+    assert not any("recompute" in k for k in delta), delta
+
+
+def test_streamed_tiles_compute_norms_once():
+    """The streamed pairwise sweep computes each tile's Σ x² exactly once (it
+    rides the HBM batch cache with the tile): `knn.x2_tile_computes` equals
+    the tile count even though every query block sweeps all tiles, and the
+    upload counters stay at one pass (zero per-block norm recomputation)."""
+    from spark_rapids_ml_tpu.ops.pairwise_streaming import streaming_exact_knn
+
+    rng = np.random.default_rng(8)
+    X = rng.normal(size=(1000, 8)).astype(np.float32)
+    Q = X[:96]
+    before_tiles = _counters("knn.x2_tile_computes")
+    before_up = _counters("stream.upload_batches")
+    d, i = streaming_exact_knn(Q, X, 5, query_block=32, item_block=256)
+    n_tiles = -(-1000 // 256)
+    dt = _delta(before_tiles, _counters("knn.x2_tile_computes"))
+    du = _delta(before_up, _counters("stream.upload_batches"))
+    assert dt.get("knn.x2_tile_computes", 0) == n_tiles, (dt, n_tiles)
+    assert du.get("stream.upload_batches", 0) == n_tiles, du
+    # parity: the cached-norm sweep matches the in-core scan exactly
+    d_ref, i_ref = exact_knn_single(
+        jnp.asarray(Q), jnp.asarray(X), jnp.ones((1000,), bool), 5
+    )
+    np.testing.assert_array_equal(i, np.asarray(i_ref))
+
+
+# ----------------------------------------------------------- config strategies
+
+
+@pytest.mark.parametrize("strategy", ["exact_full", "exact_tiled", "approx"])
+def test_knn_model_results_under_every_strategy(strategy, n_devices):
+    """NearestNeighbors end-to-end under each configured strategy: exact modes
+    match sklearn exactly; approx meets the recall target with exact
+    distances for whatever ids it returns."""
+    from sklearn.neighbors import NearestNeighbors as SkNN
+
+    from spark_rapids_ml_tpu.knn import NearestNeighbors
+
+    rng = np.random.default_rng(9)
+    items = rng.normal(size=(500, 12)).astype(np.float32)
+    queries = rng.normal(size=(30, 12)).astype(np.float32)
+    srml_config.set("knn.selection", strategy)
+    try:
+        model = NearestNeighbors(k=6, inputCol="features").fit(
+            pd.DataFrame({"features": list(items)})
+        )
+        _, _, knn_df = model.kneighbors(pd.DataFrame({"features": list(queries)}))
+    finally:
+        srml_config.unset("knn.selection")
+    got_idx = np.stack(knn_df["indices"].to_numpy())
+    got_d = np.stack(knn_df["distances"].to_numpy())
+    sk_d, sk_idx = SkNN(n_neighbors=6).fit(items).kneighbors(queries)
+    if strategy == "approx":
+        recall = (got_idx[:, :, None] == sk_idx[:, None, :]).any(-1).mean()
+        assert recall >= float(srml_config.get("knn.recall_target")), recall
+        d_ref = np.sqrt(((queries[:, None] - items[got_idx]) ** 2).sum(-1))
+        np.testing.assert_allclose(got_d, d_ref, rtol=1e-4, atol=1e-4)
+    else:
+        np.testing.assert_array_equal(got_idx, sk_idx)
+        np.testing.assert_allclose(got_d, sk_d, rtol=1e-3, atol=1e-3)
