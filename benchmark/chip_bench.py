@@ -134,10 +134,12 @@ def bench_pca(ctx) -> Dict:
         marginal = max((t6 - t1) / 5, 1e-9)
     else:
         # CPU fallback: plain whole-pass timing of the XLA path (pallas interpret
-        # is orders slower than XLA on CPU and would just measure the interpreter)
+        # is orders slower than XLA on CPU and would just measure the
+        # interpreter). Called DIRECTLY — the kernel is already compiled via
+        # the device plane's compiled_kernel wrapper; re-jitting it here would
+        # bypass the cost-analysis capture that feeds the scenario's mfu.
         prec_name = "XLA"
-        cf = __import__("jax").jit(weighted_covariance)
-        marginal, _ = _timed(lambda: cf(X, w))
+        marginal, _ = _timed(lambda: weighted_covariance(X, w))
     rate = n / marginal / n_chips
     ceiling = PEAK_BW / (d * 4)  # rows/s at one f32 X read per chip
     out["pca_cov_rows_per_sec_per_chip"] = round(rate, 1)
@@ -151,7 +153,7 @@ def bench_pca(ctx) -> Dict:
     # parity: fused (6-pass) vs XLA HIGHEST on the full matrix
     if ctx["on_tpu"]:
         cov_f, mean_f, ws_f = covariance_prefix_mask(X, w, mesh=mesh)
-        cov_x, mean_x, ws_x = __import__("jax").jit(weighted_covariance)(X, w)
+        cov_x, mean_x, ws_x = weighted_covariance(X, w)
         cf_, cx_ = np.asarray(cov_f), np.asarray(cov_x)
         rel = float(np.max(np.abs(cf_ - cx_)) / np.max(np.abs(cx_)))
         out["pca_parity_max_rel"] = round(rel, 8)

@@ -43,7 +43,16 @@ _SECS_RE = re.compile(r'\\?"(\w+)_bench_secs\\?"\s*:\s*([0-9]+(?:\.[0-9]+)?)')
 # gated like scenario wall times so a selection regression can't hide inside
 # a unit whose total time moved for other reasons
 _SELECT_RE = re.compile(r'\\?"(\w+)_select_s\\?"\s*:\s*([0-9]+(?:\.[0-9]+)?)')
+# measured MFU per scenario (`<unit>_mfu`, observability/device.py): gated
+# DIRECTION-AWARE — mfu is higher-is-better, unlike every wall-time key
+_MFU_RE = re.compile(
+    r'\\?"(\w+_mfu)\\?"\s*:\s*([0-9]+(?:\.[0-9]+)?(?:[eE][+-]?[0-9]+)?)'
+)
 _PLATFORM_RE = re.compile(r'\\?"platform\\?"\s*:\s*\\?"(\w+)\\?"')
+
+
+def _higher_is_better(name: str) -> bool:
+    return name.endswith("_mfu")
 
 
 def _round_key(path: str) -> Tuple[int, str]:
@@ -79,6 +88,8 @@ def extract(path: str) -> Dict[str, object]:
             scenarios[k[: -len("_bench_secs")]] = float(v)
         elif k.endswith("_select_s") and isinstance(v, (int, float)):
             scenarios[k[: -len("_s")]] = float(v)
+        elif k.endswith("_mfu") and isinstance(v, (int, float)):
+            scenarios[k] = float(v)  # keeps the _mfu suffix: direction marker
     if isinstance(secondary.get("platform"), str):
         platform = secondary["platform"]
     # fall back to regex over DECODED text: inside the artifact the bench line
@@ -94,6 +105,8 @@ def extract(path: str) -> Dict[str, object]:
             scenarios[name] = float(secs)
         for name, secs in _SELECT_RE.findall(text):
             scenarios[f"{name}_select"] = float(secs)
+        for name, v in _MFU_RE.findall(text):
+            scenarios[name] = float(v)
     if platform is None:
         for text in texts:
             m = _PLATFORM_RE.findall(text)
@@ -121,9 +134,15 @@ def compare(old: Dict[str, object], new: Dict[str, object],
                          "ratio": None, "verdict": "only-one-round"})
             continue
         ratio = n / o if o > 0 else float("inf")
-        verdict = "REGRESSED" if ratio > 1.0 + threshold else (
-            "improved" if ratio < 1.0 - threshold else "ok"
-        )
+        if _higher_is_better(name):
+            # mfu: new/old BELOW 1-threshold is the regression; above is the win
+            verdict = "REGRESSED" if ratio < 1.0 - threshold else (
+                "improved" if ratio > 1.0 + threshold else "ok"
+            )
+        else:
+            verdict = "REGRESSED" if ratio > 1.0 + threshold else (
+                "improved" if ratio < 1.0 - threshold else "ok"
+            )
         rows.append({"scenario": name, "old_s": o, "new_s": n,
                      "ratio": ratio, "verdict": verdict})
     rows.sort(key=lambda r: -(r["ratio"] or 0.0))

@@ -34,6 +34,12 @@ image ships no third-party linters, so the gate is stdlib-only but real:
     top_k_max) so the strategy knob, the invalid-sentinel convention, and the
     selection telemetry can never be bypassed (mirrors the jax.jit-in-models
     ban). `# noqa` on the line exempts.
+  * off-plane device analysis: any `.cost_analysis()` / `.memory_analysis()` /
+    `.memory_stats()` reference outside observability/device.py. The
+    device-performance plane (docs/design.md §6f) owns XLA cost/memory
+    capture and HBM sampling — including the graceful degrade when a runtime
+    lacks them; a direct call elsewhere bypasses the capture contract AND the
+    no-warning-spam guarantee. `# noqa` on the line exempts.
 
 Exit code 1 on any finding; CI runs this before the test tiers (ci/test.sh).
 """
@@ -64,6 +70,9 @@ _BROAD_EXC_NAMES = {"Exception", "BaseException"}
 
 # top-k primitives whose only legal home under ops/ is ops/selection.py
 _TOPK_PRIMS = {"top_k", "approx_max_k"}
+
+# XLA device-analysis surfaces whose only legal home is observability/device.py
+_DEVICE_ANALYSIS = {"cost_analysis", "memory_analysis", "memory_stats"}
 
 
 def _is_broad_catch(type_node) -> bool:
@@ -248,6 +257,28 @@ def check_file(path: Path) -> list:
                     "through ops/selection.py (select_topk/merge_topk/"
                     "top_k_max)"
                 )
+
+    # XLA cost/memory analysis + memory_stats live in observability/device.py
+    # only (the device-performance plane owns capture AND graceful degrade)
+    if not (path.name == "device.py" and "observability" in path.parts):
+        src_lines = src.splitlines()
+        for node in ast.walk(tree):
+            if (
+                isinstance(node, ast.Attribute)
+                and node.attr in _DEVICE_ANALYSIS
+            ):
+                line = (
+                    src_lines[node.lineno - 1]
+                    if node.lineno - 1 < len(src_lines)
+                    else ""
+                )
+                if "noqa" not in line:
+                    findings.append(
+                        f"{path}:{node.lineno}: direct .{node.attr}() outside "
+                        "observability/device.py — route through the "
+                        "device-performance plane (compiled_kernel / "
+                        "sample_hbm, docs/design.md §6f)"
+                    )
 
     if not any(part in PROFILING_INTERNALS_EXEMPT_PARTS for part in path.parts):
         src_lines = src.splitlines()

@@ -71,7 +71,21 @@ assert c["stream.upload_batches"] == n_batches, c  # pass 2+ uploaded zero
 steps = [s for s in iter_spans(rep) if s["name"] == "kmeans.step"]
 assert len(steps) >= 2 and c["cache.hits"] == (len(steps) - 1) * n_batches, c
 assert rep["metrics"]["gauges"]["cache.bytes_resident"] == 0
-print("OBSERVABILITY SMOKE OK: report parses, pass-2 uploads == 0")
+# device-performance plane (docs/design.md §6f): per-span flops/bytes
+# attribution + roofline classification + compile accounting + exported cost
+# records — all from the JSONL, like a dashboard would read them
+for s in steps:
+    d = s["attrs"]["device"]
+    assert d["flops"] > 0 and d["bytes"] > 0, d
+    assert d["roofline_bound"] in ("compute", "memory"), d
+assert any(k.startswith("device.compile{") and v >= 1 for k, v in c.items()), c
+recs = rep["device"]["kernels"]
+assert any(r["kernel"] == "streaming.accum_kmeans" and r["flops"] > 0
+           for r in recs), recs
+# graceful degrade: no hbm gauges on a CPU runtime without memory_stats
+assert not any("hbm" in k for k in rep["metrics"]["gauges"]), rep["metrics"]
+print("OBSERVABILITY SMOKE OK: report parses, pass-2 uploads == 0, "
+      "spans carry flops/bytes + roofline verdicts")
 PY
   # inference-plane smoke (docs/design.md §6e): a fit + transform must export
   # BOTH fit_reports.jsonl and transform_reports.jsonl; the recompile sentinel
@@ -128,6 +142,32 @@ fi
 # small benchmark smoke (reference runs a small bench pre-merge)
 python benchmark/benchmark_runner.py kmeans --num_rows 2000 --num_cols 32 --k 5 --no_cpu
 python benchmark/benchmark_runner.py pca --num_rows 2000 --num_cols 32 --k 3 --no_cpu
+
+# device-observability smoke (docs/design.md §6f): one REAL bench unit through
+# the worker path; the assembled bench line must carry measured mfu +
+# roofline_bound for the scenario (the keys ci/bench_check.py gates
+# direction-aware). Runs the pca unit only — cheap on CPU, and its XLA path
+# routes through the compiled_kernel plane.
+SRML_DEVICE_SMOKE_DIR="$(mktemp -d)"
+SRML_BENCH_ROLE=worker \
+SRML_BENCH_PROGRESS="$SRML_DEVICE_SMOKE_DIR/progress.jsonl" \
+SRML_BENCH_DEADLINE_TS="$(python -c 'import time; print(time.time() + 600)')" \
+SRML_BENCH_SKIP="kmeans_headline,logreg,linreg,rf,umap,dbscan,fit_e2e,cache,knn,ann,wide256" \
+python bench.py
+SRML_BENCH_PROGRESS="$SRML_DEVICE_SMOKE_DIR/progress.jsonl" python - <<'PY'
+import json, os, sys
+sys.path.insert(0, ".")
+import bench
+
+line = bench._assemble(os.environ["SRML_BENCH_PROGRESS"], 0.0, baseline_dir=None)
+sec = line["secondary"]
+assert isinstance(sec.get("pca_mfu"), float) and sec["pca_mfu"] > 0.0, sec
+assert sec.get("pca_roofline_bound") in ("compute", "memory"), sec
+assert sec.get("pca_device_flops", 0) > 0, sec
+print("DEVICE BENCH SMOKE OK: scenario carries measured "
+      f"mfu={sec['pca_mfu']} roofline_bound={sec['pca_roofline_bound']}")
+PY
+rm -rf "$SRML_DEVICE_SMOKE_DIR"
 
 # selection-plane smoke (perf tier): the three strategies must agree — tiled
 # bit-for-bit with full, approx (+ parity re-rank) above the recall target

@@ -105,6 +105,23 @@ _DEFAULTS: Dict[str, Any] = {
     # max_report_bytes, keep max_report_files rotated generations
     "observability.max_report_bytes": 32 << 20,
     "observability.max_report_files": 4,
+    # device-performance plane (observability/device.py, docs/design.md §6f):
+    # compiled_kernel AOT cost/memory-analysis capture + compile accounting +
+    # roofline span attribution. Off = kernels run as plain jax.jit calls.
+    "observability.device_enabled": True,
+    # HBM telemetry: sample local_devices() memory_stats() at span boundaries
+    # (gauges are simply absent on platforms without memory_stats — CPU)
+    "observability.hbm_sampling": True,
+    "observability.hbm_sample_interval_s": 0.05,  # span-boundary rate limit
+    # roofline peak overrides (FLOP/s and bytes/s PER CHIP); 0 = auto from the
+    # per-platform peak table keyed on device_kind
+    "observability.peak_flops": 0.0,
+    "observability.peak_bw": 0.0,
+    # opt-in jax.profiler capture of ONE designated pass of a streamed fit:
+    # set profile_dir to enable; profile_pass picks the pass (default 2 — the
+    # first post-compile steady-state pass); one capture per site per process
+    "observability.profile_dir": None,
+    "observability.profile_pass": 2,
 }
 
 _ENV_KEYS: Dict[str, str] = {
@@ -140,6 +157,13 @@ _ENV_KEYS: Dict[str, str] = {
     "observability.transform_sample_rate": "SRML_TPU_TRANSFORM_SAMPLE_RATE",
     "observability.max_report_bytes": "SRML_TPU_MAX_REPORT_BYTES",
     "observability.max_report_files": "SRML_TPU_MAX_REPORT_FILES",
+    "observability.device_enabled": "SRML_TPU_DEVICE_OBSERVABILITY",
+    "observability.hbm_sampling": "SRML_TPU_HBM_SAMPLING",
+    "observability.hbm_sample_interval_s": "SRML_TPU_HBM_SAMPLE_INTERVAL_S",
+    "observability.peak_flops": "SRML_TPU_PEAK_FLOPS",
+    "observability.peak_bw": "SRML_TPU_PEAK_BW",
+    "observability.profile_dir": "SRML_TPU_PROFILE_DIR",
+    "observability.profile_pass": "SRML_TPU_PROFILE_PASS",
 }
 
 _overrides: Dict[str, Any] = {}

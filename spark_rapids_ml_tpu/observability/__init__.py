@@ -9,6 +9,8 @@
 #   runs.py       write fan-out, structured spans, events, FitRun, worker_scope
 #   inference.py  TransformRun, predict_dispatch, shape buckets + sentinel
 #   export.py     JSONL run/transform reports (rotating) + Prometheus textfile
+#   device.py     compiled_kernel cost/memory-analysis capture, HBM telemetry,
+#                 roofline span attribution, compile accounting, profiler hook
 #
 
 from .registry import (
@@ -57,6 +59,16 @@ from .export import (
     write_prometheus_textfile,
     write_run_report,
 )
+from .device import (
+    CompiledKernel,
+    compiled_kernel,
+    kernel_cost,
+    kernel_cost_records,
+    platform_peaks,
+    profile_pass,
+    sample_hbm,
+    scenario_summary,
+)
 
 __all__ = [
     "DEFAULT_TIME_BUCKETS",
@@ -97,4 +109,12 @@ __all__ = [
     "render_prometheus",
     "write_prometheus_textfile",
     "write_run_report",
+    "CompiledKernel",
+    "compiled_kernel",
+    "kernel_cost",
+    "kernel_cost_records",
+    "platform_peaks",
+    "profile_pass",
+    "sample_hbm",
+    "scenario_summary",
 ]

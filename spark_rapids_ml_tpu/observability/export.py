@@ -124,7 +124,12 @@ def load_run_reports(path_or_dir: str,
     """Parse a fit_reports.jsonl (or the directory holding one) back to report
     dicts — the round-trip half the acceptance tests assert. Rotated files
     (`*.jsonl.N`) are read oldest-first before the live file, so report order
-    survives rotation."""
+    survives rotation.
+
+    Truncated or corrupt lines (a worker killed mid-append, a torn write from
+    a crashed process) are SKIPPED and counted via the
+    `observability.corrupt_lines` counter instead of raising mid-load — one
+    crashed worker must not poison the whole report directory."""
     path = (
         os.path.join(path_or_dir, filename or RUN_REPORT_FILENAME)
         if os.path.isdir(path_or_dir)
@@ -135,12 +140,26 @@ def load_run_reports(path_or_dir: str,
         # preserve the pre-rotation contract: a missing file raises
         paths = [path]
     reports: List[Dict[str, Any]] = []
+    n_corrupt = 0
     for p in paths:
         with open(p) as f:
             for line in f:
                 line = line.strip()
-                if line:
-                    reports.append(json.loads(line))
+                if not line:
+                    continue
+                try:
+                    doc = json.loads(line)
+                except json.JSONDecodeError:
+                    n_corrupt += 1
+                    continue
+                if not isinstance(doc, dict):
+                    n_corrupt += 1  # a bare scalar is not a report line
+                    continue
+                reports.append(doc)
+    if n_corrupt:
+        from .runs import counter_inc
+
+        counter_inc("observability.corrupt_lines", n_corrupt)
     return reports
 
 

@@ -159,10 +159,18 @@ class Histogram(_Metric):
                     "count": 0,
                     "sum": 0.0,
                     "buckets": [0] * (len(self.bounds) + 1),
+                    "min": v,
+                    "max": v,
                 }
             state["count"] += 1
             state["sum"] += v
             state["buckets"][idx] += 1
+            # true observed extremes: what q=0.0 / q=1.0 return EXACTLY instead
+            # of a bucket-edge interpolation that can overshoot every sample
+            if v < state["min"]:
+                state["min"] = v
+            if v > state["max"]:
+                state["max"] = v
 
     def state(self, **labels: Any) -> Optional[Dict[str, Any]]:
         with self._lock:
@@ -170,14 +178,18 @@ class Histogram(_Metric):
             return None if st is None else {
                 "count": st["count"], "sum": st["sum"],
                 "buckets": list(st["buckets"]),
+                "min": st.get("min"), "max": st.get("max"),
             }
 
-    def quantile(self, q: float, **labels: Any) -> float:
+    def quantile(self, q: float, **labels: Any) -> Optional[float]:
         """Estimated q-quantile with exponential-bucket interpolation (see
-        interpolate_quantile). NaN when no observations exist."""
+        interpolate_quantile). Edge semantics: None when no observations exist
+        (an empty histogram has no quantiles — interpolating would fabricate
+        one); q<=0.0 returns the true observed minimum and q>=1.0 the true
+        observed maximum."""
         st = self.state(**labels)
-        if st is None:
-            return math.nan
+        if st is None or st["count"] <= 0:
+            return None
         return interpolate_quantile(st, q, self.bounds)
 
 
@@ -266,6 +278,7 @@ class MetricsRegistry:
                     out[key] = (
                         {"count": v["count"], "sum": v["sum"],
                          "buckets": list(v["buckets"]),
+                         "min": v.get("min"), "max": v.get("max"),
                          "bounds": list(m.bounds)}  # type: ignore[attr-defined]
                         if kind == "histogram"
                         else v
@@ -325,6 +338,12 @@ class MetricsRegistry:
                     }
                 mine["count"] += st["count"]
                 mine["sum"] += st["sum"]
+                for fn, key_mm in ((min, "min"), (max, "max")):
+                    other = st.get(key_mm)
+                    if other is None:
+                        continue
+                    ours = mine.get(key_mm)
+                    mine[key_mm] = other if ours is None else fn(ours, other)
                 theirs: List[int] = list(st["buckets"])
                 if len(theirs) == len(mine["buckets"]):
                     mine["buckets"] = [
@@ -344,11 +363,18 @@ def interpolate_quantile(state: Mapping[str, Any], q: float,
     bucket clamps to the largest finite bound (nothing sane to extrapolate to).
     Exact edge semantics: when q*count lands exactly on a bucket's cumulative
     boundary the estimate is that bucket's upper bound — the same
-    upper-inclusive convention the buckets themselves use (`v <= le`)."""
+    upper-inclusive convention the buckets themselves use (`v <= le`). States
+    that track true observed extremes ("min"/"max" keys, Histogram.observe)
+    return them EXACTLY at q<=0.0 / q>=1.0 instead of a bucket-edge estimate;
+    legacy states without them keep the interpolated clamp."""
     total = state["count"]
     if total <= 0:
         return math.nan
     q = min(max(float(q), 0.0), 1.0)
+    if q <= 0.0 and state.get("min") is not None:
+        return float(state["min"])
+    if q >= 1.0 and state.get("max") is not None:
+        return float(state["max"])
     target = q * total
     bounds = [float(b) for b in bounds]
     seen = 0.0

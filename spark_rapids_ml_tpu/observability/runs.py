@@ -60,6 +60,20 @@ def global_registry() -> MetricsRegistry:
     return _GLOBAL
 
 
+_device_mod = None
+
+
+def _device():
+    """Lazy device-plane import (observability/device.py imports THIS module at
+    its top; the reverse edge must resolve at call time)."""
+    global _device_mod
+    if _device_mod is None:
+        from . import device as dev
+
+        _device_mod = dev
+    return _device_mod
+
+
 def _worker_scopes() -> List["WorkerScope"]:
     scopes = getattr(_tls, "worker_scopes", None)
     if scopes is None:
@@ -209,6 +223,10 @@ def span(name: str, attrs: Optional[Mapping[str, Any]] = None) -> Iterator[None]
                 stack.remove(node)
             except ValueError:
                 pass
+        # device plane (observability/device.py): roofline-classify any kernel
+        # work attributed to this span + keep the HBM gauge fresh. Runs BEFORE
+        # add_span so the stored span dicts carry the finalized attrs.
+        _device().on_span_close(node)
         for reg in _sink_registries():
             reg.add_span_total(name, node.duration_s)
             reg.histogram(name).observe(node.duration_s, status=node.status)
@@ -340,6 +358,7 @@ class FitRun:
         self._root = span(f"{self.algo}.{self._root_suffix}", {"site": self.site})
         with _state_lock:
             _active_runs.append(self)
+        _device().note_run_start(self)
         self._root.__enter__()
         return self
 
@@ -352,6 +371,7 @@ class FitRun:
                     _active_runs.remove(self)
                 except ValueError:
                     pass
+            _device().note_run_end(self)
             self.duration_s = time.perf_counter() - (self._t0 or time.perf_counter())
             if exc_type is not None:
                 self.status = "error"
@@ -377,7 +397,9 @@ class FitRun:
             ]
             dropped = self._dropped_spans
             dropped_events = self._dropped_events
+        device_section = _device().device_report_section(self.registry)
         return {
+            **({"device": device_section} if device_section else {}),
             "schema": 1,
             "kind": self.kind,
             "run_id": self.run_id,

@@ -19,7 +19,6 @@
 
 from __future__ import annotations
 
-import functools
 from typing import Dict, Tuple
 
 import jax
@@ -30,6 +29,7 @@ from ..observability import span as obs_span
 from ..reliability import RetryPolicy, fault_point
 from . import selection as _sel
 from .selection import mask_invalid, merge_topk, select_topk
+from ..observability.device import compiled_kernel
 
 
 def _normalize_batch_or_raise(Xb: np.ndarray) -> np.ndarray:
@@ -266,7 +266,7 @@ def streaming_cagra_build(
     return {"items": X, "graph": graph, "item_norms_sq": center_norms_sq(X)}
 
 
-@functools.partial(jax.jit, static_argnames=("nprobe",))
+@compiled_kernel("ann.probe_cells", static_argnames=("nprobe",))
 def _probe_cells(
     Q: jax.Array, centers: jax.Array, nprobe: int, center_norms=None
 ):
@@ -279,9 +279,8 @@ def _probe_cells(
     return probe
 
 
-@functools.partial(
-    jax.jit, static_argnames=("k", "strategy", "tile", "recall_target")
-)
+@compiled_kernel("ann.scan_probed",
+                 static_argnames=("k", "strategy", "tile", "recall_target"))
 def _scan_probed(qb, probed_items, probed_ids, k, strategy, tile, recall_target):
     """(bq, nprobe, max_cell, d) probed cells -> per-query top-k. EXACT f32
     difference-form distances, matching ops/knn.py::ivfflat_search's in-core
@@ -359,7 +358,7 @@ def streaming_ivfflat_search(
     return out_d, out_i
 
 
-@functools.partial(jax.jit, static_argnames=("k",))
+@compiled_kernel("ann.refine_exact_tile", static_argnames=("k",))
 def _refine_exact_tile(qb, vecs, item_ids, k: int):
     """Exact re-rank tile (always exact_full — this IS the re-rank stage)."""
     d2 = jnp.sum((vecs - qb[:, None, :]) ** 2, axis=-1)

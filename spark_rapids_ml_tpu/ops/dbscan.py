@@ -16,16 +16,16 @@
 
 from __future__ import annotations
 
-import functools
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from .knn import _block_sq_dists
+from ..observability.device import compiled_kernel
 
 
-@functools.partial(jax.jit, static_argnames=("block",))
+@compiled_kernel("dbscan.core_mask", static_argnames=("block",))
 def _core_mask(
     X: jax.Array, valid: jax.Array, eps2: float, min_samples: int, block: int = 512
 ) -> jax.Array:
@@ -45,7 +45,8 @@ def _core_mask(
     return (counts.reshape(-1)[:n] >= min_samples) & valid
 
 
-@functools.partial(jax.jit, static_argnames=("block",))
+@compiled_kernel("dbscan.min_core_neighbor_labels",
+                 static_argnames=("block",))
 def _min_core_neighbor_labels(
     X: jax.Array, labels: jax.Array, core: jax.Array, eps2: float, block: int = 512
 ) -> jax.Array:
@@ -65,7 +66,7 @@ def _min_core_neighbor_labels(
     return mins.reshape(-1)[:n]
 
 
-@jax.jit
+@compiled_kernel("dbscan.hook_and_jump")
 def _hook_and_jump(
     labels: jax.Array, mins: jax.Array, core: jax.Array
 ) -> jax.Array:
@@ -77,7 +78,7 @@ def _hook_and_jump(
     return new_labels
 
 
-@functools.partial(jax.jit, static_argnames=("max_rounds",))
+@compiled_kernel("dbscan.propagate_labels", static_argnames=("max_rounds",))
 def _propagate_labels(
     X: jax.Array, core: jax.Array, eps2: float, max_rounds: int
 ) -> jax.Array:

@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..observability.device import compiled_kernel
 from ._precision import FAST, pdot
 from .selection import top_k_max
 
@@ -51,7 +52,8 @@ def _normalize_rows(X: jax.Array) -> jax.Array:
     return X / jnp.maximum(norms, 1e-12)
 
 
-@functools.partial(jax.jit, static_argnames=("max_iter", "cosine", "fast_math"))
+@compiled_kernel("kmeans.lloyd_fit",
+                 static_argnames=("max_iter", "cosine", "fast_math"))
 def lloyd_fit(
     X: jax.Array,
     w: jax.Array,
@@ -114,14 +116,14 @@ def lloyd_fit(
     return centers, inertia, n_iter
 
 
-@functools.partial(jax.jit, static_argnames=("cosine",))
+@compiled_kernel("kmeans.predict", static_argnames=("cosine",))
 def kmeans_predict(X: jax.Array, centers: jax.Array, cosine: bool = False) -> jax.Array:
     if cosine:
         return jnp.argmax(pdot(_normalize_rows(X), _normalize_rows(centers).T), axis=1)
     return jnp.argmin(_sq_dists(X, centers), axis=1)
 
 
-@jax.jit
+@compiled_kernel("kmeans.inertia")
 def kmeans_inertia(X: jax.Array, w: jax.Array, centers: jax.Array) -> jax.Array:
     return jnp.sum(w * jnp.min(_sq_dists(X, centers), axis=1))
 

@@ -44,6 +44,7 @@ from ._precision import FAST
 from ..parallel.mesh import DATA_AXIS
 from . import selection as _sel
 from .selection import INVALID_D2, mask_invalid, merge_topk, select_topk
+from ..observability.device import compiled_kernel
 
 
 def _block_sq_dists(
@@ -83,8 +84,9 @@ def _count_x2(x2, site: str, tracing: bool) -> None:
     )
 
 
-@functools.partial(
-    jax.jit, static_argnames=("k", "block", "strategy", "tile", "recall_target")
+@compiled_kernel(
+    "knn.exact_scan",
+    static_argnames=("k", "block", "strategy", "tile", "recall_target"),
 )
 def _exact_knn_scan(
     Q: jax.Array,
@@ -117,7 +119,7 @@ def _exact_knn_scan(
     return d2b.reshape(-1, k)[:nq], idxb.reshape(-1, k)[:nq]
 
 
-@functools.partial(jax.jit, static_argnames=("k",))
+@compiled_kernel("knn.parity_rerank_sq", static_argnames=("k",))
 def parity_rerank_sq(
     Q: jax.Array, X: jax.Array, valid: jax.Array, cand_idx: jax.Array, k: int
 ) -> Tuple[jax.Array, jax.Array]:
@@ -402,8 +404,8 @@ def ivfpq_build(
     }
 
 
-@functools.partial(
-    jax.jit,
+@compiled_kernel(
+    "knn.ivfpq_search",
     static_argnames=("k", "nprobe", "block", "strategy", "tile", "recall_target"),
 )
 def _ivfpq_search_impl(
@@ -501,7 +503,7 @@ def ivfpq_search(
     )
 
 
-@functools.partial(jax.jit, static_argnames=("k",))
+@compiled_kernel("knn.pq_refine", static_argnames=("k",))
 def pq_refine(
     Q: jax.Array,
     cells: jax.Array,  # (nlist, max_cell, d) raw item vectors
@@ -524,8 +526,8 @@ def pq_refine(
     return jnp.where(ids >= 0, dists, jnp.inf), ids
 
 
-@functools.partial(
-    jax.jit,
+@compiled_kernel(
+    "knn.ivfflat_search",
     static_argnames=("k", "nprobe", "block", "strategy", "tile", "recall_target"),
 )
 def _ivfflat_search_impl(
@@ -696,8 +698,8 @@ def _optimize_graph_reverse_edges(
     return out
 
 
-@functools.partial(
-    jax.jit,
+@compiled_kernel(
+    "knn.cagra_search",
     static_argnames=(
         "k", "itopk", "iterations", "search_width", "strategy", "tile",
         "recall_target",

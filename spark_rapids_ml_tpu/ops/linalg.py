@@ -19,10 +19,11 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
+from ..observability.device import compiled_kernel
 from ._precision import pdot
 
 
-@jax.jit
+@compiled_kernel("linalg.weighted_mean")
 def weighted_mean(X: jax.Array, w: jax.Array) -> Tuple[jax.Array, jax.Array]:
     """Returns (mean, wsum). One pass; psum over the data axis is implicit."""
     wsum = jnp.sum(w)
@@ -30,7 +31,7 @@ def weighted_mean(X: jax.Array, w: jax.Array) -> Tuple[jax.Array, jax.Array]:
     return mean, wsum
 
 
-@jax.jit
+@compiled_kernel("linalg.weighted_moments")
 def weighted_moments(X: jax.Array, w: jax.Array) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Returns (mean, var, wsum) with the unbiased (wsum-1) variance denominator,
     matching Spark's Summarizer semantics used by the reference's standardization
@@ -42,7 +43,7 @@ def weighted_moments(X: jax.Array, w: jax.Array) -> Tuple[jax.Array, jax.Array, 
     return mean, jnp.maximum(var, 0.0), wsum
 
 
-@jax.jit
+@compiled_kernel("linalg.weighted_covariance")
 def weighted_covariance(X: jax.Array, w: jax.Array) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Centered covariance C = Σ w_i (x_i-μ)(x_i-μ)ᵀ / (Σw - 1) via sufficient
     statistics (single data pass: S2 = Xᵀ diag(w) X, then mean correction)."""
@@ -53,7 +54,7 @@ def weighted_covariance(X: jax.Array, w: jax.Array) -> Tuple[jax.Array, jax.Arra
     return cov, mean, wsum
 
 
-@jax.jit
+@compiled_kernel("linalg.gram_and_xty")
 def gram_and_xty(
     X: jax.Array, y: jax.Array, w: jax.Array
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:

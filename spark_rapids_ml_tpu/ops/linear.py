@@ -22,18 +22,18 @@
 
 from __future__ import annotations
 
-import functools
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..observability.device import compiled_kernel
 from ._precision import pdot
 from .linalg import power_iteration_lmax
 
 
-@jax.jit
+@compiled_kernel("linear.sufficient_stats")
 def linreg_sufficient_stats(
     X: jax.Array, y: jax.Array, w: jax.Array
 ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
@@ -55,7 +55,7 @@ def _center_stats(A, b, xbar, ybar, n, fit_intercept):
     return A, b
 
 
-@functools.partial(jax.jit, static_argnames=("fit_intercept",))
+@compiled_kernel("linear.solve_l2", static_argnames=("fit_intercept",))
 def solve_l2(
     A: jax.Array,
     b: jax.Array,
@@ -80,7 +80,8 @@ def solve_l2(
     return coef, intercept
 
 
-@functools.partial(jax.jit, static_argnames=("fit_intercept", "max_iter"))
+@compiled_kernel("linear.solve_elastic_net",
+                 static_argnames=("fit_intercept", "max_iter"))
 def solve_elastic_net(
     A: jax.Array,
     b: jax.Array,
@@ -229,7 +230,7 @@ def solve_from_stats(
     return results
 
 
-@jax.jit
+@compiled_kernel("linear.predict")
 def linreg_predict(X: jax.Array, coef: jax.Array, intercept: jax.Array) -> jax.Array:
     return pdot(X, coef) + intercept
 
@@ -250,9 +251,8 @@ def linreg_predict(X: jax.Array, coef: jax.Array, intercept: jax.Array) -> jax.A
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(
-    jax.jit, static_argnames=("fit_intercept", "standardize", "max_iter")
-)
+@compiled_kernel("linear.huber_qn",
+                 static_argnames=("fit_intercept", "standardize", "max_iter"))
 def _huber_qn(
     X: jax.Array,
     y: jax.Array,

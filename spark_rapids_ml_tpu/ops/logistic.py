@@ -24,7 +24,6 @@
 
 from __future__ import annotations
 
-import functools
 from typing import Any, Dict
 
 import jax
@@ -32,6 +31,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from ..observability.device import compiled_kernel
 from ._precision import pdot
 from .linalg import power_iteration_lmax, weighted_moments
 
@@ -109,7 +109,8 @@ def _run_lbfgs(loss, params0, max_iter: int, tol: float):
     return params, n_iter
 
 
-@functools.partial(jax.jit, static_argnames=("fit_intercept", "max_iter", "multinomial"))
+@compiled_kernel("logistic.qn_fit",
+                 static_argnames=("fit_intercept", "max_iter", "multinomial"))
 def _qn_fit(
     X, y_enc, w, scale, reg_l2, fit_intercept: bool, max_iter: int, tol, multinomial: bool
 ):
@@ -147,7 +148,8 @@ def _accelerated_prox_loop(smooth, prox, params0, step, max_iter: int, tol):
     return params, n_iter
 
 
-@functools.partial(jax.jit, static_argnames=("fit_intercept", "max_iter", "multinomial"))
+@compiled_kernel("logistic.fista_fit",
+                 static_argnames=("fit_intercept", "max_iter", "multinomial"))
 def _fista_fit(
     X, y_enc, w, scale, reg_l1, reg_l2, lipschitz, fit_intercept: bool, max_iter: int,
     tol, multinomial: bool,
@@ -176,9 +178,8 @@ def _fista_fit(
     return params, n_iter, smooth(params) + reg_l1 * jnp.sum(jnp.abs(params * coef_mask))
 
 
-@functools.partial(
-    jax.jit, static_argnames=("fit_intercept", "max_iter", "multinomial")
-)
+@compiled_kernel("logistic.projected_fit",
+                 static_argnames=("fit_intercept", "max_iter", "multinomial"))
 def _projected_fit(
     X, y_enc, w, scale, reg_l2, lipschitz, fit_intercept: bool, max_iter: int,
     tol, multinomial: bool, lb, ub,
@@ -208,7 +209,7 @@ def _projected_fit(
     return params, n_iter, smooth(params)
 
 
-@jax.jit
+@compiled_kernel("logistic.gram_lmax")
 def _gram_lmax(X, w, scale):
     """λ_max of (X/σ)ᵀW(X/σ)/Σw via one sharded Gram pass + power iteration."""
     wsum = jnp.sum(w)
@@ -351,7 +352,7 @@ def logreg_fit(
     }
 
 
-@functools.partial(jax.jit, static_argnames=("multinomial",))
+@compiled_kernel("logistic.decision", static_argnames=("multinomial",))
 def logreg_decision(X, coef, intercept, multinomial: bool):
     """Raw margins: (n,) for binomial single-vector, (n,k) for multinomial."""
     if multinomial:

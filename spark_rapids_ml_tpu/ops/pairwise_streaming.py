@@ -45,11 +45,12 @@ from . import selection as _sel
 from .knn import _block_sq_dists
 from .selection import INVALID_D2, mask_invalid, merge_topk, select_topk
 from .streaming import _prefetch
+from ..observability.device import compiled_kernel
 
 _I32MAX = np.iinfo(np.int32).max
 
 
-@jax.jit
+@compiled_kernel("pairwise.tile_norms")
 def _tile_norms(xb: jax.Array) -> jax.Array:
     """Σ x² of one item tile — computed ONCE at tile upload (and retained in
     the HBM batch cache alongside the tile), with the same reduce the distance
@@ -232,9 +233,8 @@ def _device_blocks(X: np.ndarray, block: int, extras=None, cache=None,
     return _prefetch(gen(), depth=1, site="pairwise")
 
 
-@functools.partial(
-    jax.jit, static_argnames=("k", "strategy", "tile", "recall_target")
-)
+@compiled_kernel("pairwise.tile_topk_merge",
+                 static_argnames=("k", "strategy", "tile", "recall_target"))
 def _tile_topk_merge(qb, xb, x2b, nv_items, base_id, best_d, best_i, k: int,
                      strategy: str, tile: int, recall_target: float):
     """Merge one (qb, xb) tile into the per-query running top-k: configured
@@ -361,14 +361,14 @@ def streaming_exact_knn(
     return out_d, out_i
 
 
-@jax.jit
+@compiled_kernel("pairwise.tile_count")
 def _tile_count(qb, xb, x2b, nv_items, eps2):
     d2 = _block_sq_dists(qb, xb, x2b)
     iv = jnp.arange(xb.shape[0]) < nv_items
     return jnp.sum((d2 <= eps2) & iv[None, :], axis=1).astype(jnp.int32)
 
 
-@jax.jit
+@compiled_kernel("pairwise.tile_min_core_label")
 def _tile_min_core_label(qb, xb, x2b, labels_b, core_b, nv_items, eps2):
     d2 = _block_sq_dists(qb, xb, x2b)
     iv = jnp.arange(xb.shape[0]) < nv_items

@@ -20,17 +20,17 @@
 
 from __future__ import annotations
 
-import functools
 from typing import Dict
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..observability.device import compiled_kernel
 from .linalg import weighted_covariance
 
 
-@functools.partial(jax.jit, static_argnames=("k",))
+@compiled_kernel("pca.from_cov", static_argnames=("k",))
 def _pca_from_cov(cov: jax.Array, k: int):
     eigvals, eigvecs = jnp.linalg.eigh(cov)  # ascending
     # top-k, descending
@@ -122,7 +122,7 @@ def pca_attrs_from_cov(
     }
 
 
-@jax.jit
+@compiled_kernel("pca.transform")
 def pca_transform(X: jax.Array, components: jax.Array) -> jax.Array:
     """Spark-parity projection of raw (uncentered) rows: X @ Vᵀ."""
     from ._precision import pdot

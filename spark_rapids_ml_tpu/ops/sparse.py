@@ -24,12 +24,12 @@
 
 from __future__ import annotations
 
-import functools
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+from ..observability.device import compiled_kernel
 
 # int32 column/row indices are escalated to int64 past this many nonzeros, mirroring
 # the reference's nnz>INT32_MAX fallback (classification.py:960-966)
@@ -101,11 +101,13 @@ def pad_ell_rows(
 # ---- ELL primitive contractions (all jit-inlined into the solvers) ----
 
 
+@compiled_kernel("sparse.ell_matvec")
 def ell_matvec(values: jax.Array, indices: jax.Array, v: jax.Array) -> jax.Array:
     """X @ v -> (n,)."""
     return jnp.sum(values * v[indices], axis=1)
 
 
+@compiled_kernel("sparse.ell_matmat")
 def ell_matmat(values: jax.Array, indices: jax.Array, M: jax.Array) -> jax.Array:
     """X @ M -> (n, k) for M (d, k)."""
     return jnp.einsum("nr,nrk->nk", values, M[indices])
@@ -124,7 +126,7 @@ def ell_rmatmat(values: jax.Array, indices: jax.Array, R: jax.Array, d: int) -> 
     return jnp.zeros((d, k), values.dtype).at[indices.reshape(-1)].add(contrib)
 
 
-@functools.partial(jax.jit, static_argnames=("d",))
+@compiled_kernel("sparse.weighted_moments", static_argnames=("d",))
 def sparse_weighted_moments(
     values: jax.Array, indices: jax.Array, w: jax.Array, d: int
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
@@ -182,9 +184,8 @@ def _sparse_multinomial_loss(values, indices, y_onehot, w, scale, reg_l2, fit_in
     return loss
 
 
-@functools.partial(
-    jax.jit, static_argnames=("d", "fit_intercept", "max_iter", "multinomial")
-)
+@compiled_kernel("sparse.qn_fit",
+                 static_argnames=("d", "fit_intercept", "max_iter", "multinomial"))
 def _sparse_qn_fit(
     values, indices, y_enc, w, scale, reg_l2, d: int, fit_intercept: bool,
     max_iter: int, tol, multinomial: bool,
@@ -205,9 +206,8 @@ def _sparse_qn_fit(
     return params, n_iter, loss(params)
 
 
-@functools.partial(
-    jax.jit, static_argnames=("d", "fit_intercept", "max_iter", "multinomial")
-)
+@compiled_kernel("sparse.fista_fit",
+                 static_argnames=("d", "fit_intercept", "max_iter", "multinomial"))
 def _sparse_fista_fit(
     values, indices, y_enc, w, scale, reg_l1, reg_l2, lipschitz, d: int,
     fit_intercept: bool, max_iter: int, tol, multinomial: bool,
@@ -330,9 +330,8 @@ def sparse_logreg_fit(
 # ---- sparse linear regression (matrix-free CG / FISTA on normal equations) ----
 
 
-@functools.partial(
-    jax.jit, static_argnames=("d", "fit_intercept", "max_iter", "l1_zero")
-)
+@compiled_kernel("sparse.linreg_solve",
+                 static_argnames=("d", "fit_intercept", "max_iter", "l1_zero"))
 def _sparse_linreg_solve(
     values, indices, y, w, scale, d: int, reg, l1_ratio, fit_intercept: bool,
     max_iter: int, tol, l1_zero: bool,

@@ -16,7 +16,6 @@
 
 from __future__ import annotations
 
-import functools
 from collections import deque
 from typing import Optional, Tuple
 
@@ -25,6 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..observability import span as obs_span
+from ..observability.device import compiled_kernel, profile_pass
 from ..reliability import (
     StreamBatchError,
     fault_point,
@@ -150,7 +150,7 @@ def _accumulate_stream(carry, accum, n, batch_rows, mesh, slicer, site: str = "i
 # per batch. Batch operands are NEVER donated — cached batches (device_cache)
 # must survive the call to replay on later passes. The checkpoint-resume layer
 # snapshots carry COPIES for the same reason (reliability/checkpoint.py).
-@functools.partial(jax.jit, donate_argnums=(0,))
+@compiled_kernel("streaming.accum_linreg", donate_argnums=(0,))
 def _accum_linreg(carry, X, y, w):
     A, b, sx, sy, sw = carry
     Xw = X * w[:, None]
@@ -163,7 +163,7 @@ def _accum_linreg(carry, X, y, w):
     )
 
 
-@functools.partial(jax.jit, donate_argnums=(0,))
+@compiled_kernel("streaming.accum_cov", donate_argnums=(0,))
 def _accum_cov(carry, X, w):
     S2, sx, sw = carry
     return (
@@ -261,8 +261,8 @@ def _kahan_add(acc, comp, term):
     return t, (t - acc) - y
 
 
-@functools.partial(
-    jax.jit,
+@compiled_kernel(
+    "streaming.logreg_value_grad",
     static_argnames=("fit_intercept", "multinomial"),
     donate_argnums=(0, 1, 2, 3),
 )
@@ -294,7 +294,7 @@ def _logreg_accum_value_grad(
     return acc_v, comp_v, acc_g, comp_g
 
 
-@functools.partial(jax.jit, donate_argnums=(0,))
+@compiled_kernel("streaming.accum_moments", donate_argnums=(0,))
 def _accum_moments(carry, X, w):
     sx, sxx, sw = carry
     return (sx + pdot(w, X), sxx + pdot(w, X * X), sw + jnp.sum(w))
@@ -477,8 +477,11 @@ def _streaming_logreg_fit(
         # `logreg.step` span per pass in the fit trace, with its per-batch
         # `stream.ingest` uploads (if any) as children
         _step_no[0] += 1
-        with obs_span("logreg.step", {"pass": _step_no[0]}):
-            return _value_and_grad(params_flat)
+        # profile_pass: opt-in jax.profiler capture of ONE designated pass
+        # (observability.profile_dir / profile_pass — docs/design.md §6f)
+        with profile_pass("logreg.step", _step_no[0]):
+            with obs_span("logreg.step", {"pass": _step_no[0]}):
+                return _value_and_grad(params_flat)
 
     def _value_and_grad(params_flat: np.ndarray):
         params = jnp.asarray(params_flat.reshape(shape).astype(dt))
@@ -632,7 +635,8 @@ def _finish_logreg(x, shape, scale_h, fit_intercept, multinomial, n_iter, fx):
     }
 
 
-@functools.partial(jax.jit, static_argnames=("cosine",), donate_argnums=(0,))
+@compiled_kernel("streaming.accum_kmeans", static_argnames=("cosine",),
+                 donate_argnums=(0,))
 def _accum_kmeans(carry, centers, X, w, cosine: bool = False):
     """One batch of a streamed Lloyd iteration: accumulate per-cluster weighted sums,
     counts and inertia against FIXED centers."""
@@ -734,8 +738,11 @@ def _streaming_kmeans_fit(
         )
         # one Lloyd iteration == one full streamed pass: a `kmeans.step` span
         # per pass (pass 1 carries the jit compile of the batch accumulator),
-        # with any `stream.ingest` uploads it triggered as child spans
-        with obs_span("kmeans.step", {"pass": it + 1, "compile": it == 0}):
+        # with any `stream.ingest` uploads it triggered as child spans; the
+        # designated pass may additionally capture a jax.profiler trace
+        # (observability.profile_dir — docs/design.md §6f)
+        with profile_pass("kmeans.step", it + 1), \
+                obs_span("kmeans.step", {"pass": it + 1, "compile": it == 0}):
             carry = _accumulate_stream(
                 carry,
                 lambda c, batch, centers=centers: _accum_kmeans(

@@ -33,6 +33,7 @@ from typing import Dict, List
 import jax
 import jax.numpy as jnp
 import numpy as np
+from ..observability.device import compiled_kernel
 
 
 # ---------------------------------------------------------------------------
@@ -331,7 +332,7 @@ def _build_tree_impl(
     }
 
 
-@functools.partial(jax.jit, static_argnames=("max_depth",))
+@compiled_kernel("trees.predict_forest", static_argnames=("max_depth",))
 def predict_forest(
     X: jax.Array,  # (n, d) raw features
     feature: jax.Array,  # (n_trees, n_slots)

@@ -22,13 +22,13 @@
 
 from __future__ import annotations
 
-import functools
 from typing import Dict, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..observability.device import compiled_kernel
 from .selection import (
     INVALID_D2 as _INVALID_D2,
     mask_invalid as _mask_invalid,
@@ -52,7 +52,7 @@ def find_ab_params(spread: float = 1.0, min_dist: float = 0.1) -> Tuple[float, f
     return float(params[0]), float(params[1])
 
 
-@functools.partial(jax.jit, static_argnames=("local_connectivity",))
+@compiled_kernel("umap.smooth_knn", static_argnames=("local_connectivity",))
 def smooth_knn(
     knn_dists: jax.Array, local_connectivity: float = 1.0
 ) -> Tuple[jax.Array, jax.Array]:
@@ -134,9 +134,8 @@ def fuzzy_simplicial_set(
     )
 
 
-@functools.partial(
-    jax.jit, static_argnames=("n_epochs", "n_vertices", "neg_samples")
-)
+@compiled_kernel("umap.optimize_layout",
+                 static_argnames=("n_epochs", "n_vertices", "neg_samples"))
 def optimize_layout(
     emb0: jax.Array,  # (n, dim) initial embedding
     heads: jax.Array,  # (E,)
@@ -213,7 +212,8 @@ def optimize_layout(
     return emb
 
 
-@functools.partial(jax.jit, static_argnames=("n_epochs", "neg_samples"))
+@compiled_kernel("umap.optimize_transform_layout",
+                 static_argnames=("n_epochs", "neg_samples"))
 def optimize_transform_layout(
     q_emb0: jax.Array,  # (nq, dim) init (fuzzy-weighted mean)
     ref_emb: jax.Array,  # (n_ref, dim) FROZEN reference embedding
@@ -387,7 +387,8 @@ UMAP_METRICS = (
 )
 
 
-@functools.partial(jax.jit, static_argnames=("k", "p", "qblock", "xblock"))
+@compiled_kernel("umap.minkowski_knn",
+                 static_argnames=("k", "p", "qblock", "xblock"))
 def _minkowski_knn(
     Q: jax.Array, X: jax.Array, k: int, p: float, qblock: int = 256,
     xblock: int = 2048,

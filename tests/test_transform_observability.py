@@ -418,7 +418,10 @@ def test_histogram_quantile_bucket_edges():
         h.observe(v)
     # q*count on an exact cumulative boundary -> that bucket's UPPER bound
     assert h.quantile(0.5) == pytest.approx(2.0)
-    assert h.quantile(1.0) == pytest.approx(4.0)
+    # q=1.0 returns the TRUE observed maximum (not the bucket's upper bound —
+    # 4.0 here would overshoot every sample) and q=0.0 the true minimum
+    assert h.quantile(1.0) == pytest.approx(3.0)
+    assert h.quantile(0.0) == pytest.approx(1.5)
     # geometric interpolation inside the (2, 4] and (1, 2] buckets
     assert h.quantile(0.75) == pytest.approx(2.0 * (4.0 / 2.0) ** 0.5)
     assert h.quantile(0.25) == pytest.approx(1.0 * (2.0 / 1.0) ** 0.5)
@@ -427,7 +430,9 @@ def test_histogram_quantile_bucket_edges():
     h0.observe(0.5)
     h0.observe(0.75)
     assert h0.quantile(0.5) == pytest.approx(0.5)  # frac 0.5 of (0, 1]
-    assert np.isnan(reg.histogram("empty", buckets=[1.0]).quantile(0.5))
+    # empty histogram: no quantiles exist — None, never an interpolated value
+    assert reg.histogram("empty", buckets=[1.0]).quantile(0.5) is None
+    assert reg.histogram("empty", buckets=[1.0]).quantile(0.0) is None
 
 
 def test_histogram_quantile_inf_bucket_clamps():
