@@ -48,6 +48,7 @@ UNITS = [
     "dbscan",
     "fit_e2e",
     "cache",
+    "telemetry_overhead",
     "knn",
     "ann",
     "wide256",

@@ -1004,6 +1004,95 @@ def bench_cache(ctx) -> Dict:
     return out
 
 
+def bench_telemetry_overhead(ctx) -> Dict:
+    """Live telemetry plane cost (observability/server.py + flight.py, §6g):
+    the SAME multi-pass streamed KMeans fit with the HTTP endpoint + flight
+    recorder ON (ephemeral port, default ring size) vs OFF (no port, recorder
+    disabled). Emits `telemetry_overhead_pct` — the headline number the §6g
+    contract advertises (<2% target, advisory-gated by ci/bench_check.py). The
+    base observability plane (runs, spans, gauges) is identical in both arms:
+    the scenario isolates what THIS PR added, not observability as a whole.
+
+    The estimator is the MEDIAN OF PER-PAIR DELTAS over alternating-order
+    pairs: each rep times both arms back to back, the arm that goes first
+    alternates rep to rep (a monotone warming trend otherwise flatters
+    whichever arm consistently runs second — observed at ±10% per-fit noise on
+    shared-CPU runners, far above the 2% target), and the pairwise median
+    discards the reps a scheduler hiccup poisoned. `_noise_pct` (the median
+    absolute deviation of the pair deltas) rides along so ci/bench_check.py
+    can refuse to judge an underpowered measurement instead of flagging
+    scheduler noise as a regression."""
+    from spark_rapids_ml_tpu import config
+    from spark_rapids_ml_tpu.observability import flight, server
+    from spark_rapids_ml_tpu.ops.streaming import streaming_kmeans_fit
+
+    mesh = ctx["mesh"]
+    n, d = ctx["telemetry_shape"]
+    iters = 12
+    rng = np.random.default_rng(47)
+    Xh = rng.normal(0, 1, (n, d)).astype(np.float32)  # noise: never converges
+    batch_rows = max(n // 8, 1)
+
+    def run_once(live: bool) -> float:
+        if live:
+            # pin the endpoint for the duration of this fit: bind lands before
+            # the timed window and teardown after it, so the window carries
+            # the cost of the endpoint BEING live, not bind/teardown churn.
+            # (Per-rep teardown is deliberate — a socket left up would leak
+            # the live arm's server thread into the OFF arm's timing.)
+            config.set("observability.http_port", 0)
+            config.set("observability.flight_recorder_events", 256)
+            server.start_metrics_server()
+        else:
+            config.set("observability.http_port", None)
+            config.set("observability.flight_recorder_events", 0)
+        flight.reset_flight_recorder()
+        try:
+            from spark_rapids_ml_tpu.observability import fit_run
+
+            t0 = time.perf_counter()
+            with fit_run(algo="telemetry_bench"):
+                res = streaming_kmeans_fit(
+                    Xh, None, k=8, max_iter=iters, tol=0.0, seed=0,
+                    batch_rows=batch_rows, mesh=mesh,
+                )
+            assert res["n_iter"] == iters, res["n_iter"]
+            return time.perf_counter() - t0
+        finally:
+            config.unset("observability.http_port")
+            config.unset("observability.flight_recorder_events")
+            # unpin + release: no run scopes are open here, so this closes the
+            # socket before the next arm runs
+            server.stop_metrics_server()
+
+    run_once(False)  # compile warmup, untimed
+    run_once(True)  # live-path warmup (lazy imports on the note path), untimed
+    off_ts, on_ts, deltas = [], [], []
+    heartbeat = ctx.get("heartbeat") or (lambda tag: None)
+    for rep in range(6):  # alternating-order pairs: warming drift cancels
+        if rep % 2 == 0:
+            t_off = run_once(False)
+            t_on = run_once(True)
+        else:
+            t_on = run_once(True)
+            t_off = run_once(False)
+        off_ts.append(t_off)
+        on_ts.append(t_on)
+        deltas.append((t_on - t_off) / t_off * 100.0)
+        heartbeat(f"telemetry_rep{rep}")
+    med_delta = float(np.median(deltas))
+    return {
+        "telemetry_shape": [n, d],
+        "telemetry_passes": iters,
+        "telemetry_off_s": round(float(np.median(off_ts)), 4),
+        "telemetry_on_s": round(float(np.median(on_ts)), 4),
+        "telemetry_overhead_pct": round(med_delta, 3),
+        "telemetry_overhead_noise_pct": round(
+            float(np.median(np.abs(np.asarray(deltas) - med_delta))), 3
+        ),
+    }
+
+
 # ---------------------------------------------------------------------- runner
 
 # ordered so the cheap families land before the O(n*nq) kNN/ANN scans: on the
@@ -1018,6 +1107,7 @@ FAMILIES: List = [
     ("dbscan", bench_dbscan),
     ("fit_e2e", bench_fit_e2e),
     ("cache", bench_cache),
+    ("telemetry_overhead", bench_telemetry_overhead),
     ("knn", bench_knn),
     ("ann", bench_ann),
 ]
@@ -1047,4 +1137,9 @@ def make_ctx(X, w, mesh, on_tpu: bool, platform: str, repo_root: str) -> Dict:
         "dbscan_shape": (200_000, 32) if big else (5_000, 8),
         "e2e_shape": (2_000_000, 256) if big else (50_000, 32),
         "cache_shape": (2_000_000, 128) if big else (60_000, 32),
+        # sized so one fit runs long enough (~0.5 s on the CPU fallback) for
+        # the ON/OFF delta to clear scheduler noise, while batches stay small
+        # enough that per-batch telemetry writes are still the dominant cost
+        # the scenario is probing (worst case for the plane)
+        "telemetry_shape": (400_000, 64) if big else (96_000, 32),
     }

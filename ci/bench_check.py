@@ -48,6 +48,20 @@ _SELECT_RE = re.compile(r'\\?"(\w+)_select_s\\?"\s*:\s*([0-9]+(?:\.[0-9]+)?)')
 _MFU_RE = re.compile(
     r'\\?"(\w+_mfu)\\?"\s*:\s*([0-9]+(?:\.[0-9]+)?(?:[eE][+-]?[0-9]+)?)'
 )
+# live-telemetry overhead (`telemetry_overhead_pct`, §6g): gated against an
+# ABSOLUTE budget (default <2%), not a round-over-round ratio — the value sits
+# near zero, where ratios of two small noisy numbers are meaningless
+_OVERHEAD_RE = re.compile(
+    r'\\?"(\w+_overhead_pct)\\?"\s*:\s*(-?[0-9]+(?:\.[0-9]+)?)'
+)
+# measurement-noise companion (`*_overhead_noise_pct`, the MAD of the
+# scenario's pair deltas): when the noise floor reaches the budget the point
+# estimate carries no signal, so the check reports INCONCLUSIVE instead of
+# flagging scheduler jitter as a regression
+_OVERHEAD_NOISE_RE = re.compile(
+    r'\\?"(\w+_overhead_noise_pct)\\?"\s*:\s*(-?[0-9]+(?:\.[0-9]+)?)'
+)
+DEFAULT_OVERHEAD_BUDGET_PCT = 2.0
 _PLATFORM_RE = re.compile(r'\\?"platform\\?"\s*:\s*\\?"(\w+)\\?"')
 
 
@@ -76,6 +90,8 @@ def extract(path: str) -> Dict[str, object]:
     with open(path) as f:
         raw = f.read()
     scenarios: Dict[str, float] = {}
+    overheads: Dict[str, float] = {}
+    overhead_noise: Dict[str, float] = {}
     platform: Optional[str] = None
     try:
         doc = json.loads(raw)
@@ -90,6 +106,10 @@ def extract(path: str) -> Dict[str, object]:
             scenarios[k[: -len("_s")]] = float(v)
         elif k.endswith("_mfu") and isinstance(v, (int, float)):
             scenarios[k] = float(v)  # keeps the _mfu suffix: direction marker
+        elif k.endswith("_overhead_noise_pct") and isinstance(v, (int, float)):
+            overhead_noise[k[: -len("_noise_pct")] + "_pct"] = float(v)
+        elif k.endswith("_overhead_pct") and isinstance(v, (int, float)):
+            overheads[k] = float(v)  # absolute-budget check, never a ratio
     if isinstance(secondary.get("platform"), str):
         platform = secondary["platform"]
     # fall back to regex over DECODED text: inside the artifact the bench line
@@ -107,6 +127,10 @@ def extract(path: str) -> Dict[str, object]:
             scenarios[f"{name}_select"] = float(secs)
         for name, v in _MFU_RE.findall(text):
             scenarios[name] = float(v)
+        for name, v in _OVERHEAD_NOISE_RE.findall(text):
+            overhead_noise[name[: -len("_noise_pct")] + "_pct"] = float(v)
+        for name, v in _OVERHEAD_RE.findall(text):
+            overheads[name] = float(v)
     if platform is None:
         for text in texts:
             m = _PLATFORM_RE.findall(text)
@@ -118,6 +142,8 @@ def extract(path: str) -> Dict[str, object]:
         "name": os.path.basename(path),
         "platform": platform,
         "scenarios": scenarios,
+        "overheads": overheads,
+        "overhead_noise": overhead_noise,
     }
 
 
@@ -161,16 +187,74 @@ def render_table(rows: List[Dict[str, object]]) -> str:
     return "\n".join(lines)
 
 
+def check_overheads(artifacts: List[Dict[str, object]],
+                    advisory: bool = False) -> int:
+    """Absolute-budget check for `*_overhead_pct` keys (live-telemetry plane,
+    §6g): the NEWEST artifact carrying one is held to the budget (default
+    <2%, env SRML_TELEMETRY_OVERHEAD_MAX). One artifact suffices — this is a
+    contract check, not a round-over-round comparison."""
+    budget = float(os.environ.get(
+        "SRML_TELEMETRY_OVERHEAD_MAX", str(DEFAULT_OVERHEAD_BUDGET_PCT)
+    ))
+    with_overhead = [a for a in artifacts if a.get("overheads")]
+    if not with_overhead:
+        return 0
+    newest = with_overhead[-1]
+    noise_by_key = newest.get("overhead_noise") or {}
+    n_over = 0
+    for name, pct in sorted(newest["overheads"].items()):  # type: ignore[union-attr]
+        noise = noise_by_key.get(name)  # type: ignore[union-attr]
+        if noise is not None and noise >= budget:
+            # the noise floor reached the budget: the point estimate is
+            # scheduler jitter, not signal — report, don't judge
+            print(
+                f"bench_check: {name} = {pct:.2f}% "
+                f"(budget {budget:.1f}%, noise ±{noise:.2f}%, {newest['name']})"
+                "  INCONCLUSIVE (measurement noise >= budget)"
+            )
+            continue
+        over = pct > budget
+        n_over += int(over)
+        print(
+            f"bench_check: {name} = {pct:.2f}% "
+            f"(budget {budget:.1f}%, {newest['name']})"
+            + ("  OVER BUDGET" if over else "  ok")
+        )
+    if n_over and advisory:
+        print(
+            f"bench_check: ADVISORY — {n_over} overhead key(s) over budget; "
+            "not failing (SRML_BENCH_CHECK_ADVISORY=1; set 0 to enforce)"
+        )
+        return 0
+    return n_over
+
+
+def _verdict(overhead_failures: int) -> int:
+    """Final exit verdict for paths that skipped the wall-time comparison:
+    the log's LAST line must agree with the exit code, so an overhead failure
+    reported pages earlier by check_overheads is restated here."""
+    if overhead_failures:
+        print(
+            f"bench_check: FAIL — {overhead_failures} telemetry-overhead "
+            "key(s) over budget (see overhead lines above)"
+        )
+        return 1
+    print("bench_check: OK")
+    return 0
+
+
 def check(root: str, threshold: float = DEFAULT_THRESHOLD,
           advisory: bool = False) -> int:
     artifacts = [extract(p) for p in discover(root)]
+    overhead_failures = check_overheads(artifacts, advisory=advisory)
     artifacts = [a for a in artifacts if a["scenarios"]]
     if len(artifacts) < 2:
         print(
             "bench_check: fewer than two bench artifacts carry per-scenario "
-            f"wall times ({len(artifacts)} found) — nothing to compare, passing."
+            f"wall times ({len(artifacts)} found) — skipping wall-time "
+            "comparison."
         )
-        return 0
+        return _verdict(overhead_failures)
     old, new = artifacts[-2], artifacts[-1]
     print(
         f"bench_check: comparing {old['name']} (platform={old['platform']}) "
@@ -180,15 +264,16 @@ def check(root: str, threshold: float = DEFAULT_THRESHOLD,
     if old["platform"] != new["platform"]:
         print(
             "bench_check: platform mismatch — wall times are not comparable "
-            "across backends (tunnel health, not code); passing."
+            "across backends (tunnel health, not code); skipping wall-time "
+            "comparison."
         )
-        return 0
+        return _verdict(overhead_failures)
     rows = compare(old, new, threshold)
     print(render_table(rows))
     regressed = [r for r in rows if r["verdict"] == "REGRESSED"]
     if not regressed:
-        print("bench_check: OK — no scenario regressed beyond the threshold")
-        return 0
+        print("bench_check: no scenario regressed beyond the threshold")
+        return _verdict(overhead_failures)
     names = ", ".join(r["scenario"] for r in regressed)
     if advisory:
         print(
@@ -196,7 +281,7 @@ def check(root: str, threshold: float = DEFAULT_THRESHOLD,
             f">{threshold:.0%} ({names}); not failing "
             "(SRML_BENCH_CHECK_ADVISORY=1; set 0 to enforce)"
         )
-        return 0
+        return 0  # advisory covers overhead failures too (already reported)
     print(
         f"bench_check: FAIL — {len(regressed)} scenario(s) regressed "
         f">{threshold:.0%}: {names}"

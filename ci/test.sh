@@ -136,7 +136,100 @@ assert len(load_run_reports(d)) >= 1  # fit report exported too
 print("INFERENCE SMOKE OK: both JSONLs exported; sentinel fires only on ragged")
 PY
   rm -rf "$SRML_OBS_SMOKE_DIR"
-  python -m pytest tests/ -q --ignore=tests/test_reliability.py --ignore=tests/test_device_cache.py --ignore=tests/test_observability.py --ignore=tests/test_transform_observability.py
+  # live-telemetry smoke (docs/design.md §6g): a streamed KMeans fit with an
+  # injected DeviceError at a late ingest batch. A poller thread scrapes
+  # /metrics and /runs/<id> MID-FIT (batch progress strictly advancing, valid
+  # Prometheus exposition), the fault drives the device->CPU degradation rung,
+  # and the flight recorder's postmortem bundle must exist, round-trip through
+  # json.loads, and carry the fault + degrade events in its ring — with zero
+  # server threads or sockets left after fit returns.
+  python -m pytest tests/test_telemetry_plane.py -q
+  SRML_TELEM_SMOKE_DIR="$(mktemp -d)"
+  SRML_TPU_METRICS_DIR="$SRML_TELEM_SMOKE_DIR" \
+  SRML_TPU_METRICS_PORT=0 \
+  SRML_TPU_STREAM_THRESHOLD_BYTES=1024 SRML_TPU_STREAM_BATCH_ROWS=16 \
+  SRML_TPU_FAULT_SPEC="ingest:batch=100:raise=DeviceError" \
+  python - <<'PY'
+import json, os, threading, time, urllib.request
+import numpy as np, pandas as pd
+from spark_rapids_ml_tpu.clustering import KMeans
+from spark_rapids_ml_tpu.observability import server
+
+samples, metrics_texts, run_ids = [], [], []
+stop = threading.Event()
+
+def poll():
+    # wait for the fit to open the endpoint, then scrape until it closes
+    while not stop.is_set():
+        addr = server.server_address()
+        if addr is None:
+            time.sleep(0.002)
+            continue
+        port = addr[1]
+        try:
+            idx = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/runs", timeout=2).read())
+            if not idx["runs"]:
+                continue
+            rid = idx["runs"][0]["run_id"]
+            run_ids.append(rid)
+            view = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/runs/{rid}", timeout=2).read())
+            prog = view.get("progress", {}).get("kmeans.batches")
+            if prog:
+                samples.append(prog["done"])
+            # scrape /metrics only once the progress gauge exists, so the
+            # exposition check can require the fit_progress series
+            if samples and len(metrics_texts) < 3:
+                metrics_texts.append(urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics", timeout=2
+                ).read().decode())
+        except OSError:
+            pass  # server closing between scrapes: the fit just ended
+
+poller = threading.Thread(target=poll, daemon=True)
+poller.start()
+rng = np.random.default_rng(0)
+X = np.concatenate(
+    [rng.normal(-3, 1, (1000, 8)), rng.normal(3, 1, (1000, 8))]
+).astype(np.float32)
+model = KMeans(k=2, maxIter=6, seed=5).fit(pd.DataFrame({"features": list(X)}))
+stop.set(); poller.join(timeout=10)
+
+rep = model.fit_report_
+assert rep["status"] == "ok", rep["status"]  # CPU rung absorbed the fault
+# mid-fit scrapes: progress gauge strictly advancing across distinct samples
+distinct = [s for i, s in enumerate(samples) if i == 0 or s != samples[i - 1]]
+assert len(distinct) >= 2, f"too few mid-fit progress samples: {samples}"
+assert distinct == sorted(distinct), distinct
+assert all(r == rep["run_id"] for r in run_ids)
+# /metrics served valid exposition mid-fit: every line is `name{...} value`
+assert metrics_texts, "no /metrics scrape landed mid-fit"
+for text in metrics_texts:
+    assert "srml_tpu_fit_progress" in text
+    for ln in text.splitlines():
+        if ln.startswith("#") or not ln:
+            continue
+        float(ln.rsplit(" ", 1)[1])  # value parses
+# postmortem bundle: exists, round-trips, ring holds the fault + degrade
+d = os.environ["SRML_TPU_METRICS_DIR"]
+bundles = [p for p in os.listdir(d) if p.startswith("postmortem_")]
+assert len(bundles) == 1, bundles
+with open(os.path.join(d, bundles[0])) as f:
+    doc = json.loads(f.read())
+assert doc["run_id"] == rep["run_id"], (doc["run_id"], rep["run_id"])
+kinds = [e["kind"] for e in doc["ring"]]
+assert "fault" in kinds, kinds
+assert any(e["kind"] == "degrade" and e.get("rung") == "device_to_cpu"
+           for e in doc["ring"]), kinds
+# zero leaked server threads/sockets after fit returned
+assert server.server_address() is None
+assert not any(t.name == "srml-telemetry-server" for t in threading.enumerate())
+print(f"LIVE TELEMETRY SMOKE OK: {len(distinct)} advancing progress samples, "
+      "valid /metrics mid-fit, postmortem carries fault+degrade, no leaks")
+PY
+  rm -rf "$SRML_TELEM_SMOKE_DIR"
+  python -m pytest tests/ -q --ignore=tests/test_reliability.py --ignore=tests/test_device_cache.py --ignore=tests/test_observability.py --ignore=tests/test_transform_observability.py --ignore=tests/test_telemetry_plane.py
 fi
 
 # small benchmark smoke (reference runs a small bench pre-merge)
@@ -152,7 +245,7 @@ SRML_DEVICE_SMOKE_DIR="$(mktemp -d)"
 SRML_BENCH_ROLE=worker \
 SRML_BENCH_PROGRESS="$SRML_DEVICE_SMOKE_DIR/progress.jsonl" \
 SRML_BENCH_DEADLINE_TS="$(python -c 'import time; print(time.time() + 600)')" \
-SRML_BENCH_SKIP="kmeans_headline,logreg,linreg,rf,umap,dbscan,fit_e2e,cache,knn,ann,wide256" \
+SRML_BENCH_SKIP="kmeans_headline,logreg,linreg,rf,umap,dbscan,fit_e2e,cache,telemetry_overhead,knn,ann,wide256" \
 python bench.py
 SRML_BENCH_PROGRESS="$SRML_DEVICE_SMOKE_DIR/progress.jsonl" python - <<'PY'
 import json, os, sys

@@ -122,6 +122,26 @@ _DEFAULTS: Dict[str, Any] = {
     # first post-compile steady-state pass); one capture per site per process
     "observability.profile_dir": None,
     "observability.profile_pass": 2,
+    # live telemetry plane (observability/server.py, docs/design.md §6g):
+    # opt-in driver-resident HTTP endpoint serving /metrics (Prometheus pull),
+    # /healthz and /runs[/<run_id>] (live JSON view of open runs). None = no
+    # server thread is ever started; 0 = bind an ephemeral port (exposed via
+    # observability.server.server_address()); the server runs only while at
+    # least one run scope is open (or start_metrics_server() pins it)
+    "observability.http_port": None,
+    # bind host for the telemetry endpoint. Default loopback: the endpoint is
+    # unauthenticated, so exposing it beyond the driver host is an explicit
+    # operator decision ("0.0.0.0" for cluster-visible scraping)
+    "observability.http_host": "127.0.0.1",
+    # failure flight recorder (observability/flight.py): bounded per-process
+    # ring buffer of recent span opens/closes, events, HBM samples and
+    # retry/fault/degrade transitions, dumped as postmortem_<run_id>.json on
+    # unhandled fit/transform failure or degradation-ladder entry; <=0 disables
+    "observability.flight_recorder_events": 256,
+    # per-run cap on streamed-fit convergence records (kmeans inertia/shift,
+    # logreg/linreg loss/grad-norm per iteration) kept in the run and exported
+    # in the report's `convergence` section; overflow is counted, not kept
+    "observability.max_convergence_records": 512,
 }
 
 _ENV_KEYS: Dict[str, str] = {
@@ -164,6 +184,10 @@ _ENV_KEYS: Dict[str, str] = {
     "observability.peak_bw": "SRML_TPU_PEAK_BW",
     "observability.profile_dir": "SRML_TPU_PROFILE_DIR",
     "observability.profile_pass": "SRML_TPU_PROFILE_PASS",
+    "observability.http_port": "SRML_TPU_METRICS_PORT",
+    "observability.http_host": "SRML_TPU_METRICS_HOST",
+    "observability.flight_recorder_events": "SRML_TPU_FLIGHT_RECORDER_EVENTS",
+    "observability.max_convergence_records": "SRML_TPU_MAX_CONVERGENCE_RECORDS",
 }
 
 _overrides: Dict[str, Any] = {}
@@ -173,7 +197,7 @@ def _coerce(key: str, raw: str) -> Any:
     default = _DEFAULTS[key]
     if isinstance(default, bool) or key in ("fallback.enabled", "float32_inputs", "verbose"):
         return raw.strip().lower() in ("1", "true", "yes", "on")
-    if isinstance(default, int) or key == "num_workers":
+    if isinstance(default, int) or key in ("num_workers", "observability.http_port"):
         return int(raw)
     if isinstance(default, float) or key == "reliability.deadline_s":
         return float(raw)

@@ -491,11 +491,18 @@ class _TpuEstimator(_TpuCaller):
                 ):
                     raise
                 profiling.count("reliability.degrade.barrier_to_collect")
-                from ..observability import event as _obs_event
+                from ..observability import current_run, event as _obs_event
+                from ..observability.flight import dump_postmortem
 
                 _obs_event(
                     "degrade", rung="barrier_to_collect",
                     error=type(e).__name__,
+                )
+                # degradation-ladder entry is a reliability incident: dump the
+                # flight-recorder bundle now, while the ring still holds the
+                # failure's trail (observability/flight.py; never raises)
+                dump_postmortem(
+                    current_run(), reason="degrade:barrier_to_collect"
                 )
                 self.logger.warning(
                     "barrier fit plane failed (%s: %s); degrading to collect "
@@ -525,9 +532,12 @@ class _TpuEstimator(_TpuCaller):
             ):
                 raise
             profiling.count("reliability.degrade.device_to_cpu")
-            from ..observability import event as _obs_event
+            from ..observability import current_run, event as _obs_event
+            from ..observability.flight import dump_postmortem
 
             _obs_event("degrade", rung="device_to_cpu", error=type(e).__name__)
+            # same forensics contract as the barrier→collect rung (§6g)
+            dump_postmortem(current_run(), reason="degrade:device_to_cpu")
             self.logger.warning(
                 "unrecoverable device error (%s: %s); degrading to the CPU "
                 "fallback path (config fallback.enabled)",

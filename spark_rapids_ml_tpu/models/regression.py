@@ -308,7 +308,7 @@ class LinearRegression(_LinearRegressionClass, _TpuEstimatorSupervised, _LinearR
             mesh=mesh,
             float32=self._float32_inputs,
         )
-        return solve_from_stats(
+        attrs = solve_from_stats(
             A, b, xbar, ybar, sw,
             reg=float(p["alpha"]),
             l1_ratio=float(p["l1_ratio"]),
@@ -317,6 +317,23 @@ class LinearRegression(_LinearRegressionClass, _TpuEstimatorSupervised, _LinearR
             max_iter=int(p["max_iter"]),
             tol=float(p["tol"]),
         )[0]
+        # live telemetry (§6g): one convergence record for the streamed linreg —
+        # the unpenalized normal-equation residual ‖(Aβ + c·Σwx − b)/Σw‖ is the
+        # squared-loss gradient norm at the solution (≈0 for an exact l2 solve,
+        # the leftover prox residual for elastic net)
+        from ..observability import convergence as obs_convergence
+
+        coef = np.asarray(attrs["coefficients"], np.float64)
+        grad = (
+            np.asarray(A, np.float64) @ coef
+            + float(attrs["intercept"]) * np.asarray(xbar, np.float64) * float(sw)
+            - np.asarray(b, np.float64)
+        ) / float(sw)
+        obs_convergence(
+            "linreg", attrs.get("n_iter", 1),
+            grad_norm=float(np.linalg.norm(grad)),
+        )
+        return attrs
 
     def _fit_fallback_model(self, twin: type, fd) -> Dict[str, Any]:
         X = densify(fd.features, float32=self._float32_inputs)

@@ -6,11 +6,15 @@
 # this package; new instrumentation should import from here directly.
 #
 #   registry.py   Counter / Gauge / Histogram (+ quantile) / MetricsRegistry
-#   runs.py       write fan-out, structured spans, events, FitRun, worker_scope
+#   runs.py       write fan-out, structured spans, events, FitRun, worker_scope,
+#                 live progress gauges + convergence records
 #   inference.py  TransformRun, predict_dispatch, shape buckets + sentinel
 #   export.py     JSONL run/transform reports (rotating) + Prometheus textfile
 #   device.py     compiled_kernel cost/memory-analysis capture, HBM telemetry,
 #                 roofline span attribution, compile accounting, profiler hook
+#   server.py     opt-in live HTTP endpoint: /metrics, /healthz, /runs[/<id>]
+#   flight.py     failure flight recorder: bounded ring buffer + postmortem
+#                 bundles (postmortem_<run_id>.json)
 #
 
 from .registry import (
@@ -27,7 +31,9 @@ from .runs import (
     PROCESS_TOKEN,
     FitRun,
     WorkerScope,
+    active_runs,
     add_span_total,
+    convergence,
     counter_inc,
     current_run,
     event,
@@ -39,6 +45,7 @@ from .runs import (
     global_registry,
     legacy_count,
     observe,
+    progress,
     span,
     worker_scope,
 )
@@ -69,6 +76,16 @@ from .device import (
     sample_hbm,
     scenario_summary,
 )
+from .server import (
+    server_address,
+    start_metrics_server,
+    stop_metrics_server,
+)
+from .flight import (
+    dump_postmortem,
+    load_postmortem,
+    reset_flight_recorder,
+)
 
 __all__ = [
     "DEFAULT_TIME_BUCKETS",
@@ -82,7 +99,9 @@ __all__ = [
     "PROCESS_TOKEN",
     "FitRun",
     "WorkerScope",
+    "active_runs",
     "add_span_total",
+    "convergence",
     "counter_inc",
     "current_run",
     "event",
@@ -94,6 +113,7 @@ __all__ = [
     "global_registry",
     "legacy_count",
     "observe",
+    "progress",
     "span",
     "worker_scope",
     "TransformRun",
@@ -117,4 +137,10 @@ __all__ = [
     "profile_pass",
     "sample_hbm",
     "scenario_summary",
+    "server_address",
+    "start_metrics_server",
+    "stop_metrics_server",
+    "dump_postmortem",
+    "load_postmortem",
+    "reset_flight_recorder",
 ]

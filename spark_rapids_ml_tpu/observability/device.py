@@ -704,6 +704,7 @@ def sample_hbm(force: bool = False) -> Optional[int]:
     _hbm_supported = True
     total = sum(totals)
     _runs.gauge_set("device.hbm_bytes_in_use", total)
+    _runs._flight().note_hbm(total)
     with _lock:
         for run_id, peak in list(_run_peaks.items()):
             if total > peak:

@@ -64,7 +64,10 @@ def _rotate_if_needed(path: str) -> None:
 
 def _rotated_paths(path: str) -> List[str]:
     """All report files for `path`, OLDEST FIRST (…, .2, .1, live) — the order
-    that keeps loaded reports chronological across rotations."""
+    that keeps loaded reports chronological across rotations. Generations sort
+    NUMERICALLY (int suffix), never as path strings: past 9 rotated files a
+    lexicographic sort would interleave `.10` before `.2` and shuffle report
+    order (regression-pinned by the >9-generation round-trip test)."""
     suffixes = []
     d, base = os.path.split(path)
     prefix = base + "."
@@ -181,8 +184,26 @@ def _prom_name(name: str) -> str:
     return _PROM_PREFIX + _NAME_OK.sub("_", name)
 
 
+def _prom_escape(value: Any) -> str:
+    """Prometheus text-format label-VALUE escaping: backslash, double quote and
+    newline are the three characters with structural meaning inside a quoted
+    label value (exposition format spec). Raw interpolation corrupted the whole
+    exposition when a model name or path carried any of them — one bad label
+    broke every later line for the scraper. Backslash first, or the other two
+    escapes would be double-escaped."""
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
 def _prom_labels(labels: Mapping[str, str], extra: Optional[str] = None) -> str:
-    parts = [f'{_NAME_OK.sub("_", k)}="{v}"' for k, v in sorted(labels.items())]
+    parts = [
+        f'{_NAME_OK.sub("_", k)}="{_prom_escape(v)}"'
+        for k, v in sorted(labels.items())
+    ]
     if extra:
         parts.append(extra)
     return "{" + ",".join(parts) + "}" if parts else ""

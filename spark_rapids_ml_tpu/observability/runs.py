@@ -74,6 +74,34 @@ def _device():
     return _device_mod
 
 
+_flight_mod = None
+
+
+def _flight():
+    """Lazy flight-recorder import (observability/flight.py imports this module
+    inside dump_postmortem; same cycle-breaking as _device)."""
+    global _flight_mod
+    if _flight_mod is None:
+        from . import flight as fl
+
+        _flight_mod = fl
+    return _flight_mod
+
+
+_server_mod = None
+
+
+def _server():
+    """Lazy telemetry-server import (observability/server.py reads run state
+    from this module at request time)."""
+    global _server_mod
+    if _server_mod is None:
+        from . import server as srv
+
+        _server_mod = srv
+    return _server_mod
+
+
 def _worker_scopes() -> List["WorkerScope"]:
     scopes = getattr(_tls, "worker_scopes", None)
     if scopes is None:
@@ -142,22 +170,73 @@ def add_span_total(name: str, seconds: float) -> None:
 
 def event(kind: str, **fields: Any) -> None:
     """Append a structured event (retry, fault, cache_evict, degrade, ...) to
-    every open FitRun and this thread's worker scopes. No-op otherwise — events
-    have no meaning outside a run context."""
-    entry: Optional[Dict[str, Any]] = None
-    stack = _span_stack()
+    every open FitRun, this thread's worker scopes, AND the process flight
+    recorder (observability/flight.py) — the ring buffer is exactly the place
+    an event fired outside any run context still matters (postmortems)."""
     with _state_lock:
         targets: List[Any] = list(_active_runs)
     targets.extend(_worker_scopes())
+    fl = _flight()
+    if not targets and not fl.enabled():
+        return  # no sink anywhere: skip building the entry entirely
+    stack = _span_stack()
+    entry = {
+        "ts": round(time.time(), 6),
+        "kind": kind,
+        "span_id": stack[-1].span_id if stack else None,
+        **fields,
+    }
     for t in targets:
-        if entry is None:
-            entry = {
-                "ts": round(time.time(), 6),
-                "kind": kind,
-                "span_id": stack[-1].span_id if stack else None,
-                **fields,
-            }
         t.add_event(entry)
+    fl.note_event(entry)
+
+
+# ------------------------------------------------------ progress & convergence
+
+
+def progress(phase: str, done: Any, total: Any = None,
+             unit: str = "units") -> None:
+    """Publish live fit progress: gauges `fit.progress{phase=}` /
+    `fit.progress_total{phase=}` / `fit.eta_s{phase=}` through the normal
+    fan-out (global registry + open runs + worker scopes), plus a structured
+    per-phase record on every open run (EMA-rate ETA) that /runs/<id> serves
+    mid-fit (observability/server.py). Streamed-fit loops call this per pass
+    and per batch (ops/streaming.py, ops/pairwise_streaming.py)."""
+    done = int(done)
+    gauge_set("fit.progress", done, phase=phase)
+    if total is not None:
+        gauge_set("fit.progress_total", int(total), phase=phase)
+    with _state_lock:
+        runs = list(_active_runs)
+    eta = None
+    for run in runs:
+        e = run.note_progress(phase, done, total, unit)
+        if e is not None:
+            eta = e  # innermost (most recently opened) run's estimate wins
+    if eta is not None:
+        gauge_set("fit.eta_s", round(float(eta), 3), phase=phase)
+
+
+def convergence(algo: str, iteration: Any, **fields: Any) -> None:
+    """Append one per-iteration convergence record (KMeans inertia + center
+    shift, logreg/linreg loss + grad norm, ...) to every open run — exported in
+    the report's `convergence` section and visible mid-fit via /runs/<id>.
+    Numeric fields coerce to plain floats so records stay JSON-clean."""
+    rec: Dict[str, Any] = {
+        "ts": round(time.time(), 6),
+        "algo": algo,
+        "iteration": int(iteration),
+    }
+    for k, v in fields.items():
+        try:
+            rec[k] = float(v)
+        except (TypeError, ValueError):
+            rec[k] = v
+    with _state_lock:
+        runs = list(_active_runs)
+    for run in runs:
+        run.note_convergence(rec)
+    _flight().note("convergence", **{k: v for k, v in rec.items() if k != "ts"})
 
 
 # ----------------------------------------------------------------- trace spans
@@ -208,6 +287,14 @@ def span(name: str, attrs: Optional[Mapping[str, Any]] = None) -> Iterator[None]
         _span_stack()[-1].span_id if _span_stack() else None
     ))
     _span_stack().append(node)
+    # open-span registration: every open run tracks the node so /runs/<id> can
+    # serve the CURRENT span stack mid-fit, and the flight recorder keeps the
+    # open in its ring (observability/server.py, observability/flight.py)
+    with _state_lock:
+        open_runs = list(_active_runs)
+    for run in open_runs:
+        run.note_span_open(node)
+    _flight().note_span_open(node)
     try:
         yield
     except BaseException:
@@ -227,6 +314,7 @@ def span(name: str, attrs: Optional[Mapping[str, Any]] = None) -> Iterator[None]
         # work attributed to this span + keep the HBM gauge fresh. Runs BEFORE
         # add_span so the stored span dicts carry the finalized attrs.
         _device().on_span_close(node)
+        _flight().note_span_close(node)
         for reg in _sink_registries():
             reg.add_span_total(name, node.duration_s)
             reg.histogram(name).observe(node.duration_s, status=node.status)
@@ -298,6 +386,17 @@ class FitRun:
         self.max_events = max(self.max_spans, 1024)
         self._dropped_events = 0
         self._workers: List[Dict[str, Any]] = []
+        # live-telemetry state (docs/design.md §6g): the open-span stack the
+        # /runs/<id> endpoint serves mid-run, per-phase progress with EMA ETA,
+        # and the bounded per-iteration convergence record list
+        self._open_spans: Dict[int, Dict[str, Any]] = {}
+        self._progress: Dict[str, Dict[str, Any]] = {}
+        self._convergence: List[Dict[str, Any]] = []
+        self.max_convergence = max(
+            0, int(_config.get("observability.max_convergence_records"))
+        )
+        self._dropped_convergence = 0
+        self._orphan_snapshots = 0
         self.started_ts: Optional[float] = None
         self.duration_s: Optional[float] = None
         self.status = "ok"
@@ -306,8 +405,20 @@ class FitRun:
 
     # ---- sink surface (runs.py fan-out calls these) ----
 
+    def note_span_open(self, node: SpanNode) -> None:
+        with self._lock:
+            if len(self._open_spans) < self.max_spans:
+                self._open_spans[node.span_id] = {
+                    "span_id": node.span_id,
+                    "parent_id": node.parent_id,
+                    "name": node.name,
+                    "start_ts": round(node.start_ts, 6),
+                    "thread": node.thread,
+                }
+
     def add_span(self, node: SpanNode) -> None:
         with self._lock:
+            self._open_spans.pop(node.span_id, None)
             if len(self._spans) >= self.max_spans:
                 self._dropped_spans += 1
                 return
@@ -320,6 +431,94 @@ class FitRun:
                 return
             self._events.append(entry)
 
+    # ---- live progress & convergence (runs.progress / runs.convergence) ----
+
+    def note_progress(self, phase: str, done: int, total: Optional[int],
+                      unit: str) -> Optional[float]:
+        """Fold one progress observation into the per-phase record; returns the
+        EMA-based ETA in seconds (None until a rate is established). The EMA
+        smooths per-unit rate over updates (alpha 0.3) so the ETA tracks the
+        steady-state pass rate instead of the compile-heavy first pass."""
+        now = time.monotonic()
+        with self._lock:
+            st = self._progress.get(phase)
+            if st is None:
+                st = self._progress[phase] = {
+                    "phase": phase, "done": 0, "total": None, "unit": unit,
+                    "ema_rate": None, "eta_s": None, "updated_ts": None,
+                    "_t": now,
+                }
+            delta = done - st["done"]
+            dt = now - st["_t"]
+            if delta > 0 and dt > 0:
+                rate = delta / dt
+                st["ema_rate"] = (
+                    rate if st["ema_rate"] is None
+                    else 0.3 * rate + 0.7 * st["ema_rate"]
+                )
+            st["done"] = done
+            if total is not None:
+                st["total"] = int(total)
+            st["unit"] = unit
+            st["_t"] = now
+            st["updated_ts"] = round(time.time(), 6)
+            if st["total"] and st["ema_rate"]:
+                st["eta_s"] = round(
+                    max(st["total"] - done, 0) / st["ema_rate"], 3
+                )
+            return st["eta_s"]
+
+    def note_convergence(self, rec: Dict[str, Any]) -> None:
+        with self._lock:
+            if len(self._convergence) >= self.max_convergence:
+                self._dropped_convergence += 1
+                return
+            self._convergence.append(rec)
+
+    def progress_snapshot(self) -> Dict[str, Dict[str, Any]]:
+        with self._lock:
+            return {
+                phase: {k: v for k, v in st.items() if not k.startswith("_")}
+                for phase, st in self._progress.items()
+            }
+
+    def live_view(self, summary: bool = False) -> Dict[str, Any]:
+        """The /runs JSON surface: a mid-run view (observability/server.py).
+        `summary` yields the /runs index row; the full view adds the open-span
+        stack, convergence/event tails, and a full metrics snapshot."""
+        base = {
+            "run_id": self.run_id,
+            "kind": self.kind,
+            "algo": self.algo,
+            "site": self.site,
+            "status": self.status,
+            "process": PROCESS_TOKEN,
+            "started_ts": self.started_ts,
+            "duration_s": (
+                round(time.perf_counter() - self._t0, 6)
+                if self._t0 is not None and self.duration_s is None
+                else self.duration_s
+            ),
+            "progress": self.progress_snapshot(),
+        }
+        if summary:
+            return base
+        with self._lock:
+            open_spans = sorted(
+                self._open_spans.values(), key=lambda s: s["span_id"]
+            )
+            convergence = list(self._convergence[-64:])
+            events_tail = list(self._events[-64:])
+            n_workers = len(self._workers)
+        base.update(
+            open_spans=open_spans,
+            convergence=convergence,
+            events_tail=events_tail,
+            workers=n_workers,
+            metrics=self.registry.snapshot(),
+        )
+        return base
+
     # ---- worker aggregation (spark/integration.py) ----
 
     def add_worker_snapshot(self, worker: Mapping[str, Any]) -> None:
@@ -327,19 +526,35 @@ class FitRun:
         process snapshots merge into the run AND global registries (their
         counters never flowed through this process's fan-out); same-process
         snapshots (threaded local-mode harness) are recorded for the per-worker
-        breakdown only — their writes already landed here live."""
+        breakdown only — their writes already landed here live.
+
+        Trace context (§6g): snapshots stamped with a `run_id` join on it — a
+        snapshot carrying a DIFFERENT run's id is an ORPHAN (a stale sidecar
+        replay, a crossed wire in a shared executor): it is recorded for
+        forensics but its counters are NOT merged, and
+        `observability.orphan_snapshots` counts it. Legacy snapshots without a
+        run_id keep the old process-token-only semantics."""
+        snap_run_id = worker.get("run_id")
+        orphan = snap_run_id is not None and snap_run_id != self.run_id
         foreign = worker.get("process") != PROCESS_TOKEN
         with self._lock:
             self._workers.append(
                 {
                     "rank": worker.get("rank"),
                     "process": worker.get("process"),
-                    "merged": foreign,
+                    "run_id": snap_run_id,
+                    "orphan": orphan,
+                    "merged": foreign and not orphan,
                     "metrics": worker.get("metrics") or {},
                     "events": worker.get("events") or [],
                     "spans": worker.get("spans") or [],
                 }
             )
+            if orphan:
+                self._orphan_snapshots += 1
+        if orphan:
+            counter_inc("observability.orphan_snapshots", 1, run=self.run_id)
+            return
         if foreign:
             snap = worker.get("metrics") or {}
             self.registry.merge_snapshot(snap)
@@ -359,6 +574,12 @@ class FitRun:
         with _state_lock:
             _active_runs.append(self)
         _device().note_run_start(self)
+        try:
+            # live telemetry endpoint (observability/server.py): held up by
+            # refcount while any run is open; no-op when http_port is unset
+            _server().on_run_start(self)
+        except Exception as e:
+            _logger.warning("telemetry endpoint start failed: %s", e)
         self._root.__enter__()
         return self
 
@@ -375,16 +596,33 @@ class FitRun:
             self.duration_s = time.perf_counter() - (self._t0 or time.perf_counter())
             if exc_type is not None:
                 self.status = "error"
-            metrics_dir = _config.get("observability.metrics_dir")
-            if metrics_dir:
-                from .export import write_run_report
+                # failure flight recorder (observability/flight.py): an
+                # unhandled fit/transform failure dumps the postmortem bundle
+                # next to the JSONL reports; never raises
+                _flight().dump_postmortem(
+                    self, reason=f"{self.kind}_error:{exc_type.__name__}"
+                )
+            try:
+                metrics_dir = _config.get("observability.metrics_dir")
+                if metrics_dir:
+                    from .export import write_run_report
 
+                    try:
+                        write_run_report(
+                            self.report(), metrics_dir,
+                            filename=self._report_filename,
+                        )
+                    except OSError as e:
+                        _logger.warning(
+                            "could not write %s report: %s", self.kind, e
+                        )
+            finally:
+                # endpoint release must never be skipped — a leaked refcount
+                # would leave the server thread and socket alive after fit
                 try:
-                    write_run_report(
-                        self.report(), metrics_dir, filename=self._report_filename
-                    )
-                except OSError as e:
-                    _logger.warning("could not write %s report: %s", self.kind, e)
+                    _server().on_run_end(self)
+                except Exception as e:
+                    _logger.warning("telemetry endpoint release failed: %s", e)
 
     def report(self) -> Dict[str, Any]:
         """The structured fit report (finalized numbers after __exit__; callable
@@ -397,6 +635,9 @@ class FitRun:
             ]
             dropped = self._dropped_spans
             dropped_events = self._dropped_events
+            convergence = list(self._convergence)
+            dropped_convergence = self._dropped_convergence
+            orphans = self._orphan_snapshots
         device_section = _device().device_report_section(self.registry)
         return {
             **({"device": device_section} if device_section else {}),
@@ -417,6 +658,10 @@ class FitRun:
             "dropped_spans": dropped,
             "events": events,
             "dropped_events": dropped_events,
+            "convergence": convergence,
+            "dropped_convergence": dropped_convergence,
+            "progress": self.progress_snapshot(),
+            "orphan_snapshots": orphans,
             "metrics": self.registry.snapshot(),
             "workers": workers,
         }
@@ -426,6 +671,12 @@ def current_run() -> Optional[FitRun]:
     """The most recently opened still-active FitRun, if any."""
     with _state_lock:
         return _active_runs[-1] if _active_runs else None
+
+
+def active_runs() -> List[FitRun]:
+    """All currently-open run scopes, oldest first (the /runs index)."""
+    with _state_lock:
+        return list(_active_runs)
 
 
 def find_run(run_id: str) -> Optional[FitRun]:
@@ -457,11 +708,17 @@ def fit_run(algo: str, site: str = "driver") -> Iterator[Optional[FitRun]]:
 class WorkerScope:
     """One barrier task's thread-local metric delta: everything this thread
     writes while the scope is open, snapshot-able to the payload shipped to the
-    driver (spark/integration.py serializes it next to the fit result)."""
+    driver (spark/integration.py serializes it next to the fit result).
+
+    `run_id` is the TRACE CONTEXT (§6g): the driver's run id, carried through
+    the barrier/transform closure into the scope and stamped on every exported
+    snapshot, so driver-side merge and offline `load_run_reports` join
+    per-worker rows to exactly one run instead of guessing by process token."""
 
     def __init__(self, rank: Optional[int] = None, max_spans: int = 256,
-                 max_events: int = 512):
+                 max_events: int = 512, run_id: Optional[str] = None):
         self.rank = rank
+        self.run_id = run_id
         self.registry = MetricsRegistry()
         self.max_spans = max_spans
         self.max_events = max_events
@@ -491,6 +748,7 @@ class WorkerScope:
                 "schema": 1,
                 "process": PROCESS_TOKEN,
                 "rank": self.rank,
+                "run_id": self.run_id,
                 "metrics": self.registry.snapshot(),
                 "events": list(self._events),
                 "dropped_events": self._dropped_events,
@@ -500,11 +758,13 @@ class WorkerScope:
 
 
 @contextlib.contextmanager
-def worker_scope(rank: Optional[int] = None) -> Iterator[WorkerScope]:
+def worker_scope(rank: Optional[int] = None,
+                 run_id: Optional[str] = None) -> Iterator[WorkerScope]:
     """Open a thread-local capture scope (stackable; inner scopes see the same
     writes). The barrier UDF wraps its whole body in one so each task's metric
-    delta travels to the driver regardless of which process it ran in."""
-    scope = WorkerScope(rank=rank)
+    delta travels to the driver regardless of which process it ran in;
+    `run_id` stamps the driver's trace context on the exported snapshot."""
+    scope = WorkerScope(rank=rank, run_id=run_id)
     _worker_scopes().append(scope)
     try:
         yield scope

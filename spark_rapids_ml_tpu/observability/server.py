@@ -1,0 +1,285 @@
+#
+# Live telemetry endpoint — the pull half of the live telemetry plane
+# (docs/design.md §6g).
+#
+# §6d's exporters are PUSH-at-close: a 30-minute streamed fit is a black box
+# until its JSONL line lands. This module adds the standard operational
+# contract a long-running ML service is expected to honor (MLlib-style
+# production deployments, arXiv:1505.06807; the Podracer architectures'
+# decoupled monitor-while-computing split, arXiv:2104.06272): a driver-resident
+# HTTP endpoint on a stdlib `http.server` daemon thread serving
+#
+#   /metrics         Prometheus text exposition of the LIVE global registry
+#   /healthz         JSON liveness (process token, uptime, open-run count)
+#   /runs            JSON index of currently-open Fit/Transform runs
+#   /runs/<run_id>   live view of one open run: open-span stack, progress
+#                    gauges (pass k/K, batches, ETA), convergence tail, event
+#                    tail, full metrics snapshot
+#
+# Opt-in and leak-free by construction: with `observability.http_port` unset
+# (`SRML_TPU_METRICS_PORT`) no thread is EVER started. When set, the server is
+# reference-counted against open run scopes — FitRun.__enter__ acquires,
+# __exit__ releases, and the socket closes with the last release — so a fit
+# that returns leaves zero threads and zero sockets behind. A serving process
+# that wants the endpoint across fits pins it with `start_metrics_server()` /
+# `stop_metrics_server()`. Port 0 binds an ephemeral port; `server_address()`
+# exposes the bound (host, port).
+#
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Optional, Tuple
+
+from .. import config as _config
+from ..utils import get_logger
+
+_logger = get_logger("observability.server")
+
+_lock = threading.RLock()
+_server: Optional["TelemetryServer"] = None
+_refs = 0  # open run scopes holding the server up
+_pinned = False  # start_metrics_server() keeps it up across runs
+
+
+def _configured_port() -> Optional[int]:
+    port = _config.get("observability.http_port")
+    if port is None or port == "":
+        return None
+    try:
+        return int(port)
+    except (TypeError, ValueError):
+        _logger.warning("invalid observability.http_port %r; endpoint disabled",
+                        port)
+        return None
+
+
+class _Handler(BaseHTTPRequestHandler):
+    """Request handler; every response is built from a snapshot taken under the
+    source's own locks, so a scrape can never observe torn state."""
+
+    server_version = "srml-tpu-telemetry/1"
+
+    # stdlib logs every request to stderr by default — a 1 s scrape interval
+    # would drown real diagnostics
+    def log_message(self, format: str, *args: Any) -> None:  # noqa: A002
+        pass
+
+    def _send(self, code: int, body: bytes, content_type: str) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        try:
+            self.wfile.write(body)
+        except (BrokenPipeError, ConnectionResetError):
+            pass  # scraper went away mid-write; nothing to clean up
+
+    def _send_json(self, doc: Any, code: int = 200) -> None:
+        from .export import _json_fallback
+
+        body = json.dumps(doc, default=_json_fallback).encode()
+        self._send(code, body, "application/json")
+
+    def do_GET(self) -> None:  # noqa: N802 — BaseHTTPRequestHandler contract
+        try:
+            path = self.path.split("?", 1)[0].rstrip("/") or "/"
+            if path == "/metrics":
+                from .export import render_prometheus
+                from .runs import global_registry
+
+                text = render_prometheus(global_registry().snapshot())
+                self._send(200, text.encode(),
+                           "text/plain; version=0.0.4; charset=utf-8")
+            elif path == "/healthz":
+                from .runs import PROCESS_TOKEN, active_runs
+
+                self._send_json({
+                    "status": "ok",
+                    "process": PROCESS_TOKEN,
+                    "uptime_s": round(
+                        time.monotonic() - self.server.started_monotonic, 3
+                    ),
+                    "open_runs": len(active_runs()),
+                })
+            elif path == "/runs":
+                from .runs import active_runs
+
+                self._send_json({
+                    "runs": [r.live_view(summary=True) for r in active_runs()]
+                })
+            elif path.startswith("/runs/"):
+                from .runs import find_run
+
+                run = find_run(path[len("/runs/"):])
+                if run is None:
+                    self._send_json({"error": "no open run with that id"}, 404)
+                else:
+                    self._send_json(run.live_view())
+            else:
+                self._send_json({"error": "unknown path", "paths": [
+                    "/metrics", "/healthz", "/runs", "/runs/<run_id>"
+                ]}, 404)
+        except Exception as e:
+            # a scrape must never take the process down; report the error to
+            # the scraper instead
+            try:
+                self._send_json(
+                    {"error": f"{type(e).__name__}: {e}"}, 500
+                )
+            except Exception:  # noqa: silent-except — socket already gone
+                pass
+
+
+class TelemetryServer:
+    """One HTTP endpoint instance: a ThreadingHTTPServer (daemon worker
+    threads) pumped by a single daemon serve_forever thread."""
+
+    def __init__(self, port: int, host: str = "127.0.0.1"):
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.daemon_threads = True
+        self._httpd.started_monotonic = time.monotonic()
+        # tight poll: shutdown() blocks until serve_forever notices, so the
+        # poll interval IS the per-fit close latency for refcounted servers —
+        # 5 ms keeps endpoint churn invisible next to any real fit
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            kwargs={"poll_interval": 0.005},
+            name="srml-telemetry-server",
+            daemon=True,
+        )
+        self._thread.start()
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self._httpd.server_address[:2]
+
+    @property
+    def port(self) -> int:
+        return int(self._httpd.server_address[1])
+
+    def close(self) -> None:
+        """Stop serving and release the socket; joins the pump thread so a
+        caller observing close() done observes the thread gone too."""
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5.0)
+
+
+# ------------------------------------------------------------ lifecycle (refs)
+
+
+def _acquire() -> Optional["TelemetryServer"]:
+    global _server
+    port = _configured_port()
+    if port is None:
+        return None
+    # loopback by default: the endpoint is unauthenticated, so serving beyond
+    # the driver host ("0.0.0.0") is an explicit operator decision
+    host = str(_config.get("observability.http_host") or "127.0.0.1")
+    with _lock:
+        if _server is None:
+            try:
+                _server = TelemetryServer(port, host=host)
+                _logger.info("telemetry endpoint listening on port %d",
+                             _server.port)
+            except OSError as e:
+                _logger.warning(
+                    "could not bind telemetry endpoint on %s:%d: %s",
+                    host, port, e,
+                )
+                return None
+        return _server
+
+
+def _release_if_unused() -> None:
+    global _server
+    with _lock:
+        if _server is not None and _refs <= 0 and not _pinned:
+            srv, _server = _server, None
+            srv.close()
+
+
+def on_run_start(run: Any) -> None:
+    """FitRun.__enter__ hook: hold the endpoint up while the run is open.
+    No-ops (and starts nothing) when observability.http_port is unset. The
+    acquisition is recorded ON the run so on_run_end releases exactly the
+    references this run took — a run that opened before the port was
+    configured (or after it was unset) must not release another run's hold."""
+    global _refs
+    if _configured_port() is None:
+        return
+    with _lock:
+        _refs += 1
+    run._telemetry_ref = True
+    _acquire()
+
+
+def on_run_end(run: Any) -> None:
+    """FitRun.__exit__ hook: release iff this run acquired; the last release
+    closes the socket."""
+    global _refs
+    if not getattr(run, "_telemetry_ref", False):
+        return
+    run._telemetry_ref = False
+    with _lock:
+        if _refs > 0:
+            _refs -= 1
+    _release_if_unused()
+
+
+def start_metrics_server(port: Optional[int] = None) -> Optional[Tuple[str, int]]:
+    """Pin the endpoint up independently of run scopes (serving processes).
+    `port` overrides `observability.http_port` for this process. Returns the
+    bound (host, port), or None when no port is configured/bindable."""
+    global _pinned
+    if port is not None:
+        _config.set("observability.http_port", int(port))
+    # pin BEFORE acquiring: a run ending concurrently between _acquire() and a
+    # later pin would see refs==0 / pinned==False and close the socket we are
+    # about to hand back
+    with _lock:
+        _pinned = True
+    srv = _acquire()
+    if srv is None:
+        with _lock:
+            _pinned = False
+        return None
+    if port not in (None, 0) and srv.port != port:
+        # an earlier hold (open run or pin) already bound a different port;
+        # rebinding now would yank the socket from under its scrapers, so the
+        # existing address wins — the requested port takes effect only once
+        # every hold releases and a later acquire rebinds from config
+        _logger.warning(
+            "telemetry endpoint already bound on port %d; requested port %d "
+            "takes effect after the current endpoint closes", srv.port, port,
+        )
+    return srv.address
+
+
+def stop_metrics_server() -> None:
+    """Unpin and close the endpoint unless open runs still hold it."""
+    global _pinned
+    with _lock:
+        _pinned = False
+    _release_if_unused()
+
+
+def server_address() -> Optional[Tuple[str, int]]:
+    """The live endpoint's (host, port), or None when not running."""
+    with _lock:
+        return _server.address if _server is not None else None
+
+
+def _reset_for_tests() -> None:
+    """Force-close regardless of refcounts (test teardown)."""
+    global _server, _refs, _pinned
+    with _lock:
+        srv, _server = _server, None
+        _refs = 0
+        _pinned = False
+    if srv is not None:
+        srv.close()

@@ -39,7 +39,12 @@ import numpy as np
 from ..utils.jax_compat import shard_map
 from jax.sharding import PartitionSpec as P
 
-from ..observability import counter_inc as obs_counter_inc, span as obs_span
+from ..observability import (
+    convergence as obs_convergence,
+    counter_inc as obs_counter_inc,
+    progress as obs_progress,
+    span as obs_span,
+)
 from ..reliability import RetryPolicy, fault_point
 from . import selection as _sel
 from .knn import _block_sq_dists
@@ -358,6 +363,10 @@ def streaming_exact_knn(
                 "pairwise.query_block", {"start": qs, "rows": qe - qs}
             ):
                 policy.run(_scan_query_block, site="pairwise")
+            obs_progress(
+                "pairwise.query_blocks", -(-qe // query_block),
+                -(-nq // query_block), unit="blocks",
+            )
     return out_d, out_i
 
 
@@ -525,12 +534,16 @@ def _streaming_dbscan_fit_predict(
             core[qs:qe] = np.asarray(acc) >= int(min_samples)
 
         policy.run(_core_query_block, site="pairwise")
+        obs_progress(
+            "dbscan.core_blocks", -(-qe // query_block),
+            -(-n // query_block), unit="blocks",
+        )
 
     # min-label propagation with host-side hook + pointer jumping
     labels = np.arange(n, dtype=np.int32)
     mins = None
     converged = False
-    for _ in range(max_rounds):
+    for round_no in range(max_rounds):
         mins = _streamed_min_core_labels(
             X, labels, core, eps2, query_block, item_block, mesh=mesh,
             cache=cache,
@@ -538,6 +551,14 @@ def _streaming_dbscan_fit_predict(
         new = np.where(core, np.minimum(labels, mins), labels).astype(np.int32)
         new = new[new]
         new = new[new]
+        # §6g: round-level progress (total = the max_rounds bound; the loop
+        # usually converges much earlier) + a convergence record tracking how
+        # many labels the round still moved
+        obs_progress("dbscan.rounds", round_no + 1, max_rounds, unit="rounds")
+        obs_convergence(
+            "dbscan", round_no + 1,
+            labels_changed=int(np.count_nonzero(new != labels)),
+        )
         if np.array_equal(new, labels):
             converged = True
             break

@@ -23,7 +23,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..observability import span as obs_span
+from ..observability import (
+    convergence as obs_convergence,
+    progress as obs_progress,
+    span as obs_span,
+)
 from ..observability.device import compiled_kernel, profile_pass
 from ..reliability import (
     StreamBatchError,
@@ -123,7 +127,8 @@ def _batch_stream(n: int, batch_rows: int, mesh, slicer, start_row: int = 0,
 
 
 def _accumulate_stream(carry, accum, n, batch_rows, mesh, slicer, site: str = "ingest",
-                       cache=None, cache_key=None):
+                       cache=None, cache_key=None,
+                       progress_phase: Optional[str] = None):
     """Checkpoint-resumable streamed accumulation, shared by every streamed fit:
     fold `accum(carry, batch_tuple) -> carry` over the prefetched batch stream,
     snapshotting (carry, cursor) every reliability.checkpoint_batches batches so
@@ -132,9 +137,25 @@ def _accumulate_stream(carry, accum, n, batch_rows, mesh, slicer, site: str = "i
     bit-identical to the fault-free pass. `cache`/`cache_key` (multi-pass fits:
     one cache handle across all passes) replay HBM-resident batches instead of
     re-uploading; a resumed stream replays hits and re-uploads misses through
-    the same cursor arithmetic."""
+    the same cursor arithmetic.
+
+    Every folded batch publishes the live batch-progress gauge
+    `fit.progress{phase=<progress_phase>}` (done/total + EMA ETA — §6g), so a
+    mid-pass fit is visible through /runs/<id>. The counter restarts each pass
+    and clamps at the total on a checkpoint-resume replay (progress is
+    advisory telemetry, never an accounting surface)."""
+    total_batches = max(1, -(-n // batch_rows))
+    phase = progress_phase or f"{site}.batches"
+    state = {"done": 0}
+
+    def accum_with_progress(c, batch):
+        c = accum(c, batch)
+        state["done"] = min(state["done"] + 1, total_batches)
+        obs_progress(phase, state["done"], total_batches, unit="batches")
+        return c
 
     def factory(start_row: int):
+        state["done"] = min(start_row // batch_rows, total_batches)
         return _prefetch(
             _batch_stream(n, batch_rows, mesh, slicer, start_row=start_row, site=site,
                           cache=cache, cache_key=cache_key),
@@ -142,7 +163,9 @@ def _accumulate_stream(carry, accum, n, batch_rows, mesh, slicer, site: str = "i
             start_batch=start_row // batch_rows,
         )
 
-    return resumable_accumulate(site, factory, accum, carry, batch_rows, n)
+    return resumable_accumulate(
+        site, factory, accum_with_progress, carry, batch_rows, n
+    )
 
 
 # Every streamed accumulator donates its carry (argnum 0): the per-batch carry
@@ -207,7 +230,8 @@ def streaming_linreg_stats(
         )
 
     carry = _accumulate_stream(
-        carry, lambda c, batch: _accum_linreg(c, *batch), n, batch_rows, mesh, slicer
+        carry, lambda c, batch: _accum_linreg(c, *batch), n, batch_rows, mesh,
+        slicer, progress_phase="linreg.batches",
     )
     A, b, sx, sy, sw = carry
     return A, b, sx / sw, sy / sw, sw
@@ -240,7 +264,8 @@ def streaming_covariance(
         )
 
     carry = _accumulate_stream(
-        carry, lambda c, batch: _accum_cov(c, *batch), n, batch_rows, mesh, slicer
+        carry, lambda c, batch: _accum_cov(c, *batch), n, batch_rows, mesh,
+        slicer, progress_phase="pca.batches",
     )
     S2, sx, sw = carry
     mean = sx / sw
@@ -451,6 +476,7 @@ def _streaming_logreg_fit(
             carry = _accumulate_stream(
                 carry, lambda c, batch: _accum_moments(c, batch[0], batch[2]),
                 n, batch_rows, mesh, _slicer, cache=cache, cache_key=ckey,
+                progress_phase="logreg.moments",
             )
         sx, sxx, sw_j = carry
         wsum = float(sw_j)
@@ -510,6 +536,10 @@ def _streaming_logreg_fit(
             ),
             _accum_vg,
             n, batch_rows, mesh, _slicer, cache=cache, cache_key=ckey,
+            # phase is per-accumulation kind, not per-fit: blending the cheap
+            # moments/gram passes into this EMA would corrupt the gradient
+            # pass's ETA by the ratio of their per-batch costs
+            progress_phase="logreg.grad",
         )
         coef_s = params_flat.reshape(shape)[..., :-1]
         value = float(acc_v) / wsum + 0.5 * reg_l2 * float(np.sum(coef_s * coef_s))
@@ -529,6 +559,7 @@ def _streaming_logreg_fit(
             carry = _accumulate_stream(
                 carry, lambda c, batch: _accum_cov(c, batch[0] / scale, batch[2]),
                 n, batch_rows, mesh, _slicer, cache=cache, cache_key=ckey,
+                progress_phase="logreg.gram",
             )
         S2, _, sw_g = carry
         lmax = float(power_iteration_lmax(S2 / sw_g))
@@ -546,7 +577,7 @@ def _streaming_logreg_fit(
         tk = 1.0
         n_iter = 0
         for it in range(int(max_iter)):
-            _, g = value_and_grad(zk.reshape(-1))
+            fv, g = value_and_grad(zk.reshape(-1))
             p_next = prox(zk - step * g.reshape(shape))
             t_next = 0.5 * (1.0 + np.sqrt(1.0 + 4.0 * tk * tk))
             zk = p_next + ((tk - 1.0) / t_next) * (p_next - pk)
@@ -555,6 +586,16 @@ def _streaming_logreg_fit(
             )
             pk, tk = p_next, t_next
             n_iter = it + 1
+            # §6g: loss here is the SMOOTH objective at the momentum point
+            # (what the streamed pass evaluated); the L1 term is added once at
+            # the end, so the record tracks descent direction, not the exact
+            # composite objective
+            obs_progress("logreg.iters", n_iter, int(max_iter), unit="iters")
+            obs_convergence(
+                "logreg", n_iter, loss=fv,
+                grad_norm=float(np.linalg.norm(g)), delta=delta,
+                solver="fista",
+            )
             if delta <= tol:
                 break
         x = pk.reshape(-1)
@@ -609,6 +650,11 @@ def _streaming_logreg_fit(
         delta = abs(fx - f_new) / max(abs(f_new), 1.0)
         x, fx, gx = x_new, f_new, g_new
         n_iter = it + 1
+        obs_progress("logreg.iters", n_iter, int(max_iter), unit="iters")
+        obs_convergence(
+            "logreg", n_iter, loss=fx,
+            grad_norm=float(np.linalg.norm(gx)), delta=delta, solver="lbfgs",
+        )
         if delta <= tol:
             break
 
@@ -749,6 +795,7 @@ def _streaming_kmeans_fit(
                     c, centers, batch[0], batch[1], cosine
                 ),
                 n, batch_rows, mesh, _slicer, cache=cache, cache_key=ckey,
+                progress_phase="kmeans.batches",
             )
         sums, counts, inertia_j = carry
         new_centers = jnp.where(
@@ -762,6 +809,13 @@ def _streaming_kmeans_fit(
         centers = new_centers
         inertia = float(inertia_j)
         n_iter = it + 1
+        # live telemetry (§6g): pass-level progress gauge + per-iteration
+        # convergence record, both visible mid-fit through /runs/<run_id>
+        obs_progress("kmeans.passes", n_iter, max_iter, unit="passes")
+        obs_convergence(
+            "kmeans", n_iter, inertia=inertia,
+            center_shift=float(np.sqrt(shift2)),
+        )
         if shift2 <= tol * tol:
             break
 

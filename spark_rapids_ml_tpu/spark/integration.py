@@ -104,8 +104,12 @@ _DEVICE_PROGRAM_LOCK = threading.Lock()
 BARRIER_FIT_SCHEMA = "model binary, metrics binary"
 
 
-def _barrier_train_udf(estimator_payload: bytes) -> Callable:
-    """Build the barrier mapInPandas UDF. Runs on executors; requires pyspark."""
+def _barrier_train_udf(estimator_payload: bytes, run_id: str = None) -> Callable:
+    """Build the barrier mapInPandas UDF. Runs on executors; requires pyspark.
+    `run_id` is the driver FitRun's trace context (docs/design.md §6g): it
+    travels inside the closure, is stamped on every task's worker scope, and
+    comes back on the metrics snapshot so the driver-side merge joins each row
+    to exactly one run."""
     import pickle
 
     def train_udf(pdf_iter):
@@ -123,7 +127,7 @@ def _barrier_train_udf(estimator_payload: bytes) -> Callable:
         rank = ctx.partitionId()
         n_tasks = ctx.getTaskInfos().__len__()
 
-        with worker_scope(rank=rank) as wscope:
+        with worker_scope(rank=rank, run_id=run_id) as wscope:
             attrs = _barrier_task_body(
                 est, ctx, rank, n_tasks, pdf_iter, init_process_group, get_mesh,
                 _obs_span,
@@ -395,10 +399,10 @@ def _merge_worker_metrics(rows: Any) -> None:
     exactly why driver `counter_totals()` used to under-report. Same-process
     snapshots (the threaded local-mode harness) already flowed through the live
     fan-out and are recorded for the breakdown only (observability/runs.py)."""
-    from ..observability import PROCESS_TOKEN, current_run, global_registry
+    from ..observability import PROCESS_TOKEN, current_run, find_run, global_registry
 
     logger = get_logger("spark.integration")
-    run = current_run()
+    fallback_run = current_run()
     for r in rows:
         try:
             blob = r["metrics"]
@@ -408,6 +412,9 @@ def _merge_worker_metrics(rows: Any) -> None:
             continue
         try:
             snap = json.loads(bytes(blob).decode())
+            # trace-context join (§6g): a stamped snapshot goes to ITS run;
+            # legacy/unstamped snapshots keep the current-run fallback
+            run = find_run(snap.get("run_id") or "") or fallback_run
             if run is not None:
                 run.add_worker_snapshot(snap)
             elif snap.get("process") != PROCESS_TOKEN:
@@ -437,7 +444,14 @@ def fit_on_spark(estimator: Any, spark_df: Any, num_hosts: int) -> Any:
         raise ValueError(f"num_hosts must be >= 1, got {num_hosts}")
     logger = get_logger("spark.integration")
     df = spark_df.repartition(num_hosts)
-    udf = _barrier_train_udf(pickle.dumps(estimator))
+    # trace context: the open FitRun's id rides the UDF closure so every worker
+    # snapshot comes back stamped with it (§6g)
+    from ..observability import current_run
+
+    run = current_run()
+    udf = _barrier_train_udf(
+        pickle.dumps(estimator), run_id=run.run_id if run is not None else None
+    )
     rdd = df.mapInPandas(udf, schema=BARRIER_FIT_SCHEMA).rdd
     try:
         rdd = apply_stage_level_scheduling(rdd, spark_df.sparkSession)

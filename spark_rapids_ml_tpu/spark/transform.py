@@ -199,7 +199,10 @@ def transform_on_spark(model: Any, spark_df: Any) -> Any:
             m = _worker_model(bcasts)
             mname = type(m).__name__
             rank = partition_rank()
-            with worker_scope(rank=rank) as wscope, _suppress():
+            # run_id = the driver TransformRun's trace context (§6g): stamped
+            # on the scope so the snapshot — merged live or landed in the
+            # transform_partials.jsonl sidecar — joins to exactly one run
+            with worker_scope(rank=rank, run_id=run_id) as wscope, _suppress():
                 # delivery rides a finally: an early generator close (downstream
                 # limit()) or a mid-partition transform error must still ship
                 # the partial scope — the error case is exactly when the

@@ -377,6 +377,11 @@ def test_fit_report_aggregates_barrier_workers(barrier_env):
     for w in rep["workers"]:
         assert "barrier.collect" in w["metrics"]["spans"]
         assert "barrier.fit_program" in w["metrics"]["spans"]
+    # trace context (§6g): every task's snapshot came back stamped with THIS
+    # run's id — the driver joins rows by id, and none is an orphan
+    assert all(w["run_id"] == rep["run_id"] for w in rep["workers"]), rep["workers"]
+    assert all(w["orphan"] is False for w in rep["workers"])
+    assert rep["orphan_snapshots"] == 0
     # the run trace saw every task's spans too (process-global fan-out)
     names = [s["name"] for s in iter_spans(rep)]
     assert names.count("barrier.fit_program") == 4
