@@ -530,6 +530,23 @@ def _worker_main() -> None:
                 result[f"{name}_roofline_bound"] = dev["roofline_bound"]
                 result[f"{name}_device_flops"] = dev["device_flops"]
                 result[f"{name}_device_compiles"] = dev["device_compiles"]
+                # communication plane (observability/comm.py, design §6h):
+                # analyzed collective bytes over the scenario wall against
+                # the ICI peak, plus the worst rank-skew gauge when the
+                # scenario exercised the rank-snapshot plane — both gated
+                # advisory by ci/bench_check.py (lower is better)
+                from spark_rapids_ml_tpu.observability.comm import (
+                    scenario_comm_summary,
+                )
+
+                cs = scenario_comm_summary(
+                    obs_report, wall_s=time.time() - t0
+                )
+                if cs["comm_frac"] is not None:
+                    result[f"{name}_comm_frac"] = cs["comm_frac"]
+                    result[f"{name}_comm_bytes"] = cs["comm_bytes"]
+                if cs["rank_skew"] is not None:
+                    result[f"{name}_rank_skew"] = cs["rank_skew"]
             result[f"{name}_bench_secs"] = round(time.time() - t0, 1)
             _flush_progress(
                 progress,

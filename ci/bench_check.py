@@ -48,6 +48,14 @@ _SELECT_RE = re.compile(r'\\?"(\w+)_select_s\\?"\s*:\s*([0-9]+(?:\.[0-9]+)?)')
 _MFU_RE = re.compile(
     r'\\?"(\w+_mfu)\\?"\s*:\s*([0-9]+(?:\.[0-9]+)?(?:[eE][+-]?[0-9]+)?)'
 )
+# communication plane (`<unit>_comm_frac` / `<unit>_rank_skew`,
+# observability/comm.py §6h): both lower-is-better like wall times — a rising
+# comm_frac means the scenario spends more of its window on the interconnect,
+# a rising rank_skew means the barrier is waiting longer on its slowest rank
+_COMM_RE = re.compile(
+    r'\\?"(\w+_(?:comm_frac|rank_skew))\\?"\s*:\s*'
+    r"([0-9]+(?:\.[0-9]+)?(?:[eE][+-]?[0-9]+)?)"
+)
 # live-telemetry overhead (`telemetry_overhead_pct`, §6g): gated against an
 # ABSOLUTE budget (default <2%), not a round-over-round ratio — the value sits
 # near zero, where ratios of two small noisy numbers are meaningless
@@ -67,6 +75,23 @@ _PLATFORM_RE = re.compile(r'\\?"platform\\?"\s*:\s*\\?"(\w+)\\?"')
 
 def _higher_is_better(name: str) -> bool:
     return name.endswith("_mfu")
+
+
+# absolute noise floors for the comm keys: near zero (CPU-mesh comm_frac sits
+# at ~1e-6) a round-over-round ratio compares two noise samples — the same
+# rationale as the telemetry-overhead absolute budget above. Values are only
+# ratio-judged once EITHER round clears the floor.
+_NOISE_FLOORS = (
+    ("_comm_frac", 0.01),  # <1% of ICI peak: noise, not a communication story
+    ("_rank_skew", 1.5),   # below the straggler threshold: balanced enough
+)
+
+
+def _below_noise_floor(name: str, old: float, new: float) -> bool:
+    for suffix, floor in _NOISE_FLOORS:
+        if name.endswith(suffix):
+            return max(old, new) < floor
+    return False
 
 
 def _round_key(path: str) -> Tuple[int, str]:
@@ -106,6 +131,10 @@ def extract(path: str) -> Dict[str, object]:
             scenarios[k[: -len("_s")]] = float(v)
         elif k.endswith("_mfu") and isinstance(v, (int, float)):
             scenarios[k] = float(v)  # keeps the _mfu suffix: direction marker
+        elif k.endswith(("_comm_frac", "_rank_skew")) and isinstance(
+            v, (int, float)
+        ):
+            scenarios[k] = float(v)  # comm plane: lower-is-better default
         elif k.endswith("_overhead_noise_pct") and isinstance(v, (int, float)):
             overhead_noise[k[: -len("_noise_pct")] + "_pct"] = float(v)
         elif k.endswith("_overhead_pct") and isinstance(v, (int, float)):
@@ -126,6 +155,8 @@ def extract(path: str) -> Dict[str, object]:
         for name, secs in _SELECT_RE.findall(text):
             scenarios[f"{name}_select"] = float(secs)
         for name, v in _MFU_RE.findall(text):
+            scenarios[name] = float(v)
+        for name, v in _COMM_RE.findall(text):
             scenarios[name] = float(v)
         for name, v in _OVERHEAD_NOISE_RE.findall(text):
             overhead_noise[name[: -len("_noise_pct")] + "_pct"] = float(v)
@@ -160,6 +191,10 @@ def compare(old: Dict[str, object], new: Dict[str, object],
                          "ratio": None, "verdict": "only-one-round"})
             continue
         ratio = n / o if o > 0 else float("inf")
+        if _below_noise_floor(name, o, n):
+            rows.append({"scenario": name, "old_s": o, "new_s": n,
+                         "ratio": ratio, "verdict": "ok (below noise floor)"})
+            continue
         if _higher_is_better(name):
             # mfu: new/old BELOW 1-threshold is the regression; above is the win
             verdict = "REGRESSED" if ratio < 1.0 - threshold else (

@@ -40,6 +40,15 @@ image ships no third-party linters, so the gate is stdlib-only but real:
     capture and HBM sampling — including the graceful degrade when a runtime
     lacks them; a direct call elsewhere bypasses the capture contract AND the
     no-warning-spam guarantee. `# noqa` on the line exempts.
+  * off-plane HLO collective parsing: any string literal that pattern-matches
+    HLO collective-op text (a dash-spelled opcode — all-reduce / all-gather /
+    reduce-scatter / collective-permute / all-to-all — immediately followed
+    by `(`, an escaped `\\(`, or `-start`) outside observability/comm.py.
+    The communication plane (docs/design.md §6h) is the ONE HLO-text parser:
+    ad-hoc regexes drift from the exporter's collective accounting (exactly
+    what happened to the pre-§6h tests/test_collective_counts.py). Prose
+    mentions of the opcodes (docstrings, comments) don't match; `# noqa` on
+    the literal's first or last line exempts.
 
 Exit code 1 on any finding; CI runs this before the test tiers (ci/test.sh).
 """
@@ -73,6 +82,16 @@ _TOPK_PRIMS = {"top_k", "approx_max_k"}
 
 # XLA device-analysis surfaces whose only legal home is observability/device.py
 _DEVICE_ANALYSIS = {"cost_analysis", "memory_analysis", "memory_stats"}
+
+# HLO collective-op TEXT patterns whose only legal home is observability/comm.py:
+# a dash-spelled opcode directly followed by a paren (an HLO call site / a regex
+# matching one) or the async -start suffix. Prose mentions don't match.
+import re as _re  # stdlib-only gate; localized alias keeps the import obvious
+
+_HLO_PARSE_RE = _re.compile(
+    r"(?:all-reduce|all-gather|reduce-scatter|collective-permute|all-to-all)"
+    r"(?:-start|\\?\()"
+)
 
 
 def _is_broad_catch(type_node) -> bool:
@@ -279,6 +298,31 @@ def check_file(path: Path) -> list:
                         "device-performance plane (compiled_kernel / "
                         "sample_hbm, docs/design.md §6f)"
                     )
+
+    # HLO collective-op text parsing lives in observability/comm.py only (the
+    # communication plane owns extraction AND the payload/replica-group
+    # accounting the run reports export — one parser, one truth)
+    if not (path.name == "comm.py" and "observability" in path.parts):
+        src_lines = src.splitlines()
+        for node in ast.walk(tree):
+            if not (
+                isinstance(node, ast.Constant) and isinstance(node.value, str)
+            ):
+                continue
+            if not _HLO_PARSE_RE.search(node.value):
+                continue
+            exempt = False
+            for ln in (node.lineno, getattr(node, "end_lineno", node.lineno)):
+                line = src_lines[ln - 1] if ln - 1 < len(src_lines) else ""
+                if "noqa" in line:
+                    exempt = True
+            if not exempt:
+                findings.append(
+                    f"{path}:{node.lineno}: HLO collective-op text pattern in "
+                    "a string literal — collective parsing lives in "
+                    "observability/comm.py only (extract_collectives / "
+                    "collectives_of_computation, docs/design.md §6h)"
+                )
 
     if not any(part in PROFILING_INTERNALS_EXEMPT_PARTS for part in path.parts):
         src_lines = src.splitlines()

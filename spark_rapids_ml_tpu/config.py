@@ -117,6 +117,17 @@ _DEFAULTS: Dict[str, Any] = {
     # per-platform peak table keyed on device_kind
     "observability.peak_flops": 0.0,
     "observability.peak_bw": 0.0,
+    # communication plane (observability/comm.py, docs/design.md §6h):
+    # per-chip ICI/interconnect peak bytes/s override for the comm_frac /
+    # comm_bound verdicts; 0 = auto from the peak table's ICI column
+    "observability.peak_ici_bw": 0.0,
+    # per-rank skew above which a rank is flagged a straggler (its phase wall
+    # time vs the rank median): fires a `straggler` event into the run's event
+    # log + flight recorder and counts comm.stragglers{phase=}
+    "observability.straggler_threshold": 1.5,
+    # absolute per-phase wall-time floor for straggler flags: ratios over
+    # millisecond-scale phases are scheduler jitter, not stragglers
+    "observability.straggler_min_wall_s": 0.25,
     # opt-in jax.profiler capture of ONE designated pass of a streamed fit:
     # set profile_dir to enable; profile_pass picks the pass (default 2 — the
     # first post-compile steady-state pass); one capture per site per process
@@ -182,6 +193,9 @@ _ENV_KEYS: Dict[str, str] = {
     "observability.hbm_sample_interval_s": "SRML_TPU_HBM_SAMPLE_INTERVAL_S",
     "observability.peak_flops": "SRML_TPU_PEAK_FLOPS",
     "observability.peak_bw": "SRML_TPU_PEAK_BW",
+    "observability.peak_ici_bw": "SRML_TPU_PEAK_ICI_BW",
+    "observability.straggler_threshold": "SRML_TPU_STRAGGLER_THRESHOLD",
+    "observability.straggler_min_wall_s": "SRML_TPU_STRAGGLER_MIN_WALL_S",
     "observability.profile_dir": "SRML_TPU_PROFILE_DIR",
     "observability.profile_pass": "SRML_TPU_PROFILE_PASS",
     "observability.http_port": "SRML_TPU_METRICS_PORT",

@@ -12,9 +12,12 @@
 #   export.py     JSONL run/transform reports (rotating) + Prometheus textfile
 #   device.py     compiled_kernel cost/memory-analysis capture, HBM telemetry,
 #                 roofline span attribution, compile accounting, profiler hook
-#   server.py     opt-in live HTTP endpoint: /metrics, /healthz, /runs[/<id>]
+#   server.py     opt-in live HTTP endpoint: /metrics, /healthz, /runs[/<id>],
+#                 /runs/<id>/ranks (barrier timeline)
 #   flight.py     failure flight recorder: bounded ring buffer + postmortem
 #                 bundles (postmortem_<run_id>.json)
+#   comm.py       communication plane: HLO collective accounting, comm
+#                 roofline, per-rank skew + straggler detection, timeline
 #
 
 from .registry import (
@@ -44,10 +47,20 @@ from .runs import (
     gauge_set,
     global_registry,
     legacy_count,
+    note_rank_phase,
     observe,
     progress,
     span,
     worker_scope,
+)
+from .comm import (
+    COLLECTIVE_KINDS,
+    collective_summary,
+    collectives_from_executable,
+    collectives_of_computation,
+    extract_collectives,
+    rank_timeline,
+    scenario_comm_summary,
 )
 from .inference import (
     TransformRun,
@@ -71,6 +84,7 @@ from .device import (
     compiled_kernel,
     kernel_cost,
     kernel_cost_records,
+    platform_ici_bw,
     platform_peaks,
     profile_pass,
     sample_hbm,
@@ -112,10 +126,18 @@ __all__ = [
     "gauge_set",
     "global_registry",
     "legacy_count",
+    "note_rank_phase",
     "observe",
     "progress",
     "span",
     "worker_scope",
+    "COLLECTIVE_KINDS",
+    "collective_summary",
+    "collectives_from_executable",
+    "collectives_of_computation",
+    "extract_collectives",
+    "rank_timeline",
+    "scenario_comm_summary",
     "TransformRun",
     "deliver_partition_snapshot",
     "predict_dispatch",
@@ -133,6 +155,7 @@ __all__ = [
     "compiled_kernel",
     "kernel_cost",
     "kernel_cost_records",
+    "platform_ici_bw",
     "platform_peaks",
     "profile_pass",
     "sample_hbm",

@@ -72,6 +72,20 @@ _logger = get_logger("observability.device")
 
 _lock = threading.RLock()
 
+_comm_mod = None
+
+
+def _comm():
+    """Lazy communication-plane import (observability/comm.py imports this
+    module lazily for the ICI peak; the reverse edge resolves at call time —
+    the same cycle-breaking as runs._device)."""
+    global _comm_mod
+    if _comm_mod is None:
+        from . import comm as cm
+
+        _comm_mod = cm
+    return _comm_mod
+
 # every live CompiledKernel, so reset_device_plane can drop executable caches
 # (tests; a stale cache would report zero compiles for work a fresh process
 # would have compiled)
@@ -105,24 +119,27 @@ _errors_logged: set = set()
 
 # per-platform peak table: device_kind substring (lowercase, first match wins)
 # -> (peak FLOP/s per chip at parity/f32-equivalent precision, HBM bytes/s per
-# chip). TPU rows follow published chip specs (bf16 peak halved for the
-# f32-equivalent MXU rate the parity kernels run at); the cpu/gpu rows are
-# order-of-magnitude placeholders that make mfu/roofline keys PRESENT and
-# comparable across rounds — absolute truth on those backends comes from the
-# `observability.peak_flops` / `observability.peak_bw` overrides.
-_PEAK_TABLE: Tuple[Tuple[str, Tuple[float, float]], ...] = (
-    ("v5 lite", (98e12, 819e9)),
-    ("v5e", (98e12, 819e9)),
-    ("v5p", (229e12, 2765e9)),
-    ("v6", (459e12, 1640e9)),
-    ("v4", (137e12, 1228e9)),
-    ("v3", (61e12, 900e9)),
-    ("tpu", (98e12, 819e9)),
-    ("gpu", (19.5e12, 1555e9)),
-    ("cpu", (2e11, 5e10)),
+# chip, ICI/interconnect bytes/s per chip — the comm-plane roofline column,
+# docs/design.md §6h). TPU compute/HBM rows follow published chip specs (bf16
+# peak halved for the f32-equivalent MXU rate the parity kernels run at); ICI
+# rows are published per-chip interchip-interconnect totals; the cpu/gpu rows
+# are order-of-magnitude placeholders that make mfu/roofline/comm keys PRESENT
+# and comparable across rounds — absolute truth on those backends comes from
+# the `observability.peak_flops` / `observability.peak_bw` /
+# `observability.peak_ici_bw` overrides.
+_PEAK_TABLE: Tuple[Tuple[str, Tuple[float, float, float]], ...] = (
+    ("v5 lite", (98e12, 819e9, 200e9)),
+    ("v5e", (98e12, 819e9, 200e9)),
+    ("v5p", (229e12, 2765e9, 600e9)),
+    ("v6", (459e12, 1640e9, 448e9)),
+    ("v4", (137e12, 1228e9, 300e9)),
+    ("v3", (61e12, 900e9, 100e9)),
+    ("tpu", (98e12, 819e9, 200e9)),
+    ("gpu", (19.5e12, 1555e9, 600e9)),
+    ("cpu", (2e11, 5e10, 1e10)),
 )
 
-_peaks_cache: Optional[Tuple[float, float, str]] = None
+_peaks_cache: Optional[Tuple[float, float, float, str]] = None
 
 
 def _enabled() -> bool:
@@ -157,13 +174,10 @@ def reset_device_plane() -> None:
 # ------------------------------------------------------------------ peak table
 
 
-def platform_peaks() -> Tuple[float, float, str]:
-    """(peak_flops_per_chip, peak_bw_per_chip, platform). Config overrides win;
-    otherwise the first _PEAK_TABLE row whose key substring-matches the local
-    device kind (then platform)."""
+def _platform_row() -> Tuple[float, float, float, str]:
+    """(peak_flops, peak_bw, peak_ici_bw, platform) of the local device kind —
+    the raw table row (cached), before any config override."""
     global _peaks_cache
-    over_f = float(_config.get("observability.peak_flops") or 0.0)
-    over_b = float(_config.get("observability.peak_bw") or 0.0)
     with _lock:
         cached = _peaks_cache
     if cached is None:
@@ -177,17 +191,33 @@ def platform_peaks() -> Tuple[float, float, str]:
                 kind = str(getattr(dev, "device_kind", "") or "")
             except Exception as e:
                 _log_once("peaks", "device probe for peak table failed: %s", e)
-        flops, bw = 2e11, 5e10  # unknown-platform fallback = cpu row
+        flops, bw, ici = 2e11, 5e10, 1e10  # unknown-platform fallback = cpu row
         hay = f"{kind} {platform}".lower()
-        for key, (f, b) in _PEAK_TABLE:
+        for key, (f, b, i) in _PEAK_TABLE:
             if key in hay:
-                flops, bw = f, b
+                flops, bw, ici = f, b, i
                 break
-        cached = (flops, bw, platform)
+        cached = (flops, bw, ici, platform)
         with _lock:
             _peaks_cache = cached
-    flops, bw, platform = cached
+    return cached
+
+
+def platform_peaks() -> Tuple[float, float, str]:
+    """(peak_flops_per_chip, peak_bw_per_chip, platform). Config overrides win;
+    otherwise the first _PEAK_TABLE row whose key substring-matches the local
+    device kind (then platform)."""
+    over_f = float(_config.get("observability.peak_flops") or 0.0)
+    over_b = float(_config.get("observability.peak_bw") or 0.0)
+    flops, bw, _, platform = _platform_row()
     return (over_f or flops, over_b or bw, platform)
+
+
+def platform_ici_bw() -> float:
+    """Per-chip ICI/interconnect peak bytes/s — the comm-plane roofline column
+    (docs/design.md §6h). `observability.peak_ici_bw` overrides the table."""
+    over = float(_config.get("observability.peak_ici_bw") or 0.0)
+    return over or _platform_row()[2]
 
 
 def _classify(flops: float, bytes_accessed: float,
@@ -427,6 +457,18 @@ class CompiledKernel:
             "calls": 0,
             **cost,
         }
+        # communication plane (§6h): walk the compiled module's HLO ONCE per
+        # signature for collective ops/bytes/replica-groups; None (no HLO
+        # surface on this runtime) just means no collective accounting
+        try:
+            collectives = _comm().collectives_from_executable(exe)
+        except Exception as e:
+            _log_once(f"comm:{self.name}",
+                      "kernel %s: collective extraction failed (%s)",
+                      self.name, e)
+            collectives = None
+        if collectives:
+            record["collectives"] = collectives
         with _lock:
             if len(_records) < _MAX_RECORDS:
                 _records[(self.name, sig)] = record
@@ -558,6 +600,19 @@ def _attribute_call(kernel: str, record: Mapping[str, Any]) -> None:
     if bytes_accessed:
         _runs.counter_inc("device.bytes_total", int(bytes_accessed),
                           kernel=kernel)
+    # collective accounting (§6h): per call, each kind's analyzed ops/bytes
+    # aggregate like flops do — uniform `comm.*` names across every kernel
+    comm_bytes = 0.0
+    collectives = record.get("collectives")
+    if collectives:
+        for kind, st in collectives.items():
+            _runs.counter_inc("comm.collective_ops", int(st.get("ops", 0)),
+                              kind=kind, kernel=kernel)
+            b = int(st.get("bytes", 0))
+            if b:
+                _runs.counter_inc("comm.collective_bytes", b,
+                                  kind=kind, kernel=kernel)
+            comm_bytes += b
     stack = _runs._span_stack()
     if not stack:
         return
@@ -566,11 +621,12 @@ def _attribute_call(kernel: str, record: Mapping[str, Any]) -> None:
     if dev is None:
         dev = node.attrs["device"] = {
             "flops": 0.0, "bytes": 0.0, "transcendentals": 0.0,
-            "calls": 0, "kernels": {},
+            "comm_bytes": 0.0, "calls": 0, "kernels": {},
         }
     dev["flops"] += flops
     dev["bytes"] += bytes_accessed
     dev["transcendentals"] += float(record.get("transcendentals", 0.0))
+    dev["comm_bytes"] = dev.get("comm_bytes", 0.0) + comm_bytes
     dev["calls"] += 1
     dev["kernels"][kernel] = dev["kernels"].get(kernel, 0) + 1
 
@@ -656,6 +712,7 @@ def device_report_section(registry: Any = None) -> Optional[Dict[str, Any]]:
         "platform": platform,
         "peak_flops": peak_flops,
         "peak_bw": peak_bw,
+        "peak_ici_bw": platform_ici_bw(),
         "kernels": records,
     }
 
@@ -753,6 +810,13 @@ def on_span_close(node: Any) -> None:
             dev["roofline_bound"] = cls["roofline_bound"]
             ceiling = cls["ceiling_flops_per_s"]
             dev["roofline_frac"] = achieved / ceiling if ceiling > 0 else 0.0
+            # comm roofline (§6h): achieved interconnect bandwidth / comm_frac
+            # / comm_bound from the span's attributed collective bytes
+            if dev.get("comm_bytes"):
+                dev.update(_comm().classify_comm(
+                    dev["flops"], dev["bytes"], dev["comm_bytes"],
+                    node.duration_s, peaks[0], peaks[1], platform_ici_bw(),
+                ))
         sample_hbm()
     except Exception as e:
         _log_once("span_close", "device span hook failed: %s", e)

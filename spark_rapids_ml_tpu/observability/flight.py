@@ -179,6 +179,16 @@ def dump_postmortem(run: Any = None, reason: str = "failure",
         )
         if device_section:
             bundle["device"] = device_section
+        # per-rank barrier timeline (§6h): a degraded/failed barrier fit's
+        # postmortem must show WHICH rank was slow, not just that one was
+        if hasattr(run, "rank_view"):
+            try:
+                ranks = run.rank_view()
+            except Exception as e:
+                _logger.warning("postmortem rank timeline failed: %s", e)
+                ranks = None
+            if ranks and ranks.get("ranks"):
+                bundle["ranks"] = ranks
         os.makedirs(metrics_dir, exist_ok=True)
         safe_id = "".join(c if c.isalnum() or c in "-_." else "_" for c in run_id)
         path = os.path.join(metrics_dir, f"postmortem_{safe_id}.json")

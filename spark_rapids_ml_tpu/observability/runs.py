@@ -102,6 +102,21 @@ def _server():
     return _server_mod
 
 
+_comm_mod = None
+
+
+def _comm():
+    """Lazy communication-plane import (observability/comm.py, §6h: rank-skew
+    gauges + straggler events on worker-snapshot merge; same cycle-breaking
+    as _device)."""
+    global _comm_mod
+    if _comm_mod is None:
+        from . import comm as cm
+
+        _comm_mod = cm
+    return _comm_mod
+
+
 def _worker_scopes() -> List["WorkerScope"]:
     scopes = getattr(_tls, "worker_scopes", None)
     if scopes is None:
@@ -386,6 +401,8 @@ class FitRun:
         self.max_events = max(self.max_spans, 1024)
         self._dropped_events = 0
         self._workers: List[Dict[str, Any]] = []
+        # ranks already flagged as stragglers (§6h): one event per rank per run
+        self._straggler_ranks: set = set()
         # live-telemetry state (docs/design.md §6g): the open-span stack the
         # /runs/<id> endpoint serves mid-run, per-phase progress with EMA ETA,
         # and the bounded per-iteration convergence record list
@@ -545,6 +562,10 @@ class FitRun:
                     "run_id": snap_run_id,
                     "orphan": orphan,
                     "merged": foreign and not orphan,
+                    # per-rank timing (§6h): the skew/straggler/timeline inputs
+                    "started_ts": worker.get("started_ts"),
+                    "wall_s": worker.get("wall_s"),
+                    "phases": worker.get("phases") or {},
                     "metrics": worker.get("metrics") or {},
                     "events": worker.get("events") or [],
                     "spans": worker.get("spans") or [],
@@ -561,6 +582,32 @@ class FitRun:
             _GLOBAL.merge_snapshot(snap)
             for entry in worker.get("events") or []:
                 self.add_event(dict(entry, worker_rank=worker.get("rank")))
+        # communication plane (§6h): refresh rank-skew gauges and emit
+        # straggler events for newly slow ranks; a telemetry failure must
+        # never fail a merge whose barrier stage already succeeded
+        try:
+            _comm().note_worker_merge(self)
+        except Exception as e:
+            _logger.warning("rank-skew update failed: %s", e)
+
+    def rank_view(self) -> Dict[str, Any]:
+        """The per-rank barrier timeline of this run's merged worker
+        snapshots (observability/comm.py::rank_timeline): served live by
+        `/runs/<run_id>/ranks`, exported as the report's `ranks` section, and
+        carried by postmortem bundles. Orphan snapshots are excluded — they
+        belong to some OTHER run's timeline."""
+        with self._lock:
+            workers = [
+                {
+                    "rank": w.get("rank"),
+                    "started_ts": w.get("started_ts"),
+                    "wall_s": w.get("wall_s"),
+                    "phases": w.get("phases") or {},
+                }
+                for w in self._workers
+                if not w.get("orphan")
+            ]
+        return _comm().rank_timeline(workers)
 
     # ---- lifecycle ----
 
@@ -638,9 +685,20 @@ class FitRun:
             convergence = list(self._convergence)
             dropped_convergence = self._dropped_convergence
             orphans = self._orphan_snapshots
+            have_workers = bool(self._workers)
         device_section = _device().device_report_section(self.registry)
+        ranks_section = None
+        if have_workers:
+            try:
+                ranks_section = self.rank_view()
+            except Exception as e:
+                _logger.warning("rank timeline assembly failed: %s", e)
+        # a run whose only snapshots were orphans has an EMPTY timeline —
+        # exporting it would read as "this run had ranks, none reported"
+        have_ranks = bool(ranks_section and ranks_section.get("ranks"))
         return {
             **({"device": device_section} if device_section else {}),
+            **({"ranks": ranks_section} if have_ranks else {}),
             "schema": 1,
             "kind": self.kind,
             "run_id": self.run_id,
@@ -727,6 +785,47 @@ class WorkerScope:
         self._dropped_events = 0
         self._spans: List[Dict[str, Any]] = []
         self._dropped_spans = 0
+        # per-rank timing for the communication plane (§6h): the scope's own
+        # wall clock plus named phase records (collect, fit_program, transform
+        # partition, ...) with rows/bytes — the raw material of the driver's
+        # skew ratios, straggler events and barrier timeline
+        self.started_ts = time.time()
+        self._t0 = time.perf_counter()
+        self._phases: Dict[str, Dict[str, Any]] = {}
+
+    def note_phase(self, phase: str, wall_s: Optional[float] = None,
+                   rows: Optional[int] = None, nbytes: Optional[int] = None,
+                   start_ts: Optional[float] = None,
+                   end_ts: Optional[float] = None) -> None:
+        """Record (accumulating) one named phase's wall time / rows ingested /
+        bytes for this rank. Callers pass measured wall_s; start/end default to
+        a window ending NOW of that length, so merged timelines always carry
+        usable start/end stamps."""
+        now = time.time()
+        if end_ts is None:
+            end_ts = now
+        if start_ts is None and wall_s is not None:
+            start_ts = end_ts - float(wall_s)
+        with self._lock:
+            st = self._phases.setdefault(phase, {
+                "wall_s": 0.0, "rows": 0, "bytes": 0,
+                "start_ts": None, "end_ts": None,
+            })
+            if wall_s is not None:
+                st["wall_s"] = round(st["wall_s"] + float(wall_s), 6)
+            if rows:
+                st["rows"] += int(rows)
+            if nbytes:
+                st["bytes"] += int(nbytes)
+            if start_ts is not None:
+                st["start_ts"] = (
+                    round(start_ts, 6) if st["start_ts"] is None
+                    else min(st["start_ts"], round(start_ts, 6))
+                )
+            st["end_ts"] = (
+                round(end_ts, 6) if st["end_ts"] is None
+                else max(st["end_ts"], round(end_ts, 6))
+            )
 
     def add_event(self, entry: Dict[str, Any]) -> None:
         with self._lock:
@@ -749,12 +848,28 @@ class WorkerScope:
                 "process": PROCESS_TOKEN,
                 "rank": self.rank,
                 "run_id": self.run_id,
+                "started_ts": round(self.started_ts, 6),
+                "wall_s": round(time.perf_counter() - self._t0, 6),
+                "phases": {k: dict(v) for k, v in self._phases.items()},
                 "metrics": self.registry.snapshot(),
                 "events": list(self._events),
                 "dropped_events": self._dropped_events,
                 "spans": list(self._spans),
                 "dropped_spans": self._dropped_spans,
             }
+
+
+def note_rank_phase(phase: str, wall_s: Optional[float] = None,
+                    rows: Optional[int] = None, nbytes: Optional[int] = None,
+                    start_ts: Optional[float] = None,
+                    end_ts: Optional[float] = None) -> None:
+    """Record one per-rank phase observation (wall time, rows ingested, bytes)
+    on every worker scope open on THIS thread — the communication plane's
+    (§6h) raw skew material. No-op outside a worker scope, so instrumented
+    code paths (barrier task body, transform partitions) need no gating."""
+    for scope in _worker_scopes():
+        scope.note_phase(phase, wall_s=wall_s, rows=rows, nbytes=nbytes,
+                         start_ts=start_ts, end_ts=end_ts)
 
 
 @contextlib.contextmanager

@@ -15,6 +15,9 @@
 #   /runs/<run_id>   live view of one open run: open-span stack, progress
 #                    gauges (pass k/K, batches, ETA), convergence tail, event
 #                    tail, full metrics snapshot
+#   /runs/<run_id>/ranks  the barrier timeline (docs/design.md §6h): per-rank
+#                    start/end per phase, rows/bytes, skew ratios, straggler
+#                    flags — assembled from merged worker snapshots mid-run
 #
 # Opt-in and leak-free by construction: with `observability.http_port` unset
 # (`SRML_TPU_METRICS_PORT`) no thread is EVER started. When set, the server is
@@ -111,6 +114,17 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json({
                     "runs": [r.live_view(summary=True) for r in active_runs()]
                 })
+            elif path.startswith("/runs/") and path.endswith("/ranks"):
+                # barrier timeline (§6h): per-rank start/end per phase, skew
+                # ratios and straggler flags from the run's merged snapshots
+                from .runs import find_run
+
+                rid = path[len("/runs/"): -len("/ranks")]
+                run = find_run(rid)
+                if run is None:
+                    self._send_json({"error": "no open run with that id"}, 404)
+                else:
+                    self._send_json(dict(run.rank_view(), run_id=run.run_id))
             elif path.startswith("/runs/"):
                 from .runs import find_run
 
@@ -121,7 +135,8 @@ class _Handler(BaseHTTPRequestHandler):
                     self._send_json(run.live_view())
             else:
                 self._send_json({"error": "unknown path", "paths": [
-                    "/metrics", "/healthz", "/runs", "/runs/<run_id>"
+                    "/metrics", "/healthz", "/runs", "/runs/<run_id>",
+                    "/runs/<run_id>/ranks"
                 ]}, 404)
         except Exception as e:
             # a scrape must never take the process down; report the error to

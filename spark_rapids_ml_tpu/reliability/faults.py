@@ -13,9 +13,15 @@
 #              | 'raise=' NAME    -- exception class to raise (default OSError)
 #              | 'times=' INT     -- how many firings before the fault exhausts
 #                                    (default 1: a TRANSIENT fault)
+#              | 'sleep=' FLOAT   -- DELAY instead of raising: sleep this many
+#                                    seconds and return (a deterministic
+#                                    straggler — the comm plane's rank-skew/
+#                                    straggler detection is tested with it,
+#                                    docs/design.md §6h)
 #
 #   e.g.  SRML_TPU_FAULT_SPEC="ingest:batch=3:raise=OSError"
 #         SRML_TPU_FAULT_SPEC="barrier_init:raise=TimeoutError;ann_assign:batch=1"
+#         SRML_TPU_FAULT_SPEC="barrier_rank:batch=3:sleep=0.5"  # rank 3 drags
 #
 # Named sites planted in the tree (docs/design.md "Reliability"):
 #   ingest            ops/streaming.py::_batch_stream    (every streamed fit)
@@ -26,6 +32,8 @@
 #   barrier_collect   spark/integration.py  per-partition Arrow collect
 #   barrier_allgather spark/integration.py  control-plane allGather round
 #   barrier_init      spark/integration.py  jax.distributed process-group init
+#   barrier_rank      spark/integration.py  per-rank fit body (batch = RANK:
+#                     with sleep=, delays one chosen rank — straggler injection)
 #
 # Firing state lives process-wide and is keyed by the spec string, so a fault
 # with times=1 fires exactly once per configured spec — the injected failure is
@@ -91,6 +99,7 @@ class FaultSpec:
     batch: Optional[int] = None  # None: fire at any batch
     exc: type = OSError
     times: int = 1  # firings before the fault exhausts (1 == transient)
+    sleep: float = 0.0  # >0: delay this many seconds instead of raising
 
 
 def parse_fault_spec(raw: str) -> List[FaultSpec]:
@@ -101,6 +110,7 @@ def parse_fault_spec(raw: str) -> List[FaultSpec]:
             continue
         fields = clause.split(":")
         site, batch, exc, times = fields[0].strip(), None, OSError, 1
+        sleep, exc_given = 0.0, False
         if not site:
             raise ValueError(f"fault clause with empty site: {clause!r}")
         for field in fields[1:]:
@@ -117,11 +127,27 @@ def parse_fault_spec(raw: str) -> List[FaultSpec]:
                         f"known: {sorted(_EXC_REGISTRY)}"
                     )
                 exc = _EXC_REGISTRY[value]
+                exc_given = True
             elif key == "times":
                 times = int(value)
+            elif key == "sleep":
+                sleep = float(value)
+                if sleep < 0:
+                    raise ValueError(
+                        f"negative sleep in fault clause {clause!r}"
+                    )
             else:
                 raise ValueError(f"unknown fault field {key!r} in {clause!r}")
-        specs.append(FaultSpec(site, batch, exc, times))
+        if sleep > 0 and exc_given:
+            # contradictory clause: a sleep fault returns, so the raise= could
+            # only be silently ignored — reject at parse time like every other
+            # malformed field instead of handing back a delay-only fault
+            raise ValueError(
+                f"fault clause {clause!r} combines sleep= with raise=; "
+                "a sleep fault delays instead of raising — use separate "
+                "clauses for a delay and a failure"
+            )
+        specs.append(FaultSpec(site, batch, exc, times, sleep))
     return specs
 
 
@@ -175,6 +201,19 @@ def fault_point(site: str, batch: Optional[int] = None) -> None:
     profiling.count(f"reliability.fault.{site}")
     from ..observability import event as _obs_event
 
+    if fire.sleep > 0:
+        # delay fault: a deterministic straggler, not a failure — the comm
+        # plane's skew/straggler detection (docs/design.md §6h) is driven by it
+        _obs_event("fault", site=site, batch=batch, sleep_s=fire.sleep)
+        _logger.warning(
+            "fault injection: sleeping %.3fs at site '%s'%s (%d firings left)",
+            fire.sleep, site,
+            f" batch {batch}" if batch is not None else "", left,
+        )
+        import time
+
+        time.sleep(fire.sleep)
+        return
     _obs_event("fault", site=site, batch=batch, exc=fire.exc.__name__)
     _logger.warning(
         "fault injection: raising %s at site '%s'%s (%d firings left)",

@@ -147,15 +147,44 @@ def _barrier_train_udf(estimator_payload: bytes, run_id: str = None) -> Callable
     return train_udf
 
 
+def _features_nbytes(features: Any) -> Any:
+    """Best-effort byte size of a task's ingested feature block (dense ndarray,
+    scipy sparse, or pandas) for the per-rank skew record — None when nothing
+    exposes a size."""
+    nb = getattr(features, "nbytes", None)
+    if nb is not None:
+        return int(nb)
+    data_nb = getattr(getattr(features, "data", None), "nbytes", None)
+    if data_nb is not None:  # scipy sparse: data + indices
+        idx_nb = getattr(getattr(features, "indices", None), "nbytes", 0)
+        return int(data_nb) + int(idx_nb or 0)
+    try:
+        return int(features.memory_usage(index=False, deep=False).sum())
+    except (AttributeError, TypeError, ValueError):
+        return None
+
+
 def _barrier_task_body(est, ctx, rank, n_tasks, pdf_iter, init_process_group,
                        get_mesh, _obs_span):
     """One barrier task's work, returning the fit-attribute dict (meaningful on
     rank 0). Split from the generator so the task's worker_scope closes — with a
     complete metrics snapshot — before any output row is yielded."""
+    import time as _time
+
+    from ..observability import note_rank_phase
+
     # column resolution/casting goes through the SAME prep as the local path
     # (_use_label gate, float32 handling, idCol — core/estimator.py)
+    t_collect = _time.perf_counter()
     with _obs_span("barrier.collect", {"rank": rank}):
         fd = est._pre_process_data(_collect_partition(pdf_iter))
+    # per-rank skew material (§6h): this task's ingest wall/rows/bytes travel
+    # on the worker-scope snapshot; the driver merge turns them into
+    # comm.rank_skew{phase=} ratios, straggler events and the barrier timeline
+    note_rank_phase(
+        "collect", wall_s=_time.perf_counter() - t_collect,
+        rows=fd.n_rows, nbytes=_features_nbytes(fd.features),
+    )
     sparse_fit = est._sparse_fit_wanted(fd)
     ell_vals = ell_idx = None
     if sparse_fit:
@@ -179,8 +208,6 @@ def _barrier_task_body(est, ctx, rank, n_tasks, pdf_iter, init_process_group,
     from .. import profiling
     from ..parallel.bootstrap import reset_process_group
     from ..reliability import RetryPolicy, fault_point
-
-    import time as _time
 
     policy = RetryPolicy.from_config()
     failures = 0
@@ -305,9 +332,22 @@ def _barrier_task_body(est, ctx, rank, n_tasks, pdf_iter, init_process_group,
             unit_weight=fd.weight is None,
         )
 
-    # run the estimator's fit program (same SPMD program on every host)
-    with _DEVICE_PROGRAM_LOCK, _obs_span("barrier.fit_program", {"rank": rank}):
-        attrs = est._get_tpu_fit_func(None)(fit_inputs)
+    # run the estimator's fit program (same SPMD program on every host). The
+    # phase timer starts AFTER the lock, like the span: the lock only exists
+    # for the threaded local-mode harness, and queue-position wait there is
+    # not rank work — timing it would flag the last-scheduled rank of a
+    # healthy fit as a straggler. The straggler injection site fires INSIDE
+    # the timed window (batch = RANK), so a spec like
+    # `barrier_rank:batch=3:sleep=0.5` drags exactly one chosen rank and the
+    # delay lands in that rank's fit_program wall alone (§6h)
+    with _DEVICE_PROGRAM_LOCK:
+        t_fit = _time.perf_counter()
+        fault_point("barrier_rank", batch=rank)
+        with _obs_span("barrier.fit_program", {"rank": rank}):
+            attrs = est._get_tpu_fit_func(None)(fit_inputs)
+        note_rank_phase(
+            "fit_program", wall_s=_time.perf_counter() - t_fit, rows=fd.n_rows,
+        )
 
     return attrs
 

@@ -188,7 +188,9 @@ def transform_on_spark(model: Any, spark_df: Any) -> Any:
         driver_token = PROCESS_TOKEN
 
         def transform_udf(pdf_iter):
-            from ..observability import worker_scope
+            import time as _time
+
+            from ..observability import note_rank_phase, worker_scope
             from ..observability.inference import (
                 deliver_partition_snapshot,
                 partition_rank,
@@ -207,6 +209,8 @@ def transform_on_spark(model: Any, spark_df: Any) -> Any:
                 # limit()) or a mid-partition transform error must still ship
                 # the partial scope — the error case is exactly when the
                 # telemetry matters most
+                t0 = _time.perf_counter()
+                rows_total = bytes_total = 0
                 try:
                     with _span(
                         "transform.partition", {"model": mname, "rank": rank}
@@ -214,20 +218,25 @@ def transform_on_spark(model: Any, spark_df: Any) -> Any:
                         for pdf in pdf_iter:
                             if len(pdf) == 0:
                                 continue
-                            counter_inc(
-                                "transform.bytes",
-                                int(
-                                    pdf.memory_usage(
-                                        index=False, deep=False
-                                    ).sum()
-                                ),
-                                model=mname,
+                            nbytes = int(
+                                pdf.memory_usage(index=False, deep=False).sum()
                             )
+                            counter_inc("transform.bytes", nbytes, model=mname)
+                            rows_total += len(pdf)
+                            bytes_total += nbytes
                             # rows/batches/latency are counted by the nested
                             # local transform (core/estimator.py::
                             # transform_batch) — one definition, no double count
                             yield m.transform(pdf)
                 finally:
+                    # per-rank skew material (§6h): partition wall/rows/bytes
+                    # feed the driver's comm.rank_skew{phase=} ratios and the
+                    # /runs/<id>/ranks timeline, same as barrier fit tasks
+                    note_rank_phase(
+                        "transform_partition",
+                        wall_s=_time.perf_counter() - t0,
+                        rows=rows_total, nbytes=bytes_total,
+                    )
                     deliver_partition_snapshot(
                         run_id, driver_token, wscope.snapshot(),
                         metrics_dir=metrics_dir,

@@ -3,13 +3,17 @@
 The reference's cuML kernels allreduce once per iteration over NCCL (SURVEY §2.7 P1);
 here the same guarantee must come out of XLA's partitioner: the sharded-contraction
 formulation has to compile to O(1) cross-device collectives per pass, INDEPENDENT of
-mesh size and data shape. These tests pin that property by counting all-reduce ops in
+mesh size and data shape. These tests pin that property by counting collective ops in
 the optimized HLO — a regression here (e.g. an accidental resharding that inserts
 all-to-alls or per-feature reduces) would silently destroy multi-chip scaling long
 before any wall-clock test could notice on the 8-device CPU mesh.
-"""
 
-import re
+Counting goes through the communication plane's extraction API
+(observability/comm.py::collectives_of_computation, docs/design.md §6h) — the ONE
+place that parses HLO text for collectives; ci/lint_python.py bans ad-hoc opcode
+parsing everywhere else, so these assertions and the run reports' collective
+accounting can never drift apart.
+"""
 
 import jax
 import jax.numpy as jnp
@@ -17,18 +21,18 @@ import numpy as np
 import pytest
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-
-def _optimized_hlo(fn, *args, static_argnames=()):
-    jitted = jax.jit(fn, static_argnames=static_argnames)
-    return jitted.lower(*args).compile().as_text()
+from spark_rapids_ml_tpu.observability import collectives_of_computation
 
 
-def _count_collectives(hlo: str):
+def _count_collectives(fn, *args):
+    """Per-kind op counts of the compiled program (0 for absent kinds)."""
+    summary = collectives_of_computation(fn, *args)
     return {
-        "all-reduce": len(re.findall(r"all-reduce(?:-start)?\(", hlo)),
-        "all-gather": len(re.findall(r"all-gather(?:-start)?\(", hlo)),
-        "all-to-all": len(re.findall(r"all-to-all\(", hlo)),
-        "collective-permute": len(re.findall(r"collective-permute(?:-start)?\(", hlo)),
+        kind: summary.get(kind, {}).get("ops", 0)
+        for kind in (
+            "all_reduce", "all_gather", "all_to_all",
+            "collective_permute", "reduce_scatter",
+        )
     }
 
 
@@ -58,16 +62,15 @@ def test_lloyd_step_allreduce_count_constant(n_dev, n_devices):
     X, w = _sharded_blob(mesh, 64 * n_dev, 16)
     init = jnp.asarray(np.random.default_rng(1).normal(size=(4, 16)), jnp.float32)
 
-    hlo = _optimized_hlo(
+    counts = _count_collectives(
         lambda X, w, c: lloyd_fit(X, w, c, 0.0, 3), X, w, init
     )
-    counts = _count_collectives(hlo)
     # the while body reduces (sums, counts, inertia); the final reported inertia
     # adds one more reduce outside the loop. Anything above 6 means the
     # partitioner started resharding per iteration.
-    assert 1 <= counts["all-reduce"] <= 6, counts
-    assert counts["all-to-all"] == 0, counts
-    assert counts["all-gather"] == 0, counts
+    assert 1 <= counts["all_reduce"] <= 6, counts
+    assert counts["all_to_all"] == 0, counts
+    assert counts["all_gather"] == 0, counts
 
 
 def test_lloyd_allreduce_count_same_at_2_and_8_devices(n_devices):
@@ -80,8 +83,10 @@ def test_lloyd_allreduce_count_same_at_2_and_8_devices(n_devices):
         init = jnp.asarray(
             np.random.default_rng(1).normal(size=(4, 16)), jnp.float32
         )
-        hlo = _optimized_hlo(lambda X, w, c: lloyd_fit(X, w, c, 0.0, 3), X, w, init)
-        found[n_dev] = _count_collectives(hlo)["all-reduce"]
+        counts = _count_collectives(
+            lambda X, w, c: lloyd_fit(X, w, c, 0.0, 3), X, w, init
+        )
+        found[n_dev] = counts["all_reduce"]
     assert found[2] == found[8], found
 
 
@@ -92,10 +97,24 @@ def test_covariance_single_allreduce(n_devices):
 
     mesh = _mesh(8)
     X, w = _sharded_blob(mesh, 512, 32)
-    hlo = _optimized_hlo(weighted_covariance, X, w)
-    counts = _count_collectives(hlo)
-    assert 1 <= counts["all-reduce"] <= 3, counts
-    assert counts["all-to-all"] == 0, counts
+    counts = _count_collectives(weighted_covariance, X, w)
+    assert 1 <= counts["all_reduce"] <= 3, counts
+    assert counts["all_to_all"] == 0, counts
+
+
+def test_covariance_allreduce_bytes_are_dxd_shaped(n_devices):
+    """Payload accounting sanity (§6h): the covariance all-reduce moves O(d²)
+    bytes — a per-row reduction would move O(n·d) and show up here as orders of
+    magnitude more analyzed payload."""
+    from spark_rapids_ml_tpu.ops.linalg import weighted_covariance
+
+    mesh = _mesh(8)
+    d = 32
+    X, w = _sharded_blob(mesh, 512, d)
+    summary = collectives_of_computation(weighted_covariance, X, w)
+    total = sum(st["bytes"] for st in summary.values())
+    assert total >= d * d * 4, summary  # at least the d x d f32 result
+    assert total <= 16 * d * d * 4 + 4096, summary  # nowhere near O(n*d)
 
 
 def test_logreg_grad_allreduce_constant_per_lbfgs_iter(n_devices):
@@ -119,10 +138,9 @@ def test_logreg_grad_allreduce_constant_per_lbfgs_iter(n_devices):
             tol=jnp.float32(1e-6), multinomial=False,
         )[0]
 
-    hlo = _optimized_hlo(fit, X, y, w, scale)
-    counts = _count_collectives(hlo)
-    assert 1 <= counts["all-reduce"] <= 8, counts
-    assert counts["all-to-all"] == 0, counts
+    counts = _count_collectives(fit, X, y, w, scale)
+    assert 1 <= counts["all_reduce"] <= 8, counts
+    assert counts["all_to_all"] == 0, counts
 
 
 def test_exact_knn_uses_gather_not_quadratic_exchange(n_devices):
@@ -141,9 +159,8 @@ def test_exact_knn_uses_gather_not_quadratic_exchange(n_devices):
     )
 
     merge = _knn_local_then_merge_fn(mesh, shard_rows=64, k_local=4, k_eff=4)
-    hlo = _optimized_hlo(merge, Q, X, valid)
-    counts = _count_collectives(hlo)
+    counts = _count_collectives(merge, Q, X, valid)
     total_comm = (
-        counts["all-gather"] + counts["all-reduce"] + counts["collective-permute"]
+        counts["all_gather"] + counts["all_reduce"] + counts["collective_permute"]
     )
     assert 1 <= total_comm <= 6, counts

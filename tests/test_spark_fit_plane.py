@@ -385,6 +385,50 @@ def test_fit_report_aggregates_barrier_workers(barrier_env):
     # the run trace saw every task's spans too (process-global fan-out)
     names = [s["name"] for s in iter_spans(rep)]
     assert names.count("barrier.fit_program") == 4
+    # communication plane (§6h): every task's snapshot carried per-rank wall
+    # time + phase records, and the report assembles the barrier timeline
+    assert [e["rank"] for e in rep["ranks"]["ranks"]] == [0, 1, 2, 3]
+    for entry in rep["ranks"]["ranks"]:
+        assert entry["wall_s"] is not None and entry["wall_s"] > 0
+        assert entry["phases"]["collect"]["rows"] == 64  # 256 rows / 4 ranks
+        assert entry["phases"]["collect"]["bytes"] > 0
+        assert entry["phases"]["fit_program"]["wall_s"] >= 0
+    assert "collect" in rep["ranks"]["skew"]  # 4 ranks -> skew defined
+
+
+def test_barrier_delayed_rank_flagged_as_straggler(barrier_env):
+    """An artificially delayed rank (the barrier_rank delay-fault site, §6h)
+    must surface as a straggler: skewed fit_program wall in the timeline, a
+    `straggler` event in the run's event log, and the flight-recorder ring."""
+    from spark_rapids_ml_tpu.clustering import KMeans
+    from spark_rapids_ml_tpu.observability import flight
+    from spark_rapids_ml_tpu.reliability import reset_faults
+
+    barrier_env(4)
+    flight.reset_flight_recorder()
+    pdf = _blob_pdf(n=256)
+    srml_config.set("reliability.fault_spec", "barrier_rank:batch=2:sleep=0.4")
+    srml_config.set("spark_fit_mode", "barrier")
+    reset_faults()
+    try:
+        est = KMeans(k=2, maxIter=5, seed=7)
+        est._num_workers = 4
+        model = est.fit(FakeFitSparkDF(pdf, n_partitions=4))
+    finally:
+        srml_config.unset("spark_fit_mode")
+        srml_config.unset("reliability.fault_spec")
+        reset_faults()
+    rep = model.fit_report_
+    assert 2 in rep["ranks"]["stragglers"], rep["ranks"]
+    slow = next(e for e in rep["ranks"]["ranks"] if e["rank"] == 2)
+    assert slow["straggler"] is True
+    assert slow["phases"]["fit_program"]["wall_s"] >= 0.4
+    evs = [e for e in rep["events"] if e["kind"] == "straggler"]
+    assert any(e["rank"] == 2 for e in evs), rep["events"]
+    assert any(e["kind"] == "straggler" for e in flight.snapshot())
+    assert any(
+        k.startswith("comm.rank_skew") for k in rep["metrics"]["gauges"]
+    ), rep["metrics"]["gauges"]
 
 
 def test_empty_partition_raises_actionable_error(barrier_env):
