@@ -49,6 +49,7 @@ UNITS = [
     "fit_e2e",
     "cache",
     "telemetry_overhead",
+    "large_k",
     "knn",
     "ann",
     "wide256",
@@ -155,7 +156,7 @@ def _worker_main() -> None:
     # whose remaining units all build their own data (rf/umap/dbscan/fit_e2e/
     # wide256) skips the ~6 GiB generation entirely — that time comes straight
     # out of the wedge-recovery budget
-    NEED_X = {"kmeans_headline", "pca", "logreg", "linreg", "knn", "ann"}
+    NEED_X = {"kmeans_headline", "pca", "logreg", "linreg", "large_k", "knn", "ann"}
     remaining = [
         u for u in UNITS
         if u not in skip and time.time() < deadline_ts - UNIT_START_MARGIN_S

@@ -1093,6 +1093,143 @@ def bench_telemetry_overhead(ctx) -> Dict:
     }
 
 
+# ----------------------------------------------------------------- large_k
+
+
+def bench_large_k(ctx) -> Dict:
+    """Large-k distance+select family — the fused pallas kernel's win region
+    (docs/design.md §5c): k>=128 KMeans assignment + k=100 exact kNN, each
+    timed on the default strategy AND forced through `pallas_fused` with a
+    live bit-parity check against the forced-XLA path. The scenario's
+    `large_k_mfu` / `large_k_roofline_bound` land via bench.py's
+    scenario_summary (measured from the fused executables' cost analysis,
+    ci/bench_check.py gates `*_mfu` direction-aware), and the resolved
+    `knn.select_strategy` telemetry is recorded in the summary so the
+    trajectory shows WHICH kernel produced the number."""
+    import jax.numpy as jnp
+
+    from spark_rapids_ml_tpu import config as srml_config
+    from spark_rapids_ml_tpu.ops.kmeans import kmeans_predict
+    from spark_rapids_ml_tpu.ops.knn import exact_knn_single
+    from spark_rapids_ml_tpu.ops.selection import resolve
+    from spark_rapids_ml_tpu.profiling import counter_totals
+
+    X = ctx["X"]
+    n_full, d = X.shape
+    hb = ctx.get("heartbeat", lambda tag: None)
+    counts_before = dict(counter_totals())
+
+    def _forced(strategy, fn):
+        srml_config.set("knn.selection", strategy)
+        try:
+            return fn()
+        finally:
+            srml_config.unset("knn.selection")
+
+    out: Dict = {}
+
+    # ---- KMeans assignment at k >= 128 (the lane-padding boundary) ----
+    k_centers = 160
+    n_assign = min(n_full, 12_000_000 if ctx["on_tpu"] else 20_000)
+    Xa = jnp.asarray(np.asarray(X[:n_assign]))
+    centers = jnp.asarray(np.asarray(X[:k_centers]))
+    t_x, (a_xla,) = _timed(
+        lambda: (_forced("exact_full", lambda: kmeans_predict(Xa, centers)),),
+        repeats=2,
+    )
+    out["large_k_assign_xla_rows_per_sec_per_chip"] = round(
+        n_assign / t_x / ctx["n_chips"], 1
+    )
+    hb("large_k_assign_xla")
+    t_f, (a_fused,) = _timed(
+        lambda: (_forced("pallas_fused", lambda: kmeans_predict(Xa, centers)),),
+        repeats=2 if ctx["on_tpu"] else 1,
+    )
+    out["large_k_assign_fused_rows_per_sec_per_chip"] = round(
+        n_assign / t_f / ctx["n_chips"], 1
+    )
+    # off-TPU the fused argmin is bit-identical (match_frac == 1.0); on TPU
+    # the kernel's hand-rolled bf16-split emulation of pdot can disagree
+    # with XLA's own HIGHEST passes on ~2^-24-scale ties, so parity is a
+    # fraction with a tight bar rather than a strict equality
+    match_frac = float(
+        (np.asarray(a_fused) == np.asarray(a_xla)).mean()
+    )
+    out["large_k_assign_match_frac"] = round(match_frac, 6)
+    out["large_k_assign_parity_ok"] = bool(match_frac >= 0.9999)
+    out["large_k_assign_k"] = k_centers
+    hb("large_k_assign_fused")
+
+    # ---- exact kNN at k=100 ----
+    k_nn = 100
+    n_knn = min(n_full, 2_000_000 if ctx["on_tpu"] else 8_192)
+    nq = 1024 if ctx["on_tpu"] else 64
+    Xh = np.asarray(X[:n_knn])
+    Xj = jnp.asarray(Xh)
+    Qj = jnp.asarray(Xh[:nq])
+    ones = jnp.ones((n_knn,), bool)
+    t_def, (d_def, i_def) = _timed(
+        lambda: exact_knn_single(Qj, Xj, ones, k_nn), repeats=2
+    )
+    out["large_k_knn_queries_per_sec_per_chip"] = round(
+        nq / t_def / ctx["n_chips"], 1
+    )
+    out["large_k_knn_select_strategy"] = resolve(
+        n_knn, k_nn, None, fusable=True
+    )[0]
+    hb("large_k_knn_default")
+    d_ref, i_ref = _forced(
+        "exact_full", lambda: exact_knn_single(Qj, Xj, ones, k_nn)
+    )
+    exact_ids = np.asarray(i_ref)
+    t_fu, (d_fu, i_fu) = _timed(
+        lambda: _forced(
+            "pallas_fused", lambda: exact_knn_single(Qj, Xj, ones, k_nn)
+        ),
+        repeats=2 if ctx["on_tpu"] else 1,
+    )
+    out["large_k_knn_fused_queries_per_sec_per_chip"] = round(
+        nq / t_fu / ctx["n_chips"], 1
+    )
+    # f32 fused mode is bit-identical to exact_full: ids AND distances
+    out["large_k_knn_fused_parity_ok"] = bool(
+        np.array_equal(np.asarray(i_fu), exact_ids)
+        and np.array_equal(np.asarray(d_fu), np.asarray(d_ref))
+    )
+    hb("large_k_knn_fused")
+
+    # bf16-accumulation fused pool + exact re-rank: recall of the id set vs
+    # the exact scan (the §5c acceptance signal for knn.pallas_precision)
+    def _bf16():
+        srml_config.set("knn.pallas_precision", "bfloat16")
+        try:
+            return _forced(
+                "pallas_fused", lambda: exact_knn_single(Qj, Xj, ones, k_nn)
+            )
+        finally:
+            srml_config.unset("knn.pallas_precision")
+
+    try:
+        _, i_b = _bf16()
+        out["large_k_knn_bf16_recall_at_100"] = round(
+            _recall_at(np.asarray(i_b), exact_ids, k_nn), 4
+        )
+    except Exception as e:  # pragma: no cover - never kill the unit over this
+        out["large_k_knn_bf16_error"] = f"{type(e).__name__}: {str(e)[:120]}"
+    hb("large_k_knn_bf16")
+
+    # selection-strategy telemetry recorded in the scenario summary: the
+    # per-label `knn.select_strategy` counts this unit produced
+    delta = {
+        key: v - counts_before.get(key, 0)
+        for key, v in counter_totals().items()
+        if key.startswith(("knn.select_strategy", "kmeans.assign_path"))
+        and v - counts_before.get(key, 0) > 0
+    }
+    out["large_k_strategy_counts"] = delta
+    return out
+
+
 # ---------------------------------------------------------------------- runner
 
 # ordered so the cheap families land before the O(n*nq) kNN/ANN scans: on the
@@ -1108,6 +1245,7 @@ FAMILIES: List = [
     ("fit_e2e", bench_fit_e2e),
     ("cache", bench_cache),
     ("telemetry_overhead", bench_telemetry_overhead),
+    ("large_k", bench_large_k),
     ("knn", bench_knn),
     ("ann", bench_ann),
 ]

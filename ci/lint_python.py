@@ -34,6 +34,14 @@ image ships no third-party linters, so the gate is stdlib-only but real:
     top_k_max) so the strategy knob, the invalid-sentinel convention, and the
     selection telemetry can never be bypassed (mirrors the jax.jit-in-models
     ban). `# noqa` on the line exempts.
+  * off-plane pallas: any `jax.experimental.pallas` import (either spelling)
+    or `.pallas_call` attribute outside `ops/pallas_*.py`. Raw Pallas kernels
+    carry per-toolchain workarounds (Mosaic precision emulation, ragged-edge
+    masking, VMEM budgets) and parity contracts that live with the kernel
+    modules — a pallas_call elsewhere bypasses the interpret-mode gates, the
+    compiled_kernel telemetry routing, and the §5b/§5c sentinel/tie-order
+    contracts (mirrors the top_k and cost_analysis fences). `# noqa` on the
+    line exempts.
   * off-plane device analysis: any `.cost_analysis()` / `.memory_analysis()` /
     `.memory_stats()` reference outside observability/device.py. The
     device-performance plane (docs/design.md §6f) owns XLA cost/memory
@@ -275,6 +283,47 @@ def check_file(path: Path) -> list:
                     f"{path}:{node.lineno}: {hit} in ops/ — route top-k "
                     "through ops/selection.py (select_topk/merge_topk/"
                     "top_k_max)"
+                )
+
+    # pallas lives in ops/pallas_*.py only: kernels there carry the
+    # interpret-mode gates, Mosaic workarounds and parity contracts; any
+    # other pallas_call / jax.experimental.pallas import bypasses them
+    if not (
+        "ops" in path.parts
+        and "spark_rapids_ml_tpu" in path.parts
+        and path.name.startswith("pallas_")
+    ):
+        src_lines = src.splitlines()
+        for node in ast.walk(tree):
+            hit = None
+            if isinstance(node, ast.Import) and any(
+                alias.name.startswith("jax.experimental.pallas")
+                for alias in node.names
+            ):
+                hit = "import jax.experimental.pallas"
+            elif isinstance(node, ast.ImportFrom) and (
+                (node.module or "").startswith("jax.experimental.pallas")
+                or (
+                    node.module == "jax.experimental"
+                    and any(a.name == "pallas" for a in node.names)
+                )
+            ):
+                hit = "from jax.experimental import pallas"
+            elif isinstance(node, ast.Attribute) and node.attr == "pallas_call":
+                hit = "direct pallas_call"
+            if hit is None:
+                continue
+            line = (
+                src_lines[node.lineno - 1]
+                if node.lineno - 1 < len(src_lines)
+                else ""
+            )
+            if "noqa" not in line:
+                findings.append(
+                    f"{path}:{node.lineno}: {hit} outside ops/pallas_*.py — "
+                    "Pallas kernels live in the pallas kernel modules "
+                    "(interpret gates, Mosaic workarounds, §5c parity "
+                    "contracts); route through their host wrappers"
                 )
 
     # XLA cost/memory analysis + memory_stats live in observability/device.py

@@ -324,7 +324,7 @@ SRML_DEVICE_SMOKE_DIR="$(mktemp -d)"
 SRML_BENCH_ROLE=worker \
 SRML_BENCH_PROGRESS="$SRML_DEVICE_SMOKE_DIR/progress.jsonl" \
 SRML_BENCH_DEADLINE_TS="$(python -c 'import time; print(time.time() + 600)')" \
-SRML_BENCH_SKIP="kmeans_headline,logreg,linreg,rf,umap,dbscan,fit_e2e,cache,telemetry_overhead,knn,ann,wide256" \
+SRML_BENCH_SKIP="kmeans_headline,logreg,linreg,rf,umap,dbscan,fit_e2e,cache,telemetry_overhead,large_k,knn,ann,wide256" \
 python bench.py
 SRML_BENCH_PROGRESS="$SRML_DEVICE_SMOKE_DIR/progress.jsonl" python - <<'PY'
 import json, os, sys
@@ -375,6 +375,78 @@ tot = counter_totals()
 assert any(k.startswith("knn.select_strategy") for k in tot), tot
 print(f"SELECTION SMOKE OK: tiled==full bitwise; approx recall {recall:.3f}")
 PY
+
+# pallas-parity smoke (perf tier, docs/design.md §5c): the fused Pallas
+# distance+select scan in interpret mode on the 8-device CPU mesh —
+# per-shard pallas_call under shard_map through the PRODUCTION
+# exact_knn_distributed path must be bit-identical to the XLA path (ids AND
+# distances), fused KMeans assignment bit-identical to kmeans_predict, and
+# the bf16 pool + parity re-rank must leave nonzero `knn.rerank` counters in
+# the exported JSONL (the §5b invariant, read back like a dashboard would)
+SRML_PALLAS_SMOKE_DIR="$(mktemp -d)"
+SRML_TPU_METRICS_DIR="$SRML_PALLAS_SMOKE_DIR" python - <<'PY'
+import os
+import numpy as np, jax.numpy as jnp
+from spark_rapids_ml_tpu import config
+from spark_rapids_ml_tpu.observability import fit_run, load_run_reports
+from spark_rapids_ml_tpu.ops.kmeans import kmeans_predict
+from spark_rapids_ml_tpu.ops.knn import exact_knn_distributed, exact_knn_single
+from spark_rapids_ml_tpu.parallel.mesh import get_mesh, shard_array
+from spark_rapids_ml_tpu.parallel.partition import pad_rows
+
+rng = np.random.default_rng(0)
+X = rng.normal(size=(4096, 16)).astype(np.float32)
+X[100] = X[7]  # a tie the fused extraction must order like lax.top_k
+mesh = get_mesh()
+Xp, w, _ = pad_rows(X, mesh.devices.size)
+Xd, vd = shard_array(Xp, mesh), shard_array(w > 0, mesh)
+Q = X[:64]
+d_ref, i_ref = exact_knn_distributed(mesh, Q, Xd, vd, 10)
+config.set("knn.selection", "pallas_fused")
+try:
+    with fit_run(algo="PallasSelectSmoke", site="ci"):
+        d_f, i_f = exact_knn_distributed(mesh, Q, Xd, vd, 10)
+        config.set("knn.pallas_precision", "bfloat16")
+        try:
+            db, ib = exact_knn_single(
+                jnp.asarray(Q), jnp.asarray(X), jnp.ones((len(X),), bool), 10
+            )
+        finally:
+            config.unset("knn.pallas_precision")
+finally:
+    config.unset("knn.selection")
+np.testing.assert_array_equal(np.asarray(i_f), np.asarray(i_ref))
+np.testing.assert_array_equal(np.asarray(d_f), np.asarray(d_ref))
+# bf16 pool, exact-f32 distances: the §5b re-rank invariant is idempotent —
+# re-running parity_rerank_sq on the returned ids reproduces the returned
+# (distances, ids) bit-for-bit (full f32 difference form, no bf16 passes)
+from spark_rapids_ml_tpu.ops.knn import parity_rerank_sq
+db2, ib2 = parity_rerank_sq(
+    jnp.asarray(Q), jnp.asarray(X), jnp.ones((len(X),), bool),
+    jnp.asarray(np.asarray(ib)), 10,
+)
+np.testing.assert_array_equal(np.asarray(db2), np.asarray(db))
+np.testing.assert_array_equal(np.asarray(ib2), np.asarray(ib))
+# fused assignment bit-identical to the XLA kmeans_predict
+centers = jnp.asarray(X[:130])
+a_ref = np.asarray(kmeans_predict(jnp.asarray(X), centers))
+config.set("knn.selection", "pallas_fused")
+try:
+    a_f = np.asarray(kmeans_predict(jnp.asarray(X), centers))
+finally:
+    config.unset("knn.selection")
+np.testing.assert_array_equal(a_f, a_ref)
+rep = load_run_reports(os.environ["SRML_TPU_METRICS_DIR"])[-1]
+c = rep["metrics"]["counters"]
+rerank = sum(v for k, v in c.items() if k.startswith("knn.rerank"))
+assert rerank > 0, c
+assert any(
+    "pallas_fused" in k for k in c if k.startswith("knn.select_strategy")
+), c
+print("PALLAS SELECT SMOKE OK: fused scan bit-identical over the 8-device "
+      f"mesh; bf16 re-rank exact ({rerank} rerank counts in the JSONL)")
+PY
+rm -rf "$SRML_PALLAS_SMOKE_DIR"
 
 # bench regression gate (ci/bench_check.py): per-scenario wall times of the two
 # newest recorded bench rounds, >25% is a regression. ADVISORY by default —

@@ -67,6 +67,19 @@ _DEFAULTS: Dict[str, Any] = {
     # vectorize on the VPU; CPU: max(8192, n/4) — the XLA CPU TopK custom call
     # is per-call-overhead-bound, so few large tiles win)
     "knn.select_tile": 0,
+    # fused pallas distance+select scans (ops/pallas_select.py, design.md §5c):
+    # the `pallas_fused` selection strategy fuses the (block, n_items) distance
+    # tile with an in-register running top-k/argmin/count so the distance
+    # matrix never materializes in HBM. `auto` engages it on TPU at FUSABLE
+    # call sites (exact kNN scans, IVF coarse probes, DBSCAN neighborhood
+    # counts, KMeans assignment) once the scanned item width reaches this
+    # threshold; below it (or off-TPU) auto keeps the PR-5 strategies
+    "knn.pallas_min_items": 1 << 16,
+    # distance-ACCUMULATION precision of the fused scan: float32 is exact
+    # (bit-identical to the XLA path); bfloat16/int8 compute an approximate
+    # candidate pool on the fast MXU paths and the parity_rerank_sq invariant
+    # restores exact-f32 returned distances (only the id set is approximate)
+    "knn.pallas_precision": "float32",
     # HBM-resident batch cache (ops/device_cache.py): multi-pass streamed fits
     # retain pass-1 device batches and replay passes 2..N from HBM (the TPU
     # analog of the reference's cross-pass cuDF/UVM residency). The budget
@@ -170,6 +183,8 @@ _ENV_KEYS: Dict[str, str] = {
     "knn.selection": "SRML_TPU_KNN_SELECTION",
     "knn.recall_target": "SRML_TPU_KNN_RECALL_TARGET",
     "knn.select_tile": "SRML_TPU_KNN_SELECT_TILE",
+    "knn.pallas_min_items": "SRML_TPU_KNN_PALLAS_MIN_ITEMS",
+    "knn.pallas_precision": "SRML_TPU_KNN_PALLAS_PRECISION",
     "cache.enabled": "SRML_TPU_CACHE_ENABLED",
     "cache.hbm_budget_bytes": "SRML_TPU_CACHE_BUDGET",
     "reliability.enabled": "SRML_TPU_RELIABILITY_ENABLED",

@@ -324,6 +324,20 @@ def streaming_ivfflat_search(
     k_eff = min(k, nprobe * max_cell)
     strategy, tile, rt = _sel.resolve(nprobe * max_cell, k_eff, None)
     _sel.record_selection(strategy, site="ann_streaming_search")
+    # the COARSE probe is a fusable scan (Q vs resident centers): route it
+    # through the fused pallas distance+select kernel when `pallas_fused`
+    # resolves for the nlist width (explicit, or auto on TPU past
+    # knn.pallas_min_items). The probe stays exact-f32 either way — the probe
+    # list bounds recall for the whole search, so knn.pallas_precision never
+    # applies to it; ids are bit-identical to the exact_full probe.
+    probe_fused = (
+        _sel.resolve(nlist, min(nprobe, nlist), None, fusable=True)[0]
+        == "pallas_fused"
+    )
+    if probe_fused:
+        _sel.record_selection(
+            "pallas_fused", site="ann_streaming_probe"
+        )
     from .knn import _count_x2
 
     _count_x2(cn_j, "ann_streaming_search", False)
@@ -337,9 +351,16 @@ def streaming_ivfflat_search(
         def _search_block(s=s, e=e, bi=bi):
             fault_point("ann_search", batch=bi)
             qb = jnp.asarray(np.ascontiguousarray(Q[s:e], dtype=np.float32))
-            probe = np.asarray(
-                _probe_cells(qb, centers_j, nprobe, cn_j)
-            )  # (bq, nprobe)
+            if probe_fused:
+                from .pallas_select import fused_probe
+
+                probe = np.asarray(
+                    fused_probe(qb, centers_j, nprobe, center_norms=cn_j)
+                )  # (bq, nprobe) — bit-identical to the exact probe
+            else:
+                probe = np.asarray(
+                    _probe_cells(qb, centers_j, nprobe, cn_j)
+                )  # (bq, nprobe)
             # the host gather IS the out-of-core page-in
             probed_items = jnp.asarray(cells[probe])
             probed_ids = jnp.asarray(cell_ids[probe])

@@ -26,7 +26,7 @@ from ..observability.device import compiled_kernel
 
 
 @compiled_kernel("dbscan.core_mask", static_argnames=("block",))
-def _core_mask(
+def _core_mask_xla(
     X: jax.Array, valid: jax.Array, eps2: float, min_samples: int, block: int = 512
 ) -> jax.Array:
     """Bool mask of core points (eps-neighbor count incl. self >= min_samples).
@@ -43,6 +43,22 @@ def _core_mask(
 
     counts = jax.lax.map(count_block, Xp.reshape(-1, block, X.shape[1]))
     return (counts.reshape(-1)[:n] >= min_samples) & valid
+
+
+def _core_mask(
+    X: jax.Array, valid: jax.Array, eps2: float, min_samples: int, block: int = 512
+) -> jax.Array:
+    """Core-point detection, host wrapper (the PR-5 resolution contract):
+    routes to the fused pallas distance+count scan (ops/pallas_select.py —
+    the (block, n) distance tile never leaves VMEM, counts bit-identical)
+    when `knn.selection` is `pallas_fused`, or under `auto` on TPU once n
+    clears knn.pallas_min_items; XLA blocked scan otherwise."""
+    from .pallas_select import fused_count_below, use_fused_count
+
+    if use_fused_count(X.shape[0]):
+        counts = fused_count_below(X, X, valid, eps2)
+        return (counts >= min_samples) & valid
+    return _core_mask_xla(X, valid, eps2, min_samples, block)
 
 
 @compiled_kernel("dbscan.min_core_neighbor_labels",

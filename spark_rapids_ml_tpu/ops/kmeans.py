@@ -117,10 +117,44 @@ def lloyd_fit(
 
 
 @compiled_kernel("kmeans.predict", static_argnames=("cosine",))
-def kmeans_predict(X: jax.Array, centers: jax.Array, cosine: bool = False) -> jax.Array:
+def _kmeans_predict_xla(
+    X: jax.Array, centers: jax.Array, cosine: bool = False
+) -> jax.Array:
     if cosine:
         return jnp.argmax(pdot(_normalize_rows(X), _normalize_rows(centers).T), axis=1)
     return jnp.argmin(_sq_dists(X, centers), axis=1)
+
+
+def kmeans_predict(
+    X: jax.Array, centers: jax.Array, cosine: bool = False
+) -> jax.Array:
+    """Nearest-center assignment. Host wrapper (the PR-5 contract: strategy
+    resolves OUTSIDE any trace): euclidean assignment routes to the fused
+    pallas distance+argmin scan (ops/pallas_select.py — X streams through
+    once, no (n, k) distance matrix in HBM, bit-identical argmin) when
+    `knn.selection` is `pallas_fused`, or under `auto` on TPU at k >= 128
+    (below that the lane-padded MXU tiles erase the fusion win — the
+    documented ops/pallas_kmeans.py small-k region). Cosine keeps the XLA
+    kernel: its ranking is a normalized argMAX, not this kernel's reduction.
+    `kmeans.assign_path{path=}` proves which path ran."""
+    from ..ops import pallas_select as _ps
+    from . import selection as _sel
+
+    tracing = _sel.is_tracing(X, centers)
+    if (
+        not cosine
+        and not tracing
+        and _ps.use_fused_assign(centers.shape[0], centers.shape[1])
+    ):
+        from .. import observability as _obs
+
+        _obs.counter_inc("kmeans.assign_path", 1, path="pallas_fused")
+        return _ps.fused_assign(X, centers)
+    if not tracing:
+        from .. import observability as _obs
+
+        _obs.counter_inc("kmeans.assign_path", 1, path="xla")
+    return _kmeans_predict_xla(X, centers, cosine)
 
 
 @compiled_kernel("kmeans.inertia")
@@ -329,20 +363,53 @@ def kmeans_fit(
     init_centers = jnp.asarray(kmeans_init(X, w, k, init, init_steps, seed))
     from .. import config as _config
 
-    # The fused pallas Lloyd is an explicit opt-in (SRML_TPU_PALLAS_KMEANS), NOT
-    # the default and NOT tied to fast_math: steady-state TPU measurement at the
-    # bench shape (12M x 128, k=20, v5e) puts the XLA path at 18.7 ms/iter (~92%
-    # of the two-X-reads HBM roofline) vs 26.3/37.5 ms/iter for the WEIGHTED fused
-    # kernel at 1-pass/6-pass precision — at small k both fused matmuls pad k to
-    # the 128-lane MXU width and the per-block argmin/one-hot VPU work dominates,
-    # so streaming X once does not pay. Values:
-    #   "1"    weighted kernel (any w)
+    # Fused pallas Lloyd routing (SRML_TPU_PALLAS_KMEANS). Steady-state TPU
+    # measurement at the bench shape (12M x 128, k=20, v5e) puts the XLA path
+    # at 18.7 ms/iter (~92% of the two-X-reads HBM roofline) vs 26.3/37.5
+    # ms/iter for the WEIGHTED fused kernel at 1-pass/6-pass precision — at
+    # small k both fused matmuls pad k to the 128-lane MXU width and the
+    # per-block argmin/one-hot VPU work dominates, so streaming X once does
+    # not pay. Values:
+    #   "auto" (default) self-resolves the documented small-k loss region:
+    #          the fused kernel engages ONLY on TPU at k >= 128 (the lane
+    #          padding vanishes and XLA's (n, k) intermediates approach the
+    #          size of X); masked form when the weights are the unit
+    #          prefix-mask, weighted otherwise. Off-TPU / small k: XLA.
+    #   "1"    weighted kernel (any w), unconditional
     #   "mask" weight-stream-free kernel — requires unit_weight (the pad_rows
-    #          prefix-mask contract); the (blk,1)-operand elimination measured 3x
-    #          on the Gram kernel (ops/pallas_xtwx.py); falls back to "1" when
-    #          sample weights are present
-    _pallas_env = __import__("os").environ.get("SRML_TPU_PALLAS_KMEANS", "")
-    use_fused = not cosine and _pallas_env in ("1", "mask")
+    #          prefix-mask contract); the (blk,1)-operand elimination measured
+    #          3x on the Gram kernel (ops/pallas_xtwx.py); falls back to "1"
+    #          when sample weights are present
+    #   "0"/"" XLA always
+    # `kmeans.lloyd_path{path=}` counts which path actually ran.
+    from ..ops.pallas_select import FUSED_ASSIGN_MIN_K as _FUSED_MIN_K
+
+    _pallas_env = __import__("os").environ.get("SRML_TPU_PALLAS_KMEANS", "auto")
+    if _pallas_env == "auto":
+        # upper bound on the auto gate: the kernel module's own VMEM
+        # predicate (lloyd_fits_vmem — C+sums residents plus the (blk, k)
+        # one-hot working set at the precision's split count) decides
+        # placeability; an unplaceable (k, d) stays on XLA rather than
+        # handing Mosaic a compile it cannot place. Forced "1"/"mask" stay
+        # unconditional (explicit opt-in, as before).
+        from ._precision import parity_precision
+        from .pallas_kmeans import _N_SPLIT, lloyd_fits_vmem
+
+        _n_split = (
+            1 if bool(_config.get("fast_math"))
+            else _N_SPLIT[parity_precision()]
+        )
+        use_fused = (
+            not cosine
+            and jax.default_backend() == "tpu"
+            and k >= _FUSED_MIN_K
+            and lloyd_fits_vmem(k, int(X.shape[1]), _n_split)
+        )
+        _pallas_env = "mask" if unit_weight else "1"
+    else:
+        use_fused = not cosine and _pallas_env in ("1", "mask")
+    from .. import observability as _obs
+
     if use_fused:
         from jax.sharding import NamedSharding
 
@@ -359,13 +426,19 @@ def kmeans_fit(
             if bool(_config.get("fast_math"))
             else parity_precision()
         )
+        unit_mask = _pallas_env == "mask" and unit_weight
+        _obs.counter_inc(
+            "kmeans.lloyd_path", 1,
+            path="pallas_masked" if unit_mask else "pallas_weighted",
+        )
         centers, inertia, n_iter = lloyd_fit_pallas(
             X, w, init_centers, float(tol), int(max_iter), mesh=mesh,
             interpret=(jax.default_backend() != "tpu"),
             precision=prec,
-            unit_mask=(_pallas_env == "mask" and unit_weight),
+            unit_mask=unit_mask,
         )
     else:
+        _obs.counter_inc("kmeans.lloyd_path", 1, path="xla")
         centers, inertia, n_iter = lloyd_fit(
             X, w, init_centers, float(tol), int(max_iter), cosine=cosine,
             fast_math=bool(_config.get("fast_math")),

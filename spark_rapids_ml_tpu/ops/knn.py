@@ -151,20 +151,67 @@ def exact_knn_single(
     trace, so a config change can never be baked stale into a cached trace).
     Under `approx`, the scan selects a winner pool with approx_max_k and a
     parity-precision re-rank restores exact distances — the id set carries the
-    recall target, the values don't."""
+    recall target, the values don't. This is a FUSABLE site: `pallas_fused`
+    (explicit, or `auto` on TPU past knn.pallas_min_items) runs the fused
+    distance+select scan (ops/pallas_select.py) — bit-identical in f32 mode;
+    under `knn.pallas_precision` bf16/int8 the fused pool re-ranks through
+    the same parity_rerank_sq invariant as approx."""
     n = X.shape[0]
     k = min(int(k), n)
-    strategy, tile, rt = _sel.resolve(n, k, strategy)
+    strategy, tile, rt = _sel.resolve(n, k, strategy, fusable=True)
     tracing = _sel.is_tracing(Q, X, valid)
     if not tracing:
         _sel.record_selection(strategy, site="exact_knn", model=model_name)
     _count_x2(x2, "exact_knn", tracing)
+    if strategy == "pallas_fused":
+        from .pallas_select import fused_topk, oversample_width
+
+        precision = _sel.resolve_fused_precision(None)
+        if precision == "float32":
+            # exact mode: the fused scan IS the answer (bit-identical)
+            with _span_or_null(
+                "knn.select", {"strategy": strategy, "k": k}, tracing
+            ):
+                return fused_topk(Q, X, valid, k, x2=x2, precision=precision)
+        # approximate accumulation: oversampled pool + the §5b re-rank
+        # invariant — returned distances stay exact-f32, ids carry the
+        # approximation (the same contract as the approx strategy)
+        kc = oversample_width(k, n, precision)
+        with _span_or_null(
+            "knn.select",
+            {"strategy": strategy, "k": kc, "precision": precision},
+            tracing,
+        ):
+            _, idx = fused_topk(Q, X, valid, kc, x2=x2, precision=precision)
+        with _span_or_null("knn.rerank", {"k": k}, tracing):
+            if not tracing:
+                from .. import observability as _obs
+
+                _obs.counter_inc(
+                    "knn.rerank_calls", 1, site="exact_knn",
+                    precision=precision,
+                )
+            d2c, idc = parity_rerank_sq(Q, X, valid, idx, k)
+            if kc == k:
+                return d2c, idc
+            # canonicalize through the k-shaped parity computation: the
+            # oversampled-pool rerank runs at width kc, where XLA's reduce
+            # vectorization can differ from the k-shaped program by 1 ulp.
+            # Re-deriving the returned distances at width k makes the §5c
+            # invariant exactly idempotent — returned (d2, ids) ARE
+            # parity_rerank_sq(returned ids) bit-for-bit, the property the
+            # tier-1 property tests assert
+            return parity_rerank_sq(Q, X, valid, idc, k)
     if strategy == "approx":
         with _span_or_null("knn.select", {"strategy": strategy, "k": k}, tracing):
             _, idx = _exact_knn_scan(
                 Q, X, valid, x2, k, block, strategy, tile, rt
             )
         with _span_or_null("knn.rerank", {"k": k}, tracing):
+            if not tracing:
+                from .. import observability as _obs
+
+                _obs.counter_inc("knn.rerank_calls", 1, site="exact_knn")
             return parity_rerank_sq(Q, X, valid, idx, k)
     return _exact_knn_scan(Q, X, valid, x2, k, block, strategy, tile, rt)
 
@@ -189,9 +236,12 @@ def exact_knn_distributed(
     # (n_dev * k_local >= min(k_eff, n_total)) still covers the global top-k
     k_local = min(k_eff, shard_rows)
     # telemetry fires HERE: the per-shard exact_knn_single runs inside the
-    # shard_map trace, where host-side counters are suppressed
+    # shard_map trace, where host-side counters are suppressed (fusable: the
+    # per-shard scan holds Q and its X shard, so pallas_fused applies —
+    # one single-device pallas_call per shard under shard_map)
     _sel.record_selection(
-        _sel.resolve(shard_rows, k_local, None)[0], site="exact_knn_distributed"
+        _sel.resolve(shard_rows, k_local, None, fusable=True)[0],
+        site="exact_knn_distributed",
     )
     _count_x2(x2_sharded, "exact_knn_distributed", False)
 

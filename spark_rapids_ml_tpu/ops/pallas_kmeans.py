@@ -21,10 +21,15 @@
 # lloyd_fit 18.7 ms/iter (~92% of its two-X-reads HBM roofline) vs this kernel at
 # 26.3 (1-pass) / 37.5 (6-pass parity) ms/iter. At small k the two MXU matmuls pad
 # k to the 128-lane width, so halving HBM traffic buys nothing — the kernel is
-# VPU/MXU-bound, not DMA-bound. It therefore stays an explicit opt-in
-# (SRML_TPU_PALLAS_KMEANS=1); the expected win region is large k (k >~ 128), where
+# VPU/MXU-bound, not DMA-bound. SRML_TPU_PALLAS_KMEANS therefore AUTO-resolves
+# (the default since the §5c fused-selection PR): on TPU at k >= 128 — where
 # lane padding vanishes and XLA's (n, k) distance/one-hot intermediates approach
-# the size of X itself.
+# the size of X itself — the fused kernel engages (masked form under unit
+# weights); below that, or off-TPU, the XLA path runs. "1"/"mask" force the
+# kernel unconditionally, "0" forces XLA; `kmeans.lloyd_path{path=}` counts
+# which path ran (ops/kmeans.py::kmeans_fit owns the routing). The ASSIGNMENT
+# half of the win region is served by the lighter fused distance+argmin scan
+# in ops/pallas_select.py (kmeans_predict routes there under the same gate).
 #
 
 from __future__ import annotations
@@ -65,6 +70,30 @@ def _block_rows(d: int, n_split: int = 1) -> int:
 
 def _round_up(x: int, m: int) -> int:
     return ((x + m - 1) // m) * m
+
+
+def lloyd_fits_vmem(k: int, d: int, n_split: int) -> bool:
+    """Can the fused Lloyd place its VMEM residents at this (k, d, n_split)?
+    The kernel keeps C and the sums accumulator (k, d) resident (bf16
+    splitting materializes n_split operand copies of C and the one-hot) next
+    to one _block_rows-sized X block and the (blk, k) distance/one-hot
+    intermediates. The routing gate (ops/kmeans.py::kmeans_fit auto mode)
+    asks THIS predicate instead of hand-rolling a formula, so the knowledge
+    of the kernel's working set lives with the kernel — a (k, d) that fails
+    here stays on the XLA path rather than handing Mosaic an unplaceable
+    compile."""
+    from .pallas_select import _VMEM_BUDGET_BYTES  # one budget, one source
+
+    copies = max(1, int(n_split))
+    blk = _block_rows(d, copies)
+    # f32 operands carry 2-byte bf16 split copies when n_split > 1
+    split_b = 2 * copies if copies > 1 else 0
+    resident = k * d * (8 + split_b)  # C (+splits) and the f32 sums
+    working = (
+        blk * d * (4 + split_b)  # X block (+splits)
+        + blk * k * (8 + split_b)  # distance tile + one-hot (+splits)
+    )
+    return resident + working <= _VMEM_BUDGET_BYTES
 
 
 def _split_bf16(x, n_split: int):

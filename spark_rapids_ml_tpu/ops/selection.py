@@ -25,6 +25,18 @@
 #                stacked on it) follow with a parity-precision re-rank of the
 #                winner pool (ops/knn.py::parity_rerank_sq) so returned
 #                distances stay exact; recall of the id set is >= the target.
+#   pallas_fused the fused Pallas distance+select scan (ops/pallas_select.py,
+#                docs/design.md §5c): the (block, n_items) distance tile and
+#                the running top-k/argmin/count live in VMEM registers, so the
+#                distance matrix is NEVER materialized in HBM — X streams
+#                through once per scan. Only FUSABLE call sites (the host
+#                wrappers that hold Q and X, not a materialized d2) can run
+#                it: `resolve(fusable=True)` marks them, and a d2-level
+#                select asked for `pallas_fused` degrades to exact_full.
+#                Exact-f32 mode is bit-identical to exact_full (tie order
+#                included); `knn.pallas_precision` bf16/int8 modes select an
+#                approximate candidate pool and the parity_rerank_sq
+#                invariant restores exact returned distances.
 #
 # MERGES STAY EXACT: a running top-k merge (pairwise tile sweeps, the ring
 # hop merge, the all-gather candidate merge) must never lose carried
@@ -55,7 +67,11 @@ import numpy as np
 # of two sentinels stay finite (f32max/2 + f32max/2 == f32max, no overflow).
 INVALID_D2 = np.float32(np.finfo(np.float32).max / 2)
 
-STRATEGIES = ("auto", "exact_full", "exact_tiled", "approx")
+STRATEGIES = ("auto", "exact_full", "exact_tiled", "approx", "pallas_fused")
+
+# distance-accumulation modes of the fused pallas scan (knn.pallas_precision):
+# float32 is bit-exact; bfloat16/int8 pair with the parity_rerank_sq re-rank
+FUSED_PRECISIONS = ("float32", "bfloat16", "int8")
 
 
 def mask_invalid(d2: jax.Array, valid: jax.Array) -> jax.Array:
@@ -81,12 +97,42 @@ def _auto_tile(n: int, backend: str) -> int:
     return max(8192, -(-n // 4))
 
 
+def _fused_auto(n: int) -> bool:
+    """Should `auto` hand a FUSABLE width-n scan to the fused pallas kernel?
+    TPU only (off-TPU the kernel runs the Pallas interpreter — a correctness
+    tool, not a fast path), and only once the scanned item width clears
+    `knn.pallas_min_items` (small scans don't pay back the kernel's in-register
+    selection work)."""
+    from .. import config as _config
+
+    return _backend() == "tpu" and n >= int(_config.get("knn.pallas_min_items"))
+
+
+def resolve_fused_precision(precision: Optional[str] = None) -> str:
+    """Resolve the fused scan's distance-accumulation mode
+    (`knn.pallas_precision` unless the caller pinned one). Host-side — like
+    `resolve`, so a config change can never be baked stale into a cached
+    trace. Non-float32 modes REQUIRE the caller to follow with the
+    parity_rerank_sq re-rank (returned distances stay exact-f32)."""
+    from .. import config as _config
+
+    if precision is None:
+        precision = str(_config.get("knn.pallas_precision"))
+    if precision not in FUSED_PRECISIONS:
+        raise ValueError(
+            f"knn.pallas_precision must be one of {FUSED_PRECISIONS}, "
+            f"got '{precision}'"
+        )
+    return precision
+
+
 def resolve(
     n: int,
     k: int,
     strategy: Optional[str] = None,
     tile: Optional[int] = None,
     recall_target: Optional[float] = None,
+    fusable: bool = False,
 ) -> Tuple[str, int, float]:
     """Resolve (strategy, tile, recall_target) for a width-n, top-k select.
 
@@ -95,7 +141,15 @@ def resolve(
     at trace time (a stale traced strategy could otherwise outlive a config
     change). Degradations keep small selects on the fused exact path:
     tiled/approx fall back to exact_full when the width is a single tile or
-    within 4x of k (the pool would be the whole input)."""
+    within 4x of k (the pool would be the whole input).
+
+    `fusable=True` marks call sites that hold Q and X (not a materialized d2
+    matrix) and can therefore run the fused pallas distance+select scan
+    (ops/pallas_select.py): under `auto` on TPU such a site picks
+    `pallas_fused` once n >= knn.pallas_min_items. A NON-fusable site asked
+    for `pallas_fused` (explicitly or via a threaded resolved value) degrades
+    to exact_full — there is nothing left to fuse once d2 exists, and
+    exact_full preserves the fused scan's bit-exact contract."""
     from .. import config as _config
 
     if strategy is None:
@@ -105,7 +159,12 @@ def resolve(
             f"knn.selection must be one of {STRATEGIES}, got '{strategy}'"
         )
     if strategy == "auto":
-        strategy = "approx" if _backend() == "tpu" else "exact_tiled"
+        if fusable and _fused_auto(n):
+            strategy = "pallas_fused"
+        else:
+            strategy = "approx" if _backend() == "tpu" else "exact_tiled"
+    if strategy == "pallas_fused" and not fusable:
+        strategy = "exact_full"
     # degradations: k-of-n selects with no real pool reduction run fused
     # exact. The tile term applies ONLY to exact_tiled — tying approx to the
     # tile width would silently disable the approx path (and its parity
@@ -175,6 +234,8 @@ def select_topk(
     changes can never be baked stale into a cached trace."""
     n = d2.shape[-1]
     k = min(int(k), n)
+    # a d2-level select can't fuse (the matrix already exists): resolve with
+    # fusable=False so an inherited `pallas_fused` degrades to exact_full
     strategy, tile, recall_target = resolve(n, k, strategy, tile, recall_target)
     # clamp: inf (or beyond-sentinel) entries would rank after tiled padding
     # and break exact_full/exact_tiled bit-parity; after the clamp every
