@@ -49,6 +49,7 @@ UNITS = [
     "fit_e2e",
     "cache",
     "telemetry_overhead",
+    "serving_qps",
     "large_k",
     "knn",
     "ann",

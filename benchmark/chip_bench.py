@@ -1093,6 +1093,144 @@ def bench_telemetry_overhead(ctx) -> Dict:
     }
 
 
+# -------------------------------------------------------------- serving_qps
+
+
+def bench_serving_qps(ctx) -> Dict:
+    """Online serving plane (serving/, docs/design.md §7): sustained-QPS
+    closed-loop driver. T client threads issue mixed-size predict requests
+    back-to-back against one served KMeans model for a fixed window; the
+    micro-batcher coalesces them into padded power-of-two buckets executed on
+    device. Emits CLIENT-side `serving_p50/p95/p99_ms` + `serving_qps`
+    (what a caller experiences end to end) plus the plane's own telemetry:
+    `serving_batch_occupancy` (mean real-rows/bucket from the
+    serving.batch_occupancy histogram) and `serving_warm_compiles` — the
+    number of NEW `device.compile` entries during the timed window, which the
+    bucketed AOT pre-warm contract requires to be ZERO. ci/bench_check.py
+    gates serving_p99_ms lower-is-better behind an absolute noise floor
+    (sub-floor CPU tails are scheduler jitter, not regressions)."""
+    import threading
+
+    import pandas as pd
+
+    from spark_rapids_ml_tpu import config as _srml_config
+    from spark_rapids_ml_tpu import serving
+    from spark_rapids_ml_tpu.clustering import KMeans
+    from spark_rapids_ml_tpu.observability import current_run
+    from spark_rapids_ml_tpu.observability.runs import global_registry
+    from spark_rapids_ml_tpu.profiling import counter_totals
+
+    on_tpu = ctx["on_tpu"]
+    n_fit, d = ctx["serving_shape"]
+    clients = 8 if on_tpu else 4
+    window_s = 6.0 if on_tpu else 3.0
+    max_req = 256 if on_tpu else 64
+
+    rng = np.random.default_rng(11)
+    centers = rng.normal(0, 5, (8, d)).astype(np.float32)
+    Xh = (centers[rng.integers(0, 8, n_fit)]
+          + rng.normal(0, 1, (n_fit, d))).astype(np.float32)
+    model = KMeans(k=8, maxIter=5, seed=1).fit(
+        pd.DataFrame({"features": list(Xh[:4096])})
+    )
+
+    registry = serving.ModelRegistry()
+    heartbeat = ctx.get("heartbeat") or (lambda tag: None)
+    try:
+        t0 = time.perf_counter()
+        registry.register("km", model)  # uploads weights + pre-warms buckets
+        prewarm_s = time.perf_counter() - t0
+        heartbeat("serving_prewarm")
+
+        stop_at = [0.0]
+        lat_lock = threading.Lock()
+        latencies: List[float] = []
+        errors: List[str] = []
+
+        def client(seed: int) -> None:
+            r = np.random.default_rng(seed)
+            local: List[float] = []
+            try:
+                while time.perf_counter() < stop_at[0]:
+                    rows = int(r.integers(1, max_req + 1))
+                    off = int(r.integers(0, n_fit - rows))
+                    t = time.perf_counter()
+                    out = registry.predict("km", Xh[off: off + rows])
+                    local.append(time.perf_counter() - t)
+                    if out["prediction"].shape != (rows,):
+                        errors.append("row-count mismatch")
+                        return
+            except Exception as e:  # pragma: no cover — surfaced in the line
+                errors.append(f"{type(e).__name__}: {str(e)[:80]}")
+            with lat_lock:
+                latencies.extend(local)
+
+        # untimed warm lap (thread ramp, allocator warm-up), then the window
+        stop_at[0] = time.perf_counter() + 0.5
+        warm = [threading.Thread(target=client, args=(99 + i,))
+                for i in range(clients)]
+        [t.start() for t in warm]
+        [t.join() for t in warm]
+        with lat_lock:
+            latencies.clear()
+
+        compiles_before = {
+            k: v for k, v in counter_totals().items()
+            if k.startswith("device.compile{")
+        }
+        stop_at[0] = time.perf_counter() + window_s
+        t_open = time.perf_counter()
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(clients)]
+        [t.start() for t in threads]
+        [t.join() for t in threads]
+        wall = time.perf_counter() - t_open
+        heartbeat("serving_window")
+        compiles_after = {
+            k: v for k, v in counter_totals().items()
+            if k.startswith("device.compile{")
+        }
+        warm_compiles = sum(
+            compiles_after.get(k, 0) - compiles_before.get(k, 0)
+            for k in compiles_after
+        )
+        if errors:
+            raise RuntimeError(f"serving clients failed: {errors[:3]}")
+
+        # occupancy from the plane's own histogram — the scenario runs inside
+        # bench.py's fit_run scope, so the run registry holds ONLY this unit's
+        # serving writes; fall back to the global registry without one
+        run = current_run()
+        snap = (run.registry if run is not None else global_registry()).snapshot()
+        occ = snap["histograms"].get(
+            "serving.batch_occupancy{model=km}"
+        )
+        batches = snap["counters"].get("serving.batches{model=km}", 0)
+
+        lat_ms = np.asarray(latencies) * 1e3
+        return {
+            "serving_shape": [n_fit, d],
+            "serving_clients": clients,
+            "serving_requests": int(len(latencies)),
+            "serving_batches": int(batches),
+            "serving_qps": round(len(latencies) / wall, 1),
+            "serving_p50_ms": round(float(np.percentile(lat_ms, 50)), 3),
+            "serving_p95_ms": round(float(np.percentile(lat_ms, 95)), 3),
+            "serving_p99_ms": round(float(np.percentile(lat_ms, 99)), 3),
+            "serving_batch_occupancy": (
+                round(occ["sum"] / occ["count"], 4)
+                if occ and occ.get("count") else None
+            ),
+            "serving_prewarm_s": round(prewarm_s, 3),
+            "serving_warm_compiles": int(warm_compiles),
+            "serving_max_wait_ms": float(
+                _srml_config.get("serving.max_wait_ms")
+            ),
+        }
+    finally:
+        registry.close()
+
+
 # ----------------------------------------------------------------- large_k
 
 
@@ -1245,6 +1383,7 @@ FAMILIES: List = [
     ("fit_e2e", bench_fit_e2e),
     ("cache", bench_cache),
     ("telemetry_overhead", bench_telemetry_overhead),
+    ("serving_qps", bench_serving_qps),
     ("large_k", bench_large_k),
     ("knn", bench_knn),
     ("ann", bench_ann),
@@ -1280,4 +1419,8 @@ def make_ctx(X, w, mesh, on_tpu: bool, platform: str, repo_root: str) -> Dict:
         # enough that per-batch telemetry writes are still the dominant cost
         # the scenario is probing (worst case for the plane)
         "telemetry_shape": (400_000, 64) if big else (96_000, 32),
+        # serving_qps fit-set shape: small — the scenario measures request
+        # latency under micro-batching, not fit throughput; request sizes are
+        # drawn up to 256 rows and the model is a k=8 KMeans on this data
+        "serving_shape": (200_000, 64) if big else (20_000, 16),
     }

@@ -42,6 +42,12 @@ image ships no third-party linters, so the gate is stdlib-only but real:
     compiled_kernel telemetry routing, and the §5b/§5c sentinel/tie-order
     contracts (mirrors the top_k and cost_analysis fences). `# noqa` on the
     line exempts.
+  * off-plane HTTP server: any `http.server` import (or `ThreadingHTTPServer`
+    reference) outside observability/server.py. The telemetry endpoint is THE
+    driver-resident HTTP plane (refcounted lifecycle, loopback default, zero
+    threads when disabled, §6g); other planes — the serving endpoints (§7) —
+    mount path-prefix handlers on it via `register_mount` rather than binding
+    a second socket. `# noqa` on the line exempts.
   * off-plane device analysis: any `.cost_analysis()` / `.memory_analysis()` /
     `.memory_stats()` reference outside observability/device.py. The
     device-performance plane (docs/design.md §6f) owns XLA cost/memory
@@ -324,6 +330,49 @@ def check_file(path: Path) -> list:
                     "Pallas kernels live in the pallas kernel modules "
                     "(interpret gates, Mosaic workarounds, §5c parity "
                     "contracts); route through their host wrappers"
+                )
+
+    # the stdlib HTTP server lives in observability/server.py only: one
+    # driver-resident endpoint (refcounted lifecycle, §6g); the serving plane
+    # and anything else mount handlers on it via register_mount (§7)
+    if not (path.name == "server.py" and "observability" in path.parts):
+        src_lines = src.splitlines()
+        for node in ast.walk(tree):
+            hit = None
+            if isinstance(node, ast.Import) and any(
+                alias.name == "http.server" or
+                alias.name.startswith("http.server.")
+                for alias in node.names
+            ):
+                hit = "import http.server"
+            elif isinstance(node, ast.ImportFrom) and (
+                (node.module or "") == "http.server"
+                or (node.module or "").startswith("http.server.")
+                or (
+                    node.module == "http"
+                    and any(a.name == "server" for a in node.names)
+                )
+            ):
+                hit = "from http.server import ..."
+            elif (
+                isinstance(node, (ast.Name, ast.Attribute))
+                and (getattr(node, "id", None) == "ThreadingHTTPServer"
+                     or getattr(node, "attr", None) == "ThreadingHTTPServer")
+            ):
+                hit = "ThreadingHTTPServer reference"
+            if hit is None:
+                continue
+            line = (
+                src_lines[node.lineno - 1]
+                if node.lineno - 1 < len(src_lines)
+                else ""
+            )
+            if "noqa" not in line:
+                findings.append(
+                    f"{path}:{node.lineno}: {hit} outside observability/"
+                    "server.py — one HTTP plane only; mount handlers on it "
+                    "via observability.server.register_mount (docs/design.md "
+                    "§6g/§7)"
                 )
 
     # XLA cost/memory analysis + memory_stats live in observability/device.py

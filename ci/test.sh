@@ -308,7 +308,91 @@ print("COMM SMOKE OK: collective ops/bytes + comm_frac in the exported JSONL; "
       "delayed rank 3 flagged in events, /ranks and the postmortem")
 PY
   rm -rf "$SRML_COMM_SMOKE_DIR"
-  python -m pytest tests/ -q --ignore=tests/test_reliability.py --ignore=tests/test_device_cache.py --ignore=tests/test_observability.py --ignore=tests/test_transform_observability.py --ignore=tests/test_telemetry_plane.py --ignore=tests/test_comm_plane.py
+  # serving-plane smoke (docs/design.md §7): unit tests first, then the
+  # acceptance end-to-end — start the endpoint on port 0, register a fitted
+  # KMeans AND a fitted logreg (weights HBM-resident, per-bucket AOT
+  # pre-warm), drive concurrent mixed-size HTTP requests, and assert the
+  # steady-state contract FROM the plane's own telemetry: zero new
+  # device.compile{kernel=} entries after warm-up, zero recompile-storm
+  # events, exact per-request row counts, p99 + occupancy present in the
+  # exported serving_reports.jsonl, and zero leaked threads/sockets after
+  # stop_serving.
+  python -m pytest tests/test_serving.py -q
+  SRML_SERVING_SMOKE_DIR="$(mktemp -d)"
+  SRML_TPU_METRICS_DIR="$SRML_SERVING_SMOKE_DIR" \
+  python - <<'PY'
+import json, threading, urllib.request
+import numpy as np, pandas as pd
+from spark_rapids_ml_tpu import serving
+from spark_rapids_ml_tpu.classification import LogisticRegression
+from spark_rapids_ml_tpu.clustering import KMeans
+from spark_rapids_ml_tpu.observability import server as obs_server
+from spark_rapids_ml_tpu.observability.export import load_serving_reports
+from spark_rapids_ml_tpu.profiling import counter_totals
+
+rng = np.random.default_rng(0)
+X = np.concatenate(
+    [rng.normal(-3, 1, (128, 8)), rng.normal(3, 1, (128, 8))]
+).astype(np.float32)
+y = np.concatenate([np.zeros(128), np.ones(128)])
+km = KMeans(k=2, maxIter=6, seed=5).fit(pd.DataFrame({"features": list(X)}))
+lr = LogisticRegression(maxIter=8).fit(
+    pd.DataFrame({"features": list(X), "label": y})
+)
+
+addr = serving.start_serving(port=0)
+assert addr is not None, "endpoint did not bind"
+port = addr[1]
+serving.register_model("km", km)   # register = upload + per-bucket pre-warm
+serving.register_model("lr", lr)
+
+ref_km = km._serving_predict(X)["prediction"]
+compiles = lambda: {k: v for k, v in counter_totals().items()
+                    if k.startswith("device.compile{")}
+storms = lambda: sum(v for k, v in counter_totals().items()
+                     if k.startswith("transform.recompile_storm"))
+c0, s0 = compiles(), storms()
+
+def post(name, block):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/models/{name}:predict",
+        data=json.dumps({"instances": block.tolist()}).encode(), method="POST")
+    return json.loads(urllib.request.urlopen(req, timeout=15).read())
+
+failures = []
+def client(seed):
+    r = np.random.default_rng(seed)
+    for _ in range(15):
+        n = int(r.integers(1, 48)); off = int(r.integers(0, 256 - n))
+        doc = post("km", X[off:off + n])
+        if doc["rows"] != n or doc["outputs"]["prediction"] != \
+                ref_km[off:off + n].tolist():
+            failures.append(("km", off, n))
+        if post("lr", X[off:off + n])["rows"] != n:
+            failures.append(("lr", off, n))
+
+threads = [threading.Thread(target=client, args=(i,)) for i in range(6)]
+[t.start() for t in threads]; [t.join() for t in threads]
+assert not failures, failures[:5]
+new = {k: v - c0.get(k, 0) for k, v in compiles().items() if v != c0.get(k, 0)}
+assert not new, f"steady-state serving compiled: {new}"
+assert storms() == s0, "recompile sentinel fired on bucketed serving traffic"
+rep = serving.stop_serving()
+summary = serving.serving_summary(load_serving_reports(
+    __import__("os").environ["SRML_TPU_METRICS_DIR"])[-1])
+assert summary["km"]["requests"] == 90 and summary["lr"]["requests"] == 90
+assert summary["km"]["p99_ms"] > 0 and summary["km"]["batch_occupancy"] > 0
+assert summary["km"]["batches"] < summary["km"]["requests"]  # coalesced
+# zero leaked threads/sockets after shutdown
+assert obs_server.server_address() is None
+assert not any(t.name.startswith(("srml-serving", "srml-telemetry"))
+               for t in threading.enumerate())
+print(f"SERVING SMOKE OK: 180 concurrent HTTP requests exact, 0 warm-path "
+      f"compiles, km p99={summary['km']['p99_ms']}ms "
+      f"occupancy={summary['km']['batch_occupancy']}, no leaks")
+PY
+  rm -rf "$SRML_SERVING_SMOKE_DIR"
+  python -m pytest tests/ -q --ignore=tests/test_reliability.py --ignore=tests/test_device_cache.py --ignore=tests/test_observability.py --ignore=tests/test_transform_observability.py --ignore=tests/test_telemetry_plane.py --ignore=tests/test_comm_plane.py --ignore=tests/test_serving.py
 fi
 
 # small benchmark smoke (reference runs a small bench pre-merge)
@@ -324,7 +408,7 @@ SRML_DEVICE_SMOKE_DIR="$(mktemp -d)"
 SRML_BENCH_ROLE=worker \
 SRML_BENCH_PROGRESS="$SRML_DEVICE_SMOKE_DIR/progress.jsonl" \
 SRML_BENCH_DEADLINE_TS="$(python -c 'import time; print(time.time() + 600)')" \
-SRML_BENCH_SKIP="kmeans_headline,logreg,linreg,rf,umap,dbscan,fit_e2e,cache,telemetry_overhead,large_k,knn,ann,wide256" \
+SRML_BENCH_SKIP="kmeans_headline,logreg,linreg,rf,umap,dbscan,fit_e2e,cache,telemetry_overhead,serving_qps,large_k,knn,ann,wide256" \
 python bench.py
 SRML_BENCH_PROGRESS="$SRML_DEVICE_SMOKE_DIR/progress.jsonl" python - <<'PY'
 import json, os, sys

@@ -166,6 +166,32 @@ _DEFAULTS: Dict[str, Any] = {
     # logreg/linreg loss/grad-norm per iteration) kept in the run and exported
     # in the report's `convergence` section; overflow is counted, not kept
     "observability.max_convergence_records": 512,
+    # online serving plane (serving/, docs/design.md §7): the driver-resident
+    # inference server that turns per-request predict calls into fixed-shape
+    # device batches. A batch closes when it reaches max_batch_rows OR the
+    # OLDEST queued request has waited max_wait_ms — the classic latency/size
+    # cutoff pair (Podracer decoupled feed threads, arXiv:2104.06272)
+    "serving.max_batch_rows": 4096,
+    "serving.max_wait_ms": 2.0,
+    # smallest padding bucket: coalesced batches pad UP to the next power-of-
+    # two row count >= this, so the set of predict shape signatures is fixed
+    # and finite — bucketing IS the built-in fix for the recompile storms the
+    # PR-4 sentinel detects (one XLA compile per ragged batch size)
+    "serving.bucket_min_rows": 16,
+    # AOT pre-warm on model registration: compile one executable per
+    # (model, bucket) up front through the compiled_kernel cache so steady-
+    # state serving never compiles
+    "serving.prewarm": True,
+    # HBM byte budget of the serving model registry (weights of hot models
+    # stay device-resident; cold models evict LRU — pinned-while-serving —
+    # and reload transparently, counted as serving.model_reloads)
+    "serving.hbm_budget_bytes": 1 << 30,
+    # backpressure: max requests queued per served model before submit/POST
+    # rejects (HTTP 429); a bounded queue keeps tail latency bounded too
+    "serving.queue_depth": 1024,
+    # per-request wall-clock budget the HTTP handler waits on a future before
+    # answering 504 (the request may still complete; its slot is not replayed)
+    "serving.request_timeout_s": 30.0,
 }
 
 _ENV_KEYS: Dict[str, str] = {
@@ -217,6 +243,13 @@ _ENV_KEYS: Dict[str, str] = {
     "observability.http_host": "SRML_TPU_METRICS_HOST",
     "observability.flight_recorder_events": "SRML_TPU_FLIGHT_RECORDER_EVENTS",
     "observability.max_convergence_records": "SRML_TPU_MAX_CONVERGENCE_RECORDS",
+    "serving.max_batch_rows": "SRML_TPU_SERVING_MAX_BATCH_ROWS",
+    "serving.max_wait_ms": "SRML_TPU_SERVING_MAX_WAIT_MS",
+    "serving.bucket_min_rows": "SRML_TPU_SERVING_BUCKET_MIN_ROWS",
+    "serving.prewarm": "SRML_TPU_SERVING_PREWARM",
+    "serving.hbm_budget_bytes": "SRML_TPU_SERVING_HBM_BUDGET",
+    "serving.queue_depth": "SRML_TPU_SERVING_QUEUE_DEPTH",
+    "serving.request_timeout_s": "SRML_TPU_SERVING_REQUEST_TIMEOUT_S",
 }
 
 _overrides: Dict[str, Any] = {}

@@ -759,6 +759,42 @@ class _TpuModel(_TpuClass, _TpuParams):
         ELL contractions); models without it densify the query block."""
         return False
 
+    # ---- serving hooks (serving/, docs/design.md §7) ----
+    #
+    # The online serving plane coalesces many small requests into one padded
+    # fixed-shape batch and slices per-request results back out. That is only
+    # correct when a model's predict is ROW-INDEPENDENT: row i of the output
+    # depends on row i of the input alone (true for every matmul/scan predict
+    # kernel here). Models whose transform computes a function of the WHOLE
+    # query set (DBSCAN clusters it, UMAP optimizes the joint embedding)
+    # override `_serving_row_independent` to opt out — batch coalescing would
+    # bleed information across requests and padding would change results.
+
+    def _serving_row_independent(self) -> bool:
+        return True
+
+    def _serving_predict(self, X: np.ndarray) -> Dict[str, np.ndarray]:
+        """One serving batch: feature block -> named output arrays. The default
+        IS the batch transform path (`_transform_arrays`) so the serving plane
+        reuses each family's predict kernels un-forked; models whose transform
+        surface is not array-shaped (kNN) override with an equivalent routed
+        through the same predict_dispatch instrumentation."""
+        return self._transform_arrays(X)
+
+    def _serving_device_attrs(self) -> Tuple[str, ...]:
+        """Names of fitted attributes the serving registry keeps HBM-resident
+        (uploaded once at registration, reused as device operands every batch).
+        Default: every float ndarray attribute — the weight matrices predict
+        kernels consume. Models whose predict consumes other dtypes as device
+        operands (tree forests) or uses some arrays host-side (kNN item_ids)
+        override."""
+        return tuple(
+            k for k, v in self._model_attributes.items()
+            if isinstance(v, np.ndarray)
+            and v.dtype.kind == "f"
+            and v.ndim >= 1
+        )
+
     def _transform_sparse(self, csr: Any) -> Dict[str, np.ndarray]:
         raise NotImplementedError
 

@@ -158,6 +158,12 @@ class DBSCANModel(_DBSCANClass, _TpuModel, _DBSCANParams):
         )
         self._use_sklearn = False
 
+    def _serving_row_independent(self) -> bool:
+        # DBSCAN's "predict" clusters the query set itself: labels depend on
+        # the WHOLE batch, so coalescing requests (or padding rows) changes
+        # results — the serving plane must refuse to register it
+        return False
+
     def _transform_arrays(self, X: np.ndarray) -> Dict[str, np.ndarray]:
         self._validate_param_bounds()  # DBSCAN defers compute to transform
         if self._use_sklearn:

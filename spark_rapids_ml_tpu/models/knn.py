@@ -158,6 +158,55 @@ class NearestNeighborsModel(_NearestNeighborsClass, _TpuModel, _NNParams):
     def _transform_arrays(self, X: np.ndarray) -> Dict[str, np.ndarray]:
         raise NotImplementedError("Use kneighbors() / exactNearestNeighborsJoin().")
 
+    def _serving_device_attrs(self) -> Tuple[str, ...]:
+        # item_features (+ the fit-cached Σ X² when present) are the device
+        # operands of the serving scan; item_ids stay host-side (the gather
+        # back to user ids happens on the host after the top-k returns)
+        return tuple(
+            n for n in ("item_features", "item_norms_sq")
+            if isinstance(self._model_attributes.get(n), np.ndarray)
+            or hasattr(self._model_attributes.get(n), "shape")
+        )
+
+    def _serving_predict(self, X: np.ndarray) -> Dict[str, np.ndarray]:
+        """Serving-batch kNN: the same single-shard exact scan the production
+        search path uses (ops/knn.exact_knn_single — strategy knob, sentinel
+        and selection telemetry all apply), per query row independent, routed
+        through predict_dispatch like every other family. Returns the
+        kneighbors() column surface: per-row neighbor `indices` (user item
+        ids) and EUCLIDEAN `distances`."""
+        import jax.numpy as jnp
+
+        from ..observability.inference import predict_dispatch
+        from ..ops.knn import exact_knn_single
+
+        items = self._model_attributes["item_features"]
+        item_ids = np.asarray(self._model_attributes["item_ids"])
+        n_items = int(items.shape[0])
+        k = min(self.getK(), n_items)
+        x2 = self._model_attributes.get("item_norms_sq")
+        d2, idx = predict_dispatch(
+            self,
+            exact_knn_single,
+            jnp.asarray(np.asarray(X, np.float32)),
+            jnp.asarray(items),
+            jnp.ones((n_items,), bool),
+            k,
+            x2=jnp.asarray(x2) if x2 is not None else None,
+            model_name=type(self).__name__,
+            shape_of=X,
+        )
+        d2, idx = np.asarray(d2), np.asarray(idx)
+        # all items are valid here, so idx is always in range; keep the -1/inf
+        # API convention anyway for callers that serve a masked index
+        ids = np.where(
+            idx >= 0, item_ids[np.clip(idx, 0, n_items - 1)], -1
+        )
+        return {
+            "indices": ids,
+            "distances": np.sqrt(np.maximum(d2, 0.0)).astype(np.float32),
+        }
+
     def kneighbors(self, query_df: Any) -> Tuple[Any, Any, pd.DataFrame]:
         """Returns (item_df, query_df, knn_df): knn_df has query_id + arrays of item
         indices (ids) and euclidean distances (reference knn.py:574-660)."""

@@ -664,6 +664,11 @@ class _RandomForestModel(_RandomForestClass, _TpuModelWithPredictionCol, _Random
         predict)."""
         return [_DecisionTreeView(self, i) for i in range(self.getNumTrees())]
 
+    def _serving_device_attrs(self):
+        # the forest predict kernel's device operands include the int/bool
+        # structure arrays, not just float weights (the estimator default)
+        return ("feature", "threshold", "is_leaf", "value")
+
     def _forest_outputs(self, X: np.ndarray) -> np.ndarray:
         from ..observability.inference import predict_dispatch
 

@@ -384,6 +384,12 @@ class UMAPModel(_UMAPClass, _TpuModelWithColumns, _UMAPParams):
     def rawData_(self) -> np.ndarray:
         return self._model_attributes["raw_data"]
 
+    def _serving_row_independent(self) -> bool:
+        # the transform SGD refines all query embeddings jointly (negative
+        # sampling draws across the batch): padding rows and batch coalescing
+        # would change per-row results — not servable through the micro-batcher
+        return False
+
     def _transform_arrays(self, X: np.ndarray) -> Dict[str, np.ndarray]:
         from ..observability.inference import predict_dispatch
 

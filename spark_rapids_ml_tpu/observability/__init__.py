@@ -73,6 +73,7 @@ from .inference import (
 )
 from .export import (
     load_run_reports,
+    load_serving_reports,
     load_transform_partials,
     load_transform_reports,
     render_prometheus,
@@ -146,6 +147,7 @@ __all__ = [
     "transform_batch",
     "transform_run",
     "load_run_reports",
+    "load_serving_reports",
     "load_transform_partials",
     "load_transform_reports",
     "render_prometheus",

@@ -31,6 +31,7 @@ from .registry import MetricsRegistry, split_label_key
 RUN_REPORT_FILENAME = "fit_reports.jsonl"
 TRANSFORM_REPORT_FILENAME = "transform_reports.jsonl"
 TRANSFORM_PARTIALS_FILENAME = "transform_partials.jsonl"
+SERVING_REPORT_FILENAME = "serving_reports.jsonl"
 
 _NAME_OK = re.compile(r"[^a-zA-Z0-9_:]")
 _PROM_PREFIX = "srml_tpu_"
@@ -177,6 +178,14 @@ def load_transform_partials(path_or_dir: str) -> List[Dict[str, Any]]:
     """Partition-snapshot sidecar lines (see append_transform_partial)."""
     if os.path.isdir(path_or_dir):
         return load_run_reports(path_or_dir, filename=TRANSFORM_PARTIALS_FILENAME)
+    return load_run_reports(path_or_dir)
+
+
+def load_serving_reports(path_or_dir: str) -> List[Dict[str, Any]]:
+    """`load_run_reports` for the serving plane's JSONL (one line per serving
+    session, written when the ServingRun scope closes — serving/http.py)."""
+    if os.path.isdir(path_or_dir):
+        return load_run_reports(path_or_dir, filename=SERVING_REPORT_FILENAME)
     return load_run_reports(path_or_dir)
 
 

@@ -82,6 +82,25 @@ def suppress_transform_runs() -> Iterator[None]:
         _tls.suppress_depth = _suppress_depth() - 1
 
 
+def _bucketed_depth() -> int:
+    return getattr(_tls, "bucketed_depth", 0)
+
+
+@contextlib.contextmanager
+def bucketed_signatures() -> Iterator[None]:
+    """Mark this thread's predict shape signatures as BUCKETED BY DESIGN (the
+    serving plane's finite power-of-two bucket table, serving/batcher.py):
+    each new signature still counts `transform.compile{model=}` — it IS a
+    compile — but is exempt from the recompile-storm sentinel. The sentinel
+    exists to catch unbounded ragged-batch signature growth; a fixed bucket
+    table is the fix it recommends, and warming that table must not trip it."""
+    _tls.bucketed_depth = _bucketed_depth() + 1
+    try:
+        yield
+    finally:
+        _tls.bucketed_depth = _bucketed_depth() - 1
+
+
 @contextlib.contextmanager
 def transform_run(algo: str, site: str = "driver") -> Iterator[Optional[TransformRun]]:
     """TransformRun gated on `observability.enabled` AND on not already being
@@ -119,6 +138,11 @@ def _should_sample(key: str) -> bool:
 
 _shape_lock = threading.Lock()
 _shape_sigs: Dict[str, set] = {}
+# signatures registered under bucketed_signatures() (the serving plane's
+# finite bucket table): remembered for compile dedup, EXCLUDED from the storm
+# count — a served model's 9-bucket table must not push an unrelated ragged
+# transform over the threshold
+_bucketed_sigs: Dict[str, set] = {}
 _storm_warned: set = set()
 
 # membership cap per model: a pathological fully-ragged serving stream (every
@@ -133,6 +157,7 @@ def reset_shape_buckets() -> None:
     that reload models)."""
     with _shape_lock:
         _shape_sigs.clear()
+        _bucketed_sigs.clear()
         _storm_warned.clear()
 
 
@@ -163,14 +188,21 @@ def record_shape_signature(model_name: str, sig: Tuple[int, int, str]) -> bool:
     signature is NEW for this model (== one more XLA compile of its predict
     program) and fires the recompile sentinel once the distinct count exceeds
     `observability.recompile_warn_threshold`."""
+    bucketed = _bucketed_depth() > 0
     with _shape_lock:
         sigs = _shape_sigs.setdefault(model_name, set())
         if sig in sigs:
             return False
         if len(sigs) < _MAX_TRACKED_SIGS:
             sigs.add(sig)
-        n_distinct = len(sigs)
+            if bucketed:
+                _bucketed_sigs.setdefault(model_name, set()).add(sig)
+        # the storm judges only UN-bucketed growth: a served model's finite
+        # bucket table is the sentinel's recommended fix, not evidence
+        n_distinct = len(sigs) - len(_bucketed_sigs.get(model_name, ()))
     counter_inc("transform.compile", 1, model=model_name)
+    if bucketed:
+        return True  # bucketed by design (serving plane): no storm accounting
     threshold = int(_config.get("observability.recompile_warn_threshold"))
     if threshold > 0 and n_distinct > threshold:
         counter_inc("transform.recompile_storm", 1, model=model_name)

@@ -47,6 +47,40 @@ _server: Optional["TelemetryServer"] = None
 _refs = 0  # open run scopes holding the server up
 _pinned = False  # start_metrics_server() keeps it up across runs
 
+# path-prefix mounts: other driver-resident planes (the serving plane's
+# /v1/... inference endpoints, serving/http.py) attach their handlers HERE
+# instead of starting a second HTTP server — one socket, one refcounted
+# lifecycle, zero threads when nothing is enabled. A mount handler takes
+# (method, path, body_bytes_or_None) and returns (status_code, json_doc).
+_mounts: dict = {}
+
+# bound on POST bodies a mount can receive (a predict batch of feature rows
+# is comfortably under this; an unbounded read is a trivial memory DoS)
+_MAX_BODY_BYTES = 64 << 20
+
+
+def register_mount(prefix: str, handler: Any) -> None:
+    """Attach `handler` for every request whose path starts with `prefix`.
+    Longest matching prefix wins when mounts nest."""
+    with _lock:
+        _mounts[str(prefix)] = handler
+
+
+def unregister_mount(prefix: str) -> None:
+    with _lock:
+        _mounts.pop(str(prefix), None)
+
+
+def _find_mount(path: str):
+    with _lock:
+        best = None
+        for prefix, handler in _mounts.items():
+            if path.startswith(prefix) and (
+                best is None or len(prefix) > len(best[0])
+            ):
+                best = (prefix, handler)
+        return best[1] if best else None
+
 
 def _configured_port() -> Optional[int]:
     port = _config.get("observability.http_port")
@@ -87,9 +121,46 @@ class _Handler(BaseHTTPRequestHandler):
         body = json.dumps(doc, default=_json_fallback).encode()
         self._send(code, body, "application/json")
 
+    def _dispatch_mount(self, method: str, path: str,
+                        body: Optional[bytes]) -> bool:
+        """Route to a registered path-prefix mount (the serving plane's /v1/
+        endpoints). Returns False when no mount claims the path."""
+        handler = _find_mount(path)
+        if handler is None:
+            return False
+        code, doc = handler(method, path, body)
+        self._send_json(doc, int(code))
+        return True
+
+    def _read_body(self) -> Optional[bytes]:
+        try:
+            length = int(self.headers.get("Content-Length") or 0)
+        except (TypeError, ValueError):
+            length = 0
+        if length <= 0 or length > _MAX_BODY_BYTES:
+            return None
+        return self.rfile.read(length)
+
+    def do_POST(self) -> None:  # noqa: N802 — BaseHTTPRequestHandler contract
+        try:
+            path = self.path.split("?", 1)[0].rstrip("/") or "/"
+            body = self._read_body()
+            if not self._dispatch_mount("POST", path, body):
+                self._send_json(
+                    {"error": "unknown path",
+                     "mounts": sorted(_mounts)}, 404,
+                )
+        except Exception as e:
+            try:
+                self._send_json({"error": f"{type(e).__name__}: {e}"}, 500)
+            except Exception:  # noqa: silent-except — socket already gone
+                pass
+
     def do_GET(self) -> None:  # noqa: N802 — BaseHTTPRequestHandler contract
         try:
             path = self.path.split("?", 1)[0].rstrip("/") or "/"
+            if self._dispatch_mount("GET", path, None):
+                return
             if path == "/metrics":
                 from .export import render_prometheus
                 from .runs import global_registry
@@ -137,7 +208,7 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json({"error": "unknown path", "paths": [
                     "/metrics", "/healthz", "/runs", "/runs/<run_id>",
                     "/runs/<run_id>/ranks"
-                ]}, 404)
+                ], "mounts": sorted(_mounts)}, 404)
         except Exception as e:
             # a scrape must never take the process down; report the error to
             # the scraper instead
@@ -296,5 +367,6 @@ def _reset_for_tests() -> None:
         srv, _server = _server, None
         _refs = 0
         _pinned = False
+        _mounts.clear()
     if srv is not None:
         srv.close()

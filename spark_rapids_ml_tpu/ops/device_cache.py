@@ -82,6 +82,11 @@ class DeviceBatchCache:
         # cannot alias a freed dataset's key to a new array's key while this
         # cache lives
         self._key_pins: Dict[Any, Sequence[Any]] = {}
+        # stream key -> pin count: a pinned stream's entries are NEVER evicted
+        # (the serving plane pins a model's weights for the duration of each
+        # in-flight batch; before this existed nothing stopped LRU pressure
+        # from evicting a tuple a concurrent reader still referenced)
+        self._pin_counts: Dict[Any, int] = {}
 
     def stream_key(self, arrays: Sequence[Any], batch_rows: int, mesh,
                    site: str = "ingest") -> Any:
@@ -103,6 +108,11 @@ class DeviceBatchCache:
         self._key_pins.setdefault(key, tuple(arrays))
         return key
 
+    def contains(self, stream_key: Any, batch_index: int) -> bool:
+        """Residency probe: no hit/miss counting, no LRU touch (stats views
+        must not promote an entry they only looked at)."""
+        return (stream_key, batch_index) in self._entries
+
     def get(self, stream_key: Any, batch_index: int) -> Optional[tuple]:
         """Resident batch tuple, or None (counted as hit/miss)."""
         entry = self._entries.get((stream_key, batch_index))
@@ -113,21 +123,51 @@ class DeviceBatchCache:
         profiling.count("cache.hits")
         return entry[0]
 
+    def pin(self, stream_key: Any) -> None:
+        """Hold this stream's entries resident: eviction skips pinned streams
+        (counted as `cache.evict_skipped_pinned`). Pins nest — a stream is
+        evictable again only once every pin() has been matched by unpin()."""
+        self._pin_counts[stream_key] = self._pin_counts.get(stream_key, 0) + 1
+
+    def unpin(self, stream_key: Any) -> None:
+        n = self._pin_counts.get(stream_key, 0) - 1
+        if n <= 0:
+            self._pin_counts.pop(stream_key, None)
+        else:
+            self._pin_counts[stream_key] = n
+
+    def is_pinned(self, stream_key: Any) -> bool:
+        return self._pin_counts.get(stream_key, 0) > 0
+
     def put(self, stream_key: Any, batch_index: int, batch: tuple) -> bool:
         """Retain a freshly-streamed batch. Evicts LRU entries of OTHER
         streams under budget pressure; never evicts the inserting stream's own
-        batches (prefix semantics: cache the head, stream the tail)."""
+        batches (prefix semantics: cache the head, stream the tail) and never
+        evicts a PINNED stream's batches (a reader is mid-flight on them —
+        each skip counts `cache.evict_skipped_pinned`)."""
         if (stream_key, batch_index) in self._entries:
             return True  # a resumed pass replayed a batch already resident
         nbytes = sum(int(getattr(a, "nbytes", 0)) for a in batch)
         if nbytes > self.budget_bytes:
             return False
+        # skipped pinned entries count ONCE per put() — the eviction loop
+        # rescans from the head every pass, and re-counting the same pinned
+        # entry each pass would overstate pin pressure E-fold
+        skip_counted: set = set()
         while self.bytes_resident + nbytes > self.budget_bytes:
-            victim = next(
-                (k for k in self._entries if k[0] != stream_key), None
-            )
+            victim = None
+            for k in self._entries:
+                if k[0] == stream_key:
+                    continue
+                if self.is_pinned(k[0]):
+                    if k not in skip_counted:
+                        skip_counted.add(k)
+                        profiling.count("cache.evict_skipped_pinned")
+                    continue
+                victim = k
+                break
             if victim is None:
-                return False  # only our own prefix is resident: fall through
+                return False  # only own-prefix/pinned entries remain: stream
             self._evict(victim)
         self._entries[(stream_key, batch_index)] = (batch, nbytes)
         self.bytes_resident += nbytes
@@ -144,6 +184,21 @@ class DeviceBatchCache:
     def resident_batches(self) -> int:
         return len(self._entries)
 
+    def drop_stream(self, stream_key: Any) -> int:
+        """Release every entry of one stream (lifecycle free — NOT counted as
+        eviction pressure) and its source/pin bookkeeping. Returns the bytes
+        released. The serving plane uses this when a model unregisters."""
+        freed = 0
+        for ek in [k for k in self._entries if k[0] == stream_key]:
+            _, nbytes = self._entries.pop(ek)
+            freed += nbytes
+        if freed:
+            self.bytes_resident -= freed
+            _obs.gauge_dec("cache.bytes_resident", freed)
+        self._key_pins.pop(stream_key, None)
+        self._pin_counts.pop(stream_key, None)
+        return freed
+
     def close(self) -> None:
         """Drop every device reference (the HBM frees once the accumulators
         release their last use) and unpin the sources. Not counted as
@@ -153,6 +208,7 @@ class DeviceBatchCache:
         self.bytes_resident = 0
         self._entries.clear()
         self._key_pins.clear()
+        self._pin_counts.clear()
 
 
 def cached_build(cache: Optional[DeviceBatchCache], cache_key: Any,

@@ -1,0 +1,70 @@
+#
+# Online serving plane (docs/design.md §7): a driver-resident inference server
+# for trained models — async dynamic micro-batching, bucketed shape padding
+# with AOT pre-warm, an HBM-resident multi-tenant model registry, and HTTP
+# endpoints mounted on the live telemetry plane's server.
+#
+#   batcher.py    per-model request queue + dispatcher thread: latency/size
+#                 cutoffs, power-of-two row buckets, per-request scatter
+#   registry.py   HBM-resident model registry over ops/device_cache.py
+#                 (pin-while-serving, LRU eviction, transparent reloads) +
+#                 bucketed AOT pre-warm through compiled_kernel
+#   http.py       lifecycle (start_serving/stop_serving, ServingRun scope) +
+#                 the /v1/ mount on observability/server.py
+#
+# Quick start:
+#
+#   from spark_rapids_ml_tpu import serving
+#   serving.start_serving(port=0)                  # ephemeral loopback port
+#   serving.register_model("km", fitted_kmeans)    # uploads + pre-warms
+#   out = serving.predict("km", X_block)           # in-process
+#   # or: curl -X POST http://127.0.0.1:<port>/v1/models/km:predict \
+#   #          -d '{"instances": [[...], ...]}'
+#   report = serving.stop_serving()                # serving_reports.jsonl
+#
+
+from .batcher import (
+    MicroBatcher,
+    QueueFull,
+    RequestTooLarge,
+    ServingError,
+    bucket_rows,
+    bucket_table,
+    pad_to_bucket,
+)
+from .http import (
+    MOUNT_PREFIX,
+    ServingRun,
+    get_registry,
+    predict,
+    register_model,
+    serving_address,
+    serving_summary,
+    start_serving,
+    stop_serving,
+    submit,
+    unregister_model,
+)
+from .registry import ModelRegistry
+
+__all__ = [
+    "MOUNT_PREFIX",
+    "MicroBatcher",
+    "ModelRegistry",
+    "QueueFull",
+    "RequestTooLarge",
+    "ServingError",
+    "ServingRun",
+    "bucket_rows",
+    "bucket_table",
+    "get_registry",
+    "pad_to_bucket",
+    "predict",
+    "register_model",
+    "serving_address",
+    "serving_summary",
+    "start_serving",
+    "stop_serving",
+    "submit",
+    "unregister_model",
+]

@@ -1,0 +1,280 @@
+#
+# Async dynamic micro-batcher — the request-coalescing half of the serving
+# plane (docs/design.md §7).
+#
+# The Podracer architectures (arXiv:2104.06272) decouple request feeding from
+# accelerator stepping: feed threads enqueue, the accelerator executes
+# fixed-shape batched steps. This module is that split for model inference:
+#
+#   * HTTP handler threads (or in-process callers) `submit()` variable-size
+#     requests and block on a Future;
+#   * ONE dispatcher thread per served model drains the queue, closing a batch
+#     when it reaches `serving.max_batch_rows` OR the oldest queued request
+#     has waited `serving.max_wait_ms` (the latency/size cutoff pair);
+#   * the coalesced rows are written into a REUSED per-bucket staging buffer,
+#     padded to the power-of-two row bucket (padding rows replicate the last
+#     real row — always a valid input, so cosine/normalization paths never see
+#     a synthetic zero vector), executed ONCE through the model's predict
+#     kernels, and per-request slices scatter back to the waiting futures.
+#
+# Because every executed shape is a bucket, the set of predict shape
+# signatures is finite and pre-warmable: steady-state serving never compiles
+# and the PR-4 recompile sentinel (`transform.recompile_storm`) cannot fire.
+#
+# Telemetry (all label-aware `{model=}`): per-request `serving.queue_s` /
+# `serving.total_s` histograms, per-batch `serving.pad_s` / `serving.execute_s`
+# / `serving.batch_occupancy` (real rows / bucket rows — proof the batcher is
+# actually coalescing), counters `serving.requests` / `serving.rows` /
+# `serving.batches` / `serving.padded_rows` / `serving.errors` /
+# `serving.bucket_hit` / `serving.bucket_miss` (pre-warmed bucket or not).
+#
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .. import config as _config
+from ..observability.runs import counter_inc, observe, span
+from ..utils import get_logger
+
+_logger = get_logger("serving.batcher")
+
+
+class ServingError(RuntimeError):
+    """Base class for request-rejection errors of the serving plane."""
+
+
+class QueueFull(ServingError):
+    """Backpressure: the per-model queue reached `serving.queue_depth`."""
+
+
+class RequestTooLarge(ServingError):
+    """A single request exceeded `serving.max_batch_rows`."""
+
+
+def bucket_rows(n: int, min_rows: Optional[int] = None,
+                max_rows: Optional[int] = None) -> int:
+    """The power-of-two row bucket `n` pads to: smallest 2^i >= max(n,
+    serving.bucket_min_rows), clamped to the bucket ceiling (the power of two
+    covering serving.max_batch_rows)."""
+    if min_rows is None:
+        min_rows = int(_config.get("serving.bucket_min_rows"))
+    if max_rows is None:
+        max_rows = int(_config.get("serving.max_batch_rows"))
+    n = max(int(n), max(int(min_rows), 1))
+    bucket = 1 << (n - 1).bit_length()
+    return min(bucket, 1 << (max(int(max_rows), 1) - 1).bit_length())
+
+
+def bucket_table(min_rows: Optional[int] = None,
+                 max_rows: Optional[int] = None) -> Tuple[int, ...]:
+    """Every bucket the batcher can emit under the current config — the set
+    registration pre-warms one executable for."""
+    lo = bucket_rows(1, min_rows, max_rows)
+    hi = bucket_rows(
+        int(max_rows if max_rows is not None
+            else _config.get("serving.max_batch_rows")),
+        min_rows, max_rows,
+    )
+    out = []
+    b = lo
+    while b <= hi:
+        out.append(b)
+        b *= 2
+    return tuple(out)
+
+
+def pad_to_bucket(X: np.ndarray, bucket: int,
+                  out: Optional[np.ndarray] = None) -> np.ndarray:
+    """Pad a (n, d) float32 block to (bucket, d) by replicating the LAST real
+    row (any real row is a valid model input; zeros would poison cosine /
+    normalization paths). With `out` given, fills the reused staging buffer
+    in place — steady-state serving allocates no per-batch host memory."""
+    n = int(X.shape[0])
+    if out is None:
+        out = np.empty((bucket, X.shape[1]), np.float32)
+    out[:n] = X
+    if bucket > n:
+        out[n:] = out[n - 1]
+    return out
+
+
+class _Request:
+    __slots__ = ("X", "n_rows", "future", "enqueue_ts")
+
+    def __init__(self, X: np.ndarray):
+        self.X = X
+        self.n_rows = int(X.shape[0])
+        self.future: "Future[Dict[str, np.ndarray]]" = Future()
+        self.enqueue_ts = time.perf_counter()
+
+
+class MicroBatcher:
+    """One served model's queue + dispatcher thread. `execute` is the bound
+    predict closure the registry supplies (residency pin + padded predict);
+    `warm_buckets` is the registry's set of pre-warmed bucket sizes (read-only
+    here, used for the bucket_hit/bucket_miss counters)."""
+
+    def __init__(self, name: str, n_cols: int,
+                 execute: Callable[[np.ndarray, int], Dict[str, np.ndarray]],
+                 warm_buckets: Optional[set] = None):
+        self.name = name
+        self.n_cols = int(n_cols)
+        self._execute = execute
+        self.warm_buckets = warm_buckets if warm_buckets is not None else set()
+        self._queue: "deque[_Request]" = deque()
+        self._cond = threading.Condition()
+        self._stop = False
+        self._staging: Dict[int, np.ndarray] = {}
+        self._thread = threading.Thread(
+            target=self._loop, name=f"srml-serving-{name}", daemon=True
+        )
+        self._thread.start()
+
+    # ------------------------------------------------------------ client side
+
+    def submit(self, X: np.ndarray) -> "Future[Dict[str, np.ndarray]]":
+        """Enqueue one request; the returned Future resolves to this request's
+        named output arrays (exactly `n_rows` leading rows each)."""
+        X = np.asarray(X, np.float32)
+        if X.ndim == 1:
+            X = X[None, :]
+        if X.ndim != 2 or X.shape[1] != self.n_cols:
+            raise ServingError(
+                f"model '{self.name}' expects (n, {self.n_cols}) features; "
+                f"got shape {tuple(X.shape)}"
+            )
+        if X.shape[0] < 1:
+            raise ServingError("empty request (0 rows)")
+        if X.shape[0] > int(_config.get("serving.max_batch_rows")):
+            raise RequestTooLarge(
+                f"request of {X.shape[0]} rows exceeds serving.max_batch_rows="
+                f"{_config.get('serving.max_batch_rows')}; split it client-side"
+            )
+        req = _Request(X)
+        with self._cond:
+            if self._stop:
+                raise ServingError(f"model '{self.name}' is shutting down")
+            if len(self._queue) >= int(_config.get("serving.queue_depth")):
+                counter_inc("serving.rejected", 1, model=self.name)
+                raise QueueFull(
+                    f"model '{self.name}' queue is full "
+                    f"(serving.queue_depth={_config.get('serving.queue_depth')})"
+                )
+            self._queue.append(req)
+            self._cond.notify()
+        return req.future
+
+    def pending(self) -> int:
+        with self._cond:
+            return len(self._queue)
+
+    def stop(self, timeout: float = 10.0) -> None:
+        """Stop accepting requests, drain what is queued, join the thread."""
+        with self._cond:
+            self._stop = True
+            self._cond.notify_all()
+        self._thread.join(timeout=timeout)
+
+    # -------------------------------------------------------- dispatcher side
+
+    def _loop(self) -> None:
+        while True:
+            with self._cond:
+                while not self._queue and not self._stop:
+                    self._cond.wait(0.05)
+                if not self._queue and self._stop:
+                    return
+                first = self._queue.popleft()
+            self._run_batch(self._coalesce(first))
+
+    def _coalesce(self, first: _Request) -> List[_Request]:
+        """Drain until size or latency cutoff: the batch closes at
+        max_batch_rows, or when the FIRST (oldest) request has waited
+        max_wait_ms — later arrivals never extend the oldest request's wait."""
+        batch = [first]
+        rows = first.n_rows
+        max_rows = int(_config.get("serving.max_batch_rows"))
+        deadline = first.enqueue_ts + (
+            float(_config.get("serving.max_wait_ms")) / 1000.0
+        )
+        while rows < max_rows:
+            with self._cond:
+                if self._queue and rows + self._queue[0].n_rows <= max_rows:
+                    nxt = self._queue.popleft()
+                    batch.append(nxt)
+                    rows += nxt.n_rows
+                    continue
+                if self._queue:
+                    break  # next request would overflow: close this batch
+                remaining = deadline - time.perf_counter()
+                if remaining <= 0 or self._stop:
+                    break
+                self._cond.wait(min(remaining, 0.05))
+        return batch
+
+    def _run_batch(self, batch: List[_Request]) -> None:
+        t_start = time.perf_counter()
+        n = sum(r.n_rows for r in batch)
+        for r in batch:
+            observe("serving.queue_s", t_start - r.enqueue_ts, model=self.name)
+        bucket = bucket_rows(n)
+        try:
+            stage = self._staging.get(bucket)
+            if stage is None:
+                stage = self._staging[bucket] = np.empty(
+                    (bucket, self.n_cols), np.float32
+                )
+            off = 0
+            for r in batch:
+                stage[off: off + r.n_rows] = r.X
+                off += r.n_rows
+            if bucket > n:
+                stage[n:] = stage[n - 1]
+            t_padded = time.perf_counter()
+            observe("serving.pad_s", t_padded - t_start, model=self.name)
+            counter_inc("serving.padded_rows", bucket - n, model=self.name)
+            counter_inc(
+                "serving.bucket_hit" if bucket in self.warm_buckets
+                else "serving.bucket_miss", 1, model=self.name,
+            )
+            with span("serving.batch",
+                      {"model": self.name, "rows": n, "bucket": bucket}):
+                outputs = self._execute(stage, n)
+            t_done = time.perf_counter()
+            observe("serving.execute_s", t_done - t_padded, model=self.name)
+            observe("serving.batch_occupancy", n / bucket, model=self.name)
+        except Exception as e:
+            counter_inc("serving.errors", 1, model=self.name)
+            _logger.warning("serving batch failed for %s: %s", self.name, e)
+            for r in batch:
+                if not r.future.set_running_or_notify_cancel():
+                    continue
+                r.future.set_exception(e)
+            return
+        # scatter per-request slices back to the waiting futures: exact row
+        # counts, no cross-request bleed (sliced COPIES so one request's
+        # result does not keep the whole bucket's outputs alive)
+        off = 0
+        now = time.perf_counter()
+        for r in batch:
+            out_r: Dict[str, Any] = {}
+            for key, v in outputs.items():
+                arr = np.asarray(v)
+                if arr.ndim >= 1 and arr.shape[0] == bucket:
+                    out_r[key] = arr[off: off + r.n_rows].copy()
+                else:  # per-model scalars/metadata ride along unsliced
+                    out_r[key] = arr
+            off += r.n_rows
+            if r.future.set_running_or_notify_cancel():
+                r.future.set_result(out_r)
+            observe("serving.total_s", now - r.enqueue_ts, model=self.name)
+        counter_inc("serving.batches", 1, model=self.name)
+        counter_inc("serving.requests", len(batch), model=self.name)
+        counter_inc("serving.rows", n, model=self.name)

@@ -1,0 +1,282 @@
+#
+# Serving-plane lifecycle + HTTP surface (docs/design.md §7).
+#
+# The inference endpoints MOUNT on the live telemetry plane's existing HTTP
+# server (observability/server.py, §6g) instead of starting a second one: the
+# same loopback-by-default socket, the same refcounted lifecycle, and with
+# serving never started there are zero extra threads and zero sockets.
+#
+#   POST /v1/models/<name>:predict   {"instances": [[...], ...]}
+#       -> {"model", "rows", "outputs": {col: [...], ...}}
+#   GET  /v1/models                  registry index with per-model stats
+#   GET  /v1/models/<name>           one model's stats view
+#
+# A serving session is a ServingRun (a FitRun subclass, kind="serving"): every
+# serving counter/histogram/span from every dispatcher and HTTP thread fans
+# out into its scoped registry, and `stop_serving()` closes the scope and
+# exports one line to `serving_reports.jsonl` — the run report the
+# concurrency tests and the bench scenario read p50/p95/p99 and
+# batch-occupancy from (`Histogram.quantile` plumbing, §6d).
+#
+
+from __future__ import annotations
+
+import json
+import threading
+from concurrent.futures import TimeoutError as FutureTimeout
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .. import config as _config
+from ..observability import server as _obs_server
+from ..observability.export import SERVING_REPORT_FILENAME
+from ..observability.registry import interpolate_quantile, split_label_key
+from ..observability.runs import FitRun
+from ..utils import get_logger
+from .batcher import QueueFull, RequestTooLarge, ServingError
+from .registry import ModelRegistry
+
+_logger = get_logger("serving.http")
+
+MOUNT_PREFIX = "/v1/"
+
+
+class ServingRun(FitRun):
+    """One serving session's observability scope — exports to
+    `serving_reports.jsonl` (the serving mirror of Fit/TransformRun)."""
+
+    kind = "serving"
+    _id_prefix = "serving"
+    _root_suffix = "serving_run"
+    _report_filename = SERVING_REPORT_FILENAME
+
+
+_lock = threading.RLock()
+# serializes the whole start/stop transition (a check-then-act on _started
+# under the state lock alone would let two concurrent start_serving calls
+# both enter a ServingRun and leak the loser's server refcount forever)
+_lifecycle_lock = threading.Lock()
+_registry: Optional[ModelRegistry] = None
+_run: Optional[ServingRun] = None
+_started = False
+_port_was_set = False
+
+
+def get_registry() -> ModelRegistry:
+    """The process serving registry (created on first use). Usable without the
+    HTTP endpoint — tests and in-process callers register/predict directly."""
+    global _registry
+    with _lock:
+        if _registry is None:
+            _registry = ModelRegistry()
+        return _registry
+
+
+def register_model(name: str, model: Any,
+                   prewarm: Optional[bool] = None) -> Dict[str, Any]:
+    return get_registry().register(name, model, prewarm=prewarm)
+
+
+def unregister_model(name: str) -> bool:
+    with _lock:
+        reg = _registry
+    return reg.unregister(name) if reg is not None else False
+
+
+def predict(name: str, X: np.ndarray,
+            timeout: Optional[float] = None) -> Dict[str, np.ndarray]:
+    return get_registry().predict(name, X, timeout=timeout)
+
+
+def submit(name: str, X: np.ndarray):
+    return get_registry().submit(name, X)
+
+
+def start_serving(port: Optional[int] = None) -> Optional[Tuple[str, int]]:
+    """Open the serving session: pin the telemetry HTTP endpoint up (binding
+    `port`; None uses `observability.http_port`, falling back to an ephemeral
+    port), mount the /v1/ handlers on it, and open the ServingRun scope.
+    Returns the bound (host, port); None when the endpoint could not bind."""
+    global _run, _started, _port_was_set
+    with _lifecycle_lock:
+        with _lock:
+            if _started:
+                return _obs_server.server_address()
+        if port is None and _config.get("observability.http_port") is None:
+            port = 0  # serving asked for an endpoint: ephemeral beats none
+        addr = _obs_server.start_metrics_server(port)
+        if addr is None:
+            _logger.warning("serving endpoint could not bind; not starting")
+            return None
+        get_registry()
+        run = ServingRun("serving", site="driver")
+        run.__enter__()
+        _obs_server.register_mount(MOUNT_PREFIX, _http_handler)
+        with _lock:
+            _run = run
+            _started = True
+            _port_was_set = port is not None
+    _logger.info("serving endpoint mounted at http://%s:%d/v1/", *addr)
+    return addr
+
+
+def stop_serving() -> Optional[Dict[str, Any]]:
+    """Tear the serving session down: unmount /v1/, drain and join every
+    dispatcher thread, drop the HBM weight entries, close the ServingRun
+    (exporting its report), and release the endpoint pin. Returns the session
+    report (None when serving was never started)."""
+    global _registry, _run, _started, _port_was_set
+    with _lifecycle_lock:
+        with _lock:
+            was_started = _started
+            registry, _registry = _registry, None
+            run, _run = _run, None
+            port_was_set = _port_was_set
+            _started = False
+            _port_was_set = False
+        report = None
+        if was_started:
+            _obs_server.unregister_mount(MOUNT_PREFIX)
+        if registry is not None:
+            registry.close()
+        if run is not None:
+            run.__exit__(None, None, None)
+            report = run.report()
+        if was_started:
+            _obs_server.stop_metrics_server()
+            if port_was_set:
+                # start_serving routed its port through config; no override
+                # must outlive the session
+                _config.unset("observability.http_port")
+        return report
+
+
+def serving_address() -> Optional[Tuple[str, int]]:
+    return _obs_server.server_address()
+
+
+# ------------------------------------------------------------------- handlers
+
+
+def _http_handler(method: str, path: str,
+                  body: Optional[bytes]) -> Tuple[int, Any]:
+    """The /v1/ mount (observability/server.py dispatches here). Never raises:
+    every error maps to a status + JSON body."""
+    with _lock:
+        reg = _registry
+    if reg is None:
+        return 503, {"error": "serving is not started"}
+    try:
+        if method == "GET" and path == "/v1/models":
+            return 200, {"models": reg.stats_all()}
+        if method == "GET" and path.startswith("/v1/models/"):
+            return 200, reg.stats(path[len("/v1/models/"):])
+        if method == "POST" and path.startswith("/v1/models/") \
+                and path.endswith(":predict"):
+            name = path[len("/v1/models/"): -len(":predict")]
+            return _handle_predict(reg, name, body)
+        return 404, {"error": "unknown serving path", "paths": [
+            "GET /v1/models", "GET /v1/models/<name>",
+            "POST /v1/models/<name>:predict",
+        ]}
+    except KeyError as e:
+        return 404, {"error": str(e.args[0]) if e.args else "not found"}
+    except QueueFull as e:
+        return 429, {"error": str(e)}
+    except (RequestTooLarge, ServingError, ValueError) as e:
+        return 400, {"error": str(e)}
+    except FutureTimeout:
+        return 504, {"error": "request timed out "
+                              f"(serving.request_timeout_s="
+                              f"{_config.get('serving.request_timeout_s')})"}
+    except Exception as e:
+        _logger.warning("serving handler error: %s", e)
+        return 500, {"error": f"{type(e).__name__}: {e}"}
+
+
+def _handle_predict(reg: ModelRegistry, name: str,
+                    body: Optional[bytes]) -> Tuple[int, Any]:
+    if not body:
+        return 400, {"error": "empty request body; send "
+                              '{"instances": [[...], ...]}'}
+    try:
+        doc = json.loads(body)
+    except json.JSONDecodeError as e:
+        return 400, {"error": f"invalid JSON body: {e}"}
+    if not isinstance(doc, dict):
+        # a bare list of rows is the most natural malformed payload: a
+        # client-input error, not a 500-worthy handler fault
+        return 400, {"error": 'body must be a JSON object: '
+                              '{"instances": [[...], ...]}'}
+    inst = doc.get("instances", doc.get("inputs"))
+    if inst is None:
+        return 400, {"error": 'body must carry "instances" (list of feature '
+                              "rows)"}
+    X = np.asarray(inst, dtype=np.float32)
+    out = reg.predict(name, X)
+    rows = 1 if X.ndim == 1 else int(X.shape[0])
+    return 200, {
+        "model": name,
+        "rows": rows,
+        "outputs": {k: np.asarray(v).tolist() for k, v in out.items()},
+    }
+
+
+# ------------------------------------------------------------------ summaries
+
+
+def serving_summary(report: Dict[str, Any]) -> Dict[str, Dict[str, Any]]:
+    """Per-model latency/throughput digest of a serving-session report:
+    p50/p95/p99 (ms) of `serving.total_s` via the exponential-bucket quantile
+    plumbing, mean batch occupancy, request/batch/row counts, qps over the
+    session wall. What the concurrency tests and the bench `serving_qps`
+    scenario read."""
+    out: Dict[str, Dict[str, Any]] = {}
+    metrics = report.get("metrics") or {}
+    hists = metrics.get("histograms") or {}
+    counters = metrics.get("counters") or {}
+    duration = float(report.get("duration_s") or 0.0)
+
+    def _counter(name: str, model: str) -> int:
+        return int(counters.get(f"{name}{{model={model}}}", 0))
+
+    for key, st in hists.items():
+        hname, labels = split_label_key(key)
+        if hname != "serving.total_s" or "model" not in labels:
+            continue
+        model = labels["model"]
+        bounds = st.get("bounds") or []
+        occ = hists.get(f"serving.batch_occupancy{{model={model}}}")
+        requests = _counter("serving.requests", model)
+        out[model] = {
+            "requests": requests,
+            "batches": _counter("serving.batches", model),
+            "rows": _counter("serving.rows", model),
+            "reloads": _counter("serving.model_reloads", model),
+            "errors": _counter("serving.errors", model),
+            "p50_ms": round(interpolate_quantile(st, 0.50, bounds) * 1e3, 3),
+            "p95_ms": round(interpolate_quantile(st, 0.95, bounds) * 1e3, 3),
+            "p99_ms": round(interpolate_quantile(st, 0.99, bounds) * 1e3, 3),
+            "batch_occupancy": (
+                round(occ["sum"] / occ["count"], 4)
+                if occ and occ.get("count") else None
+            ),
+            "qps": round(requests / duration, 2) if duration > 0 else None,
+        }
+    return out
+
+
+__all__: List[str] = [
+    "MOUNT_PREFIX",
+    "ServingRun",
+    "get_registry",
+    "predict",
+    "register_model",
+    "serving_address",
+    "serving_summary",
+    "start_serving",
+    "stop_serving",
+    "submit",
+    "unregister_model",
+]

@@ -1,0 +1,350 @@
+#
+# Multi-tenant model registry with HBM residency — the model-weights half of
+# the serving plane (docs/design.md §7).
+#
+# Every predict kernel takes the fitted weight arrays as operands. Called with
+# host numpy attributes (the batch-transform path), jax re-uploads them on
+# every dispatch; at serving rates that is a host->device weight transfer per
+# micro-batch. This registry uploads a model's device-consumed attributes ONCE
+# at registration and keeps them HBM-resident in an eviction-aware,
+# pin-while-serving extension of the HBM batch cache (ops/device_cache.py —
+# the same budget/LRU/gauge machinery that already backs multi-pass fits):
+#
+#   * key = ("serving_model", name), one entry holding the device tuple;
+#   * budget `serving.hbm_budget_bytes`: registering more hot models than fit
+#     evicts the least-recently-served model's weights (LRU across entries);
+#   * a model PINNED by an in-flight batch is never evicted
+#     (DeviceBatchCache.pin/unpin; skipped evictions count
+#     `cache.evict_skipped_pinned`);
+#   * a cold (evicted) model reloads transparently on its next batch, counted
+#     as `serving.model_reloads{model=}`.
+#
+# During a batch the device arrays are installed into the model's attribute
+# dict (the predict kernels read attributes — reused un-forked) and the host
+# originals restored afterwards, so the CACHE stays the only long-lived holder
+# of device memory: eviction actually frees HBM.
+#
+# Registration also performs the bucketed AOT pre-warm: one predict execution
+# per (model, bucket) through the existing `compiled_kernel` cache
+# (observability/device.py), so every shape the batcher can emit is compiled
+# before the first request and `device.compile{kernel=}` stays flat in steady
+# state (CI-asserted).
+#
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .. import config as _config
+from ..observability.inference import (
+    bucketed_signatures,
+    suppress_transform_runs,
+)
+from ..observability.runs import counter_inc, gauge_set, observe, span
+from ..ops.device_cache import DeviceBatchCache
+from ..utils import get_logger
+from .batcher import MicroBatcher, ServingError, bucket_table, pad_to_bucket
+
+_logger = get_logger("serving.registry")
+
+
+class _ServedModel:
+    """One registered model: the live model object, host copies of its
+    device-consumed attributes, the bucket table, and its micro-batcher."""
+
+    def __init__(self, name: str, model: Any, attr_names: Tuple[str, ...],
+                 n_cols: int, buckets: Tuple[int, ...]):
+        self.name = name
+        self.model = model
+        self.attr_names = attr_names
+        self.n_cols = int(n_cols)
+        self.buckets = buckets
+        self.cache_key = ("serving_model", name)
+        # host originals: the reload source after eviction, and what the
+        # model's attribute dict holds between batches
+        self.host_attrs: Dict[str, Any] = {
+            n: model._model_attributes[n] for n in attr_names
+        }
+        self.nbytes = int(sum(
+            int(getattr(v, "nbytes", 0)) for v in self.host_attrs.values()
+        ))
+        self.uploads = 0
+        self.reloads = 0
+        # whether the last upload was RETAINED by the cache: a reload is a
+        # re-upload after eviction; a model whose weights never fit the
+        # budget streams every batch and must not masquerade as "reloading"
+        self.was_cached = False
+        self.warm: set = set()
+        self.registered_ts = time.time()
+        self.batcher: Optional[MicroBatcher] = None
+
+
+class ModelRegistry:
+    """Thread-safe registry of served models. One instance per serving
+    session; `serving/http.py` owns the default process instance."""
+
+    def __init__(self, hbm_budget_bytes: Optional[int] = None):
+        budget = int(
+            hbm_budget_bytes
+            if hbm_budget_bytes is not None
+            else _config.get("serving.hbm_budget_bytes")
+        )
+        self._cache = DeviceBatchCache(max(budget, 0))
+        # DeviceBatchCache is single-owner by contract; the registry is the
+        # owner and serializes access across per-model dispatcher threads
+        self._cache_lock = threading.Lock()
+        self._lock = threading.RLock()
+        self._models: Dict[str, _ServedModel] = {}
+
+    # ----------------------------------------------------------- registration
+
+    def register(self, name: str, model: Any,
+                 prewarm: Optional[bool] = None) -> Dict[str, Any]:
+        """Serve `model` under `name`: validate servability, upload weights to
+        HBM, pre-warm one executable per bucket, start the dispatcher thread.
+        Returns the model's stats view. Re-registering a name replaces the
+        previous model (its batcher drains first)."""
+        if not hasattr(model, "_serving_predict"):
+            raise ServingError(
+                f"{type(model).__name__} is not a servable model"
+            )
+        if not model._serving_row_independent():
+            raise ServingError(
+                f"{type(model).__name__} predictions are not row-independent "
+                "(the transform is a function of the whole query set); it "
+                "cannot be served through the micro-batcher"
+            )
+        n_cols = model.n_cols
+        if not n_cols:
+            raise ServingError(
+                f"cannot infer the feature width of {type(model).__name__}; "
+                "is the model fitted?"
+            )
+        attr_names = tuple(
+            n for n in model._serving_device_attrs()
+            if n in model._model_attributes
+            and model._model_attributes[n] is not None
+        )
+        entry = _ServedModel(
+            name, model, attr_names, n_cols, bucket_table()
+        )
+        if entry.nbytes > int(self._cache.budget_bytes):
+            # it still serves — but every batch re-uploads the weights, the
+            # exact per-batch cost residency exists to remove; say so once
+            _logger.warning(
+                "model '%s' weights (%.1f MiB) exceed serving.hbm_budget_"
+                "bytes (%.1f MiB); it will stream weights on every batch "
+                "(counted as serving.weight_streams)",
+                name, entry.nbytes / 2**20,
+                self._cache.budget_bytes / 2**20,
+            )
+        old = None
+        with self._lock:
+            # one dispatcher per MODEL OBJECT: two entries sharing one model
+            # would interleave install/restore on the same attribute dict and
+            # leave device arrays installed permanently (pin/evict contract).
+            # Re-registering the same name (replacement) is fine.
+            dup = next(
+                (e.name for e in self._models.values()
+                 if e.model is model and e.name != name),
+                None,
+            )
+            if dup is None:
+                old = self._models.pop(name, None)
+        if dup is not None:
+            raise ServingError(
+                f"this model object is already served as '{dup}'; "
+                "register a separate copy to serve it under a second name"
+            )
+        if old is not None:
+            self._retire(old)
+        with self._cache_lock:
+            self._ensure_resident(entry)
+        do_warm = (
+            bool(_config.get("serving.prewarm")) if prewarm is None else prewarm
+        )
+        if do_warm:
+            self._prewarm(entry)
+        entry.batcher = MicroBatcher(
+            name, n_cols,
+            execute=lambda stage, n_valid, _e=entry: self._predict_padded(
+                _e, stage
+            ),
+            warm_buckets=entry.warm,
+        )
+        with self._lock:
+            self._models[name] = entry
+            gauge_set("serving.models", len(self._models))
+        counter_inc("serving.registered", 1, model=name)
+        _logger.info(
+            "serving model '%s' (%s, %d cols, %.1f KiB weights, buckets %s)",
+            name, type(model).__name__, n_cols, entry.nbytes / 1024,
+            list(entry.buckets),
+        )
+        return self.stats(name)
+
+    def unregister(self, name: str) -> bool:
+        with self._lock:
+            entry = self._models.pop(name, None)
+            gauge_set("serving.models", len(self._models))
+        if entry is None:
+            return False
+        self._retire(entry)
+        return True
+
+    def _retire(self, entry: _ServedModel) -> None:
+        if entry.batcher is not None:
+            entry.batcher.stop()
+        with self._cache_lock:
+            self._cache.drop_stream(entry.cache_key)
+
+    def close(self) -> None:
+        """Unregister everything (serving session teardown): every dispatcher
+        thread joined, every weight entry dropped, HBM gauge back to zero."""
+        with self._lock:
+            entries = list(self._models.values())
+            self._models.clear()
+            gauge_set("serving.models", 0)
+        for entry in entries:
+            self._retire(entry)
+
+    # -------------------------------------------------------------- residency
+
+    def _ensure_resident(self, entry: _ServedModel) -> Tuple[Any, ...]:
+        """The model's device weight tuple, uploading (and counting a reload
+        when this is not the first upload) if evicted. Caller holds
+        _cache_lock."""
+        tup = self._cache.get(entry.cache_key, 0)
+        if tup is not None:
+            return tup
+        import jax.numpy as jnp
+
+        tup = tuple(
+            jnp.asarray(entry.host_attrs[n]) for n in entry.attr_names
+        )
+        entry.uploads += 1
+        if entry.was_cached:
+            # it WAS resident and is gone: a genuine eviction-driven reload
+            entry.reloads += 1
+            counter_inc("serving.model_reloads", 1, model=entry.name)
+        else:
+            # never retained (budget too small / pinned pressure): this is a
+            # per-batch weight stream, not a reload — count it as such
+            if entry.uploads > 1:
+                counter_inc("serving.weight_streams", 1, model=entry.name)
+        entry.was_cached = self._cache.put(entry.cache_key, 0, tup)
+        return tup
+
+    def resident(self, name: str) -> bool:
+        entry = self._entry(name)
+        with self._cache_lock:
+            return self._cache.contains(entry.cache_key, 0)
+
+    def _predict_padded(self, entry: _ServedModel,
+                        stage: np.ndarray) -> Dict[str, np.ndarray]:
+        """Run one padded bucket through the model's predict path with the
+        HBM-resident weights installed. The entry is PINNED for the duration:
+        budget pressure from other models' uploads cannot evict weights an
+        in-flight batch references."""
+        with self._cache_lock:
+            self._cache.pin(entry.cache_key)
+            tup = self._ensure_resident(entry)
+        try:
+            saved = {
+                n: entry.model._model_attributes[n] for n in entry.attr_names
+            }
+            entry.model._model_attributes.update(
+                zip(entry.attr_names, tup)
+            )
+            try:
+                # no nested TransformRun per batch (the ServingRun is the
+                # scope; predict_dispatch counters/spans still fan out), and
+                # the bucket-table signatures are storm-exempt — a finite
+                # bucket set is the fix the sentinel recommends
+                with suppress_transform_runs(), bucketed_signatures():
+                    outputs = entry.model._serving_predict(stage)
+            finally:
+                entry.model._model_attributes.update(saved)
+            return {k: np.asarray(v) for k, v in outputs.items()}
+        finally:
+            with self._cache_lock:
+                self._cache.unpin(entry.cache_key)
+
+    # ---------------------------------------------------------------- prewarm
+
+    def _prewarm(self, entry: _ServedModel) -> None:
+        """Compile one executable per (model, bucket) up front: run the predict
+        path on a synthetic batch of each bucket shape through the
+        compiled_kernel AOT cache. All-ones features — a valid, finite input
+        for every family (zeros would trip cosine's zero-vector guard)."""
+        for bucket in entry.buckets:
+            stage = np.ones((bucket, entry.n_cols), np.float32)
+            t0 = time.perf_counter()
+            with span("serving.prewarm",
+                      {"model": entry.name, "bucket": bucket}):
+                self._predict_padded(entry, stage)
+            observe(
+                "serving.prewarm_s", time.perf_counter() - t0,
+                model=entry.name,
+            )
+            entry.warm.add(bucket)
+
+    # ------------------------------------------------------------ client side
+
+    def _entry(self, name: str) -> _ServedModel:
+        with self._lock:
+            entry = self._models.get(name)
+        if entry is None:
+            raise KeyError(f"no served model named '{name}'")
+        return entry
+
+    def models(self) -> List[str]:
+        with self._lock:
+            return sorted(self._models)
+
+    def submit(self, name: str, X: np.ndarray):
+        """Enqueue one request; returns the Future of its output dict."""
+        entry = self._entry(name)
+        assert entry.batcher is not None
+        return entry.batcher.submit(X)
+
+    def predict(self, name: str, X: np.ndarray,
+                timeout: Optional[float] = None) -> Dict[str, np.ndarray]:
+        """Blocking request: submit + wait (the in-process twin of the HTTP
+        POST /v1/models/<name>:predict path)."""
+        if timeout is None:
+            timeout = float(_config.get("serving.request_timeout_s"))
+        return self.submit(name, X).result(timeout=timeout)
+
+    def stats(self, name: str) -> Dict[str, Any]:
+        entry = self._entry(name)
+        with self._cache_lock:
+            is_resident = self._cache.contains(entry.cache_key, 0)
+        return {
+            "name": entry.name,
+            "model": type(entry.model).__name__,
+            "n_cols": entry.n_cols,
+            "buckets": list(entry.buckets),
+            "warm_buckets": sorted(entry.warm),
+            "weight_bytes": entry.nbytes,
+            "resident": is_resident,
+            "uploads": entry.uploads,
+            "reloads": entry.reloads,
+            "pending": entry.batcher.pending() if entry.batcher else 0,
+            "registered_ts": entry.registered_ts,
+        }
+
+    def stats_all(self) -> List[Dict[str, Any]]:
+        return [self.stats(name) for name in self.models()]
+
+
+__all__ = [
+    "ModelRegistry",
+    "ServingError",
+    "bucket_table",
+    "pad_to_bucket",
+]

@@ -127,6 +127,68 @@ def test_cache_unit_lru_eviction_across_streams():
     cache.close()
 
 
+def test_cache_pin_blocks_eviction_and_counts_skips():
+    """pin(key) holds a stream's entries against LRU pressure: eviction scans
+    skip pinned streams (counted as cache.evict_skipped_pinned) and fall
+    through to streaming when only pinned/own entries remain; unpin() makes
+    the stream evictable again. The serving plane's pin-while-serving contract
+    rides on exactly this (serving/registry.py)."""
+    import jax.numpy as jnp
+
+    cache = DeviceBatchCache(budget_bytes=4 * 400)
+    A = np.zeros((4, 1), np.float32)
+    B = np.zeros((4, 2), np.float32)
+    key_a = cache.stream_key((A,), 1, None)
+    key_b = cache.stream_key((B,), 1, None)
+    for i in range(4):
+        cache.put(key_a, i, (jnp.zeros((100,), jnp.float32),))
+    cache.pin(key_a)
+    assert cache.is_pinned(key_a)
+
+    # budget pressure from B: A is pinned, nothing else is evictable -> B's
+    # batches stream (put returns False), A stays fully resident
+    for i in range(2):
+        assert not cache.put(key_b, i, (jnp.zeros((100,), jnp.float32),))
+    totals = _counters()
+    assert totals.get("cache.evictions", 0) == 0
+    assert totals["cache.evict_skipped_pinned"] >= 2
+    assert all(cache.contains(key_a, i) for i in range(4))
+
+    # pins nest: one unpin of two leaves the stream pinned
+    cache.pin(key_a)
+    cache.unpin(key_a)
+    assert cache.is_pinned(key_a)
+    cache.unpin(key_a)
+    assert not cache.is_pinned(key_a)
+
+    # unpinned: the same pressure now evicts A's LRU entries
+    assert cache.put(key_b, 0, (jnp.zeros((100,), jnp.float32),))
+    assert profiling.counter_totals()["cache.evictions"] == 1
+    assert not cache.contains(key_a, 0)
+    cache.close()
+
+
+def test_cache_drop_stream_releases_without_evictions():
+    """drop_stream frees one stream's bytes (gauge back down) without counting
+    evictions (lifecycle free, not budget pressure) and clears its pins."""
+    import jax.numpy as jnp
+
+    cache = DeviceBatchCache(budget_bytes=10 * 400)
+    A = np.zeros((4, 1), np.float32)
+    key = cache.stream_key((A,), 1, None)
+    for i in range(3):
+        cache.put(key, i, (jnp.zeros((100,), jnp.float32),))
+    cache.pin(key)
+    freed = cache.drop_stream(key)
+    assert freed == 3 * 400
+    assert cache.bytes_resident == 0
+    assert not cache.is_pinned(key)
+    totals = _counters()
+    assert totals.get("cache.evictions", 0) == 0
+    assert totals["cache.bytes_resident"] == 0
+    cache.close()
+
+
 def test_batch_cache_scope_nesting_and_disable():
     """The outermost scope owns the cache; nested scopes reuse it; disabling
     yields None (pure streaming)."""

@@ -1,0 +1,573 @@
+"""Online serving plane (spark_rapids_ml_tpu/serving/, docs/design.md §7).
+
+The load-bearing contracts (ISSUE acceptance):
+  * PADDING PARITY: for every servable model family, predictions on a padded
+    power-of-two bucket are BIT-IDENTICAL on the valid-row prefix to the
+    unpadded predict path — including the k>n_valid kNN tail;
+  * CONCURRENCY: N threads posting mixed-size requests against one served
+    model get exact per-request row counts with no cross-request row bleed,
+    and p99 / `serving.batch_occupancy` are assertable from the EXPORTED
+    serving run report (serving_reports.jsonl);
+  * STEADY STATE: after per-bucket AOT pre-warm, a mixed-shape request stream
+    causes ZERO new `device.compile` entries and ZERO recompile-storm events;
+  * RESIDENCY: model weights stay HBM-resident in the pinned device cache;
+    evicted (cold) models reload transparently, counted as
+    `serving.model_reloads`; non-row-independent models (DBSCAN, UMAP) are
+    refused at registration;
+  * LIFECYCLE: stop_serving leaves zero dispatcher threads and zero sockets.
+"""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_ml_tpu import config, profiling, serving
+from spark_rapids_ml_tpu.observability import server as obs_server
+from spark_rapids_ml_tpu.observability.inference import reset_shape_buckets
+from spark_rapids_ml_tpu.serving import (
+    ModelRegistry,
+    QueueFull,
+    RequestTooLarge,
+    ServingError,
+    bucket_rows,
+    bucket_table,
+    pad_to_bucket,
+)
+
+SERVING_KEYS = (
+    "serving.max_batch_rows",
+    "serving.max_wait_ms",
+    "serving.bucket_min_rows",
+    "serving.prewarm",
+    "serving.hbm_budget_bytes",
+    "serving.queue_depth",
+    "serving.request_timeout_s",
+    "observability.http_port",
+    "observability.metrics_dir",
+)
+
+
+@pytest.fixture(autouse=True)
+def serving_env():
+    yield
+    serving.stop_serving()
+    for key in SERVING_KEYS:
+        config.unset(key)
+    reset_shape_buckets()
+
+
+def _serving_threads():
+    return [
+        t for t in threading.enumerate() if t.name.startswith("srml-serving")
+    ]
+
+
+rng = np.random.default_rng(7)
+X_BLOBS = np.concatenate(
+    [rng.normal(-3, 1, (96, 6)), rng.normal(3, 1, (96, 6))]
+).astype(np.float32)
+Y_BIN = np.concatenate([np.zeros(96), np.ones(96)])
+Y_CONT = (X_BLOBS @ rng.normal(size=(6,)) + 0.5).astype(np.float64)
+PDF = pd.DataFrame({"features": list(X_BLOBS)})
+
+
+def _fit_models():
+    """Every servable family, fitted once per test session (module cache)."""
+    from spark_rapids_ml_tpu.classification import (
+        LogisticRegression,
+        RandomForestClassifier,
+    )
+    from spark_rapids_ml_tpu.clustering import KMeans
+    from spark_rapids_ml_tpu.feature import PCA
+    from spark_rapids_ml_tpu.knn import NearestNeighbors
+    from spark_rapids_ml_tpu.regression import (
+        LinearRegression,
+        RandomForestRegressor,
+    )
+
+    sup = pd.DataFrame({"features": list(X_BLOBS), "label": Y_BIN})
+    reg = pd.DataFrame({"features": list(X_BLOBS), "label": Y_CONT})
+    y3 = (np.arange(len(X_BLOBS)) % 3).astype(np.float64)
+    multi = pd.DataFrame({"features": list(X_BLOBS), "label": y3})
+    return {
+        "kmeans": KMeans(k=3, maxIter=4, seed=5).fit(PDF),
+        "logreg": LogisticRegression(maxIter=8).fit(sup),
+        "logreg_multi": LogisticRegression(maxIter=6).fit(multi),
+        "linreg": LinearRegression(maxIter=10).fit(reg),
+        "pca": PCA(k=3, inputCol="features").fit(PDF),
+        "rf_clf": RandomForestClassifier(numTrees=3, maxDepth=4, seed=2).fit(sup),
+        "rf_reg": RandomForestRegressor(numTrees=3, maxDepth=4, seed=2).fit(reg),
+        "knn": NearestNeighbors(k=4, inputCol="features").fit(PDF),
+    }
+
+
+@pytest.fixture(scope="module")
+def models():
+    return _fit_models()
+
+
+# ----------------------------------------------------------------- bucket math
+
+
+def test_bucket_rows_power_of_two_with_floor_and_ceiling():
+    config.set("serving.bucket_min_rows", 16)
+    config.set("serving.max_batch_rows", 4096)
+    assert bucket_rows(1) == 16
+    assert bucket_rows(16) == 16
+    assert bucket_rows(17) == 32
+    assert bucket_rows(1000) == 1024
+    assert bucket_rows(5000) == 4096  # clamped at the ceiling bucket
+    assert bucket_table() == (16, 32, 64, 128, 256, 512, 1024, 2048, 4096)
+    config.set("serving.max_batch_rows", 100)  # non-pow2 ceiling covers it
+    assert bucket_table()[-1] == 128
+
+
+def test_pad_to_bucket_replicates_last_row_into_reused_buffer():
+    X = np.arange(12, dtype=np.float32).reshape(3, 4)
+    out = np.empty((8, 4), np.float32)
+    got = pad_to_bucket(X, 8, out=out)
+    assert got is out
+    np.testing.assert_array_equal(got[:3], X)
+    for i in range(3, 8):
+        np.testing.assert_array_equal(got[i], X[2])
+
+
+# -------------------------------------------------------------- padding parity
+
+
+@pytest.mark.parametrize(
+    "family",
+    ["kmeans", "logreg", "logreg_multi", "linreg", "pca",
+     "rf_clf", "rf_reg", "knn"],
+)
+def test_padding_parity_bit_identical_prefix(models, family):
+    """For every servable family: predict on a padded bucket, slice the valid
+    prefix, compare EXACT against the unpadded predict path."""
+    model = models[family]
+    n = 13
+    Q = X_BLOBS[:n]
+    ref = model._serving_predict(Q)
+    padded = model._serving_predict(pad_to_bucket(Q, 16))
+    assert set(padded) == set(ref)
+    for key, ref_v in ref.items():
+        got = padded[key][:n]
+        assert got.dtype == np.asarray(ref_v).dtype, key
+        np.testing.assert_array_equal(got, ref_v, err_msg=f"{family}:{key}")
+
+
+def test_knn_padding_parity_includes_k_gt_n_valid_tail(n_devices):
+    """The kNN invalid tail (k > n_valid items) must survive query padding
+    bit-for-bit: same winner ids, same inf-distance tail, on the production
+    single-shard scan the serving path uses."""
+    import jax.numpy as jnp
+
+    from spark_rapids_ml_tpu.ops.knn import exact_knn_single
+
+    items = rng.normal(size=(8, 6)).astype(np.float32)
+    valid = np.zeros((8,), bool)
+    valid[:3] = True  # 3 valid items, k=5 -> 2-slot invalid tail
+    Q = X_BLOBS[:5, :6]
+    d_ref, i_ref = exact_knn_single(
+        jnp.asarray(Q), jnp.asarray(items), jnp.asarray(valid), 5
+    )
+    Qp = pad_to_bucket(Q, 16)
+    d_pad, i_pad = exact_knn_single(
+        jnp.asarray(Qp), jnp.asarray(items), jnp.asarray(valid), 5
+    )
+    np.testing.assert_array_equal(np.asarray(i_pad)[:5], np.asarray(i_ref))
+    np.testing.assert_array_equal(np.asarray(d_pad)[:5], np.asarray(d_ref))
+    # the tail IS invalid: the last k - n_valid slots carry the in-flight
+    # sentinel (INVALID_D2, §5b — the -1/inf mapping is the model-level API)
+    from spark_rapids_ml_tpu.ops.selection import INVALID_D2
+
+    np.testing.assert_array_equal(
+        np.asarray(d_ref)[:, 3:], np.full((5, 2), INVALID_D2)
+    )
+
+
+def test_knn_served_outputs_match_kneighbors(models):
+    model = models["knn"]
+    out = model._serving_predict(X_BLOBS[:9])
+    _, _, knn_df = model.kneighbors(PDF.head(9))
+    np.testing.assert_array_equal(
+        out["indices"], np.stack(knn_df["indices"].to_numpy())
+    )
+    np.testing.assert_allclose(
+        out["distances"], np.stack(knn_df["distances"].to_numpy()),
+        rtol=1e-6, atol=1e-6,
+    )
+
+
+def test_non_row_independent_models_refused():
+    from spark_rapids_ml_tpu.clustering import DBSCAN
+
+    db = DBSCAN(eps=1.0, min_samples=3).fit(PDF)
+    registry = ModelRegistry()
+    with pytest.raises(ServingError, match="row-independent"):
+        registry.register("db", db)
+    registry.close()
+
+
+# ------------------------------------------------------- registry + residency
+
+
+def test_registry_residency_eviction_and_transparent_reload(models):
+    """Two models over a budget that fits only one: registering the second
+    evicts the first's weights (LRU); the first's next batch transparently
+    reloads them, counted as serving.model_reloads."""
+    km = models["kmeans"]
+    pca = models["pca"]
+
+    def weight_bytes(m):
+        return sum(
+            int(np.asarray(m._model_attributes[n]).nbytes)
+            for n in m._serving_device_attrs()
+        )
+
+    # fits either model's weights alone, never both at once
+    budget = max(weight_bytes(km), weight_bytes(pca)) + 8
+    registry = ModelRegistry(hbm_budget_bytes=budget)
+    try:
+        registry.register("km", km, prewarm=False)
+        assert registry.resident("km")
+        registry.register("pca", pca, prewarm=False)
+        # pca's weights displaced km's (LRU across entries)
+        assert registry.resident("pca")
+        assert not registry.resident("km")
+        before = profiling.counter_totals().get(
+            "serving.model_reloads{model=km}", 0
+        )
+        out = registry.predict("km", X_BLOBS[:4])
+        np.testing.assert_array_equal(
+            out["prediction"],
+            km._serving_predict(pad_to_bucket(X_BLOBS[:4], 16))[
+                "prediction"
+            ][:4],
+        )
+        assert profiling.counter_totals()[
+            "serving.model_reloads{model=km}"
+        ] == before + 1
+    finally:
+        registry.close()
+    assert not _serving_threads()
+
+
+def test_same_model_object_refused_under_second_name(models):
+    """One dispatcher per model OBJECT: serving the same object under two
+    names would interleave install/restore on one attribute dict; the second
+    registration is refused (re-registering the same name still replaces)."""
+    registry = ModelRegistry()
+    try:
+        registry.register("a", models["kmeans"], prewarm=False)
+        with pytest.raises(ServingError, match="already served as 'a'"):
+            registry.register("b", models["kmeans"], prewarm=False)
+        # replacement under the SAME name stays legal
+        registry.register("a", models["kmeans"], prewarm=False)
+        assert registry.models() == ["a"]
+    finally:
+        registry.close()
+
+
+def test_never_fitting_weights_stream_not_reload(models):
+    """A model whose weights never fit the budget serves from per-batch
+    uploads: counted serving.weight_streams, NOT serving.model_reloads, and
+    stats()['reloads'] stays 0 (reload = re-upload after eviction only)."""
+    km = models["kmeans"]
+    registry = ModelRegistry(hbm_budget_bytes=1)  # nothing fits
+
+    def totals():
+        t = profiling.counter_totals()
+        return (t.get("serving.model_reloads{model=km}", 0),
+                t.get("serving.weight_streams{model=km}", 0))
+
+    reloads0, streams0 = totals()
+    try:
+        registry.register("km", km, prewarm=False)
+        assert not registry.resident("km")
+        for _ in range(3):
+            registry.predict("km", X_BLOBS[:4])
+        reloads1, streams1 = totals()
+        assert reloads1 - reloads0 == 0  # never resident -> never "reloaded"
+        assert streams1 - streams0 >= 2  # every batch re-streamed weights
+        assert registry.stats("km")["reloads"] == 0
+    finally:
+        registry.close()
+
+
+def test_registry_stats_and_unregister_frees(models):
+    registry = ModelRegistry()
+    registry.register("km", models["kmeans"], prewarm=False)
+    st = registry.stats("km")
+    assert st["model"] == "KMeansModel" and st["resident"]
+    assert st["buckets"] == list(bucket_table())
+    assert registry.unregister("km")
+    assert not registry.unregister("km")
+    assert "km" not in registry.models()
+    assert not _serving_threads()
+    registry.close()
+
+
+# ------------------------------------------------------------------- batching
+
+
+def test_batcher_coalesces_concurrent_requests_into_one_bucket(models):
+    """Requests submitted together coalesce into ONE padded batch: exact
+    request/batch/occupancy accounting read from the serving run report."""
+    config.set("serving.max_wait_ms", 150.0)  # generous window: must coalesce
+    serving.start_serving(port=0)
+    serving.register_model("km", models["kmeans"], prewarm=True)
+    sizes = [3, 5, 7, 9]
+    futs = [
+        serving.submit("km", X_BLOBS[i * 10: i * 10 + n])
+        for i, n in enumerate(sizes)
+    ]
+    outs = [f.result(timeout=30) for f in futs]
+    for n, out in zip(sizes, outs):
+        assert out["prediction"].shape == (n,)
+    report = serving.stop_serving()
+    summary = serving.serving_summary(report)["km"]
+    assert summary["requests"] == len(sizes)
+    assert summary["batches"] == 1  # one coalesced dispatch
+    # 24 rows in a 32-row bucket
+    assert summary["batch_occupancy"] == pytest.approx(24 / 32)
+
+
+def test_backpressure_and_oversized_requests(models):
+    config.set("serving.queue_depth", 2)
+    config.set("serving.max_batch_rows", 64)
+    registry = ModelRegistry()
+    try:
+        registry.register("km", models["kmeans"], prewarm=False)
+        with pytest.raises(RequestTooLarge):
+            registry.submit("km", np.zeros((65, 6), np.float32))
+        with pytest.raises(ServingError):
+            registry.submit("km", np.zeros((0, 6), np.float32))
+        with pytest.raises(ServingError):  # wrong width
+            registry.submit("km", np.zeros((4, 5), np.float32))
+    finally:
+        registry.close()
+
+
+def test_queue_full_backpressure_with_stalled_dispatcher():
+    """Deterministic QueueFull: a batcher whose execute blocks on an event;
+    with queue_depth=2 the 4th submit must reject (1 in flight, 2 queued)."""
+    from spark_rapids_ml_tpu.serving.batcher import MicroBatcher
+
+    config.set("serving.queue_depth", 2)
+    config.set("serving.max_batch_rows", 4)
+    config.set("serving.max_wait_ms", 1.0)
+    release = threading.Event()
+    started = threading.Event()
+
+    def slow_execute(stage, n_valid):
+        started.set()
+        assert release.wait(timeout=30)
+        return {"echo": stage.copy()}
+
+    b = MicroBatcher("stall", 3, execute=slow_execute)
+    try:
+        futs = [b.submit(np.zeros((4, 3), np.float32))]
+        assert started.wait(timeout=10)  # first batch is in flight
+        futs += [b.submit(np.zeros((4, 3), np.float32)) for _ in range(2)]
+        with pytest.raises(QueueFull):
+            b.submit(np.zeros((4, 3), np.float32))
+        assert profiling.counter_totals()[
+            "serving.rejected{model=stall}"
+        ] >= 1
+        release.set()
+        for f in futs:
+            assert f.result(timeout=30)["echo"].shape == (4, 3)
+    finally:
+        release.set()
+        b.stop()
+
+
+# ------------------------------------- concurrency satellite (exported report)
+
+
+def test_concurrent_mixed_requests_exact_scatter_and_exported_report(
+    models, tmp_path
+):
+    """N threads x mixed-size requests against one served model: every
+    response is the exact per-request slice (values compared against the
+    unbatched reference — no cross-request row bleed), and p99 +
+    serving.batch_occupancy are asserted FROM the exported serving report."""
+    config.set("observability.metrics_dir", str(tmp_path))
+    config.set("serving.max_wait_ms", 4.0)
+    serving.start_serving(port=0)
+    km = models["kmeans"]
+    serving.register_model("km", km, prewarm=True)
+    ref = km._serving_predict(X_BLOBS)["prediction"]
+
+    failures = []
+
+    def client(seed: int) -> None:
+        r = np.random.default_rng(seed)
+        for _ in range(25):
+            n = int(r.integers(1, 40))
+            off = int(r.integers(0, len(X_BLOBS) - n))
+            out = serving.predict("km", X_BLOBS[off: off + n])
+            if out["prediction"].shape != (n,):
+                failures.append(("shape", off, n, out["prediction"].shape))
+            elif not np.array_equal(out["prediction"], ref[off: off + n]):
+                failures.append(("values", off, n))
+
+    threads = [threading.Thread(target=client, args=(s,)) for s in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not failures, failures[:5]
+
+    report = serving.stop_serving()
+    from spark_rapids_ml_tpu.observability.export import load_serving_reports
+
+    exported = load_serving_reports(str(tmp_path))
+    assert len(exported) == 1 and exported[0]["run_id"] == report["run_id"]
+    summary = serving.serving_summary(exported[0])["km"]
+    assert summary["requests"] == 8 * 25
+    assert summary["rows"] > 0 and summary["batches"] >= 1
+    assert summary["p99_ms"] is not None and summary["p99_ms"] > 0
+    assert summary["p99_ms"] >= summary["p50_ms"]
+    assert 0 < summary["batch_occupancy"] <= 1.0
+    # the batcher actually coalesced: strictly fewer batches than requests
+    assert summary["batches"] < summary["requests"]
+    hists = exported[0]["metrics"]["histograms"]
+    assert any(
+        k.startswith("serving.batch_occupancy") for k in hists
+    ), hists.keys()
+
+
+# -------------------------------------------------- steady-state zero compiles
+
+
+def test_prewarm_then_mixed_traffic_zero_new_compiles_zero_storms(models):
+    """The acceptance bar: after per-bucket pre-warm, a mixed-shape request
+    stream causes zero new device.compile entries and zero recompile-storm
+    events (the bucket table absorbs every request shape)."""
+    serving.start_serving(port=0)
+    serving.register_model("km", models["kmeans"], prewarm=True)
+    serving.register_model("lr", models["logreg"], prewarm=True)
+
+    def compile_counters():
+        return {
+            k: v for k, v in profiling.counter_totals().items()
+            if k.startswith("device.compile{")
+        }
+
+    def storm_total():
+        return sum(
+            v for k, v in profiling.counter_totals().items()
+            if k.startswith("transform.recompile_storm")
+        )
+
+    before, storms_before = compile_counters(), storm_total()
+    r = np.random.default_rng(3)
+    for _ in range(30):
+        n = int(r.integers(1, 50))
+        serving.predict("km", X_BLOBS[:n])
+        serving.predict("lr", X_BLOBS[:n])
+    after, storms_after = compile_counters(), storm_total()
+    new = {
+        k: after.get(k, 0) - before.get(k, 0)
+        for k in set(after) | set(before)
+        if after.get(k, 0) != before.get(k, 0)
+    }
+    assert not new, f"steady-state serving compiled: {new}"
+    assert storms_after == storms_before
+    serving.stop_serving()
+
+
+def test_bucketed_signatures_do_not_inflate_ragged_storm_count(models):
+    """Mixed serving + ad-hoc transform in one process: the served model's
+    bucket-table signatures are remembered (compile dedup) but EXCLUDED from
+    the storm count — a few ragged transform calls after registration must
+    not fire the sentinel just because 9 buckets were pre-warmed."""
+    reset_shape_buckets()
+    config.set("observability.recompile_warn_threshold", 8)
+    serving.start_serving(port=0)
+    serving.register_model("km", models["kmeans"], prewarm=True)  # 9 buckets
+
+    def storm_total():
+        return sum(
+            v for k, v in profiling.counter_totals().items()
+            if k.startswith("transform.recompile_storm")
+        )
+
+    before = storm_total()
+    for n in (3, 5, 7):  # 3 ragged sigs, far under threshold 8
+        models["kmeans"]._serving_predict(X_BLOBS[:n])
+    assert storm_total() == before
+    serving.stop_serving()
+
+
+# ------------------------------------------------------------------------ HTTP
+
+
+def test_http_endpoint_predict_stats_and_errors(models):
+    addr = serving.start_serving(port=0)
+    assert addr is not None
+    port = addr[1]
+    serving.register_model("km", models["kmeans"], prewarm=True)
+
+    def post(path, doc):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}{path}",
+            data=json.dumps(doc).encode(),
+            method="POST",
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=15) as resp:
+                return resp.status, json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read())
+
+    code, doc = post("/v1/models/km:predict", {"instances": X_BLOBS[:3].tolist()})
+    assert code == 200 and doc["rows"] == 3
+    ref = models["kmeans"]._serving_predict(pad_to_bucket(X_BLOBS[:3], 16))
+    assert doc["outputs"]["prediction"] == ref["prediction"][:3].tolist()
+
+    # single instance (1-D) is accepted as one row
+    code, doc = post("/v1/models/km:predict", {"instances": X_BLOBS[0].tolist()})
+    assert code == 200 and doc["rows"] == 1
+
+    idx = json.loads(urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/v1/models", timeout=5).read())
+    assert [m["name"] for m in idx["models"]] == ["km"]
+    assert idx["models"][0]["warm_buckets"] == list(bucket_table())
+
+    one = json.loads(urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/v1/models/km", timeout=5).read())
+    assert one["resident"] is True
+
+    code, _ = post("/v1/models/nope:predict", {"instances": [[0.0] * 6]})
+    assert code == 404
+    code, _ = post("/v1/models/km:predict", {"wrong": 1})
+    assert code == 400
+    code, _ = post("/v1/models/km:predict", [[0.0] * 6])
+    assert code == 400  # bare list body: client error, never a 500
+    code, _ = post("/v1/models/km:predict", {"instances": [[0.0] * 5]})
+    assert code == 400  # wrong feature width
+
+    # the telemetry paths still serve next to the mount
+    health = json.loads(urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/healthz", timeout=5).read())
+    assert health["status"] == "ok"
+
+    serving.stop_serving()
+    assert obs_server.server_address() is None
+    assert not _serving_threads()
+    assert not any(
+        t.name == "srml-telemetry-server" for t in threading.enumerate()
+    )
+
+
+def test_stop_serving_idempotent_and_clean_when_never_started():
+    assert serving.stop_serving() is None
+    assert obs_server.server_address() is None
+    assert not _serving_threads()
