@@ -51,6 +51,7 @@ UNITS = [
     "telemetry_overhead",
     "serving_qps",
     "large_k",
+    "autotune",
     "knn",
     "ann",
     "wide256",

@@ -1368,6 +1368,109 @@ def bench_large_k(ctx) -> Dict:
     return out
 
 
+# ----------------------------------------------------------------- autotune
+
+
+def bench_autotune(ctx) -> Dict:
+    """Closed-loop autotuner scenario (docs/design.md §6i): search tuning
+    tables for the knn-select and kmeans-assign units into a throwaway
+    SRML_TPU_TUNE_DIR, then time the tuned path (mode=load, table present)
+    against the default path (mode=off) and prove bit-identical outputs.
+
+    Emits `autotune_speedup` (the better of the two units — the >=1.0
+    contract holds because the search persists the DEFAULT when no
+    challenger clears the MAD noise floor), `autotune_search_s` (the cost of
+    the sweep), per-unit speedups, and live parity flags. Reps alternate
+    arm order (the telemetry_overhead recipe) so warming drift cannot
+    flatter either arm; the headline is a median of per-pair ratios."""
+    import shutil
+    import tempfile
+
+    from spark_rapids_ml_tpu import config
+    from spark_rapids_ml_tpu.autotune import reset as at_reset
+    from spark_rapids_ml_tpu.autotune.search import run_search
+    from spark_rapids_ml_tpu.ops.kmeans import kmeans_predict
+    from spark_rapids_ml_tpu.ops.knn import exact_knn_single
+
+    heartbeat = ctx.get("heartbeat") or (lambda tag: None)
+    big = ctx["on_tpu"]
+    n_knn, d_knn, k_knn = (1_000_000, 64, 10) if big else (50_000, 24, 10)
+    n_asg, d_asg, k_asg = (1_000_000, 64, 160) if big else (50_000, 32, 16)
+
+    rng = np.random.default_rng(11)
+    import jax.numpy as jnp
+
+    Xk = jnp.asarray(rng.normal(size=(n_knn, d_knn)).astype(np.float32))
+    Qk, ones = Xk[:64], jnp.ones((n_knn,), bool)
+    Xa = jnp.asarray(rng.normal(size=(n_asg, d_asg)).astype(np.float32))
+    Ca = Xa[:k_asg]
+
+    tune_dir = tempfile.mkdtemp(prefix="srml_autotune_bench_")
+    config.set("autotune.dir", tune_dir)
+    at_reset()
+    out: Dict = {}
+    try:
+        t0 = time.perf_counter()
+        summary = run_search(
+            None,  # every searchable knob (pallas geometry self-skips off-TPU)
+            shapes=[(n_knn, d_knn, k_knn), (n_asg, d_asg, k_asg)],
+            replicates=3,
+        )
+        out["autotune_search_s"] = round(time.perf_counter() - t0, 3)
+        out["autotune_table_entries"] = summary["table_entries"]
+        out["autotune_winners"] = {
+            e["knob"] + "|" + e["bucket"]: e["value"] for e in summary["results"]
+        }
+        heartbeat("autotune_search")
+
+        def knn_unit():
+            d, i = exact_knn_single(Qk, Xk, ones, k_knn)
+            return np.asarray(d), np.asarray(i)
+
+        def assign_unit():
+            return (np.asarray(kmeans_predict(Xa, Ca)),)
+
+        def run_arm(unit, tuned: bool):
+            config.set("autotune.mode", "load" if tuned else "off")
+            t0 = time.perf_counter()
+            vals = unit()
+            return time.perf_counter() - t0, vals
+
+        results = {}
+        for name, unit in (("knn", knn_unit), ("assign", assign_unit)):
+            # warmup both arms (AOT compile both signatures, untimed)
+            _, ref_default = run_arm(unit, tuned=False)
+            _, ref_tuned = run_arm(unit, tuned=True)
+            parity = all(
+                np.array_equal(a, b) for a, b in zip(ref_default, ref_tuned)
+            )
+            ratios = []
+            for rep in range(6):  # alternating-order pairs
+                if rep % 2 == 0:
+                    t_def, _ = run_arm(unit, tuned=False)
+                    t_tun, _ = run_arm(unit, tuned=True)
+                else:
+                    t_tun, _ = run_arm(unit, tuned=True)
+                    t_def, _ = run_arm(unit, tuned=False)
+                ratios.append(t_def / max(t_tun, 1e-9))
+                heartbeat(f"autotune_{name}_rep{rep}")
+            results[name] = (float(np.median(ratios)), parity)
+        out["autotune_knn_speedup"] = round(results["knn"][0], 4)
+        out["autotune_knn_parity_ok"] = results["knn"][1]
+        out["autotune_assign_speedup"] = round(results["assign"][0], 4)
+        out["autotune_assign_parity_ok"] = results["assign"][1]
+        # headline: the better unit — "on at least one unit, tuned >= default"
+        out["autotune_speedup"] = round(
+            max(results["knn"][0], results["assign"][0]), 4
+        )
+    finally:
+        config.unset("autotune.mode")
+        config.unset("autotune.dir")
+        at_reset()
+        shutil.rmtree(tune_dir, ignore_errors=True)
+    return out
+
+
 # ---------------------------------------------------------------------- runner
 
 # ordered so the cheap families land before the O(n*nq) kNN/ANN scans: on the
@@ -1385,6 +1488,7 @@ FAMILIES: List = [
     ("telemetry_overhead", bench_telemetry_overhead),
     ("serving_qps", bench_serving_qps),
     ("large_k", bench_large_k),
+    ("autotune", bench_autotune),
     ("knn", bench_knn),
     ("ann", bench_ann),
 ]

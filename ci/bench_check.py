@@ -69,6 +69,14 @@ _OVERHEAD_RE = re.compile(
 _SERVING_P99_RE = re.compile(
     r'\\?"(serving_p99_ms)\\?"\s*:\s*([0-9]+(?:\.[0-9]+)?(?:[eE][+-]?[0-9]+)?)'
 )
+# autotune plane (`autotune_speedup`, docs/design.md §6i): tuned-vs-default
+# ratio of the better-tuned unit — HIGHER is better like mfu, behind an
+# absolute noise floor (both rounds hovering at ~1.0 means the table holds
+# no real win on this platform; ratio-judging two 1.0-ish samples is noise —
+# the gate only engages once a round has shown a genuine tuned win)
+_SPEEDUP_RE = re.compile(
+    r'\\?"(\w+_speedup)\\?"\s*:\s*([0-9]+(?:\.[0-9]+)?(?:[eE][+-]?[0-9]+)?)'
+)
 # measurement-noise companion (`*_overhead_noise_pct`, the MAD of the
 # scenario's pair deltas): when the noise floor reaches the budget the point
 # estimate carries no signal, so the check reports INCONCLUSIVE instead of
@@ -81,7 +89,7 @@ _PLATFORM_RE = re.compile(r'\\?"platform\\?"\s*:\s*\\?"(\w+)\\?"')
 
 
 def _higher_is_better(name: str) -> bool:
-    return name.endswith("_mfu")
+    return name.endswith(("_mfu", "_speedup"))
 
 
 # absolute noise floors for the comm keys: near zero (CPU-mesh comm_frac sits
@@ -92,6 +100,7 @@ _NOISE_FLOORS = (
     ("_comm_frac", 0.01),  # <1% of ICI peak: noise, not a communication story
     ("_rank_skew", 1.5),   # below the straggler threshold: balanced enough
     ("_p99_ms", 5.0),      # single-digit-ms serving tails: scheduler jitter
+    ("_speedup", 1.1),     # tuned ~= default on both rounds: nothing to lose
 )
 
 
@@ -145,6 +154,8 @@ def extract(path: str) -> Dict[str, object]:
             scenarios[k] = float(v)  # comm plane: lower-is-better default
         elif k == "serving_p99_ms" and isinstance(v, (int, float)):
             scenarios[k] = float(v)  # serving tail: lower-is-better + floor
+        elif k.endswith("_speedup") and isinstance(v, (int, float)):
+            scenarios[k] = float(v)  # autotune plane: higher-is-better + floor
         elif k.endswith("_overhead_noise_pct") and isinstance(v, (int, float)):
             overhead_noise[k[: -len("_noise_pct")] + "_pct"] = float(v)
         elif k.endswith("_overhead_pct") and isinstance(v, (int, float)):
@@ -169,6 +180,8 @@ def extract(path: str) -> Dict[str, object]:
         for name, v in _COMM_RE.findall(text):
             scenarios[name] = float(v)
         for name, v in _SERVING_P99_RE.findall(text):
+            scenarios[name] = float(v)
+        for name, v in _SPEEDUP_RE.findall(text):
             scenarios[name] = float(v)
         for name, v in _OVERHEAD_NOISE_RE.findall(text):
             overhead_noise[name[: -len("_noise_pct")] + "_pct"] = float(v)

@@ -64,6 +64,16 @@ image ships no third-party linters, so the gate is stdlib-only but real:
     mentions of the opcodes (docstrings, comments) don't match; `# noqa` on
     the literal's first or last line exempts.
 
+  * hard-coded tunables: a module-level `SOMETHING_TILE/BLOCK/MIN_ITEMS/
+    MIN_K/BUCKET... = <nonzero int literal>` constant inside
+    spark_rapids_ml_tpu/ops/. Numeric tile/block/threshold DEFAULTS live in
+    the knob-registry defaults module (spark_rapids_ml_tpu/autotune/
+    defaults.py, docs/design.md §6i) and their measured per-platform
+    overrides live in tuning tables — a fresh literal in ops/ is a knob the
+    autotuner can't see and a re-tuning chore on the next hardware target.
+    Zero-valued sentinels (`BLOCK_ROWS = 0` = adaptive) stay legal; `# noqa`
+    on the line exempts.
+
 Exit code 1 on any finding; CI runs this before the test tiers (ci/test.sh).
 """
 
@@ -106,6 +116,42 @@ _HLO_PARSE_RE = _re.compile(
     r"(?:all-reduce|all-gather|reduce-scatter|collective-permute|all-to-all)"
     r"(?:-start|\\?\()"
 )
+
+# tunable-looking constant names whose numeric defaults belong in the knob
+# registry's defaults module (spark_rapids_ml_tpu/autotune/defaults.py)
+_TUNABLE_NAME_RE = _re.compile(r"(TILE|BLOCK|MIN_ITEMS|MIN_K|BUCKET)")
+
+
+def _const_int(node):
+    """Evaluate a literal int expression (`2048`, `1 << 16`, `8 * 1024`);
+    None for anything else — only plain numeric literals are banned."""
+    if isinstance(node, ast.Constant):
+        return node.value if (
+            isinstance(node.value, int) and not isinstance(node.value, bool)
+        ) else None
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+        v = _const_int(node.operand)
+        return -v if v is not None else None
+    if isinstance(node, ast.BinOp):
+        left, right = _const_int(node.left), _const_int(node.right)
+        if left is None or right is None:
+            return None
+        try:
+            if isinstance(node.op, ast.LShift):
+                return left << right
+            if isinstance(node.op, ast.Mult):
+                return left * right
+            if isinstance(node.op, ast.Add):
+                return left + right
+            if isinstance(node.op, ast.Sub):
+                return left - right
+            if isinstance(node.op, ast.Pow):
+                return left ** right
+            if isinstance(node.op, ast.FloorDiv):
+                return left // right
+        except (OverflowError, ZeroDivisionError, ValueError):
+            return None
+    return None
 
 
 def _is_broad_catch(type_node) -> bool:
@@ -289,6 +335,42 @@ def check_file(path: Path) -> list:
                     f"{path}:{node.lineno}: {hit} in ops/ — route top-k "
                     "through ops/selection.py (select_topk/merge_topk/"
                     "top_k_max)"
+                )
+
+    # ops/ may not hard-code tunable tile/block/threshold constants: numeric
+    # defaults live in the knob-registry defaults module (autotune/
+    # defaults.py) where the autotuner's tuning tables can override them per
+    # (platform, shape-bucket); a fresh literal here is invisible to it
+    if "ops" in path.parts and "spark_rapids_ml_tpu" in path.parts:
+        src_lines = src.splitlines()
+        for node in tree.body:
+            if isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                targets, value = [node.target], node.value
+            else:
+                continue
+            names = [
+                t.id for t in targets
+                if isinstance(t, ast.Name) and _TUNABLE_NAME_RE.search(t.id)
+            ]
+            if not names:
+                continue
+            v = _const_int(value)
+            if not v:  # zero = adaptive sentinel, None = not a literal
+                continue
+            line = (
+                src_lines[node.lineno - 1]
+                if node.lineno - 1 < len(src_lines)
+                else ""
+            )
+            if "noqa" not in line:
+                findings.append(
+                    f"{path}:{node.lineno}: hard-coded tunable "
+                    f"'{names[0]} = {v}' in ops/ — numeric tile/threshold "
+                    "defaults live in spark_rapids_ml_tpu/autotune/"
+                    "defaults.py (knob registry, docs/design.md §6i); "
+                    "import it or declare a knob"
                 )
 
     # pallas lives in ops/pallas_*.py only: kernels there carry the

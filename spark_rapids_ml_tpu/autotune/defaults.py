@@ -1,0 +1,60 @@
+#
+# Knob-registry defaults — THE home for the numeric tile/block/threshold
+# defaults the closed-loop autotuner (docs/design.md §6i) overrides with
+# measured per-platform tuning-table entries.
+#
+# These used to live as magic constants scattered through the ops/ host
+# wrappers, each justified by a one-off measurement baked into a comment.
+# Now: the DEFAULT lives here (one module, import-light, no jax), the
+# MEASURED choice lives in a tuning table entry whose `provenance` field
+# records the search that produced it (platform, device_kind, shape bucket,
+# trial stats), and ci/lint_python.py bans new numeric tile/threshold
+# literals in ops/ so the split cannot silently regress.
+#
+# Nothing here reads config or the tables — that is knobs.lookup()'s job.
+# Callers fall through to these values when autotune is off, the table has
+# no entry for the bucket, or the table failed to load (corrupt/stale).
+#
+
+from __future__ import annotations
+
+# --------------------------------------------------------- selection plane
+# exact_tiled tile width (ops/selection.py::_auto_tile): on TPU small fixed
+# tiles vectorize the per-tile select on the VPU; on CPU each XLA TopK custom
+# call pays per-call overhead, so few large tiles win (see the tuning table
+# for any measured per-bucket override of this folklore).
+TPU_SELECT_TILE = 2048
+CPU_SELECT_TILE_FLOOR = 8192
+CPU_SELECT_TILE_DENOM = 4  # CPU tile = max(floor, ceil(n / denom))
+
+
+def default_select_tile(n: int, backend: str) -> int:
+    """The pre-autotuner platform tile heuristic, verbatim."""
+    if backend == "tpu":
+        return TPU_SELECT_TILE
+    return max(CPU_SELECT_TILE_FLOOR, -(-int(n) // CPU_SELECT_TILE_DENOM))
+
+
+# ---------------------------------------------- fused pallas scan geometry
+# (ops/pallas_select.py) — the query block bounds the (block, tile) distance
+# tile in VMEM (256*1024*4 = 1 MiB) next to one double-buffered X tile; the
+# assignment form streams ROWS against resident centers. Floors are what the
+# VMEM-budget shrink loops halve toward; a floor-sized scan always fits.
+DEFAULT_QUERY_BLOCK = 256
+DEFAULT_ITEM_TILE = 1024
+DEFAULT_ASSIGN_BLOCK = 2048
+MIN_ASSIGN_BLOCK = 256
+MIN_QUERY_BLOCK = 8
+MIN_ITEM_TILE = 128
+
+# k >= this engages the fused assignment/Lloyd paths under `auto` on TPU:
+# below it the (B, k) tiles pad k to the 128-lane MXU width and the XLA
+# path's two-read formulation is already at its HBM roofline (the measured
+# small-k loss region of ops/pallas_kmeans.py).
+FUSED_ASSIGN_MIN_K = 128
+LLOYD_FUSED_MIN_K = 128
+
+# ----------------------------------------------------- other pallas kernels
+# segment-reduce histogram (ops/pallas_histogram.py)
+PALLAS_HISTOGRAM_BLOCK_ROWS = 512
+PALLAS_HISTOGRAM_MAX_SEG_TILE = 2048

@@ -1,0 +1,365 @@
+#
+# Knob registry + table lookup — the resolution half of the closed-loop
+# autotuner (docs/design.md §6i).
+#
+# Every tunable the ops/serving host wrappers consult is DECLARED here: its
+# kind, the config key that pins it (set()/env always beat the table — the
+# resolution-order contract is programmatic set() > env > table > default),
+# which shape dimensions key its bucket, and whether the search loop
+# (autotune/search.py) knows how to measure it. `lookup()` is the single
+# entry point the resolution sites call; it returns a table value on a hit
+# and None otherwise — callers fall through to their defaults module value
+# (autotune/defaults.py), so a missing/corrupt/stale table is always safe.
+#
+# Exactness: knobs marked exactness="bit" only ever take values whose
+# outputs are bit-identical to the default path (exact selection strategies,
+# tile widths, kernel geometry). `pallas.precision` is exactness="rerank" —
+# its non-f32 values are legal ONLY because every consuming site pairs them
+# with the parity_rerank_sq invariant (returned distances stay exact-f32;
+# the id set carries the approximation). The search loop never explores
+# rerank-class candidates unless explicitly asked (CLI --allow-approx).
+#
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Tuple
+
+from . import table as _table
+
+_STRATEGY_VALUES = ("exact_full", "exact_tiled", "approx", "pallas_fused")
+_PRECISION_VALUES = ("float32", "bfloat16", "int8")
+
+
+@dataclass(frozen=True)
+class Knob:
+    name: str
+    kind: str  # int | str | int_pair
+    description: str
+    config_key: Optional[str] = None  # config key that PINS the knob
+    # config values that mean "choose for me" rather than a real pin: a
+    # deployment restating the documented sentinel (env knn.selection=auto,
+    # knn.select_tile=0) must NOT silently disable table resolution
+    auto_values: Tuple = ()
+    dims: Tuple[str, ...] = ()  # subset of ("n", "d", "k") keying the bucket
+    values: Optional[Tuple[str, ...]] = None  # legal values for kind == str
+    searchable: bool = False  # search.py implements a trial runner
+    exactness: str = "bit"  # bit | rerank (see module header)
+    grid: Tuple = field(default=())  # candidate hints for the search loop
+
+
+KNOBS: Dict[str, Knob] = {
+    k.name: k
+    for k in (
+        Knob(
+            "selection.strategy", "str",
+            "top-k strategy at auto-resolved search-plane sites "
+            "(ops/selection.py::resolve)",
+            config_key="knn.selection", auto_values=("auto",),
+            dims=("n", "k"), values=_STRATEGY_VALUES, searchable=True,
+        ),
+        Knob(
+            "selection.tile", "int",
+            "exact_tiled tile width (replaces _auto_tile's platform folklore)",
+            config_key="knn.select_tile", auto_values=(0,),
+            dims=("n", "k"), searchable=True,
+            grid=(512, 1024, 2048, 4096, 8192, 16384, 32768),
+        ),
+        Knob(
+            "pallas.min_items", "int",
+            "item width above which auto hands a fusable scan to the fused "
+            "pallas kernel (ops/selection.py::_fused_auto)",
+            config_key="knn.pallas_min_items", dims=(),
+            grid=(1 << 14, 1 << 15, 1 << 16, 1 << 17, 1 << 18),
+        ),
+        Knob(
+            "pallas.precision", "str",
+            "fused-scan distance accumulation mode; non-f32 values are gated "
+            "by the parity_rerank_sq exactness invariant",
+            config_key="knn.pallas_precision", dims=(),
+            values=_PRECISION_VALUES, exactness="rerank",
+        ),
+        Knob(
+            "pallas.topk_geometry", "int_pair",
+            "(q_block, item_tile) of the fused top-k scan "
+            "(ops/pallas_select.py::_topk_geometry); tuned values still pass "
+            "the VMEM-budget shrink",
+            dims=("n", "d", "k"), searchable=True,
+        ),
+        Knob(
+            "pallas.assign_block", "int",
+            "row block of the fused KMeans assignment "
+            "(ops/pallas_select.py::_assign_geometry)",
+            dims=("d", "k"), searchable=True,
+            grid=(512, 1024, 2048, 4096, 8192),
+        ),
+        Knob(
+            "assign.fused_min_k", "int",
+            "k threshold where auto routes KMeans assignment to the fused "
+            "kernel (ops/pallas_select.py::use_fused_assign)",
+            dims=("d",), grid=(32, 64, 128, 256),
+        ),
+        Knob(
+            "lloyd.fused_min_k", "int",
+            "k threshold where the auto Lloyd gate engages the fused pallas "
+            "iteration (ops/kmeans.py::kmeans_fit)",
+            dims=("d",), grid=(32, 64, 128, 256),
+        ),
+        Knob(
+            "serving.bucket_min_rows", "int",
+            "smallest serving padding bucket (serving/batcher.py::bucket_rows)",
+            config_key="serving.bucket_min_rows", dims=(),
+            grid=(8, 16, 32, 64),
+        ),
+        Knob(
+            "cache.budget_bytes", "int",
+            "HBM batch-cache byte budget / prefix split "
+            "(ops/device_cache.py::batch_cache)",
+            config_key="cache.hbm_budget_bytes", dims=(),
+        ),
+    )
+}
+
+
+# ----------------------------------------------------------- shape buckets
+
+
+def _pow2_bucket(x: int) -> int:
+    x = int(x)
+    if x <= 1:
+        return max(x, 0)
+    return 1 << (x - 1).bit_length()
+
+
+def shape_bucket(n: Optional[int] = None, d: Optional[int] = None,
+                 k: Optional[int] = None) -> str:
+    """The shape-bucket key: each provided dim rounds UP to its power of two
+    (`n131072-d64-k16` style). Per-exact-shape entries would never be
+    consulted twice; pow2 buckets match how XLA padding/compile costs
+    actually step."""
+    parts = []
+    for tag, v in (("n", n), ("d", d), ("k", k)):
+        if v is not None:
+            parts.append(f"{tag}{_pow2_bucket(v)}")
+    return "-".join(parts) or "any"
+
+
+def bucket_for(knob: Knob, n: Optional[int], d: Optional[int],
+               k: Optional[int]) -> str:
+    return shape_bucket(
+        n=n if "n" in knob.dims else None,
+        d=d if "d" in knob.dims else None,
+        k=k if "k" in knob.dims else None,
+    )
+
+
+# ------------------------------------------------------- resolution records
+
+_state_lock = threading.Lock()
+# (knob, bucket, dtype) -> how it last resolved: the report section's source
+_resolutions: Dict[str, Dict[str, Any]] = {}
+_MAX_RESOLUTIONS = 256
+
+_tl = threading.local()  # .searching: trials must resolve to pure defaults
+
+
+def _in_search() -> bool:
+    return bool(getattr(_tl, "searching", False))
+
+
+def _note(knob: str, bucket: Optional[str], dtype: str, value: Any,
+          source: str) -> None:
+    key = _table.entry_key(knob, bucket or "-", dtype)
+    with _state_lock:
+        if key not in _resolutions and len(_resolutions) >= _MAX_RESOLUTIONS:
+            return
+        _resolutions[key] = {
+            "knob": knob,
+            "bucket": bucket,
+            "dtype": dtype,
+            "value": value,
+            "source": source,
+        }
+
+
+def _counter(name: str, **labels: Any) -> None:
+    try:
+        from ..observability.runs import counter_inc
+
+        counter_inc(name, 1, **labels)
+    except Exception:  # noqa: silent-except — telemetry is best-effort here
+        pass
+
+
+# strategies whose outputs are bit-identical to the exact_full reference —
+# the only values a TABLE entry may introduce for the bit-class strategy
+# knob. "approx" is accepted solely where it IS the platform auto default
+# (TPU), where a table entry saying so changes nothing.
+_BIT_SAFE_STRATEGIES = ("exact_full", "exact_tiled", "pallas_fused")
+
+
+def _coerce_value(knob: Knob, raw: Any) -> Optional[Any]:
+    """Validate/coerce a table value against the knob's declared kind AND its
+    exactness class; None for anything malformed or exactness-violating
+    (counted `autotune.table_invalid`, treated as a miss — a hand-edited or
+    truncated entry must not crash a fit, and a bit-class knob must never be
+    steered onto an approximate path by a table no test ever vetted)."""
+    try:
+        if knob.kind == "int":
+            v = int(raw)
+            return v if v > 0 else None
+        if knob.kind == "str":
+            v = str(raw)
+            if knob.values is not None and v not in knob.values:
+                return None
+            if (
+                knob.name == "selection.strategy"
+                and v not in _BIT_SAFE_STRATEGIES
+                and _table.platform_key()[0] != "tpu"
+            ):
+                return None  # exactness="bit": approx only where it's default
+            return v
+        if knob.kind == "int_pair":
+            a, b = (int(raw[0]), int(raw[1]))
+            return (a, b) if a > 0 and b > 0 else None
+    except (TypeError, ValueError, IndexError, KeyError):
+        return None
+    return None
+
+
+# ------------------------------------------------------------------ lookup
+
+
+def lookup(name: str, *, n: Optional[int] = None, d: Optional[int] = None,
+           k: Optional[int] = None, dtype: str = "float32") -> Optional[Any]:
+    """Resolve a knob from the tuning table; None means 'use your default'.
+
+    Order of precedence (docs/design.md §6i): a knob whose config key is
+    pinned (programmatic set() or env) returns None WITHOUT touching the
+    table — config always wins; `autotune.mode=off` returns None without
+    loading anything; a table hit returns the validated value (counted
+    `autotune.table_hit{knob=}`); a miss counts `autotune.table_miss{knob=}`
+    and, in `search` mode at a searchable knob, triggers the one-shot online
+    search for this bucket (counted `autotune.searches{knob=}`), persisting
+    and returning the winner. Host-side only — the resolution sites are the
+    PR-5 host wrappers, so cached traces never bake a stale choice."""
+    knob = KNOBS[name]
+    from .. import config as _config
+
+    mode = str(_config.get("autotune.mode"))
+    if mode == "off" or _in_search():
+        return None
+    if knob.config_key is not None and _config.source(knob.config_key) != "default":
+        # a pin to the knob's "choose for me" sentinel (env restating
+        # `auto`/0) is not a real pin — the table still resolves
+        if _config.get(knob.config_key) not in knob.auto_values:
+            _note(name, None, dtype, None, "config")
+            return None
+    bucket = bucket_for(knob, n, d, k)
+    tbl = _table.load_table()
+    key = _table.entry_key(name, bucket, dtype)
+    entry = tbl.get(key)
+    if entry is not None:
+        value = _coerce_value(knob, entry.get("value"))
+        if value is None:
+            _counter("autotune.table_invalid", knob=name)
+        else:
+            _counter("autotune.table_hit", knob=name)
+            _note(name, bucket, dtype, value, "table")
+            return value
+    _counter("autotune.table_miss", knob=name)
+    if mode == "search" and knob.searchable:
+        value = _online_search(knob, n=n, d=d, k=k, dtype=dtype)
+        if value is not None:
+            _note(name, bucket, dtype, value, "searched")
+            return value
+    _note(name, bucket, dtype, None, "default")
+    return None
+
+
+_search_lock = threading.Lock()
+
+
+def _online_search(knob: Knob, *, n: Optional[int], d: Optional[int],
+                   k: Optional[int], dtype: str) -> Optional[Any]:
+    """Online `search` mode: first sight of an uncovered (knob, bucket) runs
+    the measurement loop synchronously, persists the winner, and returns it.
+    Serialized — concurrent first sights re-check the table under the lock."""
+    with _search_lock:
+        tbl = _table.load_table()
+        key = _table.entry_key(knob.name, bucket_for(knob, n, d, k), dtype)
+        entry = tbl.get(key)
+        if entry is not None:  # another thread searched while we waited
+            return _coerce_value(knob, entry.get("value"))
+        try:
+            from . import search as _search
+
+            entry = _search.search_knob(
+                knob.name, n=n, d=d, k=k, dtype=dtype
+            )
+        except Exception as e:
+            from ..utils import get_logger
+
+            get_logger("autotune").warning(
+                "online search for %s failed: %s; using defaults", knob.name, e
+            )
+            return None
+        if entry is None:
+            return None
+        _counter("autotune.searches", knob=knob.name)
+        return _coerce_value(knob, entry.get("value"))
+
+
+# ---------------------------------------------------------- report section
+
+
+def report_section(registry: Any = None) -> Optional[Dict[str, Any]]:
+    """The run report's `autotune` section (observability/runs.py): mode,
+    table identity/version, every knob resolution this process has made, and
+    this RUN's hit/miss/search counts parsed from its scoped registry — the
+    join key between a perf regression and the knob choice that caused it."""
+    from .. import config as _config
+
+    with _state_lock:
+        resolutions = {k: dict(v) for k, v in _resolutions.items()}
+    mode = str(_config.get("autotune.mode"))
+    if mode == "off" and not resolutions:
+        return None
+    tbl = _table.peek_table()
+    hits: Dict[str, int] = {}
+    misses: Dict[str, int] = {}
+    searches = 0
+    if registry is not None:
+        try:
+            from ..observability.registry import split_label_key
+
+            for key, v in (registry.snapshot().get("counters") or {}).items():
+                cname, labels = split_label_key(key)
+                knob = labels.get("knob", "")
+                if cname == "autotune.table_hit":
+                    hits[knob] = hits.get(knob, 0) + int(v)
+                elif cname == "autotune.table_miss":
+                    misses[knob] = misses.get(knob, 0) + int(v)
+                elif cname == "autotune.searches":
+                    searches += int(v)
+        except Exception:  # noqa: silent-except — report assembly best-effort
+            pass
+    return {
+        "mode": mode,
+        "table_version": _table.TABLE_VERSION,
+        "table_path": tbl.path if tbl is not None else None,
+        "table_status": tbl.status if tbl is not None else "unloaded",
+        "table_entries": len(tbl) if tbl is not None else 0,
+        "knobs": resolutions,
+        "table_hits": hits,
+        "table_misses": misses,
+        "searches": searches,
+    }
+
+
+def reset() -> None:
+    """Tests: drop cached tables and resolution notes."""
+    _table.reset_tables()
+    with _state_lock:
+        _resolutions.clear()

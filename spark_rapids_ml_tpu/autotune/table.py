@@ -1,0 +1,249 @@
+#
+# Persisted tuning tables — the durable half of the closed-loop autotuner
+# (docs/design.md §6i).
+#
+# One versioned JSON file per (platform, device_kind) under `autotune.dir`
+# (`SRML_TPU_TUNE_DIR`): `tuning_<platform>_<device_kind>.json`. Entries are
+# keyed `<knob>|<shape-bucket>|<dtype>` and carry the measured winner plus
+# its trial statistics and a `provenance` field (the search that produced
+# it) — the stale one-off-measurement comments the defaults module replaced.
+#
+# Contracts:
+#   * atomic writes: tmp file + os.replace, the JSONL-exporter discipline —
+#     a reader never observes a torn table;
+#   * corrupt or stale tables NEVER fail a fit: a JSON parse error counts
+#     `autotune.table_corrupt`, a version (or platform) mismatch counts
+#     `autotune.table_stale`, and either falls through to the in-code
+#     defaults exactly like a missing file (mirroring `load_run_reports`'s
+#     corrupt-line handling);
+#   * loaded ONCE per process (per directory+platform) and consulted at the
+#     HOST-wrapper resolution points only, so cached traces never bake a
+#     stale choice — the PR-5 resolution contract.
+#
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import tempfile
+import threading
+import time
+from typing import Any, Dict, Optional, Tuple
+
+TABLE_VERSION = 1
+
+_lock = threading.Lock()
+# process cache: one loaded table per (dir-or-None, platform, device_kind)
+_tables: Dict[Tuple[Optional[str], str, str], "TuningTable"] = {}
+_platform_cache: Optional[Tuple[str, str]] = None
+
+
+def _counter(name: str, n: int = 1, **labels: Any) -> None:
+    """Best-effort observability counter: table handling must never fail a
+    fit because the metrics plane is mid-teardown."""
+    try:
+        from ..observability.runs import counter_inc
+
+        counter_inc(name, n, **labels)
+    except Exception:  # noqa: silent-except — telemetry is best-effort here
+        pass
+
+
+def platform_key() -> Tuple[str, str]:
+    """(platform, device_kind) of device 0 — the table file identity. Cached:
+    the backend cannot change within a process, and jax.devices() is not free."""
+    global _platform_cache
+    if _platform_cache is None:
+        try:
+            import jax
+
+            dev = jax.devices()[0]
+            kind = str(getattr(dev, "device_kind", "") or dev.platform)
+            _platform_cache = (str(dev.platform), kind)
+        except Exception:  # pragma: no cover - backend probe must never fail
+            _platform_cache = ("cpu", "cpu")
+    return _platform_cache
+
+
+def _safe_name(s: str) -> str:
+    return re.sub(r"[^A-Za-z0-9._-]+", "_", s.strip()) or "unknown"
+
+
+def table_path(tune_dir: str, platform: str, device_kind: str) -> str:
+    return os.path.join(
+        tune_dir, f"tuning_{_safe_name(platform)}_{_safe_name(device_kind)}.json"
+    )
+
+
+def entry_key(knob: str, bucket: str, dtype: str) -> str:
+    return f"{knob}|{bucket}|{dtype}"
+
+
+class TuningTable:
+    """One platform's knob table. `status` records how it materialized:
+    'loaded' (file parsed), 'missing' (no file yet), 'memory' (no tune dir
+    configured), 'corrupt' / 'stale' (fell through to empty)."""
+
+    def __init__(self, path: Optional[str], platform: str, device_kind: str):
+        self.path = path
+        self.platform = platform
+        self.device_kind = device_kind
+        self.version = TABLE_VERSION
+        self.status = "memory" if path is None else "missing"
+        self.entries: Dict[str, Dict[str, Any]] = {}
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------- access
+
+    def get(self, key: str) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            e = self.entries.get(key)
+            return dict(e) if e is not None else None
+
+    def put(self, key: str, entry: Dict[str, Any]) -> None:
+        with self._lock:
+            self.entries[key] = dict(entry)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self.entries)
+
+    # -------------------------------------------------------- persistence
+
+    def as_doc(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "version": TABLE_VERSION,
+                "platform": self.platform,
+                "device_kind": self.device_kind,
+                "updated_ts": round(time.time(), 3),
+                "entries": {k: dict(v) for k, v in self.entries.items()},
+            }
+
+    def save(self) -> Optional[str]:
+        """Atomic write (tmp + os.replace). No-op for in-memory tables. A
+        STALE on-disk table (e.g. written by a newer schema before a library
+        rollback) is moved aside to `<path>.stale` instead of clobbered —
+        rolling forward again must be able to recover its accumulated
+        entries; corrupt files hold no data worth preserving."""
+        if self.path is None:
+            return None
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        if self.status == "stale" and os.path.exists(self.path):
+            os.replace(self.path, self.path + ".stale")
+            _warn_once(
+                self.path + ".stale",
+                f"preserved version-mismatched tuning table as "
+                f"{self.path}.stale before writing v{TABLE_VERSION}",
+            )
+        doc = self.as_doc()
+        fd, tmp = tempfile.mkstemp(
+            dir=os.path.dirname(self.path) or ".", suffix=".tmp"
+        )
+        try:
+            with os.fdopen(fd, "w") as f:
+                json.dump(doc, f, indent=1, sort_keys=True)
+            os.replace(tmp, self.path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        self.status = "loaded"
+        return self.path
+
+
+def _load_file(path: str, platform: str, device_kind: str) -> TuningTable:
+    tbl = TuningTable(path, platform, device_kind)
+    if not os.path.exists(path):
+        return tbl  # status 'missing': every lookup is a clean miss
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+        if not isinstance(doc, dict) or not isinstance(doc.get("entries"), dict):
+            raise ValueError("tuning table is not an object with entries")
+    except (json.JSONDecodeError, ValueError, OSError) as e:
+        # corrupt table: fall through to defaults, never fail the fit
+        tbl.status = "corrupt"
+        _counter("autotune.table_corrupt", 1)
+        _warn_once(path, f"corrupt tuning table {path}: {e}; using defaults")
+        return tbl
+    if doc.get("version") != TABLE_VERSION or (
+        doc.get("platform") and doc["platform"] != platform
+    ):
+        # a table written by a different schema generation (or copied from
+        # another backend) must not steer this process's knobs
+        tbl.status = "stale"
+        _counter("autotune.table_stale", 1)
+        _warn_once(
+            path,
+            f"stale tuning table {path} (version={doc.get('version')}, "
+            f"platform={doc.get('platform')}; want v{TABLE_VERSION} "
+            f"{platform}); using defaults",
+        )
+        return tbl
+    tbl.entries = {
+        str(k): dict(v) for k, v in doc["entries"].items() if isinstance(v, dict)
+    }
+    tbl.status = "loaded"
+    return tbl
+
+
+_warned: set = set()
+
+
+def _warn_once(key: str, msg: str) -> None:
+    with _lock:
+        if key in _warned:
+            return
+        _warned.add(key)
+    from ..utils import get_logger
+
+    get_logger("autotune.table").warning("%s", msg)
+
+
+def load_table(tune_dir: Optional[str] = None) -> TuningTable:
+    """The process's tuning table for the current platform: loaded once per
+    (dir, platform) and cached. `tune_dir=None` reads `autotune.dir`; with no
+    directory configured an in-memory table is returned (searches still work
+    for the life of the process, nothing persists)."""
+    if tune_dir is None:
+        from .. import config as _config
+
+        raw = _config.get("autotune.dir")
+        tune_dir = str(raw) if raw else None
+    platform, kind = platform_key()
+    cache_key = (tune_dir, platform, kind)
+    with _lock:
+        tbl = _tables.get(cache_key)
+    if tbl is not None:
+        return tbl
+    if tune_dir is None:
+        tbl = TuningTable(None, platform, kind)
+    else:
+        tbl = _load_file(table_path(tune_dir, platform, kind), platform, kind)
+    with _lock:
+        # racing loaders: first one in wins so every caller shares one object
+        tbl = _tables.setdefault(cache_key, tbl)
+    return tbl
+
+
+def peek_table() -> Optional[TuningTable]:
+    """The already-loaded table for the current config, or None — the report
+    path uses this so building a report never triggers a table load."""
+    from .. import config as _config
+
+    raw = _config.get("autotune.dir")
+    tune_dir = str(raw) if raw else None
+    platform, kind = platform_key()
+    with _lock:
+        return _tables.get((tune_dir, platform, kind))
+
+
+def reset_tables() -> None:
+    """Drop every cached table (tests; a directory change mid-process)."""
+    with _lock:
+        _tables.clear()
+        _warned.clear()

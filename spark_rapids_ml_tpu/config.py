@@ -192,6 +192,27 @@ _DEFAULTS: Dict[str, Any] = {
     # per-request wall-clock budget the HTTP handler waits on a future before
     # answering 504 (the request may still complete; its slot is not replayed)
     "serving.request_timeout_s": 30.0,
+    # closed-loop autotuner (spark_rapids_ml_tpu/autotune/, docs/design.md
+    # §6i): telemetry-driven knob search persisted as per-platform tuning
+    # tables. mode:
+    #   off    never consult tables (every knob resolves to its built-in
+    #          default unless config pins it)
+    #   load   (default) consult the tuning table at the host-wrapper
+    #          resolution points; misses fall through to defaults
+    #   search on first sight of an uncovered (knob, shape-bucket) at a
+    #          searchable knob, run the measurement loop, persist the winner,
+    #          and use it — the opt-in online mode
+    "autotune.mode": "load",
+    # tuning-table directory (versioned tuning_<platform>_<device_kind>.json
+    # files, atomic writes). None = in-memory tables only: lookups/searches
+    # work for the life of the process but nothing persists
+    "autotune.dir": None,
+    # measurement-loop replication: timed reps per candidate (round-robin
+    # across candidates so warming drift cannot favor late candidates), and
+    # how many MADs of separation a challenger needs to displace the default
+    # (the ci/bench_check.py lesson: judging two noise samples is not a win)
+    "autotune.replicates": 5,
+    "autotune.noise_mads": 3.0,
 }
 
 _ENV_KEYS: Dict[str, str] = {
@@ -250,6 +271,10 @@ _ENV_KEYS: Dict[str, str] = {
     "serving.hbm_budget_bytes": "SRML_TPU_SERVING_HBM_BUDGET",
     "serving.queue_depth": "SRML_TPU_SERVING_QUEUE_DEPTH",
     "serving.request_timeout_s": "SRML_TPU_SERVING_REQUEST_TIMEOUT_S",
+    "autotune.mode": "SRML_TPU_AUTOTUNE_MODE",
+    "autotune.dir": "SRML_TPU_TUNE_DIR",
+    "autotune.replicates": "SRML_TPU_AUTOTUNE_REPLICATES",
+    "autotune.noise_mads": "SRML_TPU_AUTOTUNE_NOISE_MADS",
 }
 
 _overrides: Dict[str, Any] = {}
@@ -276,6 +301,21 @@ def get(key: str) -> Any:
     if env is not None and env != "":
         return _coerce(key, env)
     return _DEFAULTS[key]
+
+
+def source(key: str) -> str:
+    """Where `get(key)` currently resolves from: 'set' (programmatic
+    override), 'env', or 'default'. The autotuner's tuning tables slot in
+    BETWEEN env and default (docs/design.md §6i): a knob's table entry is
+    consulted only when this returns 'default' — set() and env always win."""
+    if key not in _DEFAULTS:
+        raise KeyError(f"Unknown config key '{key}'; known: {sorted(_DEFAULTS)}")
+    if key in _overrides:
+        return "set"
+    env = os.environ.get(_ENV_KEYS[key])
+    if env is not None and env != "":
+        return "env"
+    return "default"
 
 
 def set(key: str, value: Any) -> None:  # noqa: A001 — spark-conf style name

@@ -382,7 +382,7 @@ def kmeans_fit(
     #          when sample weights are present
     #   "0"/"" XLA always
     # `kmeans.lloyd_path{path=}` counts which path actually ran.
-    from ..ops.pallas_select import FUSED_ASSIGN_MIN_K as _FUSED_MIN_K
+    from ..autotune.defaults import LLOYD_FUSED_MIN_K as _FUSED_MIN_K
 
     _pallas_env = __import__("os").environ.get("SRML_TPU_PALLAS_KMEANS", "auto")
     if _pallas_env == "auto":
@@ -399,10 +399,24 @@ def kmeans_fit(
             1 if bool(_config.get("fast_math"))
             else _N_SPLIT[parity_precision()]
         )
+        # the k-threshold of the auto gate is a tuning-table knob
+        # (`lloyd.fused_min_k`, docs/design.md §6i): a platform where the
+        # fused win boundary sits elsewhere ships a table entry instead of a
+        # code change; the default stays the measured v5e boundary. Off-TPU
+        # the gate is closed anyway, so the table is never consulted there.
+        _min_k = _FUSED_MIN_K
+        if jax.default_backend() == "tpu":
+            from .. import autotune as _autotune
+
+            _tuned_min_k = _autotune.lookup(
+                "lloyd.fused_min_k", d=int(X.shape[1])
+            )
+            if _tuned_min_k is not None:
+                _min_k = int(_tuned_min_k)
         use_fused = (
             not cosine
             and jax.default_backend() == "tpu"
-            and k >= _FUSED_MIN_K
+            and k >= _min_k
             and lloyd_fits_vmem(k, int(X.shape[1]), _n_split)
         )
         _pallas_env = "mask" if unit_weight else "1"

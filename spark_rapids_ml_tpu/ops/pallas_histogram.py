@@ -34,8 +34,12 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-BLOCK_ROWS = 512
-MAX_SEG_TILE = 2048
+# tile defaults live in the knob-registry defaults module (docs/design.md
+# §6i; ci/lint_python.py bans new tile/threshold literals in ops/)
+from ..autotune.defaults import (  # noqa: re-exported tile defaults
+    PALLAS_HISTOGRAM_BLOCK_ROWS as BLOCK_ROWS,
+    PALLAS_HISTOGRAM_MAX_SEG_TILE as MAX_SEG_TILE,
+)
 
 
 def _round_up(x: int, m: int) -> int:

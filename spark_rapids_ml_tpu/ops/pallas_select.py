@@ -73,33 +73,31 @@ from jax.experimental import pallas as pl
 from ..observability.device import compiled_kernel
 from .selection import INVALID_D2
 
-# default tile geometry: the query block bounds the (block, tile) distance
-# tile in VMEM (256*1024*4 = 1 MiB) next to one double-buffered X tile
-# (1024*d*4); both sit comfortably inside the 16 MiB scoped-VMEM budget at
-# any d <= 2048. Tests pass explicit odd tiles to exercise ragged edges.
-DEFAULT_QUERY_BLOCK = 256
-DEFAULT_ITEM_TILE = 1024
-
-# the assignment form streams ROWS; same ~1-2 MiB-of-X-per-block sizing
-# rationale as ops/pallas_kmeans.py::_block_rows
-DEFAULT_ASSIGN_BLOCK = 2048
-MIN_ASSIGN_BLOCK = 256
-
-# k >= this engages the fused assignment under `auto` on TPU: below it the
-# (B, k) distance tile pads k to the 128-lane MXU width and the XLA path's
-# two-read formulation is already at its HBM roofline (the measured small-k
-# loss region of ops/pallas_kmeans.py)
-FUSED_ASSIGN_MIN_K = 128
+# tile-geometry DEFAULTS live in the knob-registry defaults module
+# (autotune/defaults.py — ci/lint_python.py bans new tile/threshold literals
+# in ops/): the query block bounds the (block, tile) distance tile in VMEM
+# (256*1024*4 = 1 MiB) next to one double-buffered X tile (1024*d*4). The
+# tuning table (docs/design.md §6i) can override geometry per (platform,
+# shape-bucket); tuned values still pass the VMEM-budget shrink below.
+# Tests pass explicit odd tiles to exercise ragged edges.
+from ..autotune.defaults import (  # noqa: re-exported — kmeans/tests import here
+    DEFAULT_ASSIGN_BLOCK,
+    DEFAULT_ITEM_TILE,
+    DEFAULT_QUERY_BLOCK,
+    FUSED_ASSIGN_MIN_K,
+    MIN_ASSIGN_BLOCK,
+    MIN_ITEM_TILE,
+    MIN_QUERY_BLOCK,
+)
 
 # VMEM ceiling the fused tiles must fit under (the scoped-VMEM budget is
 # ~16 MiB; half is left for double buffering and compiler scratch — the
 # ops/pallas_kmeans.py lesson that a 4096x512 block blows exactly that
-# limit). Geometry resolution shrinks blocks toward the floors below and
-# REFUSES (-> XLA path) when nothing fits: a Mosaic compile failure at k in
-# the thousands would crash a predict the XLA path handles fine.
+# limit). A hardware property, NOT a tunable. Geometry resolution shrinks
+# blocks toward the floors and REFUSES (-> XLA path) when nothing fits: a
+# Mosaic compile failure at k in the thousands would crash a predict the
+# XLA path handles fine.
 _VMEM_BUDGET_BYTES = 8 << 20
-MIN_QUERY_BLOCK = 8
-MIN_ITEM_TILE = 128
 
 
 def _interpret_default() -> bool:
@@ -132,6 +130,30 @@ def _maybe_cost(kwargs: dict, flops: float, bytes_accessed: float) -> dict:
     return kwargs
 
 
+def topk_fits_vmem(q_block: int, item_tile: int, d: int, k: int) -> bool:
+    """Can the running-pool scan place (q_block, item_tile) at this (d, k)?
+    ONE working-set formula — `_topk_geometry`'s shrink loop and the
+    autotuner's candidate filter (autotune/search.py) both ask this, so the
+    two can never drift and admit a geometry Mosaic cannot place."""
+    work = (
+        q_block * (k + item_tile) * 4 * 4  # concat d2+ids + masked copies
+        + q_block * d * 4 + item_tile * d * 4  # Q block + X tile
+        + q_block * k * 8  # running pool (d2 + ids)
+    )
+    return work <= _VMEM_BUDGET_BYTES
+
+
+def assign_block_fits_vmem(blk: int, d: int, k: int, n_split: int) -> bool:
+    """Can the fused assignment place a blk-row block at this (d, k,
+    n_split)? Shared by `_assign_geometry` and the autotuner's
+    `pallas.assign_block` candidate filter — same no-drift rationale as
+    `topk_fits_vmem`."""
+    copies = max(1, n_split)  # bf16 splitting materializes n_split copies
+    centers_b = k * d * 4 * copies
+    tile_b = blk * d * 4 * copies + blk * k * 4 * 2  # X block + d2/onehot
+    return centers_b + tile_b <= _VMEM_BUDGET_BYTES
+
+
 def _topk_geometry(
     nq: int, n: int, d: int, k: int,
     q_block: Optional[int], item_tile: Optional[int],
@@ -141,23 +163,27 @@ def _topk_geometry(
     copies). Caller-pinned values pass through untouched (tests exercise
     ragged geometries); unpinned axes halve toward their floors until the
     budget holds — a floor-sized scan always fits for any k the search
-    family produces."""
-    qb = q_block or min(DEFAULT_QUERY_BLOCK, max(nq, 1))
-    t = item_tile or min(DEFAULT_ITEM_TILE, max(n, 1))
+    family produces. Fully-unpinned geometry consults the tuning table first
+    (`pallas.topk_geometry`, docs/design.md §6i); tuned values are still
+    treated as unpinned, so a table entry written on different hardware can
+    never hand Mosaic an unplaceable compile."""
+    tuned_q = tuned_t = None
+    if q_block is None and item_tile is None:
+        from .. import autotune as _autotune
 
-    def fits(qb_: int, t_: int) -> bool:
-        work = (
-            qb_ * (k + t_) * 4 * 4  # concat d2+ids and their masked copies
-            + qb_ * d * 4 + t_ * d * 4  # Q block + X tile
-            + qb_ * k * 8  # running pool (d2 + ids)
-        )
-        return work <= _VMEM_BUDGET_BYTES
+        tuned = _autotune.lookup("pallas.topk_geometry", n=n, d=d, k=k)
+        if tuned is not None:
+            # clamp tuned values into the data like the defaults are
+            tuned_q = min(int(tuned[0]), max(nq, 1))
+            tuned_t = min(int(tuned[1]), max(n, 1))
+    qb = q_block or tuned_q or min(DEFAULT_QUERY_BLOCK, max(nq, 1))
+    t = item_tile or tuned_t or min(DEFAULT_ITEM_TILE, max(n, 1))
 
     if q_block is None:
-        while not fits(qb, t) and qb > MIN_QUERY_BLOCK:
+        while not topk_fits_vmem(qb, t, d, k) and qb > MIN_QUERY_BLOCK:
             qb //= 2
     if item_tile is None:
-        while not fits(qb, t) and t > MIN_ITEM_TILE:
+        while not topk_fits_vmem(qb, t, d, k) and t > MIN_ITEM_TILE:
             t //= 2
     return max(qb, 1), max(t, 1)
 
@@ -182,13 +208,14 @@ def _assign_geometry(d: int, k: int, n_split: int, n: int) -> Optional[int]:
     block cannot fit resident centers + tiles under the VMEM budget — the
     caller must keep the XLA path (which handles any k) rather than hand
     Mosaic a compile it cannot place."""
-    copies = max(1, n_split)  # bf16 splitting materializes n_split copies
-    centers_b = k * d * 4 * copies
     floor = min(MIN_ASSIGN_BLOCK, max(n, 1))
-    blk = min(DEFAULT_ASSIGN_BLOCK, max(n, 1))
+    from .. import autotune as _autotune
+
+    tuned = _autotune.lookup("pallas.assign_block", d=d, k=k)
+    start = int(tuned) if tuned is not None else DEFAULT_ASSIGN_BLOCK
+    blk = min(max(start, floor), max(n, 1))
     while True:
-        tile_b = blk * d * 4 * copies + blk * k * 4 * 2  # X block + d2/onehot
-        if centers_b + tile_b <= _VMEM_BUDGET_BYTES:
+        if assign_block_fits_vmem(blk, d, k, n_split):
             return blk
         if blk <= floor:
             return None
@@ -514,13 +541,30 @@ def use_fused_assign(
     s = strategy or str(_config.get("knn.selection"))
     if s not in ("pallas_fused", "auto"):
         return False
-    if d is not None and _assign_geometry(
-        int(d), int(k), _assign_n_split(), DEFAULT_ASSIGN_BLOCK
-    ) is None:
+    if s == "auto":
+        if _sel._backend() != "tpu":
+            # auto off-TPU: XLA always — return before any probe so a CPU
+            # predict never pays (or counter-pollutes) a table consult
+            return False
+        # min_k gate BEFORE the geometry probe: the probe can trigger a
+        # pallas.assign_block table consult (and, in online search mode, a
+        # whole measurement sweep) that a below-threshold k would discard
+        min_k = FUSED_ASSIGN_MIN_K
+        from .. import autotune as _autotune
+
+        tuned = _autotune.lookup("assign.fused_min_k", d=d)
+        if tuned is not None:
+            min_k = int(tuned)
+        if int(k) < min_k:
+            return False
+    if d is not None and not assign_block_fits_vmem(
+        # placeability = the FLOOR block fits (what _assign_geometry's
+        # shrink bottoms out at); asking the predicate directly keeps the
+        # gate free of a second pallas.assign_block table consult per call
+        MIN_ASSIGN_BLOCK, int(d), int(k), _assign_n_split()
+    ):
         return False
-    if s == "pallas_fused":
-        return True
-    return _sel._backend() == "tpu" and int(k) >= FUSED_ASSIGN_MIN_K
+    return True
 
 
 # -------------------------------------------------------------------- count

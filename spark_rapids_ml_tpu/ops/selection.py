@@ -88,34 +88,48 @@ def _backend() -> str:
 
 
 def _auto_tile(n: int, backend: str) -> int:
-    """Platform-aware tile: on TPU small fixed tiles vectorize the per-tile
-    select on the VPU; on CPU each TopK custom call pays per-call overhead, so
-    keep the tile count small (~4) — measured at (1024, 100k): tile 2048 is
-    1.8x SLOWER than full-width on this CPU XLA while tile n/4 is parity."""
-    if backend == "tpu":
-        return 2048
-    return max(8192, -(-n // 4))
+    """Platform tile DEFAULT when no tuning-table entry covers the bucket:
+    on TPU small fixed tiles vectorize the per-tile select on the VPU; on CPU
+    each TopK custom call pays per-call overhead, so keep the tile count
+    small. The values live in autotune/defaults.py (the knob-registry
+    defaults module); measured per-bucket choices live in the tuning table,
+    whose entries carry their own `provenance` field (docs/design.md §6i)."""
+    from ..autotune.defaults import default_select_tile
+
+    return default_select_tile(n, backend)
 
 
 def _fused_auto(n: int) -> bool:
     """Should `auto` hand a FUSABLE width-n scan to the fused pallas kernel?
     TPU only (off-TPU the kernel runs the Pallas interpreter — a correctness
-    tool, not a fast path), and only once the scanned item width clears
-    `knn.pallas_min_items` (small scans don't pay back the kernel's in-register
-    selection work)."""
+    tool, not a fast path), and only once the scanned item width clears the
+    `pallas.min_items` threshold (tuning table, else `knn.pallas_min_items`;
+    small scans don't pay back the kernel's in-register selection work)."""
+    if _backend() != "tpu":
+        return False
+    from .. import autotune as _autotune
     from .. import config as _config
 
-    return _backend() == "tpu" and n >= int(_config.get("knn.pallas_min_items"))
+    min_items = _autotune.lookup("pallas.min_items")
+    if min_items is None:
+        min_items = int(_config.get("knn.pallas_min_items"))
+    return n >= int(min_items)
 
 
 def resolve_fused_precision(precision: Optional[str] = None) -> str:
     """Resolve the fused scan's distance-accumulation mode
     (`knn.pallas_precision` unless the caller pinned one). Host-side — like
     `resolve`, so a config change can never be baked stale into a cached
-    trace. Non-float32 modes REQUIRE the caller to follow with the
-    parity_rerank_sq re-rank (returned distances stay exact-f32)."""
+    trace. Resolution order: caller-pinned > config set()/env > tuning table
+    > default (the table may only steer this knob because every consuming
+    site pairs non-f32 modes with the parity_rerank_sq exactness invariant —
+    returned distances stay exact-f32 either way). Non-float32 modes REQUIRE
+    the caller to follow with that re-rank."""
+    from .. import autotune as _autotune
     from .. import config as _config
 
+    if precision is None:
+        precision = _autotune.lookup("pallas.precision")
     if precision is None:
         precision = str(_config.get("knn.pallas_precision"))
     if precision not in FUSED_PRECISIONS:
@@ -162,7 +176,18 @@ def resolve(
         if fusable and _fused_auto(n):
             strategy = "pallas_fused"
         else:
-            strategy = "approx" if _backend() == "tpu" else "exact_tiled"
+            # tuning table first (docs/design.md §6i): a measured per-bucket
+            # strategy beats the platform heuristic. A REAL set()/env pin on
+            # knn.selection never reaches here (strategy wasn't "auto"), and
+            # lookup() itself treats a pin to the literal sentinel "auto" as
+            # "choose for me" — the table slots between env and the default
+            from .. import autotune as _autotune
+
+            tuned = _autotune.lookup("selection.strategy", n=n, k=k)
+            if tuned is not None and (fusable or tuned != "pallas_fused"):
+                strategy = tuned
+            else:
+                strategy = "approx" if _backend() == "tpu" else "exact_tiled"
     if strategy == "pallas_fused" and not fusable:
         strategy = "exact_full"
     # degradations: k-of-n selects with no real pool reduction run fused
@@ -176,7 +201,12 @@ def resolve(
         if tile is None:
             tile = int(_config.get("knn.select_tile") or 0)
         if tile <= 0:
-            tile = _auto_tile(n, _backend())
+            # tuning table between config and the platform heuristic: a
+            # nonzero knn.select_tile (set()/env) took the branch above
+            from .. import autotune as _autotune
+
+            tuned = _autotune.lookup("selection.tile", n=n, k=k)
+            tile = int(tuned) if tuned is not None else _auto_tile(n, _backend())
         if n <= tile:
             strategy = "exact_full"
     # knn.recall_target is read/validated ONLY when approx actually runs:

@@ -1,0 +1,313 @@
+"""Closed-loop autotuner tests (spark_rapids_ml_tpu/autotune/, design §6i):
+table lifecycle (round-trip persistence, corrupt-file fall-through, version-
+mismatch rejection), the resolution-order contract (programmatic set() > env
+> table > default), bit-parity of tuned vs default selection outputs, the
+measurement loop's entry shape, online search mode, and the run report's
+autotune section."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from spark_rapids_ml_tpu import autotune, config as srml_config
+from spark_rapids_ml_tpu.autotune import knobs as at_knobs, table as at_table
+from spark_rapids_ml_tpu.ops.knn import exact_knn_single
+from spark_rapids_ml_tpu.ops.selection import resolve
+from spark_rapids_ml_tpu.profiling import counter_totals
+
+
+@pytest.fixture(autouse=True)
+def _clean_autotune(tmp_path):
+    """Every test gets a fresh tune dir and clean knob/config state."""
+    srml_config.set("autotune.dir", str(tmp_path / "tables"))
+    autotune.reset()
+    yield
+    for key in ("autotune.dir", "autotune.mode", "autotune.replicates",
+                "knn.selection", "knn.select_tile"):
+        srml_config.unset(key)
+    autotune.reset()
+
+
+def _counters(prefix):
+    return {k: v for k, v in counter_totals().items() if k.startswith(prefix)}
+
+
+def _put_entry(knob, value, n=None, d=None, k=None, dtype="float32"):
+    tbl = at_table.load_table()
+    bucket = at_knobs.bucket_for(at_knobs.KNOBS[knob], n, d, k)
+    tbl.put(at_table.entry_key(knob, bucket, dtype), {"value": value})
+    return tbl
+
+
+# ---------------------------------------------------------------- buckets
+
+
+def test_shape_bucket_rounds_up_to_pow2():
+    assert autotune.shape_bucket(n=50_000, k=10) == "n65536-k16"
+    assert autotune.shape_bucket(n=65_536, d=64, k=16) == "n65536-d64-k16"
+    assert autotune.shape_bucket() == "any"
+    # dims the knob does not declare are dropped from its bucket
+    assert at_knobs.bucket_for(
+        at_knobs.KNOBS["selection.tile"], 100, 999, 7
+    ) == "n128-k8"
+
+
+# ----------------------------------------------------------- table lifecycle
+
+
+def test_table_round_trip_persistence(tmp_path):
+    tbl = _put_entry("selection.tile", 512, n=20_000, k=10)
+    path = tbl.save()
+    assert path and os.path.exists(path)
+    autotune.reset()  # drop the process cache: force a re-load from disk
+    assert autotune.lookup("selection.tile", n=20_000, k=10) == 512
+    reloaded = at_table.load_table()
+    assert reloaded.status == "loaded" and len(reloaded) == 1
+
+
+def test_corrupt_table_falls_through_to_defaults():
+    tbl = at_table.load_table()
+    path = tbl.path
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        f.write('{"version": 1, "entries": {"truncated...')
+    autotune.reset()
+    before = _counters("autotune.table_corrupt")
+    assert autotune.lookup("selection.tile", n=20_000, k=10) is None
+    after = _counters("autotune.table_corrupt")
+    assert sum(after.values()) == sum(before.values()) + 1
+    assert at_table.load_table().status == "corrupt"
+
+
+def test_version_mismatch_rejected():
+    tbl = at_table.load_table()
+    doc = tbl.as_doc()
+    doc["version"] = 999
+    doc["entries"] = {"selection.tile|n32768-k16|float32": {"value": 512}}
+    os.makedirs(os.path.dirname(tbl.path), exist_ok=True)
+    with open(tbl.path, "w") as f:
+        json.dump(doc, f)
+    autotune.reset()
+    before = _counters("autotune.table_stale")
+    assert autotune.lookup("selection.tile", n=20_000, k=10) is None
+    after = _counters("autotune.table_stale")
+    assert sum(after.values()) == sum(before.values()) + 1
+    assert at_table.load_table().status == "stale"
+
+
+def test_atomic_save_leaves_no_tmp_files(tmp_path):
+    tbl = _put_entry("selection.tile", 1024, n=20_000, k=10)
+    tbl.save()
+    leftover = [
+        p for p in os.listdir(os.path.dirname(tbl.path))
+        if p.endswith(".tmp")
+    ]
+    assert leftover == []
+
+
+def test_bit_class_strategy_rejects_approx_from_table():
+    """exactness="bit" enforcement on the LOAD path: a (hand-edited) table
+    entry may not switch exact selection to `approx` where approx is not
+    already the platform default — on the CPU mesh it is rejected like any
+    malformed value and the default path runs."""
+    _put_entry("selection.strategy", "approx", n=20_000, k=10)
+    before = _counters("autotune.table_invalid")
+    assert autotune.lookup("selection.strategy", n=20_000, k=10) is None
+    after = _counters("autotune.table_invalid")
+    assert sum(after.values()) == sum(before.values()) + 1
+    strategy, _, _ = resolve(20_000, 10)
+    assert strategy != "approx"  # CPU default: exact_tiled (or degraded)
+
+
+def test_invalid_table_value_counted_and_ignored():
+    _put_entry("selection.strategy", "bogus_strategy", n=20_000, k=10)
+    before = _counters("autotune.table_invalid")
+    assert autotune.lookup("selection.strategy", n=20_000, k=10) is None
+    after = _counters("autotune.table_invalid")
+    assert sum(after.values()) == sum(before.values()) + 1
+    # the resolution path survives a bad entry: plain platform auto
+    strategy, _, _ = resolve(20_000, 10)
+    assert strategy in ("exact_tiled", "approx", "exact_full", "pallas_fused")
+
+
+def test_in_memory_table_when_no_dir_configured():
+    srml_config.unset("autotune.dir")
+    autotune.reset()
+    tbl = at_table.load_table()
+    assert tbl.path is None and tbl.status == "memory"
+    assert tbl.save() is None  # no-op, never raises
+
+
+# -------------------------------------------------------- resolution order
+
+
+def test_mode_off_never_consults_table():
+    _put_entry("selection.tile", 512, n=20_000, k=10)
+    srml_config.set("autotune.mode", "off")
+    before = _counters("autotune.table_hit")
+    assert autotune.lookup("selection.tile", n=20_000, k=10) is None
+    assert _counters("autotune.table_hit") == before
+
+
+def test_table_steers_resolve_tile_and_strategy():
+    _put_entry("selection.tile", 640, n=20_000, k=10)
+    _put_entry("selection.strategy", "exact_tiled", n=20_000, k=10)
+    strategy, tile, _ = resolve(20_000, 10)
+    assert (strategy, tile) == ("exact_tiled", 640)
+
+
+def test_env_beats_table(monkeypatch):
+    _put_entry("selection.tile", 640, n=20_000, k=10)
+    monkeypatch.setenv("SRML_TPU_KNN_SELECT_TILE", "768")
+    strategy, tile, _ = resolve(20_000, 10)
+    assert tile == 768  # env wins over the table entry
+    assert srml_config.source("knn.select_tile") == "env"
+
+
+def test_programmatic_set_beats_env_and_table(monkeypatch):
+    _put_entry("selection.tile", 640, n=20_000, k=10)
+    monkeypatch.setenv("SRML_TPU_KNN_SELECT_TILE", "768")
+    srml_config.set("knn.select_tile", 896)
+    strategy, tile, _ = resolve(20_000, 10)
+    assert tile == 896
+    assert srml_config.source("knn.select_tile") == "set"
+
+
+def test_pinned_strategy_config_skips_table(monkeypatch):
+    _put_entry("selection.strategy", "exact_full", n=20_000, k=10)
+    monkeypatch.setenv("SRML_TPU_KNN_SELECTION", "exact_tiled")
+    strategy, _, _ = resolve(20_000, 10)
+    assert strategy == "exact_tiled"
+
+
+def test_env_pin_to_sentinel_keeps_table_live(monkeypatch):
+    """Restating the documented sentinel via env (SRML_TPU_KNN_SELECTION=auto
+    / SRML_TPU_KNN_SELECT_TILE=0 — 'choose for me') is NOT a pin: table
+    resolution stays live, unlike a pin to a real value."""
+    _put_entry("selection.tile", 640, n=20_000, k=10)
+    _put_entry("selection.strategy", "exact_tiled", n=20_000, k=10)
+    monkeypatch.setenv("SRML_TPU_KNN_SELECTION", "auto")
+    monkeypatch.setenv("SRML_TPU_KNN_SELECT_TILE", "0")
+    strategy, tile, _ = resolve(20_000, 10)
+    assert (strategy, tile) == ("exact_tiled", 640)
+
+
+def test_save_preserves_stale_table_aside():
+    """A version-mismatched on-disk table (newer schema, library rolled
+    back) must not be clobbered by a search's save(): it is moved aside to
+    <path>.stale so rolling forward can recover it."""
+    tbl = at_table.load_table()
+    newer = {"version": 999, "platform": tbl.platform,
+             "device_kind": tbl.device_kind,
+             "entries": {"future|any|float32": {"value": 7}}}
+    os.makedirs(os.path.dirname(tbl.path), exist_ok=True)
+    with open(tbl.path, "w") as f:
+        json.dump(newer, f)
+    autotune.reset()
+    stale = at_table.load_table()
+    assert stale.status == "stale"
+    stale.put(at_table.entry_key("selection.tile", "n1024-k8", "float32"),
+              {"value": 512})
+    stale.save()
+    preserved = json.load(open(tbl.path + ".stale"))
+    assert preserved["version"] == 999 and preserved["entries"], preserved
+    assert json.load(open(tbl.path))["version"] == at_table.TABLE_VERSION
+
+
+# ------------------------------------------------------------- bit parity
+
+
+def test_tuned_selection_bit_identical_to_default():
+    """A tuned exact tile/strategy must return byte-identical (d2, ids) to
+    the untouched default path — the §6i exactness contract for bit-class
+    knobs, including tie order."""
+    rng = np.random.default_rng(7)
+    X = np.round(rng.normal(size=(6_000, 12)), 1).astype(np.float32)  # ties
+    X[100] = X[7]
+    Xd = jnp.asarray(X)
+    Q, ones = Xd[:32], jnp.ones((6_000,), bool)
+    srml_config.set("autotune.mode", "off")
+    d_ref, i_ref = [np.asarray(a) for a in exact_knn_single(Q, Xd, ones, 9)]
+    srml_config.unset("autotune.mode")
+    _put_entry("selection.tile", 768, n=6_000, k=9)
+    _put_entry("selection.strategy", "exact_tiled", n=6_000, k=9)
+    d_t, i_t = [np.asarray(a) for a in exact_knn_single(Q, Xd, ones, 9)]
+    np.testing.assert_array_equal(i_t, i_ref)
+    np.testing.assert_array_equal(d_t, d_ref)
+
+
+def test_tuned_topk_geometry_still_respects_vmem_budget():
+    from spark_rapids_ml_tpu.ops import pallas_select as ps
+
+    _put_entry(
+        "pallas.topk_geometry", [1 << 16, 1 << 16], n=1 << 20, d=2048, k=128
+    )
+    qb, t = ps._topk_geometry(4096, 1 << 20, 2048, 128, None, None)
+    work = qb * (128 + t) * 16 + (qb + t) * 2048 * 4 + qb * 128 * 8
+    assert work <= ps._VMEM_BUDGET_BYTES  # absurd tuned values get shrunk
+
+
+# ------------------------------------------------------------------ search
+
+
+def test_search_selection_tile_persists_measured_entry():
+    from spark_rapids_ml_tpu.autotune.search import search_knob
+
+    srml_config.set("autotune.replicates", 2)
+    entry = search_knob("selection.tile", n=6_000, k=10)
+    assert entry is not None
+    assert entry["speedup"] >= 1.0  # default persisted when nothing wins
+    assert entry["trials"] == 2 and entry["baseline_s"] > 0
+    assert "provenance" in entry and "defaults.py" in entry["provenance"]
+    autotune.reset()  # fresh load from disk: the entry must round-trip
+    assert autotune.lookup("selection.tile", n=6_000, k=10) == entry["value"]
+
+
+def test_online_search_mode_searches_once_then_loads():
+    srml_config.set("autotune.mode", "search")
+    srml_config.set("autotune.replicates", 2)
+    before = _counters("autotune.searches")
+    v1 = autotune.lookup("selection.tile", n=6_000, k=10)
+    mid = _counters("autotune.searches")
+    assert v1 is not None
+    assert sum(mid.values()) == sum(before.values()) + 1
+    v2 = autotune.lookup("selection.tile", n=6_000, k=10)
+    assert v2 == v1  # table hit now: no second search
+    assert _counters("autotune.searches") == mid
+
+
+def test_search_skips_unsearchable_and_unknown_knobs():
+    from spark_rapids_ml_tpu.autotune.search import run_search, search_knob
+
+    assert search_knob("cache.budget_bytes") is None  # declared, no searcher
+    with pytest.raises(KeyError):
+        run_search(["no.such.knob"], shapes=[(1024, 8, 4)])
+
+
+# ----------------------------------------------------------------- reports
+
+
+def test_fit_report_carries_autotune_section():
+    from spark_rapids_ml_tpu.observability import fit_run
+
+    _put_entry("selection.tile", 640, n=20_000, k=10)
+    with fit_run(algo="AutotuneReport", site="test") as run:
+        resolve(20_000, 10)
+    rep = run.report()
+    at = rep.get("autotune")
+    assert at is not None
+    assert at["mode"] == "load" and at["table_version"] == at_table.TABLE_VERSION
+    assert at["table_hits"].get("selection.tile", 0) >= 1
+    assert at["searches"] == 0
+    values = {r["knob"]: r for r in at["knobs"].values()}
+    assert values["selection.tile"]["value"] == 640
+    assert values["selection.tile"]["source"] == "table"
+
+
+def test_report_section_absent_when_off_and_silent():
+    srml_config.set("autotune.mode", "off")
+    assert autotune.report_section() is None
