@@ -124,7 +124,7 @@ def _worker_main() -> None:
         # runs (and the driver's run after this session's) skip all of it
         jax.config.update("jax_compilation_cache_dir", "/tmp/srml_jax_cache")
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-    except Exception:  # noqa: silent-except (best-effort probe)
+    except Exception:  # noqa: fence/silent-except (best-effort probe)
         pass
 
     from jax.sharding import NamedSharding, PartitionSpec as P

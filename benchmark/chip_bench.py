@@ -814,7 +814,7 @@ def bench_dbscan(ctx) -> Dict:
         sub = rng.choice(n, min(8000, n), replace=False)
         sk = SkDBSCAN(eps=eps, min_samples=5).fit(Xh[sub])
         ari = float(adjusted_rand_score(sk.labels_, np.asarray(labels)[sub]))
-    except Exception:  # noqa: silent-except (best-effort probe)
+    except Exception:  # noqa: fence/silent-except (best-effort probe)
         pass
     out = {
         "dbscan_rows_per_sec_per_chip": round(rate, 1),

@@ -1,629 +1,33 @@
 #!/usr/bin/env python
-"""Lint tier (role of reference ci/lint_python.py: black/isort/mypy gate). This
-image ships no third-party linters, so the gate is stdlib-only but real:
+"""DEPRECATED shim — the lint tier is now the whole-program analyzer.
 
-  * syntax: every file must compile (py_compile)
-  * AST checks: unused imports, bare `except:`, mutable default arguments,
-    `__all__` names that don't resolve, tabs in indentation
-  * silent exception swallowing: a BROAD handler (`except:` / `except
-    Exception:` / `except BaseException:`) whose body is only `pass`/`...`
-    hides failures the reliability subsystem is supposed to surface — it must
-    at least log. Narrow typed catches (`except StopIteration: pass`) stay
-    legal control flow; the reliability module itself (which implements the
-    handling) and `# noqa: silent-except` lines are exempt.
-  * uncached multi-pass re-ingest: a direct `_batch_stream(...)` call inside a
-    for/while loop re-uploads every batch on every pass, bypassing the HBM
-    batch cache (ops/device_cache.py). Such call sites must pass a `cache=`
-    handle (the loop replays passes 2..N from HBM) or hoist the stream out of
-    the loop; `# noqa` on the call line exempts.
-  * profiling internals poking: any reference to `profiling._counters` /
-    `profiling._spans` outside the observability package. Those dicts no
-    longer exist — profiling.py is a compat shim over the typed registry
-    (observability/registry.py) — and historically direct mutation was how
-    scoped FitRun accounting got silently corrupted. Go through the public
-    surface (count/add_time/counter_totals/...) or the observability API.
-  * uninstrumented model predict: any `jax.jit` use inside
-    spark_rapids_ml_tpu/models/*.py. Model-layer predict calls must route
-    through `observability.inference.predict_dispatch` (uniform metric names,
-    shape-bucket/recompile-sentinel telemetry); jitted kernels belong in ops/,
-    where the dispatch helper wraps them. `# noqa` on the line exempts.
-  * off-plane top-k: any direct `jax.lax.top_k` / `jax.lax.approx_max_k` (or
-    `lax.top_k`, or `from jax.lax import top_k` spellings) inside
-    spark_rapids_ml_tpu/ops/ outside ops/selection.py. Every search-plane
-    top-k must route through ops/selection.py (select_topk / merge_topk /
-    top_k_max) so the strategy knob, the invalid-sentinel convention, and the
-    selection telemetry can never be bypassed (mirrors the jax.jit-in-models
-    ban). `# noqa` on the line exempts.
-  * off-plane pallas: any `jax.experimental.pallas` import (either spelling)
-    or `.pallas_call` attribute outside `ops/pallas_*.py`. Raw Pallas kernels
-    carry per-toolchain workarounds (Mosaic precision emulation, ragged-edge
-    masking, VMEM budgets) and parity contracts that live with the kernel
-    modules — a pallas_call elsewhere bypasses the interpret-mode gates, the
-    compiled_kernel telemetry routing, and the §5b/§5c sentinel/tie-order
-    contracts (mirrors the top_k and cost_analysis fences). `# noqa` on the
-    line exempts.
-  * off-plane HTTP server: any `http.server` import (or `ThreadingHTTPServer`
-    reference) outside observability/server.py. The telemetry endpoint is THE
-    driver-resident HTTP plane (refcounted lifecycle, loopback default, zero
-    threads when disabled, §6g); other planes — the serving endpoints (§7) —
-    mount path-prefix handlers on it via `register_mount` rather than binding
-    a second socket. `# noqa` on the line exempts.
-  * off-plane device analysis: any `.cost_analysis()` / `.memory_analysis()` /
-    `.memory_stats()` reference outside observability/device.py. The
-    device-performance plane (docs/design.md §6f) owns XLA cost/memory
-    capture and HBM sampling — including the graceful degrade when a runtime
-    lacks them; a direct call elsewhere bypasses the capture contract AND the
-    no-warning-spam guarantee. `# noqa` on the line exempts.
-  * off-plane HLO collective parsing: any string literal that pattern-matches
-    HLO collective-op text (a dash-spelled opcode — all-reduce / all-gather /
-    reduce-scatter / collective-permute / all-to-all — immediately followed
-    by `(`, an escaped `\\(`, or `-start`) outside observability/comm.py.
-    The communication plane (docs/design.md §6h) is the ONE HLO-text parser:
-    ad-hoc regexes drift from the exporter's collective accounting (exactly
-    what happened to the pre-§6h tests/test_collective_counts.py). Prose
-    mentions of the opcodes (docstrings, comments) don't match; `# noqa` on
-    the literal's first or last line exempts.
+Everything this file used to check (the ten plane-fences + the flat hygiene
+checks) migrated into the rule registry of `tools/analysis` (docs/design.md
+§6j) as `fence/*` and `hygiene/*` rules, joined there by the three cross-file
+passes (`purity/*` trace-purity, `locks/*` lock-graph, `metrics/*` metric
+contracts). ONE analyzer, one scoped-suppression grammar
+(`# noqa: <rule-id>`), one CI tier:
 
-  * hard-coded tunables: a module-level `SOMETHING_TILE/BLOCK/MIN_ITEMS/
-    MIN_K/BUCKET... = <nonzero int literal>` constant inside
-    spark_rapids_ml_tpu/ops/. Numeric tile/block/threshold DEFAULTS live in
-    the knob-registry defaults module (spark_rapids_ml_tpu/autotune/
-    defaults.py, docs/design.md §6i) and their measured per-platform
-    overrides live in tuning tables — a fresh literal in ops/ is a knob the
-    autotuner can't see and a re-tuning chore on the next hardware target.
-    Zero-valued sentinels (`BLOCK_ROWS = 0` = adaptive) stay legal; `# noqa`
-    on the line exempts.
+    python -m tools.analysis                 # what ci/test.sh runs
+    python -m tools.analysis --list-rules    # the rule catalog
+    python -m tools.analysis --explain <id>  # rationale + fix per rule
 
-Exit code 1 on any finding; CI runs this before the test tiers (ci/test.sh).
+This shim keeps the historical `python ci/lint_python.py` entry point alive
+for muscle memory and external callers; it simply delegates.
 """
 
 from __future__ import annotations
 
-import ast
-import py_compile
 import sys
 from pathlib import Path
 
-ROOT = Path(__file__).resolve().parent.parent
-TARGETS = ["spark_rapids_ml_tpu", "benchmark", "tests", "bench.py", "__graft_entry__.py"]
-
-# modules where dynamic re-export makes unused-import analysis meaningless
-UNUSED_IMPORT_EXEMPT = {"__init__.py"}
-
-# the module that IMPLEMENTS exception handling policy is exempt from the
-# silent-swallow check (it must classify and rethrow freely)
-SILENT_SWALLOW_EXEMPT_PARTS = ("reliability",)
-
-# the observability package (and the shim module itself) may touch profiling
-# internals; everyone else goes through the public surface
-PROFILING_INTERNALS = {"_counters", "_spans"}
-PROFILING_INTERNALS_EXEMPT_PARTS = ("observability", "profiling.py")
-
-_BROAD_EXC_NAMES = {"Exception", "BaseException"}
-
-# top-k primitives whose only legal home under ops/ is ops/selection.py
-_TOPK_PRIMS = {"top_k", "approx_max_k"}
-
-# XLA device-analysis surfaces whose only legal home is observability/device.py
-_DEVICE_ANALYSIS = {"cost_analysis", "memory_analysis", "memory_stats"}
-
-# HLO collective-op TEXT patterns whose only legal home is observability/comm.py:
-# a dash-spelled opcode directly followed by a paren (an HLO call site / a regex
-# matching one) or the async -start suffix. Prose mentions don't match.
-import re as _re  # stdlib-only gate; localized alias keeps the import obvious
-
-_HLO_PARSE_RE = _re.compile(
-    r"(?:all-reduce|all-gather|reduce-scatter|collective-permute|all-to-all)"
-    r"(?:-start|\\?\()"
-)
-
-# tunable-looking constant names whose numeric defaults belong in the knob
-# registry's defaults module (spark_rapids_ml_tpu/autotune/defaults.py)
-_TUNABLE_NAME_RE = _re.compile(r"(TILE|BLOCK|MIN_ITEMS|MIN_K|BUCKET)")
-
-
-def _const_int(node):
-    """Evaluate a literal int expression (`2048`, `1 << 16`, `8 * 1024`);
-    None for anything else — only plain numeric literals are banned."""
-    if isinstance(node, ast.Constant):
-        return node.value if (
-            isinstance(node.value, int) and not isinstance(node.value, bool)
-        ) else None
-    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
-        v = _const_int(node.operand)
-        return -v if v is not None else None
-    if isinstance(node, ast.BinOp):
-        left, right = _const_int(node.left), _const_int(node.right)
-        if left is None or right is None:
-            return None
-        try:
-            if isinstance(node.op, ast.LShift):
-                return left << right
-            if isinstance(node.op, ast.Mult):
-                return left * right
-            if isinstance(node.op, ast.Add):
-                return left + right
-            if isinstance(node.op, ast.Sub):
-                return left - right
-            if isinstance(node.op, ast.Pow):
-                return left ** right
-            if isinstance(node.op, ast.FloorDiv):
-                return left // right
-        except (OverflowError, ZeroDivisionError, ValueError):
-            return None
-    return None
-
-
-def _is_broad_catch(type_node) -> bool:
-    """True for `except:`, `except Exception:`, `except BaseException:` and
-    tuples containing one of those."""
-    if type_node is None:
-        return True
-    if isinstance(type_node, ast.Name):
-        return type_node.id in _BROAD_EXC_NAMES
-    if isinstance(type_node, ast.Tuple):
-        return any(_is_broad_catch(elt) for elt in type_node.elts)
-    return False
-
-
-def _is_silent_body(body) -> bool:
-    """Handler body that cannot possibly record the failure: only pass/..."""
-    for stmt in body:
-        if isinstance(stmt, ast.Pass):
-            continue
-        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Constant):
-            continue  # docstring or `...`
-        return False
-    return True
-
-
-def iter_files():
-    for t in TARGETS:
-        p = ROOT / t
-        if p.is_file():
-            yield p
-        else:
-            yield from sorted(p.rglob("*.py"))
-
-
-def _names_bound_by_import(node):
-    for alias in node.names:
-        name = alias.asname or alias.name.split(".")[0]
-        yield name, alias
-
-
-class _UncachedStreamVisitor(ast.NodeVisitor):
-    """Flags `_batch_stream(...)` calls lexically inside a for/while loop that
-    do not pass a `cache=` keyword — the multi-pass re-ingest shape the HBM
-    batch cache exists to eliminate (ops/device_cache.py)."""
-
-    def __init__(self, path: Path, src_lines, findings):
-        self.path = path
-        self.src_lines = src_lines
-        self.findings = findings
-        self.loop_depth = 0
-
-    def _visit_loop(self, node):
-        self.loop_depth += 1
-        self.generic_visit(node)
-        self.loop_depth -= 1
-
-    visit_For = visit_AsyncFor = visit_While = _visit_loop
-
-    def visit_Call(self, node):
-        func = node.func
-        name = (
-            func.id
-            if isinstance(func, ast.Name)
-            else func.attr if isinstance(func, ast.Attribute) else ""
-        )
-        if (
-            name == "_batch_stream"
-            and self.loop_depth > 0
-            and not any(kw.arg == "cache" for kw in node.keywords)
-        ):
-            line = (
-                self.src_lines[node.lineno - 1]
-                if node.lineno - 1 < len(self.src_lines)
-                else ""
-            )
-            if "noqa" not in line:
-                self.findings.append(
-                    f"{self.path}:{node.lineno}: _batch_stream call inside a "
-                    "loop without a cache= handle (multi-pass re-ingest "
-                    "bypassing ops/device_cache)"
-                )
-        self.generic_visit(node)
-
-
-def check_file(path: Path) -> list:
-    findings = []
-    src = path.read_text()
-    try:
-        py_compile.compile(str(path), doraise=True)
-    except py_compile.PyCompileError as e:
-        return [f"{path}: syntax error: {e.msg}"]
-    tree = ast.parse(src)
-
-    for lineno, line in enumerate(src.splitlines(), 1):
-        stripped = line.lstrip(" ")
-        if stripped.startswith("\t"):
-            findings.append(f"{path}:{lineno}: tab in indentation")
-
-    _UncachedStreamVisitor(path, src.splitlines(), findings).visit(tree)
-
-    # models/ may not call jax.jit directly: predict kernels live in ops/ and
-    # route through observability.inference.predict_dispatch so every family
-    # reports the same transform metrics + recompile-sentinel telemetry
-    if "models" in path.parts and "spark_rapids_ml_tpu" in path.parts:
-        src_lines = src.splitlines()
-        for node in ast.walk(tree):
-            hit = None
-            if (
-                isinstance(node, ast.Attribute)
-                and node.attr == "jit"
-                and isinstance(node.value, ast.Name)
-                and node.value.id == "jax"
-            ):
-                hit = "jax.jit"
-            elif (
-                # `from jax import jit` (any alias) bypasses the attribute
-                # form above and must not slip past the gate
-                isinstance(node, ast.ImportFrom)
-                and node.module
-                and node.module.split(".")[0] == "jax"
-                and any(alias.name == "jit" for alias in node.names)
-            ):
-                hit = "from jax import jit"
-            if hit is None:
-                continue
-            line = (
-                src_lines[node.lineno - 1]
-                if node.lineno - 1 < len(src_lines)
-                else ""
-            )
-            if "noqa" not in line:
-                findings.append(
-                    f"{path}:{node.lineno}: {hit} in models/ — route "
-                    "predict calls through observability.inference."
-                    "predict_dispatch (jitted kernels belong in ops/)"
-                )
-
-    # ops/ may not call the top-k primitives directly: selection lives in
-    # ops/selection.py (strategy knob + invalid-sentinel + telemetry); every
-    # other kernel routes through select_topk/merge_topk/top_k_max
-    if (
-        "ops" in path.parts
-        and "spark_rapids_ml_tpu" in path.parts
-        and path.name != "selection.py"
-    ):
-        src_lines = src.splitlines()
-        for node in ast.walk(tree):
-            hit = None
-            if (
-                isinstance(node, ast.Attribute)
-                and node.attr in _TOPK_PRIMS
-                and (
-                    # jax.lax.top_k
-                    (
-                        isinstance(node.value, ast.Attribute)
-                        and node.value.attr == "lax"
-                    )
-                    # lax.top_k (from jax import lax)
-                    or (
-                        isinstance(node.value, ast.Name)
-                        and node.value.id == "lax"
-                    )
-                )
-            ):
-                hit = f"direct {node.attr}"
-            elif (
-                isinstance(node, ast.ImportFrom)
-                and node.module == "jax.lax"
-                and any(alias.name in _TOPK_PRIMS for alias in node.names)
-            ):
-                hit = "from jax.lax import top_k/approx_max_k"
-            if hit is None:
-                continue
-            line = (
-                src_lines[node.lineno - 1]
-                if node.lineno - 1 < len(src_lines)
-                else ""
-            )
-            if "noqa" not in line:
-                findings.append(
-                    f"{path}:{node.lineno}: {hit} in ops/ — route top-k "
-                    "through ops/selection.py (select_topk/merge_topk/"
-                    "top_k_max)"
-                )
-
-    # ops/ may not hard-code tunable tile/block/threshold constants: numeric
-    # defaults live in the knob-registry defaults module (autotune/
-    # defaults.py) where the autotuner's tuning tables can override them per
-    # (platform, shape-bucket); a fresh literal here is invisible to it
-    if "ops" in path.parts and "spark_rapids_ml_tpu" in path.parts:
-        src_lines = src.splitlines()
-        for node in tree.body:
-            if isinstance(node, ast.Assign):
-                targets, value = node.targets, node.value
-            elif isinstance(node, ast.AnnAssign) and node.value is not None:
-                targets, value = [node.target], node.value
-            else:
-                continue
-            names = [
-                t.id for t in targets
-                if isinstance(t, ast.Name) and _TUNABLE_NAME_RE.search(t.id)
-            ]
-            if not names:
-                continue
-            v = _const_int(value)
-            if not v:  # zero = adaptive sentinel, None = not a literal
-                continue
-            line = (
-                src_lines[node.lineno - 1]
-                if node.lineno - 1 < len(src_lines)
-                else ""
-            )
-            if "noqa" not in line:
-                findings.append(
-                    f"{path}:{node.lineno}: hard-coded tunable "
-                    f"'{names[0]} = {v}' in ops/ — numeric tile/threshold "
-                    "defaults live in spark_rapids_ml_tpu/autotune/"
-                    "defaults.py (knob registry, docs/design.md §6i); "
-                    "import it or declare a knob"
-                )
-
-    # pallas lives in ops/pallas_*.py only: kernels there carry the
-    # interpret-mode gates, Mosaic workarounds and parity contracts; any
-    # other pallas_call / jax.experimental.pallas import bypasses them
-    if not (
-        "ops" in path.parts
-        and "spark_rapids_ml_tpu" in path.parts
-        and path.name.startswith("pallas_")
-    ):
-        src_lines = src.splitlines()
-        for node in ast.walk(tree):
-            hit = None
-            if isinstance(node, ast.Import) and any(
-                alias.name.startswith("jax.experimental.pallas")
-                for alias in node.names
-            ):
-                hit = "import jax.experimental.pallas"
-            elif isinstance(node, ast.ImportFrom) and (
-                (node.module or "").startswith("jax.experimental.pallas")
-                or (
-                    node.module == "jax.experimental"
-                    and any(a.name == "pallas" for a in node.names)
-                )
-            ):
-                hit = "from jax.experimental import pallas"
-            elif isinstance(node, ast.Attribute) and node.attr == "pallas_call":
-                hit = "direct pallas_call"
-            if hit is None:
-                continue
-            line = (
-                src_lines[node.lineno - 1]
-                if node.lineno - 1 < len(src_lines)
-                else ""
-            )
-            if "noqa" not in line:
-                findings.append(
-                    f"{path}:{node.lineno}: {hit} outside ops/pallas_*.py — "
-                    "Pallas kernels live in the pallas kernel modules "
-                    "(interpret gates, Mosaic workarounds, §5c parity "
-                    "contracts); route through their host wrappers"
-                )
-
-    # the stdlib HTTP server lives in observability/server.py only: one
-    # driver-resident endpoint (refcounted lifecycle, §6g); the serving plane
-    # and anything else mount handlers on it via register_mount (§7)
-    if not (path.name == "server.py" and "observability" in path.parts):
-        src_lines = src.splitlines()
-        for node in ast.walk(tree):
-            hit = None
-            if isinstance(node, ast.Import) and any(
-                alias.name == "http.server" or
-                alias.name.startswith("http.server.")
-                for alias in node.names
-            ):
-                hit = "import http.server"
-            elif isinstance(node, ast.ImportFrom) and (
-                (node.module or "") == "http.server"
-                or (node.module or "").startswith("http.server.")
-                or (
-                    node.module == "http"
-                    and any(a.name == "server" for a in node.names)
-                )
-            ):
-                hit = "from http.server import ..."
-            elif (
-                isinstance(node, (ast.Name, ast.Attribute))
-                and (getattr(node, "id", None) == "ThreadingHTTPServer"
-                     or getattr(node, "attr", None) == "ThreadingHTTPServer")
-            ):
-                hit = "ThreadingHTTPServer reference"
-            if hit is None:
-                continue
-            line = (
-                src_lines[node.lineno - 1]
-                if node.lineno - 1 < len(src_lines)
-                else ""
-            )
-            if "noqa" not in line:
-                findings.append(
-                    f"{path}:{node.lineno}: {hit} outside observability/"
-                    "server.py — one HTTP plane only; mount handlers on it "
-                    "via observability.server.register_mount (docs/design.md "
-                    "§6g/§7)"
-                )
-
-    # XLA cost/memory analysis + memory_stats live in observability/device.py
-    # only (the device-performance plane owns capture AND graceful degrade)
-    if not (path.name == "device.py" and "observability" in path.parts):
-        src_lines = src.splitlines()
-        for node in ast.walk(tree):
-            if (
-                isinstance(node, ast.Attribute)
-                and node.attr in _DEVICE_ANALYSIS
-            ):
-                line = (
-                    src_lines[node.lineno - 1]
-                    if node.lineno - 1 < len(src_lines)
-                    else ""
-                )
-                if "noqa" not in line:
-                    findings.append(
-                        f"{path}:{node.lineno}: direct .{node.attr}() outside "
-                        "observability/device.py — route through the "
-                        "device-performance plane (compiled_kernel / "
-                        "sample_hbm, docs/design.md §6f)"
-                    )
-
-    # HLO collective-op text parsing lives in observability/comm.py only (the
-    # communication plane owns extraction AND the payload/replica-group
-    # accounting the run reports export — one parser, one truth)
-    if not (path.name == "comm.py" and "observability" in path.parts):
-        src_lines = src.splitlines()
-        for node in ast.walk(tree):
-            if not (
-                isinstance(node, ast.Constant) and isinstance(node.value, str)
-            ):
-                continue
-            if not _HLO_PARSE_RE.search(node.value):
-                continue
-            exempt = False
-            for ln in (node.lineno, getattr(node, "end_lineno", node.lineno)):
-                line = src_lines[ln - 1] if ln - 1 < len(src_lines) else ""
-                if "noqa" in line:
-                    exempt = True
-            if not exempt:
-                findings.append(
-                    f"{path}:{node.lineno}: HLO collective-op text pattern in "
-                    "a string literal — collective parsing lives in "
-                    "observability/comm.py only (extract_collectives / "
-                    "collectives_of_computation, docs/design.md §6h)"
-                )
-
-    if not any(part in PROFILING_INTERNALS_EXEMPT_PARTS for part in path.parts):
-        src_lines = src.splitlines()
-        for node in ast.walk(tree):
-            if (
-                isinstance(node, ast.Attribute)
-                and node.attr in PROFILING_INTERNALS
-                and isinstance(node.value, ast.Name)
-                and node.value.id == "profiling"
-            ):
-                line = (
-                    src_lines[node.lineno - 1]
-                    if node.lineno - 1 < len(src_lines)
-                    else ""
-                )
-                if "noqa" not in line:
-                    findings.append(
-                        f"{path}:{node.lineno}: direct use of profiling."
-                        f"{node.attr} (the dict no longer exists — go through "
-                        "the profiling/observability public surface)"
-                    )
-
-    # collect import bindings and all referenced names
-    imports = {}
-    for node in ast.walk(tree):
-        if isinstance(node, (ast.Import, ast.ImportFrom)):
-            if isinstance(node, ast.ImportFrom) and node.module == "__future__":
-                continue
-            for name, alias in _names_bound_by_import(node):
-                if name == "*":
-                    continue
-                imports.setdefault(name, node.lineno)
-        elif isinstance(node, ast.ExceptHandler):
-            if node.type is None:
-                findings.append(
-                    f"{path}:{node.lineno}: bare `except:` (catch Exception)"
-                )
-            if (
-                node.type is not None  # bare except already reported above
-                and _is_broad_catch(node.type)
-                and _is_silent_body(node.body)
-                and not any(part in SILENT_SWALLOW_EXEMPT_PARTS for part in path.parts)
-            ):
-                src_lines = src.splitlines()
-                line = (
-                    src_lines[node.lineno - 1]
-                    if node.lineno - 1 < len(src_lines)
-                    else ""
-                )
-                if "noqa" not in line:
-                    findings.append(
-                        f"{path}:{node.lineno}: silent exception swallowing "
-                        "(broad `except ...: pass` with no logging)"
-                    )
-        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            for default in list(node.args.defaults) + [
-                d for d in node.args.kw_defaults if d is not None
-            ]:
-                if isinstance(default, (ast.List, ast.Dict, ast.Set)):
-                    findings.append(
-                        f"{path}:{default.lineno}: mutable default argument in "
-                        f"{node.name}()"
-                    )
-
-    used = set()
-    exported = set()
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Name):
-            used.add(node.id)
-        elif isinstance(node, ast.Attribute):
-            pass  # attribute roots appear as Name nodes anyway
-    for node in ast.walk(tree):  # __all__ may live inside try/except re-export blocks
-        if (
-            isinstance(node, ast.Assign)
-            and any(getattr(t, "id", "") == "__all__" for t in node.targets)
-            and isinstance(node.value, (ast.List, ast.Tuple))
-        ):
-            for elt in node.value.elts:
-                if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
-                    exported.add(elt.value)
-
-    module_names = {
-        n.name
-        for n in ast.walk(tree)
-        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef))
-    }
-    top_assigned = {
-        getattr(t, "id", None)
-        for node in tree.body
-        if isinstance(node, ast.Assign)
-        for t in node.targets
-    }
-    for name in exported:
-        if name not in module_names and name not in top_assigned and name not in imports:
-            findings.append(f"{path}: __all__ name '{name}' is not defined")
-
-    if path.name not in UNUSED_IMPORT_EXEMPT:
-        src_lines = src.splitlines()
-        for name, lineno in imports.items():
-            if name in used or name in exported:
-                continue
-            line = src_lines[lineno - 1] if lineno - 1 < len(src_lines) else ""
-            if "noqa" in line:
-                continue
-            findings.append(f"{path}:{lineno}: unused import '{name}'")
-    return findings
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 
 def main() -> int:
-    all_findings = []
-    n = 0
-    for path in iter_files():
-        n += 1
-        all_findings.extend(check_file(path))
-    if all_findings:
-        print(f"LINT: {len(all_findings)} findings in {n} files")
-        for f in all_findings:
-            print("  " + f)
-        return 1
-    print(f"LINT OK: {n} files clean")
-    return 0
+    from tools.analysis.__main__ import main as analysis_main
+
+    return analysis_main(["--max-seconds", "10"])
 
 
 if __name__ == "__main__":

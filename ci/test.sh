@@ -12,8 +12,13 @@ export PALLAS_AXON_POOL_IPS=""
 
 MODE="${1:-premerge}"
 
-# lint tier (reference ci/lint_python.py role)
-python ci/lint_python.py
+# analysis tier (tools/analysis, docs/design.md §6j — supersedes the flat
+# lint): ONE whole-program analyzer runs the migrated fences + hygiene checks
+# AND the three cross-file passes (trace-purity, lock-graph, metric
+# contracts) off a single shared AST parse, under a hard wall-clock budget.
+# The JSON report lands next to the bench artifacts; a failing line is
+# self-documenting via `python -m tools.analysis --explain <rule-id>`.
+python -m tools.analysis --max-seconds 10 --out analysis_report.json
 
 # native build (non-fatal: pure-python fallback covers it)
 ./native/build.sh || echo "WARN: native build failed; numpy fallbacks in use"

@@ -20,7 +20,7 @@ def main() -> None:
         )
         raise SystemExit(2)
 
-    import spark_rapids_ml_tpu.install  # noqa: F401 — installs the interposer
+    import spark_rapids_ml_tpu.install  # noqa: hygiene/unused-import — installs the interposer
 
     if argv[0] == "-m":
         if len(argv) < 2:

@@ -22,7 +22,7 @@
 #               MAD noise floor mirroring ci/bench_check.py.
 #   defaults.py the knob-registry defaults module — the one home for the
 #               numeric tile/threshold defaults ops/ used to hard-code
-#               (ci/lint_python.py enforces the split).
+#               (the analyzer, tools/analysis, enforces the split).
 #
 # Offline: `python -m spark_rapids_ml_tpu.autotune` searches and persists.
 # Online: `autotune.mode` = off | load (default) | search.

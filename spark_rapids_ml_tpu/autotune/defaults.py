@@ -8,7 +8,7 @@
 # Now: the DEFAULT lives here (one module, import-light, no jax), the
 # MEASURED choice lives in a tuning table entry whose `provenance` field
 # records the search that produced it (platform, device_kind, shape bucket,
-# trial stats), and ci/lint_python.py bans new numeric tile/threshold
+# trial stats), and the analyzer's fence/hardcoded-tunable rule bans new
 # literals in ops/ so the split cannot silently regress.
 #
 # Nothing here reads config or the tables — that is knobs.lookup()'s job.

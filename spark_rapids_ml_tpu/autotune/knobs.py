@@ -188,7 +188,7 @@ def _counter(name: str, **labels: Any) -> None:
         from ..observability.runs import counter_inc
 
         counter_inc(name, 1, **labels)
-    except Exception:  # noqa: silent-except — telemetry is best-effort here
+    except Exception:  # noqa: fence/silent-except — telemetry is best-effort here
         pass
 
 
@@ -343,7 +343,7 @@ def report_section(registry: Any = None) -> Optional[Dict[str, Any]]:
                     misses[knob] = misses.get(knob, 0) + int(v)
                 elif cname == "autotune.searches":
                     searches += int(v)
-        except Exception:  # noqa: silent-except — report assembly best-effort
+        except Exception:  # noqa: fence/silent-except — report assembly best-effort
             pass
     return {
         "mode": mode,

@@ -46,7 +46,7 @@ def _counter(name: str, n: int = 1, **labels: Any) -> None:
         from ..observability.runs import counter_inc
 
         counter_inc(name, n, **labels)
-    except Exception:  # noqa: silent-except — telemetry is best-effort here
+    except Exception:  # noqa: fence/silent-except — telemetry is best-effort here
         pass
 
 

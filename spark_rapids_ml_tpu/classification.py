@@ -4,7 +4,7 @@
 from .models.classification import LogisticRegression, LogisticRegressionModel
 
 try:  # RandomForestClassifier arrives with models/tree.py
-    from .models.tree import (  # noqa: F401
+    from .models.tree import (  # re-exported surface
         RandomForestClassificationModel,
         RandomForestClassifier,
     )

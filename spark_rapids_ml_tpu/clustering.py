@@ -3,7 +3,7 @@
 from .models.clustering import KMeans, KMeansModel
 
 try:  # DBSCAN arrives with models/dbscan.py
-    from .models.dbscan import DBSCAN, DBSCANModel  # noqa: F401
+    from .models.dbscan import DBSCAN, DBSCANModel  # re-exported surface
 
     __all__ = ["KMeans", "KMeansModel", "DBSCAN", "DBSCANModel"]
 except ImportError:  # pragma: no cover

@@ -318,7 +318,7 @@ def source(key: str) -> str:
     return "default"
 
 
-def set(key: str, value: Any) -> None:  # noqa: A001 — spark-conf style name
+def set(key: str, value: Any) -> None:  # spark-conf style name (shadows the builtin deliberately)
     if key not in _DEFAULTS:
         raise KeyError(f"Unknown config key '{key}'; known: {sorted(_DEFAULTS)}")
     _overrides[key] = value
@@ -328,5 +328,5 @@ def unset(key: str) -> None:
     _overrides.pop(key, None)
 
 
-def all() -> Dict[str, Any]:  # noqa: A001
+def all() -> Dict[str, Any]:  # spark-conf style name (shadows the builtin deliberately)
     return {k: get(k) for k in _DEFAULTS}

@@ -195,7 +195,7 @@ def serve(
             payload = result_registrar(result)
         else:
             payload = dispatch_fit(operator_name, params, dataset)
-    except BaseException as e:  # noqa: BLE001 — every failure must cross the wire
+    except BaseException as e:  # deliberate: every failure must cross the wire
         logger.exception("connect dispatch failed")
         write_framed_utf8(outfile, "ERR")
         write_framed_utf8(outfile, f"{type(e).__name__}: {e}")

@@ -29,7 +29,7 @@ from ..parallel.mesh import get_mesh, shard_array
 from ..parallel.partition import PartitionDescriptor, pad_rows
 from ..utils import get_logger
 from .backend_params import _TpuClass, _TpuParams
-from .dataset import (  # noqa: F401
+from .dataset import (  # re-exported surface
     FeatureData,
     append_output_columns,
     densify,

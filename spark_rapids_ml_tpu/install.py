@@ -121,7 +121,7 @@ def _set_mod_getattr(mod_name: str, attrs: Dict[str, str]) -> None:
 def install() -> None:
     """Install the interposer over pyspark.ml (idempotent)."""
     try:
-        import pyspark.ml  # noqa: F401 — materialize real modules first when present
+        import pyspark.ml  # noqa: hygiene/unused-import — materialize real modules first when present
         for mod_name in _accelerated_attributes:
             try:
                 importlib.import_module(mod_name)

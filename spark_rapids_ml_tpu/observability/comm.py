@@ -10,7 +10,7 @@
 # here:
 #
 #   * Collective accounting — the ONE place in the tree that parses optimized
-#     HLO text for collective ops (ci/lint_python.py bans the dash-spelled
+#     HLO text for collective ops (fence/hlo-parse-off-plane bans the dash-spelled
 #     opcode patterns everywhere else, exactly like the top-k and
 #     cost_analysis bans). `extract_collectives` walks an executable's HLO
 #     once per (kernel, signature) — observability/device.py calls it from

@@ -47,7 +47,7 @@
 # for the designated pass (`observability.profile_pass`, default 2 — the first
 # post-compile steady-state pass) of a streamed fit, once per process per site.
 #
-# ci/lint_python.py bans direct `.cost_analysis()` / `.memory_analysis()` /
+# The analyzer (fence/device-analysis-off-plane) bans direct `.cost_analysis()` /
 # `.memory_stats()` calls outside this module so the capture contract (and its
 # graceful-degrade guarantees) cannot be bypassed.
 #
@@ -440,7 +440,14 @@ class CompiledKernel:
         leaves, treedef = jax.tree_util.tree_flatten((dyn_args, dyn_kwargs))
         if any(isinstance(l, jax.core.Tracer) for l in leaves):
             return None  # under trace: inline through the plain jit path
-        return (tuple(_leaf_key(l) for l in leaves), treedef, statics)
+        # trace-affecting config rides in the signature (the trace epoch):
+        # a kernel body that reads one of these keys at trace time can never
+        # serve a STALE bake — changing the key re-keys the AOT cache and
+        # _compile_and_capture re-lowers (lower() always re-traces), reading
+        # the new value. This is what licenses the one sanctioned trace-time
+        # config read (ops/_precision.py::parity_precision).
+        return (tuple(_leaf_key(l) for l in leaves), treedef,
+                statics + _trace_epoch())
 
     # ---- compile + capture ----
 
@@ -538,6 +545,21 @@ class CompiledKernel:
         return out
 
 
+# config keys whose values a kernel body may read AT TRACE TIME (today only
+# parity_precision — ops/_precision.py). Folding the current value into every
+# AOT signature makes such reads stale-proof: see CompiledKernel._signature.
+# The residual: with the device plane disabled (observability.device_enabled
+# off) calls run through plain jax.jit, whose cache does not know the epoch —
+# documented in docs/design.md §6j.
+_TRACE_EPOCH_KEYS = ("parity_precision",)
+
+
+def _trace_epoch() -> Tuple[Tuple[str, str], ...]:
+    return tuple(
+        (f"cfg:{k}", repr(_config.get(k))) for k in _TRACE_EPOCH_KEYS
+    )
+
+
 def _sig_str(sig) -> str:
     leaves, treedef, statics = sig
     arrays = ",".join(
@@ -584,7 +606,7 @@ def _extract_cost(exe: Any, lowered: Any) -> Dict[str, Any]:
         out["output_bytes"] = out_b
         out["temp_bytes"] = tmp_b
         out["peak_bytes"] = arg_b + out_b + tmp_b
-    except Exception:  # noqa: silent-except — memory_analysis absent here
+    except Exception:  # noqa: fence/silent-except — memory_analysis absent here
         pass
     return out
 

@@ -16,7 +16,7 @@
 #     predict kernel call, so KMeans/LogReg/PCA/forest/UMAP/kNN/DBSCAN all
 #     report the SAME metric names: `transform.predict_calls{model=}`,
 #     `transform.predict_rows{model=}`, a `transform.predict_s{model=}`
-#     latency histogram, and the shape-bucket telemetry below. ci/lint_python.py
+#     latency histogram, and the shape-bucket telemetry below. The analyzer
 #     flags direct jax.jit use in models/*.py that bypasses this helper.
 #
 #   * Shape buckets + recompile sentinel — a per-model registry of distinct
@@ -306,7 +306,7 @@ def partition_rank() -> int:
         tc = TaskContext.get()
         if tc is not None:
             return int(tc.partitionId())
-    except Exception:  # noqa: silent-except — pyspark absent or stubbed
+    except Exception:  # noqa: fence/silent-except — pyspark absent or stubbed
         pass
     return next(_rank_counter)
 

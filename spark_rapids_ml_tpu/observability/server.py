@@ -102,7 +102,7 @@ class _Handler(BaseHTTPRequestHandler):
 
     # stdlib logs every request to stderr by default — a 1 s scrape interval
     # would drown real diagnostics
-    def log_message(self, format: str, *args: Any) -> None:  # noqa: A002
+    def log_message(self, format: str, *args: Any) -> None:  # BaseHTTPRequestHandler contract
         pass
 
     def _send(self, code: int, body: bytes, content_type: str) -> None:
@@ -141,7 +141,7 @@ class _Handler(BaseHTTPRequestHandler):
             return None
         return self.rfile.read(length)
 
-    def do_POST(self) -> None:  # noqa: N802 — BaseHTTPRequestHandler contract
+    def do_POST(self) -> None:  # BaseHTTPRequestHandler contract name
         try:
             path = self.path.split("?", 1)[0].rstrip("/") or "/"
             body = self._read_body()
@@ -153,10 +153,10 @@ class _Handler(BaseHTTPRequestHandler):
         except Exception as e:
             try:
                 self._send_json({"error": f"{type(e).__name__}: {e}"}, 500)
-            except Exception:  # noqa: silent-except — socket already gone
+            except Exception:  # noqa: fence/silent-except — socket already gone
                 pass
 
-    def do_GET(self) -> None:  # noqa: N802 — BaseHTTPRequestHandler contract
+    def do_GET(self) -> None:  # BaseHTTPRequestHandler contract name
         try:
             path = self.path.split("?", 1)[0].rstrip("/") or "/"
             if self._dispatch_mount("GET", path, None):
@@ -216,7 +216,7 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(
                     {"error": f"{type(e).__name__}: {e}"}, 500
                 )
-            except Exception:  # noqa: silent-except — socket already gone
+            except Exception:  # noqa: fence/silent-except — socket already gone
                 pass
 
 

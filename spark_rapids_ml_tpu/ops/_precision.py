@@ -22,7 +22,10 @@ def parity_precision() -> jax.lax.Precision:
     (3-pass, ~2x faster on MXU at ~2^-22 error) as a measured opt-in."""
     from .. import config as _config
 
-    value = str(_config.get("parity_precision")).lower()
+    # trace-time read, sanctioned: compiled_kernel folds parity_precision
+    # into every AOT cache signature (observability/device.py::_trace_epoch),
+    # so a config change re-keys + re-traces — the bake can never go stale
+    value = str(_config.get("parity_precision")).lower()  # noqa: purity/config-read — trace-epoch keyed
     if value == "high":
         return jax.lax.Precision.HIGH
     if value == "highest":

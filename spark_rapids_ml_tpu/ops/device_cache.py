@@ -234,6 +234,7 @@ def cached_build(cache: Optional[DeviceBatchCache], cache_key: Any,
     # per-site totals + per-batch latency histogram add_time feeds below
     with _obs.span("stream.ingest", {"site": site, "batch": batch_index}):
         batch = build()
+    # srml-metric: stream.ingest_s — per-site span family (dynamic suffix)
     profiling.add_time(f"stream.ingest_s.{site}", time.perf_counter() - t0)
     profiling.count("stream.upload_batches")
     profiling.count(

@@ -162,17 +162,57 @@ def exact_knn_single(
     tracing = _sel.is_tracing(Q, X, valid)
     if not tracing:
         _sel.record_selection(strategy, site="exact_knn", model=model_name)
-    _count_x2(x2, "exact_knn", tracing)
+    precision = q_block = item_tile = None
     if strategy == "pallas_fused":
-        from .pallas_select import fused_topk, oversample_width
+        from . import pallas_select as _ps
 
         precision = _sel.resolve_fused_precision(None)
+        kc = _ps.oversample_width(k, n, precision)
+        q_block, item_tile = _ps.resolve_topk_geometry(
+            int(Q.shape[0]), n, int(Q.shape[1]), kc
+        )
+    return _exact_knn_resolved(
+        Q, X, valid, k, block, x2, strategy, tile, rt,
+        precision=precision, q_block=q_block, item_tile=item_tile,
+    )
+
+
+def _exact_knn_resolved(
+    Q: jax.Array,
+    X: jax.Array,
+    valid: jax.Array,
+    k: int,
+    block: int,
+    x2: Optional[jax.Array],
+    strategy: str,
+    tile: int,
+    rt: float,
+    *,
+    precision: Optional[str] = None,
+    q_block: Optional[int] = None,
+    item_tile: Optional[int] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """TRACE-PURE core of exact_knn_single: every knob — strategy, tile,
+    recall target, fused precision, fused geometry — arrives concrete from a
+    host-side resolution (exact_knn_single, or the shard_map factory
+    `_knn_local_then_merge_fn`). No config read, no tuning-table read
+    (tools/analysis purity/*): this is the form traced bodies may call."""
+    n = X.shape[0]
+    k = min(int(k), n)
+    tracing = _sel.is_tracing(Q, X, valid)
+    _count_x2(x2, "exact_knn", tracing)
+    if strategy == "pallas_fused":
+        from .pallas_select import fused_topk_pinned, oversample_width
+
         if precision == "float32":
             # exact mode: the fused scan IS the answer (bit-identical)
             with _span_or_null(
                 "knn.select", {"strategy": strategy, "k": k}, tracing
             ):
-                return fused_topk(Q, X, valid, k, x2=x2, precision=precision)
+                return fused_topk_pinned(
+                    Q, X, valid, k, q_block=q_block, item_tile=item_tile,
+                    x2=x2, precision=precision,
+                )
         # approximate accumulation: oversampled pool + the §5b re-rank
         # invariant — returned distances stay exact-f32, ids carry the
         # approximation (the same contract as the approx strategy)
@@ -182,7 +222,10 @@ def exact_knn_single(
             {"strategy": strategy, "k": kc, "precision": precision},
             tracing,
         ):
-            _, idx = fused_topk(Q, X, valid, kc, x2=x2, precision=precision)
+            _, idx = fused_topk_pinned(
+                Q, X, valid, kc, q_block=q_block, item_tile=item_tile,
+                x2=x2, precision=precision,
+            )
         with _span_or_null("knn.rerank", {"k": k}, tracing):
             if not tracing:
                 from .. import observability as _obs
@@ -235,18 +278,21 @@ def exact_knn_distributed(
     # a shard can hold fewer than k rows; the all-gathered candidate pool
     # (n_dev * k_local >= min(k_eff, n_total)) still covers the global top-k
     k_local = min(k_eff, shard_rows)
-    # telemetry fires HERE: the per-shard exact_knn_single runs inside the
-    # shard_map trace, where host-side counters are suppressed (fusable: the
-    # per-shard scan holds Q and its X shard, so pallas_fused applies —
-    # one single-device pallas_call per shard under shard_map)
-    _sel.record_selection(
-        _sel.resolve(shard_rows, k_local, None, fusable=True)[0],
-        site="exact_knn_distributed",
-    )
+    # telemetry AND knob resolution fire HERE, on the host: the per-shard
+    # scan runs inside the shard_map trace, where counters are suppressed and
+    # config/tuning-table reads are banned (purity/* — a per-rank table read
+    # could trace DIVERGENT programs across pod hosts). The factory receives
+    # the fully resolved bundle. (fusable: the per-shard scan holds Q and its
+    # X shard, so pallas_fused applies — one single-device pallas_call per
+    # shard under shard_map)
+    resolved = _sel.resolve(shard_rows, k_local, None, fusable=True)
+    _sel.record_selection(resolved[0], site="exact_knn_distributed")
     _count_x2(x2_sharded, "exact_knn_distributed", False)
 
     merge = _knn_local_then_merge_fn(
-        mesh, shard_rows, k_local, k_eff, with_x2=x2_sharded is not None
+        mesh, shard_rows, k_local, k_eff, with_x2=x2_sharded is not None,
+        nq=int(np.asarray(Q).shape[0]), d=int(X_sharded.shape[1]),
+        resolved=resolved,
     )
     if x2_sharded is not None:
         d2, gidx = merge(jnp.asarray(Q), X_sharded, valid_sharded, x2_sharded)
@@ -256,12 +302,36 @@ def exact_knn_distributed(
 
 
 def _knn_local_then_merge_fn(
-    mesh: Mesh, shard_rows: int, k_local: int, k_eff: int, with_x2: bool = False
+    mesh: Mesh, shard_rows: int, k_local: int, k_eff: int,
+    with_x2: bool = False, *,
+    nq: Optional[int] = None, d: Optional[int] = None,
+    resolved: Optional[Tuple[str, int, float]] = None,
 ):
     """The shard-mapped local-topk + all_gather merge step, exposed so tests can
     lower it and assert the compiled collective structure (one gather batch, no
-    quadratic exchange). The candidate MERGE stays exact (merge_topk); the
-    per-shard selection inherits the configured strategy via exact_knn_single."""
+    quadratic exchange). The candidate MERGE stays exact (merge_topk). THIS
+    factory is the host boundary for the shard body: strategy/tile/recall
+    (`resolved`, else resolved here) and — for pallas_fused — precision and
+    scan geometry all resolve BEFORE the trace, and the body calls the
+    trace-pure _exact_knn_resolved (purity/*: a config or tuning-table read
+    inside shard_map would bake per-host, tracing divergent programs across
+    pod ranks)."""
+    strategy, tile, rt = (
+        resolved if resolved is not None
+        else _sel.resolve(shard_rows, k_local, None, fusable=True)
+    )
+    precision = q_block = item_tile = None
+    if strategy == "pallas_fused":
+        from . import pallas_select as _ps
+
+        precision = _sel.resolve_fused_precision(None)
+        kc = _ps.oversample_width(k_local, shard_rows, precision)
+        # nq/d default for legacy callers (tests lowering the factory with
+        # exact strategies never reach here)
+        q_block, item_tile = _ps.resolve_topk_geometry(
+            nq if nq is not None else shard_rows,
+            shard_rows, d if d is not None else 1, kc,
+        )
     in_specs = (P(), P(DATA_AXIS, None), P(DATA_AXIS))
     if with_x2:
         in_specs = in_specs + (P(DATA_AXIS),)
@@ -277,7 +347,11 @@ def _knn_local_then_merge_fn(
     def _local_then_merge(q, x_local, valid_local, *maybe_x2):
         rank = jax.lax.axis_index(DATA_AXIS)
         x2_local = maybe_x2[0] if maybe_x2 else None
-        d2, idx = exact_knn_single(q, x_local, valid_local, k_local, x2=x2_local)
+        d2, idx = _exact_knn_resolved(
+            q, x_local, valid_local, k_local, 1024, x2_local,
+            strategy, tile, rt,
+            precision=precision, q_block=q_block, item_tile=item_tile,
+        )
         gidx = idx + rank * shard_rows
         # all-to-all candidate exchange over ICI (the UCX replacement)
         d2_all = jax.lax.all_gather(d2, DATA_AXIS, axis=1)  # (nq, n_dev, k_local)

@@ -74,13 +74,13 @@ from ..observability.device import compiled_kernel
 from .selection import INVALID_D2
 
 # tile-geometry DEFAULTS live in the knob-registry defaults module
-# (autotune/defaults.py — ci/lint_python.py bans new tile/threshold literals
+# (autotune/defaults.py — the analyzer's fence/hardcoded-tunable rule bans new literals
 # in ops/): the query block bounds the (block, tile) distance tile in VMEM
 # (256*1024*4 = 1 MiB) next to one double-buffered X tile (1024*d*4). The
 # tuning table (docs/design.md §6i) can override geometry per (platform,
 # shape-bucket); tuned values still pass the VMEM-budget shrink below.
 # Tests pass explicit odd tiles to exercise ragged edges.
-from ..autotune.defaults import (  # noqa: re-exported — kmeans/tests import here
+from ..autotune.defaults import (  # re-exported; kmeans/tests import here
     DEFAULT_ASSIGN_BLOCK,
     DEFAULT_ITEM_TILE,
     DEFAULT_QUERY_BLOCK,
@@ -368,6 +368,43 @@ def _fused_topk_scan(
     return pool_d2[:nq], pool_id[:nq]
 
 
+def resolve_topk_geometry(
+    nq: int, n: int, d: int, k: int,
+    q_block: Optional[int] = None, item_tile: Optional[int] = None,
+) -> Tuple[int, int]:
+    """HOST-side geometry resolution for the fused top-k scan: tuning table
+    (`pallas.topk_geometry`) + the VMEM-budget shrink. Traced code must not
+    call this (the table read would bake per-host — rank-divergent SPMD
+    programs on a pod); resolve in the host wrapper / shard_map factory and
+    hand the pins to `fused_topk_pinned`."""
+    return _topk_geometry(int(nq), int(n), int(d), int(k), q_block, item_tile)
+
+
+def fused_topk_pinned(
+    Q: jax.Array,
+    X: jax.Array,
+    valid: jax.Array,
+    k: int,
+    *,
+    q_block: int,
+    item_tile: int,
+    x2: Optional[jax.Array] = None,
+    precision: str = "float32",
+    interpret: Optional[bool] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """TRACE-PURE core of the fused smallest-k scan: geometry arrives pinned
+    (resolve_topk_geometry in a host wrapper), precision arrives resolved —
+    no config read, no tuning-table read (tools/analysis purity/*). This is
+    the form shard_map bodies call; same output contract as fused_topk."""
+    n = X.shape[0]
+    k = min(int(k), n)
+    if interpret is None:
+        interpret = _interpret_default()  # backend probe, not config
+    return _fused_topk_scan(
+        Q, X, valid, x2, k, int(q_block), int(item_tile), precision, interpret,
+    )
+
+
 def fused_topk(
     Q: jax.Array,
     X: jax.Array,
@@ -384,16 +421,16 @@ def fused_topk(
     is bit-identical to the `select_topk(exact_full)` path (ids, distances,
     tie order, k > n_valid tails). bf16/int8 modes return the APPROXIMATE
     pool — callers owe the user a parity_rerank_sq pass (see fused_knn_select
-    for the paired form). Trace-safe: statics resolve before the call."""
+    for the paired form). HOST wrapper: resolves geometry (tuning table +
+    VMEM shrink) and delegates to the trace-pure fused_topk_pinned."""
     n = X.shape[0]
     k = min(int(k), n)
-    if interpret is None:
-        interpret = _interpret_default()
-    q_block, item_tile = _topk_geometry(
+    q_block, item_tile = resolve_topk_geometry(
         int(Q.shape[0]), int(n), int(Q.shape[1]), k, q_block, item_tile
     )
-    return _fused_topk_scan(
-        Q, X, valid, x2, k, q_block, item_tile, precision, interpret,
+    return fused_topk_pinned(
+        Q, X, valid, k, q_block=q_block, item_tile=item_tile, x2=x2,
+        precision=precision, interpret=interpret,
     )
 
 

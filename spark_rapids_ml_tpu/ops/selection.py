@@ -3,7 +3,7 @@
 #
 # Every top-k in the kNN/ANN family (exact_knn_*, ivfflat/ivfpq/cagra search,
 # the streamed ANN probe scans, the pairwise item-tile merges, and the kmeans/
-# tree score picks) routes through here; ci/lint_python.py bans direct
+# tree score picks) routes through here; the analyzer (fence/topk-off-plane) bans direct
 # jax.lax.top_k / jax.lax.approx_max_k anywhere else under ops/. Three
 # strategies behind one API, picked by `knn.selection` (config.py):
 #
@@ -240,13 +240,13 @@ def _tiled_topk_neg(neg: jax.Array, k: int, tile: int) -> Tuple[jax.Array, jax.A
     nt = (n + pad) // tile
     kk = min(k, tile)
     negt = neg.reshape(*lead, nt, tile)
-    v, i = jax.lax.top_k(negt, kk)  # noqa: selection-plane primitive home
+    v, i = jax.lax.top_k(negt, kk)  # selection-plane primitive home (fence-exempt file)
     base = (jnp.arange(nt, dtype=jnp.int32) * tile).reshape(
         (1,) * len(lead) + (nt, 1)
     )
     pool_v = v.reshape(*lead, nt * kk)
     pool_i = (i.astype(jnp.int32) + base).reshape(*lead, nt * kk)
-    v2, p2 = jax.lax.top_k(pool_v, k)  # noqa: selection-plane primitive home
+    v2, p2 = jax.lax.top_k(pool_v, k)  # selection-plane primitive home (fence-exempt file)
     return v2, jnp.take_along_axis(pool_i, p2, axis=-1)
 
 
@@ -254,19 +254,44 @@ def select_topk(
     d2: jax.Array,
     k: int,
     *,
-    strategy: Optional[str] = None,
+    strategy: str,
     tile: Optional[int] = None,
     recall_target: Optional[float] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Smallest-k along the last axis: returns (d2_topk, indices), distances
-    ascending. Trace-safe (pure); strategy/tile/recall_target are static —
-    host wrappers resolve them via `resolve()` and pass them down so config
-    changes can never be baked stale into a cached trace."""
+    ascending. TRACE-PURE by contract (tools/analysis purity/*): this
+    function reads no config and consults no tuning table — `strategy` must
+    arrive CONCRETE from a host-side `resolve()` call, so a cached trace can
+    never bake a stale choice. Only the pure degradations live here: a
+    k-of-n select with no real pool reduction (k >= n, n <= 4k, n within one
+    tile) runs fused exact, and `pallas_fused` degrades to exact_full (a
+    d2-level select can't fuse — the matrix already exists)."""
     n = d2.shape[-1]
     k = min(int(k), n)
-    # a d2-level select can't fuse (the matrix already exists): resolve with
-    # fusable=False so an inherited `pallas_fused` degrades to exact_full
-    strategy, tile, recall_target = resolve(n, k, strategy, tile, recall_target)
+    if strategy is None or strategy == "auto":
+        raise ValueError(
+            "select_topk requires a concrete strategy — call "
+            "ops.selection.resolve() in the HOST wrapper and pass the "
+            "resolved triple down (trace-purity contract, docs/design.md §6j)"
+        )
+    if strategy not in STRATEGIES:
+        raise ValueError(
+            f"knn.selection must be one of {STRATEGIES}, got '{strategy}'"
+        )
+    if strategy == "pallas_fused" or k >= n or n <= 4 * k:
+        strategy = "exact_full"
+    if strategy == "exact_tiled" and (not tile or n <= tile):
+        strategy = "exact_full"
+    if strategy == "approx":
+        if recall_target is None:
+            raise ValueError(
+                "select_topk(strategy='approx') requires a concrete "
+                "recall_target — resolve() in the host wrapper provides one"
+            )
+        if not 0.0 < recall_target <= 1.0:
+            raise ValueError(
+                f"knn.recall_target must be in (0, 1], got {recall_target}"
+            )
     # clamp: inf (or beyond-sentinel) entries would rank after tiled padding
     # and break exact_full/exact_tiled bit-parity; after the clamp every
     # strategy sees identical values and ties resolve identically
@@ -274,11 +299,11 @@ def select_topk(
     if strategy == "exact_tiled":
         neg, idx = _tiled_topk_neg(-d2, k, tile)
     elif strategy == "approx":
-        neg, idx = jax.lax.approx_max_k(  # noqa: selection-plane primitive home
+        neg, idx = jax.lax.approx_max_k(  # selection-plane primitive home (fence-exempt file)
             -d2, k, recall_target=recall_target
         )
     else:
-        neg, idx = jax.lax.top_k(-d2, k)  # noqa: selection-plane primitive home
+        neg, idx = jax.lax.top_k(-d2, k)  # selection-plane primitive home (fence-exempt file)
     return -neg, idx
 
 

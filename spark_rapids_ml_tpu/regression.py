@@ -4,7 +4,7 @@
 from .models.regression import LinearRegression, LinearRegressionModel
 
 try:  # RandomForestRegressor arrives with models/tree.py
-    from .models.tree import RandomForestRegressor, RandomForestRegressionModel  # noqa: F401
+    from .models.tree import RandomForestRegressor, RandomForestRegressionModel  # re-exported surface
 
     __all__ = [
         "LinearRegression",

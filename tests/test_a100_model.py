@@ -8,7 +8,7 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from benchmark import a100_model as m  # noqa: E402
+from benchmark import a100_model as m  # sys.path mutation above is deliberate
 
 
 def test_hbm_bound_families_scale_inverse_width():

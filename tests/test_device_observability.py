@@ -77,6 +77,32 @@ def test_compiled_kernel_captures_cost_and_counts_signatures():
     assert totals["device.kernel_calls{kernel=t.mm}"] == 7
 
 
+def test_trace_epoch_rekeys_cache_on_parity_precision_change():
+    """The sanction for the ONE trace-time config read (ops/_precision.py,
+    docs/design.md §6j): parity_precision rides in every AOT signature, so
+    changing it re-keys the cache and re-traces with the NEW value — the
+    stale-bake hazard the purity pass bans is structurally impossible here."""
+    from spark_rapids_ml_tpu.ops._precision import pdot
+
+    @obs.compiled_kernel("t.epoch")
+    def gram(x):
+        return pdot(x.T, x)
+
+    x = jnp.ones((16, 8))
+    try:
+        gram(x)
+        gram(x)  # same epoch: cached, one compile
+        assert dev.compile_count("t.epoch") == 1
+        config.set("parity_precision", "high")
+        gram(x)  # epoch changed: re-keyed, re-lowered with the new value
+        assert dev.compile_count("t.epoch") == 2
+        config.set("parity_precision", "highest")
+        gram(x)  # back to the FIRST epoch's key: cache hit, no third compile
+        assert dev.compile_count("t.epoch") == 2
+    finally:
+        config.unset("parity_precision")
+
+
 def test_compiled_kernel_memory_analysis_breakdown():
     @obs.compiled_kernel("t.add")
     def add(a, b):
@@ -240,7 +266,7 @@ def test_hbm_sampling_with_stubbed_stats(monkeypatch):
         platform = "cpu"
         device_kind = "cpu"
 
-        def memory_stats(self):  # noqa — stub standing in for a TPU runtime
+        def memory_stats(self):  # stub standing in for a TPU runtime
             return {"bytes_in_use": 1 << 20}
 
     monkeypatch.setattr(jax, "local_devices", lambda: [_Dev()])

@@ -48,7 +48,7 @@ def test_install_import_direct():
     """Importing install in-process interposes pyspark.ml.* modules."""
     import sys as _sys
 
-    import spark_rapids_ml_tpu.install  # noqa: F401
+    import spark_rapids_ml_tpu.install  # noqa: hygiene/unused-import
 
     mod = _sys.modules["pyspark.ml.feature"]
     cls = mod.PCA

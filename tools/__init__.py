@@ -1,0 +1,1 @@
+# Repo tooling namespace (static analysis plane lives in tools/analysis).

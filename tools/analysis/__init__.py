@@ -1,0 +1,40 @@
+#
+# Whole-program static analysis plane (docs/design.md §6j): one shared AST
+# parse + module index per run, a rule registry with stable IDs, scoped
+# `# noqa: <rule-id>` suppression, a checked-in baseline for grandfathered
+# findings, and four pass families:
+#
+#   fences/*  + hygiene/*  — the ci/lint_python.py checks, migrated
+#   purity/*               — trace-purity (host-wrapper discipline)
+#   locks/*                — lock-order cycles + blocking under hot locks
+#   metrics/*              — metric emission/consumption contract
+#
+# Run `python -m tools.analysis` (CI tier 0), `--list-rules`, or
+# `--explain <rule-id>`.
+#
+
+# importing the pass modules registers their rules and passes (__init__ is
+# exempt from the unused-import check: dynamic re-export module)
+from . import fences as _fences
+from . import purity as _purity
+from . import locks as _locks
+from . import metrics as _metrics
+from .core import (
+    DEFAULT_BASELINE,
+    DEFAULT_TARGETS,
+    AnalysisContext,
+    Finding,
+    ProjectIndex,
+    all_rules,
+    run_analysis,
+)
+
+__all__ = [
+    "AnalysisContext",
+    "DEFAULT_BASELINE",
+    "DEFAULT_TARGETS",
+    "Finding",
+    "ProjectIndex",
+    "all_rules",
+    "run_analysis",
+]

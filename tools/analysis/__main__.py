@@ -1,0 +1,143 @@
+#
+# CLI for the static-analysis plane. CI tier 0 runs:
+#
+#     python -m tools.analysis --max-seconds 10 --out analysis_report.json
+#
+# Subcommands for humans:
+#     --list-rules           rule catalog (id + one-line summary)
+#     --explain <rule-id>    full rationale + fix + suppression guidance
+#     --json                 machine-readable findings on stdout
+#     --write-baseline       grandfather the current findings (purity/* is
+#                            refused: stale-bake hazards are fixed, not waived)
+#
+# Exit codes: 0 clean, 1 findings (or wall-clock budget exceeded), 2 usage.
+#
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+from typing import List, Optional
+
+from . import DEFAULT_BASELINE, DEFAULT_TARGETS, all_rules, run_analysis
+
+_ROOT = Path(__file__).resolve().parent.parent.parent
+
+
+def _list_rules() -> int:
+    rules = all_rules()
+    width = max(len(r) for r in rules)
+    for rid in sorted(rules):
+        print(f"{rid:<{width}}  {rules[rid].summary}")
+    return 0
+
+
+def _explain(rule_id: str) -> int:
+    rules = all_rules()
+    r = rules.get(rule_id)
+    if r is None:
+        print(f"unknown rule id {rule_id!r}; run --list-rules", file=sys.stderr)
+        return 2
+    print(f"{r.id} — {r.summary}\n")
+    print(r.explain)
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.analysis",
+        description="whole-program invariant analyzer (docs/design.md §6j)",
+    )
+    ap.add_argument("targets", nargs="*",
+                    help=f"analysis roots relative to the repo root "
+                         f"(default: {' '.join(DEFAULT_TARGETS)})")
+    ap.add_argument("--root", default=str(_ROOT),
+                    help="repo root (default: this checkout)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable findings on stdout")
+    ap.add_argument("--out", metavar="PATH",
+                    help="also write the JSON report to PATH")
+    ap.add_argument("--baseline", default=None, metavar="PATH",
+                    help=f"baseline file (default: <root>/{DEFAULT_BASELINE})")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline (show grandfathered findings)")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="write current findings into the baseline and exit 0")
+    ap.add_argument("--max-seconds", type=float, default=None,
+                    help="fail if the run exceeds this wall-clock budget")
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--explain", metavar="RULE_ID")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        return _list_rules()
+    if args.explain:
+        return _explain(args.explain)
+
+    root = Path(args.root).resolve()
+    baseline = None
+    if not args.no_baseline:
+        baseline = Path(args.baseline) if args.baseline else root / DEFAULT_BASELINE
+    targets = tuple(args.targets) if args.targets else DEFAULT_TARGETS
+
+    report = run_analysis(root, targets=targets, baseline_path=baseline)
+    findings = report.pop("_finding_objs")
+    report.pop("_index")
+
+    if args.write_baseline:
+        from .core import load_baseline, write_baseline
+
+        target = baseline or root / DEFAULT_BASELINE
+        purity = [f for f in findings if f.rule.startswith("purity/")]
+        if purity:
+            print(
+                f"refusing --write-baseline: {len(purity)} purity/* "
+                "finding(s) present — trace-purity hazards are fixed, never "
+                "grandfathered:"
+            )
+            for f in purity:
+                print("  " + f.render())
+            return 1
+        keep = [f for f in findings if not f.rule.startswith("baseline/")]
+        old = load_baseline(target)
+        write_baseline(target, keep, justifications=old)
+        print(f"baseline written: {len(keep)} entr(y/ies) -> {target}")
+        return 0
+
+    if args.out:
+        Path(args.out).write_text(json.dumps(report, indent=2) + "\n")
+    if args.json:
+        print(json.dumps(report, indent=2))
+    else:
+        n = len(findings)
+        if n:
+            print(f"ANALYSIS: {n} finding(s) in {report['files_analyzed']} "
+                  f"files ({report['elapsed_s']}s)")
+            for f in findings:
+                print("  " + f.render())
+            print("\nrun `python -m tools.analysis --explain <rule-id>` for "
+                  "rationale and fixes; scoped suppression: "
+                  "`# noqa: <rule-id>`")
+        else:
+            nb = len(report.get("baselined", []))
+            print(
+                f"ANALYSIS OK: {report['files_analyzed']} files clean in "
+                f"{report['elapsed_s']}s"
+                + (f" ({nb} baselined)" if nb else "")
+            )
+
+    rc = 0 if not findings else 1
+    if args.max_seconds is not None and report["elapsed_s"] > args.max_seconds:
+        print(
+            f"ANALYSIS BUDGET EXCEEDED: {report['elapsed_s']}s > "
+            f"{args.max_seconds}s (the shared-parse budget; did a pass "
+            "start re-reading files?)"
+        )
+        rc = 1
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,234 @@
+#
+# Intra-package call graph over the shared index — the cross-file spine the
+# trace-purity and lock-graph passes walk. Deliberately approximate in the
+# safe direction for THIS codebase's idioms:
+#
+#   * `from .m import f` / `from ..pkg import mod` resolve through the package
+#     tree; absolute intra-repo imports resolve too. Third-party targets stay
+#     opaque (no edges).
+#   * a bare Name call resolves lexically: enclosing function's nested defs,
+#     then outer functions, then module-level defs, then imports.
+#   * `self.m()` resolves to a method `m` on the lexically enclosing class.
+#   * `mod.f()` resolves when `mod` is an imported module in the index.
+#   * anything else (instance attributes, dynamic dispatch) resolves to
+#     nothing — a pass that needs more (e.g. locks on `registry.upload()`)
+#     falls back to its own name-based matching.
+#
+# One graph is built per run and shared via AnalysisContext.shared["callgraph"].
+#
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from .core import AnalysisContext, ModuleInfo, ProjectIndex
+
+
+@dataclass
+class FunctionInfo:
+    qualname: str  # module.Class.fn / module.fn / module.fn.inner
+    module: ModuleInfo
+    node: ast.AST  # FunctionDef | AsyncFunctionDef | Lambda
+    lineno: int
+    class_name: Optional[str] = None
+    parent: Optional[str] = None  # qualname of lexically enclosing function
+    children: Dict[str, str] = field(default_factory=dict)  # name -> qualname
+    calls: List[Tuple[ast.Call, Optional[str]]] = field(default_factory=list)
+
+    @property
+    def short(self) -> str:
+        return self.qualname
+
+
+class CallGraph:
+    def __init__(self, index: ProjectIndex):
+        self.index = index
+        self.functions: Dict[str, FunctionInfo] = {}
+        # module name -> {local binding -> fully qualified target}
+        self.imports: Dict[str, Dict[str, str]] = {}
+        # module name -> {top-level def/class name -> qualname}
+        self.module_defs: Dict[str, Dict[str, str]] = {}
+        # module.Class -> {method name -> qualname}
+        self.class_methods: Dict[str, Dict[str, str]] = {}
+        self.edges: Dict[str, List[Tuple[str, int]]] = {}
+        self._build()
+
+    # ------------------------------------------------------------- indexing
+
+    def _resolve_import(self, mod: ModuleInfo, node: ast.AST) -> Dict[str, str]:
+        out: Dict[str, str] = {}
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                out[alias.asname or alias.name.split(".")[0]] = (
+                    alias.name if alias.asname else alias.name.split(".")[0]
+                )
+                if alias.asname:
+                    out[alias.asname] = alias.name
+        elif isinstance(node, ast.ImportFrom):
+            base = node.module or ""
+            if node.level:
+                parts = (mod.name or "").split(".")
+                # drop the module leaf + (level-1) packages
+                keep = len(parts) - node.level
+                if mod.path.name == "__init__.py":
+                    keep += 1
+                prefix = ".".join(parts[:keep]) if keep > 0 else ""
+                base = f"{prefix}.{base}" if base and prefix else (prefix or base)
+            for alias in node.names:
+                if alias.name == "*":
+                    continue
+                target = f"{base}.{alias.name}" if base else alias.name
+                out[alias.asname or alias.name] = target
+        return out
+
+    def _build(self) -> None:
+        for mod in self.index.files:
+            if mod.tree is None or not mod.name:
+                continue
+            imap: Dict[str, str] = {}
+            for node in ast.walk(mod.tree):
+                if isinstance(node, (ast.Import, ast.ImportFrom)):
+                    imap.update(self._resolve_import(mod, node))
+            self.imports[mod.name] = imap
+            self.module_defs[mod.name] = {}
+            self._index_body(mod, mod.tree.body, prefix=mod.name,
+                             class_name=None, parent=None, top_level=True)
+        for fi in list(self.functions.values()):
+            self._collect_calls(fi)
+
+    def _index_body(self, mod: ModuleInfo, body: List[ast.stmt], prefix: str,
+                    class_name: Optional[str], parent: Optional[str],
+                    top_level: bool) -> None:
+        for node in body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                q = f"{prefix}.{node.name}"
+                fi = FunctionInfo(
+                    qualname=q, module=mod, node=node, lineno=node.lineno,
+                    class_name=class_name, parent=parent,
+                )
+                self.functions[q] = fi
+                if top_level:
+                    self.module_defs[mod.name][node.name] = q
+                if parent and parent in self.functions:
+                    self.functions[parent].children[node.name] = q
+                if class_name:
+                    self.class_methods.setdefault(
+                        f"{mod.name}.{class_name}", {}
+                    )[node.name] = q
+                self._index_body(mod, node.body, prefix=q,
+                                 class_name=class_name, parent=q,
+                                 top_level=False)
+            elif isinstance(node, ast.ClassDef):
+                if top_level:
+                    self.module_defs[mod.name][node.name] = f"{prefix}.{node.name}"
+                self._index_body(mod, node.body, prefix=f"{prefix}.{node.name}",
+                                 class_name=node.name, parent=parent,
+                                 top_level=False)
+            elif isinstance(node, (ast.If, ast.Try)):
+                # defs under `if TYPE_CHECKING:` / try-import blocks
+                subbodies = [getattr(node, "body", []),
+                             getattr(node, "orelse", []),
+                             getattr(node, "finalbody", [])]
+                for h in getattr(node, "handlers", []):
+                    subbodies.append(h.body)
+                for sb in subbodies:
+                    self._index_body(mod, sb, prefix=prefix,
+                                     class_name=class_name, parent=parent,
+                                     top_level=top_level)
+
+    # ----------------------------------------------------------- resolution
+
+    def resolve_name(self, fi: FunctionInfo, name: str) -> Optional[str]:
+        """Lexical lookup of a bare name to a function qualname."""
+        cur: Optional[FunctionInfo] = fi
+        while cur is not None:
+            q = cur.children.get(name)
+            if q:
+                return q
+            cur = self.functions.get(cur.parent) if cur.parent else None
+        mod = fi.module.name or ""
+        q = self.module_defs.get(mod, {}).get(name)
+        if q and q in self.functions:
+            return q
+        target = self.imports.get(mod, {}).get(name)
+        if target and target in self.functions:
+            return target
+        # `from .m import f` where f is a method-less module function
+        return None
+
+    def resolve_call(self, fi: FunctionInfo, call: ast.Call) -> Optional[str]:
+        func = call.func
+        mod = fi.module.name or ""
+        if isinstance(func, ast.Name):
+            return self.resolve_name(fi, func.id)
+        if isinstance(func, ast.Attribute):
+            base = func.value
+            if isinstance(base, ast.Name):
+                if base.id == "self" and fi.class_name:
+                    q = self.class_methods.get(
+                        f"{mod}.{fi.class_name}", {}
+                    ).get(func.attr)
+                    if q:
+                        return q
+                    return None
+                target = self.imports.get(mod, {}).get(base.id)
+                if target:
+                    # imported module: target.attr may be a function
+                    q = f"{target}.{func.attr}"
+                    if q in self.functions:
+                        return q
+                    # imported class: ClassName.method
+                    q2 = self.class_methods.get(target, {})
+                    if func.attr in q2:
+                        return q2[func.attr]
+                # Name bound to a top-level class in this module: C.method
+                cls_q = self.module_defs.get(mod, {}).get(base.id)
+                if cls_q:
+                    q = self.class_methods.get(cls_q, {}).get(func.attr)
+                    if q:
+                        return q
+        return None
+
+    def _collect_calls(self, fi: FunctionInfo) -> None:
+        """Direct Call nodes in fi's body, excluding nested def/lambda bodies
+        (those run when called, not when defined)."""
+        own_nodes = _body_nodes(fi.node)
+        for node in own_nodes:
+            if isinstance(node, ast.Call):
+                fi.calls.append((node, self.resolve_call(fi, node)))
+        self.edges[fi.qualname] = [
+            (q, c.lineno) for c, q in fi.calls if q is not None
+        ]
+
+    def body_nodes(self, fi: FunctionInfo) -> List[ast.AST]:
+        return _body_nodes(fi.node)
+
+
+def _body_nodes(fn_node: ast.AST) -> List[ast.AST]:
+    """All AST nodes lexically inside fn_node but NOT inside a nested
+    FunctionDef/AsyncFunctionDef/Lambda (the nested body belongs to the nested
+    function)."""
+    out: List[ast.AST] = []
+    if isinstance(fn_node, ast.Lambda):
+        roots: List[ast.AST] = [fn_node.body]
+    else:
+        roots = list(fn_node.body)  # type: ignore[attr-defined]
+    stack: List[ast.AST] = list(roots)
+    while stack:
+        node = stack.pop()
+        out.append(node)
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                continue
+            stack.append(child)
+    return out
+
+
+def get_callgraph(ctx: AnalysisContext) -> CallGraph:
+    cg = ctx.shared.get("callgraph")
+    if cg is None:
+        cg = ctx.shared["callgraph"] = CallGraph(ctx.index)
+    return cg
