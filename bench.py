@@ -54,6 +54,7 @@ UNITS = [
     "autotune",
     "knn",
     "ann",
+    "ann_build",
     "wide256",
 ]
 
@@ -158,7 +159,8 @@ def _worker_main() -> None:
     # whose remaining units all build their own data (rf/umap/dbscan/fit_e2e/
     # wide256) skips the ~6 GiB generation entirely — that time comes straight
     # out of the wedge-recovery budget
-    NEED_X = {"kmeans_headline", "pca", "logreg", "linreg", "large_k", "knn", "ann"}
+    NEED_X = {"kmeans_headline", "pca", "logreg", "linreg", "large_k", "knn",
+              "ann", "ann_build"}
     remaining = [
         u for u in UNITS
         if u not in skip and time.time() < deadline_ts - UNIT_START_MARGIN_S

@@ -77,6 +77,14 @@ _SERVING_P99_RE = re.compile(
 _SPEEDUP_RE = re.compile(
     r'\\?"(\w+_speedup)\\?"\s*:\s*([0-9]+(?:\.[0-9]+)?(?:[eE][+-]?[0-9]+)?)'
 )
+# ANN lifecycle plane (`ann_build_rows_per_s`, docs/design.md §7b): pipelined
+# out-of-core build throughput — HIGHER is better like mfu (the ISSUE-15 gate:
+# pipelined build must not fall back under the serial baseline's rate). The
+# regex anchors on the exact `_rows_per_s` suffix, so the legacy
+# `*_rows_per_sec_per_chip` keys never match
+_ROWS_PER_S_RE = re.compile(
+    r'\\?"(\w+_rows_per_s)\\?"\s*:\s*([0-9]+(?:\.[0-9]+)?(?:[eE][+-]?[0-9]+)?)'
+)
 # measurement-noise companion (`*_overhead_noise_pct`, the MAD of the
 # scenario's pair deltas): when the noise floor reaches the budget the point
 # estimate carries no signal, so the check reports INCONCLUSIVE instead of
@@ -89,7 +97,7 @@ _PLATFORM_RE = re.compile(r'\\?"platform\\?"\s*:\s*\\?"(\w+)\\?"')
 
 
 def _higher_is_better(name: str) -> bool:
-    return name.endswith(("_mfu", "_speedup"))
+    return name.endswith(("_mfu", "_speedup", "_rows_per_s"))
 
 
 # absolute noise floors for the comm keys: near zero (CPU-mesh comm_frac sits
@@ -156,6 +164,8 @@ def extract(path: str) -> Dict[str, object]:
             scenarios[k] = float(v)  # serving tail: lower-is-better + floor
         elif k.endswith("_speedup") and isinstance(v, (int, float)):
             scenarios[k] = float(v)  # autotune plane: higher-is-better + floor
+        elif k.endswith("_rows_per_s") and isinstance(v, (int, float)):
+            scenarios[k] = float(v)  # ann build throughput: higher-is-better
         elif k.endswith("_overhead_noise_pct") and isinstance(v, (int, float)):
             overhead_noise[k[: -len("_noise_pct")] + "_pct"] = float(v)
         elif k.endswith("_overhead_pct") and isinstance(v, (int, float)):
@@ -182,6 +192,8 @@ def extract(path: str) -> Dict[str, object]:
         for name, v in _SERVING_P99_RE.findall(text):
             scenarios[name] = float(v)
         for name, v in _SPEEDUP_RE.findall(text):
+            scenarios[name] = float(v)
+        for name, v in _ROWS_PER_S_RE.findall(text):
             scenarios[name] = float(v)
         for name, v in _OVERHEAD_NOISE_RE.findall(text):
             overhead_noise[name[: -len("_noise_pct")] + "_pct"] = float(v)

@@ -397,7 +397,113 @@ print(f"SERVING SMOKE OK: 180 concurrent HTTP requests exact, 0 warm-path "
       f"occupancy={summary['km']['batch_occupancy']}, no leaks")
 PY
   rm -rf "$SRML_SERVING_SMOKE_DIR"
-  python -m pytest tests/ -q --ignore=tests/test_reliability.py --ignore=tests/test_device_cache.py --ignore=tests/test_observability.py --ignore=tests/test_transform_observability.py --ignore=tests/test_telemetry_plane.py --ignore=tests/test_comm_plane.py --ignore=tests/test_serving.py
+  # ann-lifecycle smoke (docs/design.md §7b): unit tests first, then the
+  # acceptance end-to-end — a pipelined streamed build whose exported run
+  # report proves per-batch overlap telemetry, save through the index store,
+  # load in a FRESH process with bit-identical search, and incremental
+  # adds/deletes on a LIVE served model with zero warm-path compiles — all
+  # asserted from exported JSONL counters, like a dashboard would.
+  python -m pytest tests/test_ann_lifecycle.py -q
+  SRML_ANN_SMOKE_DIR="$(mktemp -d)"
+  SRML_TPU_METRICS_DIR="$SRML_ANN_SMOKE_DIR/metrics" \
+  SRML_ANN_SMOKE_STATE="$SRML_ANN_SMOKE_DIR" \
+  python - <<'PY'
+import os
+import numpy as np, pandas as pd
+from spark_rapids_ml_tpu import config
+from spark_rapids_ml_tpu.knn import ApproximateNearestNeighbors
+from spark_rapids_ml_tpu.observability import load_run_reports
+
+state = os.environ["SRML_ANN_SMOKE_STATE"]
+rng = np.random.default_rng(0)
+X = rng.normal(size=(1200, 16)).astype(np.float32)
+df = pd.DataFrame({"features": list(X), "id": np.arange(1200)})
+# force the streamed (pipelined) build, then search in-core below
+config.set("stream_threshold_bytes", 1024)
+config.set("stream_batch_rows", 256)
+est = ApproximateNearestNeighbors(
+    k=8, algorithm="ivfflat", algoParams={"nlist": 16, "nprobe": 8},
+    inputCol="features", idCol="id",
+)
+model = est.fit(df)
+config.unset("stream_threshold_bytes")
+config.unset("stream_batch_rows")
+rep = load_run_reports(os.environ["SRML_TPU_METRICS_DIR"])[-1]
+assert rep["algo"] == "ApproximateNearestNeighbors", rep["algo"]
+c = rep["metrics"]["counters"]
+n_batches = -(-1200 // 256)
+assert c.get("ann.pipeline_batches{site=ann_assign}", 0) == n_batches, c
+h = rep["metrics"]["histograms"]
+stage = sum(v["count"] for k, v in h.items() if k.startswith("ann.stage_s"))
+drain = sum(v["count"] for k, v in h.items() if k.startswith("ann.drain_s"))
+assert stage == n_batches and drain == n_batches, (stage, drain)
+# batch-as-rank timeline rows exported (§7b straggler surface)
+assert rep.get("ranks") and len(rep["ranks"]["ranks"]) == n_batches, rep.get("ranks")
+qdf = pd.DataFrame({"features": list(X[:32]), "id": np.arange(32)})
+_, _, ref = model.kneighbors(qdf)
+model.write().save(os.path.join(state, "index_model"))
+np.savez(os.path.join(state, "ref.npz"),
+         ids=np.stack(ref["indices"]), dists=np.stack(ref["distances"]), X=X)
+print("ANN LIFECYCLE SMOKE (1/2) OK: pipelined build telemetry in the JSONL "
+      f"({n_batches} batches with stage/drain overlap records); model saved")
+PY
+  # FRESH process: load without refit; search must be bit-identical; a live
+  # served kNN model absorbs incremental adds/deletes with zero new compiles
+  SRML_TPU_METRICS_DIR="$SRML_ANN_SMOKE_DIR/metrics" \
+  SRML_ANN_SMOKE_STATE="$SRML_ANN_SMOKE_DIR" \
+  python - <<'PY'
+import os
+import numpy as np, pandas as pd
+from spark_rapids_ml_tpu import config, serving
+from spark_rapids_ml_tpu.knn import NearestNeighbors
+from spark_rapids_ml_tpu.models.knn import ApproximateNearestNeighborsModel
+from spark_rapids_ml_tpu.observability import fit_run, load_run_reports
+
+state = os.environ["SRML_ANN_SMOKE_STATE"]
+blob = np.load(os.path.join(state, "ref.npz"))
+X = blob["X"]
+loaded = ApproximateNearestNeighborsModel.load(os.path.join(state, "index_model"))
+qdf = pd.DataFrame({"features": list(X[:32]), "id": np.arange(32)})
+_, _, got = loaded.kneighbors(qdf)
+np.testing.assert_array_equal(np.stack(got["indices"]), blob["ids"])
+np.testing.assert_array_equal(np.stack(got["distances"]), blob["dists"])
+
+# live served kNN model: bucketed geometry -> adds/deletes compile nothing
+config.set("serving.max_batch_rows", 32)
+config.set("serving.bucket_min_rows", 16)
+nn = NearestNeighbors(k=3, inputCol="features").fit(
+    pd.DataFrame({"features": list(X[:200])})
+)
+nn.enable_incremental(capacity_rows=512)
+reg = serving.ModelRegistry()
+with fit_run(algo="AnnServeWarm", site="ci"):
+    reg.register("nn", nn)  # per-bucket AOT pre-warm compiles HERE
+    reg.predict("nn", X[:8])
+with fit_run(algo="AnnServeSteady", site="ci"):
+    new_vec = X[:4] + 100.0
+    ids = nn.add_items(new_vec)
+    reg.refresh_weights("nn")
+    out = reg.predict("nn", new_vec)
+    assert (out["indices"][:, 0] == ids).all(), (out["indices"], ids)
+    nn.delete_items(ids[:2])
+    reg.refresh_weights("nn")
+    out2 = reg.predict("nn", new_vec[:2])
+    assert not np.isin(out2["indices"][:, 0], ids[:2]).any(), out2["indices"]
+reg.close()
+rep = [r for r in load_run_reports(os.environ["SRML_TPU_METRICS_DIR"])
+       if r["algo"] == "AnnServeSteady"][-1]
+c = rep["metrics"]["counters"]
+compiles = sum(v for k, v in c.items() if k.startswith("device.compile{"))
+assert compiles == 0, c
+assert c.get("serving.weight_refreshes{model=nn}", 0) == 2, c
+assert c.get("ann.items_added", 0) == 4, c
+assert c.get("ann.items_deleted", 0) == 2, c
+print("ANN LIFECYCLE SMOKE (2/2) OK: fresh-process load searches "
+      "bit-identical; live served model absorbed 4 adds + 2 deletes with "
+      "0 warm-path compiles and 2 weight refreshes")
+PY
+  rm -rf "$SRML_ANN_SMOKE_DIR"
+  python -m pytest tests/ -q --ignore=tests/test_reliability.py --ignore=tests/test_device_cache.py --ignore=tests/test_observability.py --ignore=tests/test_transform_observability.py --ignore=tests/test_telemetry_plane.py --ignore=tests/test_comm_plane.py --ignore=tests/test_serving.py --ignore=tests/test_ann_lifecycle.py
 fi
 
 # small benchmark smoke (reference runs a small bench pre-merge)
@@ -413,7 +519,7 @@ SRML_DEVICE_SMOKE_DIR="$(mktemp -d)"
 SRML_BENCH_ROLE=worker \
 SRML_BENCH_PROGRESS="$SRML_DEVICE_SMOKE_DIR/progress.jsonl" \
 SRML_BENCH_DEADLINE_TS="$(python -c 'import time; print(time.time() + 600)')" \
-SRML_BENCH_SKIP="kmeans_headline,logreg,linreg,rf,umap,dbscan,fit_e2e,cache,telemetry_overhead,serving_qps,large_k,autotune,knn,ann,wide256" \
+SRML_BENCH_SKIP="kmeans_headline,logreg,linreg,rf,umap,dbscan,fit_e2e,cache,telemetry_overhead,serving_qps,large_k,autotune,knn,ann,ann_build,wide256" \
 python bench.py
 SRML_BENCH_PROGRESS="$SRML_DEVICE_SMOKE_DIR/progress.jsonl" python - <<'PY'
 import json, os, sys

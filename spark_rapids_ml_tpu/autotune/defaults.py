@@ -58,3 +58,26 @@ LLOYD_FUSED_MIN_K = 128
 # segment-reduce histogram (ops/pallas_histogram.py)
 PALLAS_HISTOGRAM_BLOCK_ROWS = 512
 PALLAS_HISTOGRAM_MAX_SEG_TILE = 2048
+
+# ------------------------------------------------------------ ANN lifecycle
+# (ops/ann_streaming.py + ops/ann_lifecycle.py, docs/design.md §7b)
+#
+# ANN_BUILD_BATCH_ROWS: the pipelined build's row-batch geometry when neither
+# config (`ann.build_batch_rows`) nor a tuning-table entry decides. Provenance:
+# 64k f32 rows at the BASELINE 256-col shape is a 64 MiB staging buffer — two
+# in flight (prefetch depth 1) stay far under the 2 GiB default cache budget
+# while each batch still amortizes dispatch overhead; the streamed-fit default
+# (`stream_batch_rows`, 1M rows) remains the fallback when the caller already
+# sized batches for a whole fit.
+ANN_BUILD_BATCH_ROWS = 1 << 16
+# ANN_LIST_BUCKET_MIN_ROWS: smallest bucketed IVF list capacity. Provenance:
+# mirrors `serving.bucket_min_rows`'s floor rationale — below 8 slots the
+# pow-2 ladder would re-layout on nearly every add; at 8 the padded-slot waste
+# is bounded by one sub-KiB row block per list at d=16.
+ANN_LIST_BUCKET_MIN_ROWS = 8
+# ANN_COMPACT_TOMBSTONE_PCT: tombstoned slots as a percentage of occupied
+# slots that triggers list compaction. Provenance: at 30% the probe scan's
+# wasted candidate width stays under ~1.4x live width (the select is
+# width-bound, not item-bound), while compaction — a full re-layout — stays
+# rare under churny delete/add traffic.
+ANN_COMPACT_TOMBSTONE_PCT = 30

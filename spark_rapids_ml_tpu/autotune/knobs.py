@@ -118,6 +118,29 @@ KNOBS: Dict[str, Knob] = {
             "(ops/device_cache.py::batch_cache)",
             config_key="cache.hbm_budget_bytes", dims=(),
         ),
+        Knob(
+            "ann.build_batch_rows", "int",
+            "row-batch geometry of the pipelined out-of-core ANN builds "
+            "(ops/ann_streaming.py::resolve_build_batch_rows)",
+            config_key="ann.build_batch_rows", auto_values=(0,),
+            dims=("n", "d"),
+            grid=(1 << 14, 1 << 15, 1 << 16, 1 << 17, 1 << 18),
+        ),
+        Knob(
+            "ann.list_bucket_rows", "int",
+            "minimum bucketed IVF list capacity — max_cell rounds up to a "
+            "power-of-two bucket >= this so in-slack incremental adds never "
+            "change search-executable shapes (ops/ann_lifecycle.py)",
+            config_key="ann.list_bucket_rows", auto_values=(0,), dims=(),
+            grid=(8, 16, 32, 64),
+        ),
+        Knob(
+            "ann.compact_tombstone_pct", "int",
+            "tombstoned-slot percentage of occupied slots that triggers IVF "
+            "list compaction (ops/ann_lifecycle.py::needs_compaction)",
+            config_key="ann.compact_tombstone_pct", dims=(),
+            grid=(10, 20, 30, 50),
+        ),
     )
 }
 

@@ -192,6 +192,25 @@ _DEFAULTS: Dict[str, Any] = {
     # per-request wall-clock budget the HTTP handler waits on a future before
     # answering 504 (the request may still complete; its slot is not replayed)
     "serving.request_timeout_s": 30.0,
+    # ANN index lifecycle (ops/ann_streaming.py + ops/ann_lifecycle.py,
+    # docs/design.md §7b). build_batch_rows: row-batch geometry of the
+    # pipelined out-of-core builds; 0 = auto (tuning table, else
+    # stream_batch_rows). prefetch_depth: staged batches kept in flight so
+    # host staging of batch i+1 overlaps device execution of batch i; 0 runs
+    # the serial (pre-pipeline) loop — the bench baseline mode
+    "ann.build_batch_rows": 0,
+    "ann.prefetch_depth": 1,
+    # incremental maintenance: IVF list capacity rounds UP to a power-of-two
+    # bucket >= list_bucket_rows so in-slack adds never change the search
+    # executable's shapes (0 = auto: tuning table, else the defaults-module
+    # floor); compaction re-layouts the lists once tombstoned slots exceed
+    # this percentage of occupied slots
+    "ann.list_bucket_rows": 0,
+    "ann.compact_tombstone_pct": 30,
+    # lazy device residency of loaded/served indexes (ops/ann_lifecycle.py::
+    # DeviceIndexCache): per-segment HBM budget; a segment uploads on FIRST
+    # search, not at load — cold-start never stages the whole index
+    "ann.index_cache_bytes": 1 << 30,
     # closed-loop autotuner (spark_rapids_ml_tpu/autotune/, docs/design.md
     # §6i): telemetry-driven knob search persisted as per-platform tuning
     # tables. mode:
@@ -271,6 +290,11 @@ _ENV_KEYS: Dict[str, str] = {
     "serving.hbm_budget_bytes": "SRML_TPU_SERVING_HBM_BUDGET",
     "serving.queue_depth": "SRML_TPU_SERVING_QUEUE_DEPTH",
     "serving.request_timeout_s": "SRML_TPU_SERVING_REQUEST_TIMEOUT_S",
+    "ann.build_batch_rows": "SRML_TPU_ANN_BUILD_BATCH_ROWS",
+    "ann.prefetch_depth": "SRML_TPU_ANN_PREFETCH_DEPTH",
+    "ann.list_bucket_rows": "SRML_TPU_ANN_LIST_BUCKET_ROWS",
+    "ann.compact_tombstone_pct": "SRML_TPU_ANN_COMPACT_TOMBSTONE_PCT",
+    "ann.index_cache_bytes": "SRML_TPU_ANN_INDEX_CACHE_BYTES",
     "autotune.mode": "SRML_TPU_AUTOTUNE_MODE",
     "autotune.dir": "SRML_TPU_TUNE_DIR",
     "autotune.replicates": "SRML_TPU_AUTOTUNE_REPLICATES",

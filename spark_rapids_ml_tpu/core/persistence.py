@@ -57,6 +57,24 @@ def save_instance(instance: Any, path: str, overwrite: bool = False) -> None:
         json.dump(metadata, f, default=_json_default)
 
     attrs: Optional[Dict[str, Any]] = getattr(instance, "_model_attributes", None)
+    # ANN-index-backed models (models/knn.py) store their array attributes
+    # through the versioned, mmap-friendly index format instead of arrays.npz
+    # (ops/ann_lifecycle.py, docs/design.md §7b): the hook returns
+    # (arrays, algo, meta); those keys are excluded from the npz below and
+    # load back lazily as copy-on-write memmaps.
+    index_keys: set = set()
+    spec_hook = getattr(instance, "_ann_index_spec", None)
+    if attrs is not None and callable(spec_hook):
+        spec = spec_hook()
+        if spec is not None:
+            from ..ops.ann_lifecycle import save_index
+
+            index_arrays, algo, meta = spec
+            save_index(
+                os.path.join(path, "ann_index"), index_arrays,
+                algo=algo, meta=meta,
+            )
+            index_keys = set(index_arrays)
     if attrs is not None:
         try:
             import scipy.sparse as sp
@@ -66,6 +84,8 @@ def save_instance(instance: Any, path: str, overwrite: bool = False) -> None:
         scalars = {}
         sparse_keys = []
         for k, v in attrs.items():
+            if k in index_keys:
+                continue
             if sp is not None and sp.issparse(v):
                 # CSR attributes (sparse-fitted UMAP raw_data) store as their
                 # component arrays; reassembled at load
@@ -118,6 +138,15 @@ def load_instance(path: str, expected_cls: Optional[Type] = None) -> Any:
         if os.path.exists(npz_file):
             with np.load(npz_file) as data:
                 attrs.update({k: data[k] for k in data.files})
+        index_dir = os.path.join(path, "ann_index")
+        if os.path.isdir(index_dir):
+            # lazy load: arrays come back as copy-on-write memmaps — no array
+            # bytes are read until a search (or mutation) touches them
+            from ..ops.ann_lifecycle import load_index
+
+            index_arrays, manifest = load_index(index_dir)
+            attrs.update(index_arrays)
+            attrs["__ann_manifest__"] = manifest
         for k in attrs.pop("__sparse_attr_keys__", []):
             import scipy.sparse as sp
 

@@ -19,17 +19,31 @@
 
 from __future__ import annotations
 
-from typing import Dict, Tuple
+import time
+from typing import Callable, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from ..observability import span as obs_span
+from ..observability.runs import (
+    WorkerScope,
+    counter_inc as obs_counter_inc,
+    current_run,
+    observe as obs_observe,
+)
 from ..reliability import RetryPolicy, fault_point
 from . import selection as _sel
 from .selection import mask_invalid, merge_topk, select_topk
 from ..observability.device import compiled_kernel
+from .streaming import _prefetch
+
+# per-batch rank/phase timeline entries are recorded only for builds/searches
+# up to this many batches: the timeline is a forensic surface (which batch
+# dragged?), not an accounting one, and a million-batch build must not grow a
+# million-row worker list on the run
+_TIMELINE_BATCHES_CAP = 64
 
 
 def _normalize_batch_or_raise(Xb: np.ndarray) -> np.ndarray:
@@ -38,6 +52,135 @@ def _normalize_batch_or_raise(Xb: np.ndarray) -> np.ndarray:
     from .knn import normalize_rows_or_raise
 
     return normalize_rows_or_raise(Xb)
+
+
+def _strided_sample_indices(n: int, sample_rows: int) -> np.ndarray:
+    """Deterministic strided row subsample of EXACTLY min(n, sample_rows)
+    rows, evenly spanning [0, n) — rows are not assumed shuffled, so the
+    sample must cover the tail too. The old `step = max(1, n // min(n,
+    sample_rows))` form kept every stride hit and returned up to ~2x
+    sample_rows rows whenever n is just under a multiple of the step; a
+    truncated arange would instead clamp the count but silently drop the tail
+    distribution. `(i * n) // m` is strictly increasing for n >= m, so the
+    indices are unique, exactly m, and reach within n/m rows of the end."""
+    m = min(int(n), int(sample_rows))
+    if m <= 0:
+        return np.arange(0, 0)
+    return (np.arange(m, dtype=np.int64) * int(n)) // m
+
+
+def resolve_build_batch_rows(n: int, d: int) -> int:
+    """`ann.build_batch_rows` resolution for the pipelined builds: a non-zero
+    config pin wins, then the tuning table (per (n, d) shape bucket), then an
+    EXPLICITLY-configured `stream_batch_rows` (a deployment that sized batches
+    for its streamed fits keeps that geometry), then the defaults-module build
+    geometry (ANN_BUILD_BATCH_ROWS — two 64k-row staging buffers in flight,
+    not the 1M-row streamed-fit default)."""
+    from .. import autotune as _autotune
+    from .. import config as _config
+    from ..autotune.defaults import ANN_BUILD_BATCH_ROWS
+
+    pinned = int(_config.get("ann.build_batch_rows") or 0)
+    if pinned > 0:
+        return pinned
+    tuned = _autotune.lookup("ann.build_batch_rows", n=n, d=d)
+    if tuned:
+        return int(tuned)
+    if _config.source("stream_batch_rows") != "default":
+        return int(_config.get("stream_batch_rows"))
+    return int(ANN_BUILD_BATCH_ROWS)
+
+
+def _pipelined_run(
+    total: int,
+    batch_rows: int,
+    site: str,
+    dispatch: Callable[[int, int, int], object],
+    finalize: Callable[[int, int, int, object], None],
+    depth: Optional[int] = None,
+) -> None:
+    """THE pipelined out-of-core batch loop, shared by every streamed ANN
+    build/search/refine stage. `dispatch(bi, s, e)` host-stages one batch
+    (slice -> normalize -> device_put) and ASYNC-dispatches its device op(s),
+    returning the in-flight device values; `finalize(bi, s, e, out)` performs
+    the blocking host pull and the idempotent [s, e) host write. Routed
+    through ops/streaming.py::_prefetch with `ann.prefetch_depth` extra
+    batches in flight, host staging of batch i+1 overlaps device execution of
+    batch i (jax dispatch is async; the DMA rides a separate engine on TPU).
+    depth 0 degrades to the serial per-batch loop — the bench baseline.
+
+    Retry contract (unchanged from the serial loops): `fault_point(site,
+    batch=bi)` fires before each staging attempt, and BOTH halves run under
+    the per-batch RetryPolicy. A drain-side failure re-runs `dispatch` for
+    just that batch (the in-flight value died with the failed attempt) —
+    writes target only [s, e), so a retried batch is bit-identical to a
+    fault-free one.
+
+    Telemetry: `ann.stage_s{site=}` / `ann.drain_s{site=}` histograms are the
+    overlap evidence (pipelined wall << Σstage + Σdrain), and each batch of a
+    small build lands as a rank row (rank = batch ordinal, phase = site) in
+    the open run's §6h timeline, so a straggler batch is visible exactly like
+    a straggler barrier rank."""
+    from .. import config as _config
+
+    if depth is None:
+        depth = int(_config.get("ann.prefetch_depth"))
+    policy = RetryPolicy.from_config()
+    run = current_run()
+    n_batches = -(-total // batch_rows) if total > 0 else 0
+    timeline = run is not None and 1 < n_batches <= _TIMELINE_BATCHES_CAP
+    t_loop0 = time.perf_counter()
+
+    def gen():
+        for bi, s in enumerate(range(0, total, batch_rows)):
+            e = min(s + batch_rows, total)
+            work = {"wall_s": 0.0}  # the batch's OWN stage+drain seconds
+
+            def _stage(bi=bi, s=s, e=e, work=work):
+                # timer opens BEFORE the fault point: an injected sleep= (a
+                # deterministic straggler) is this batch's stall and must
+                # land in ITS stage wall / timeline row
+                t0 = time.perf_counter()
+                fault_point(site, batch=bi)
+                out = dispatch(bi, s, e)
+                dt = time.perf_counter() - t0
+                work["wall_s"] += dt
+                obs_observe("ann.stage_s", dt, site=site)
+                return out
+
+            obs_counter_inc("ann.pipeline_batches", 1, site=site)
+            yield bi, s, e, _stage, work, policy.run(_stage, site=site)
+
+    stream = gen() if depth <= 0 else _prefetch(gen(), depth=depth)
+    for bi, s, e, stage, work, out in stream:
+        state = {"out": out, "fresh": True}
+
+        def _drain(s=s, e=e, bi=bi, stage=stage, state=state, work=work):
+            if not state["fresh"]:
+                # the in-flight value died with the failed attempt: re-stage
+                # and re-dispatch this batch (same idempotent write target)
+                state["out"] = stage()
+            state["fresh"] = False
+            t0 = time.perf_counter()
+            finalize(bi, s, e, state["out"])
+            dt = time.perf_counter() - t0
+            work["wall_s"] += dt
+            obs_observe("ann.drain_s", dt, site=site)
+
+        policy.run(_drain, site=site)
+        if timeline:
+            # batch-as-rank timeline row: same-process snapshots merge
+            # breakdown-only (no metric double count), and the comm plane's
+            # skew/straggler machinery applies to build batches for free.
+            # wall_s is the batch's OWN stage+drain time — wall-clock from
+            # staging would also count time parked in the prefetch buffer
+            # behind a neighbor's drain and smear a straggler across two rows
+            ws = WorkerScope(rank=bi, run_id=run.run_id)
+            ws.note_phase(site, wall_s=work["wall_s"], rows=e - s)
+            run.add_worker_snapshot(ws.snapshot())
+    # whole-loop wall: the overlap denominator — Σstage + Σdrain exceeding
+    # this is the proof that host staging hid behind device execution
+    obs_observe("ann.pipeline_s", time.perf_counter() - t_loop0, site=site)
 
 
 def streaming_ivfflat_build(
@@ -64,8 +207,8 @@ def streaming_ivfflat_build(
     from .kmeans import kmeans_fit, kmeans_predict
 
     n, d = X.shape
-    step = max(1, n // min(n, sample_rows))
-    Xs = np.ascontiguousarray(X[::step], dtype=np.float32)
+    Xs = np.ascontiguousarray(X[_strided_sample_indices(n, sample_rows)],
+                              dtype=np.float32)
     if cosine:
         Xs = _normalize_batch_or_raise(Xs)
     # the coarse kmeans trains on the SUBSAMPLE: k must fit it, not just n
@@ -78,26 +221,31 @@ def streaming_ivfflat_build(
     centers = fitted["cluster_centers"]
     centers_j = jnp.asarray(centers)
 
-    # per-batch retry: each batch writes only assign[s:e] (idempotent), so a
-    # transient fault re-runs just that batch — results are unchanged
-    policy = RetryPolicy.from_config()
+    # pipelined per-batch assignment: each batch writes only assign[s:e]
+    # (idempotent), so a transient fault re-runs just that batch under the
+    # retry policy — results are unchanged; host staging of batch i+1 overlaps
+    # the device's assignment matmul of batch i (_pipelined_run)
     assign = np.empty((n,), np.int32)
-    for bi, s in enumerate(range(0, n, batch_rows)):
-        e = min(s + batch_rows, n)
 
-        def _assign_batch(s=s, e=e, bi=bi):
-            fault_point("ann_assign", batch=bi)
-            Xb = np.ascontiguousarray(X[s:e], dtype=np.float32)
-            if cosine:
-                Xb = _normalize_batch_or_raise(Xb)
-            assign[s:e] = np.asarray(kmeans_predict(jnp.asarray(Xb), centers_j))
+    def _dispatch_assign(bi, s, e):
+        Xb = np.ascontiguousarray(X[s:e], dtype=np.float32)
+        if cosine:
+            Xb = _normalize_batch_or_raise(Xb)
+        return kmeans_predict(jnp.asarray(Xb), centers_j)
 
-        policy.run(_assign_batch, site="ann_assign")
+    def _finalize_assign(bi, s, e, out):
+        assign[s:e] = np.asarray(out)
+
+    _pipelined_run(n, batch_rows, "ann_assign", _dispatch_assign,
+                   _finalize_assign)
 
     from .knn import layout_cells
 
+    # X passes through UNconverted: layout_cells casts inside its row gather,
+    # so the streamed path no longer materializes a second full-dense f32
+    # copy of the dataset before laying out the cells
     cells, cell_ids, cell_sizes = layout_cells(
-        np.asarray(X, dtype=np.float32), assign, nlist,
+        np.asarray(X), assign, nlist,
         normalize=cosine,
     )
     from .knn import center_norms_sq
@@ -150,8 +298,7 @@ def streaming_ivfpq_build(
     # codebooks from a residual subsample (strided — rows are not assumed
     # shuffled); the in-core build trains on ALL residuals, so codebooks differ
     # in detail but the recall/quality contract is preserved (tested)
-    step = max(1, n // min(n, sample_rows))
-    sub_idx = np.arange(0, n, step)
+    sub_idx = _strided_sample_indices(n, sample_rows)
     X_sub = np.ascontiguousarray(X[sub_idx], np.float32)
     if cosine:
         X_sub = _normalize_batch_or_raise(X_sub)
@@ -171,28 +318,30 @@ def streaming_ivfpq_build(
             cb[k_eff:] = 1e18  # unused codes: unreachable
         codebooks[m_i] = cb
 
-    # streamed encoding passes: one batch upload covers all m sub-encodings;
-    # per-batch retry as in the assignment loop (idempotent batch writes)
-    policy = RetryPolicy.from_config()
+    # pipelined streamed encoding: one batch upload covers all m
+    # sub-encodings (dispatched async, pulled in the drain half); per-batch
+    # retry as in the assignment loop (idempotent codes_flat[s:e] writes)
     cb_j = [jnp.asarray(codebooks[m_i]) for m_i in range(m_subvectors)]
     codes_flat = np.zeros((n, m_subvectors), np.uint8)
-    for bi, s in enumerate(range(0, n, batch_rows)):
-        e = min(s + batch_rows, n)
 
-        def _encode_batch(s=s, e=e, bi=bi):
-            fault_point("ann_encode", batch=bi)
-            Xb_enc = np.ascontiguousarray(X[s:e], np.float32)
-            if cosine:
-                Xb_enc = _normalize_batch_or_raise(Xb_enc)
-            resid_b = jnp.asarray(Xb_enc - coarse[assign[s:e]])
-            for m_i in range(m_subvectors):
-                codes_flat[s:e, m_i] = np.asarray(
-                    kmeans_predict(
-                        resid_b[:, m_i * sub_d : (m_i + 1) * sub_d], cb_j[m_i]
-                    )
-                ).astype(np.uint8)
+    def _dispatch_encode(bi, s, e):
+        Xb_enc = np.ascontiguousarray(X[s:e], np.float32)
+        if cosine:
+            Xb_enc = _normalize_batch_or_raise(Xb_enc)
+        resid_b = jnp.asarray(Xb_enc - coarse[assign[s:e]])
+        return [
+            kmeans_predict(
+                resid_b[:, m_i * sub_d : (m_i + 1) * sub_d], cb_j[m_i]
+            )
+            for m_i in range(m_subvectors)
+        ]
 
-        policy.run(_encode_batch, site="ann_encode")
+    def _finalize_encode(bi, s, e, outs):
+        for m_i, out in enumerate(outs):
+            codes_flat[s:e, m_i] = np.asarray(out).astype(np.uint8)
+
+    _pipelined_run(n, batch_rows, "ann_encode", _dispatch_encode,
+                   _finalize_encode)
 
     cell_ids = flat["cell_ids"]
     # size codes from the BUILT index, not the requested nlist: the IVF build
@@ -344,38 +493,39 @@ def streaming_ivfflat_search(
 
     out_d = np.full((nq, k_eff), np.inf, np.float32)
     out_i = np.full((nq, k_eff), -1, np.int64)
-    policy = RetryPolicy.from_config()
-    for bi, s in enumerate(range(0, nq, block)):
-        e = min(s + block, nq)
 
-        def _search_block(s=s, e=e, bi=bi):
-            fault_point("ann_search", batch=bi)
-            qb = jnp.asarray(np.ascontiguousarray(Q[s:e], dtype=np.float32))
-            if probe_fused:
-                from .pallas_select import fused_probe
+    def _dispatch_search(bi, s, e):
+        qb = jnp.asarray(np.ascontiguousarray(Q[s:e], dtype=np.float32))
+        if probe_fused:
+            from .pallas_select import fused_probe
 
-                probe = np.asarray(
-                    fused_probe(qb, centers_j, nprobe, center_norms=cn_j)
-                )  # (bq, nprobe) — bit-identical to the exact probe
-            else:
-                probe = np.asarray(
-                    _probe_cells(qb, centers_j, nprobe, cn_j)
-                )  # (bq, nprobe)
-            # the host gather IS the out-of-core page-in
-            probed_items = jnp.asarray(cells[probe])
-            probed_ids = jnp.asarray(cell_ids[probe])
-            # span covers the fused scan+select kernel — named for what it
-            # times (the standalone `knn.select`/`knn.rerank` spans are
-            # reserved for separately-dispatched selection/re-rank programs)
-            with obs_span("ann.scan_select", {"start": s, "rows": e - s}):
-                dists, ids = _scan_probed(
-                    qb, probed_items, probed_ids, k_eff, strategy, tile, rt
-                )
-            out_d[s:e] = np.asarray(dists)
-            out_i[s:e] = np.asarray(ids)
+            probe = np.asarray(
+                fused_probe(qb, centers_j, nprobe, center_norms=cn_j)
+            )  # (bq, nprobe) — bit-identical to the exact probe
+        else:
+            probe = np.asarray(
+                _probe_cells(qb, centers_j, nprobe, cn_j)
+            )  # (bq, nprobe)
+        # the host gather IS the out-of-core page-in
+        probed_items = jnp.asarray(cells[probe])
+        probed_ids = jnp.asarray(cell_ids[probe])
+        # span covers the fused scan+select kernel dispatch — named for what
+        # it times (the standalone `knn.select`/`knn.rerank` spans are
+        # reserved for separately-dispatched selection/re-rank programs)
+        with obs_span("ann.scan_select", {"start": s, "rows": e - s}):
+            return _scan_probed(
+                qb, probed_items, probed_ids, k_eff, strategy, tile, rt
+            )
 
-        # per-block retry: each block only writes out_d/out_i[s:e] (idempotent)
-        policy.run(_search_block, site="ann_search")
+    def _finalize_search(bi, s, e, out):
+        dists, ids = out
+        out_d[s:e] = np.asarray(dists)
+        out_i[s:e] = np.asarray(ids)
+
+    # pipelined per-block retry: each block only writes out_d/out_i[s:e]
+    # (idempotent); the host gather/page-in of block i+1 overlaps the device
+    # scan of block i
+    _pipelined_run(nq, block, "ann_search", _dispatch_search, _finalize_search)
     return out_d, out_i
 
 
@@ -408,24 +558,23 @@ def streaming_pq_refine(
     out_i = np.empty((nq, k_eff), np.int64)
     cand_pos = np.maximum(np.asarray(cand_ids_flat), 0)
     cand_ids = np.asarray(cand_item_ids)
-    policy = RetryPolicy.from_config()
-    for bi, s in enumerate(range(0, nq, block)):
-        e = min(s + block, nq)
 
-        def _refine_block(s=s, e=e, bi=bi):
-            fault_point("ann_search", batch=bi)
-            vecs = jnp.asarray(flat[cand_pos[s:e]])  # the host page-in
-            with obs_span("knn.rerank", {"start": s, "rows": e - s}):
-                d_b, i_b = _refine_exact_tile(
-                    jnp.asarray(np.ascontiguousarray(Q[s:e], np.float32)),
-                    vecs,
-                    jnp.asarray(cand_ids[s:e]),
-                    k_eff,
-                )
-            out_d[s:e] = np.asarray(d_b)
-            out_i[s:e] = np.asarray(i_b)
+    def _dispatch_refine(bi, s, e):
+        vecs = jnp.asarray(flat[cand_pos[s:e]])  # the host page-in
+        with obs_span("knn.rerank", {"start": s, "rows": e - s}):
+            return _refine_exact_tile(
+                jnp.asarray(np.ascontiguousarray(Q[s:e], np.float32)),
+                vecs,
+                jnp.asarray(cand_ids[s:e]),
+                k_eff,
+            )
 
-        # per-block retry (idempotent out_d/out_i[s:e] writes), same site as
-        # the paged IVF search — both are search-phase page-ins
-        policy.run(_refine_block, site="ann_search")
+    def _finalize_refine(bi, s, e, out):
+        d_b, i_b = out
+        out_d[s:e] = np.asarray(d_b)
+        out_i[s:e] = np.asarray(i_b)
+
+    # pipelined per-block retry (idempotent out_d/out_i[s:e] writes), same
+    # site as the paged IVF search — both are search-phase page-ins
+    _pipelined_run(nq, block, "ann_search", _dispatch_refine, _finalize_refine)
     return out_d, out_i

@@ -174,6 +174,19 @@ class DeviceBatchCache:
         _obs.gauge_inc("cache.bytes_resident", nbytes)
         return True
 
+    def replace(self, stream_key: Any, batch_index: int, batch: tuple) -> bool:
+        """Swap one entry's tuple in place, PRESERVING its pin counts — the
+        serving plane's weight refresh (§7b) runs while other batches may
+        hold pins on the same stream; a drop_stream + put would pop the pin
+        bookkeeping and leave the fresh weights evictable mid-batch."""
+        key = (stream_key, batch_index)
+        old = self._entries.pop(key, None)
+        if old is not None:
+            _, old_bytes = old
+            self.bytes_resident -= old_bytes
+            _obs.gauge_dec("cache.bytes_resident", old_bytes)
+        return self.put(stream_key, batch_index, batch)
+
     def _evict(self, entry_key: _EntryKey) -> None:
         _, nbytes = self._entries.pop(entry_key)
         self.bytes_resident -= nbytes

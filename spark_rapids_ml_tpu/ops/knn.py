@@ -451,6 +451,11 @@ def layout_cells(
     gathered = Xh[sorted_rows]
     if normalize:
         gathered = normalize_rows_or_raise(gathered)
+    elif gathered.dtype != np.float32:
+        # cast inside the gather temp that already exists: callers hand Xh in
+        # its source dtype (the streamed build no longer pre-converts the
+        # whole dataset — that was a second full-dense host copy)
+        gathered = gathered.astype(np.float32)
     cells[sorted_cells, within] = gathered
     cell_ids[sorted_cells, within] = sorted_rows
     return cells, cell_ids, cell_sizes.astype(np.int32)

@@ -36,7 +36,9 @@ from .http import (
     MOUNT_PREFIX,
     ServingRun,
     get_registry,
+    mutate_model,
     predict,
+    refresh_model,
     register_model,
     serving_address,
     serving_summary,
@@ -60,6 +62,8 @@ __all__ = [
     "get_registry",
     "pad_to_bucket",
     "predict",
+    "mutate_model",
+    "refresh_model",
     "register_model",
     "serving_address",
     "serving_summary",

@@ -84,6 +84,19 @@ def unregister_model(name: str) -> bool:
     return reg.unregister(name) if reg is not None else False
 
 
+def refresh_model(name: str) -> Dict[str, Any]:
+    """Re-sync a served model's HBM weights after an in-place mutation (the
+    ANN lifecycle's incremental add/delete, docs/design.md §7b)."""
+    return get_registry().refresh_weights(name)
+
+
+def mutate_model(name: str, fn) -> Dict[str, Any]:
+    """Apply `fn(model)` to a LIVE served model under its execution lock and
+    refresh its HBM weights — the race-free way to drive incremental
+    add/delete against a model that is actively serving (§7b)."""
+    return get_registry().mutate(name, fn)
+
+
 def predict(name: str, X: np.ndarray,
             timeout: Optional[float] = None) -> Dict[str, np.ndarray]:
     return get_registry().predict(name, X, timeout=timeout)
@@ -272,6 +285,8 @@ __all__: List[str] = [
     "ServingRun",
     "get_registry",
     "predict",
+    "mutate_model",
+    "refresh_model",
     "register_model",
     "serving_address",
     "serving_summary",

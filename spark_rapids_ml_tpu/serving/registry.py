@@ -81,6 +81,11 @@ class _ServedModel:
         self.warm: set = set()
         self.registered_ts = time.time()
         self.batcher: Optional[MicroBatcher] = None
+        # serializes the dispatcher's install->predict->restore window against
+        # model mutation + weight refresh (§7b): an add/delete landing while
+        # device arrays are installed would either raise (read-only views) or
+        # be silently stomped by the restore
+        self.exec_lock = threading.Lock()
 
 
 class ModelRegistry:
@@ -244,6 +249,65 @@ class ModelRegistry:
         with self._cache_lock:
             return self._cache.contains(entry.cache_key, 0)
 
+    def refresh_weights(self, name: str) -> Dict[str, Any]:
+        """Re-sync a served model's HBM weights after an in-place mutation
+        (the ANN lifecycle's incremental add/delete, docs/design.md §7b): the
+        host attribute dict is re-snapshotted and the cached device tuple is
+        dropped, so the NEXT batch re-uploads current weights. One upload, no
+        dispatcher restart — and because the incremental tier mutates within
+        a BUCKETED geometry, the refreshed weights keep every operand shape,
+        so no new executable is compiled and no re-warm is needed. Counted as
+        `serving.weight_refreshes{model=}`. Returns the model's stats view."""
+        import jax.numpy as jnp
+
+        entry = self._entry(name)
+        # exec_lock: the re-derive/re-snapshot must not interleave with a
+        # dispatcher batch's install->restore (a batch could otherwise zip a
+        # refreshed attr_names against a stale device tuple)
+        with entry.exec_lock:
+            # re-derive the device attr set, not just the values: a mutation
+            # can INTRODUCE device operands (enable_incremental/delete_items
+            # create item_valid) that registration never saw — freezing
+            # attr_names would leave the new mask streaming host->device on
+            # every batch
+            entry.attr_names = tuple(
+                n for n in entry.model._serving_device_attrs()
+                if n in entry.model._model_attributes
+                and entry.model._model_attributes[n] is not None
+            )
+            entry.host_attrs = {
+                n: entry.model._model_attributes[n] for n in entry.attr_names
+            }
+            entry.nbytes = int(sum(
+                int(getattr(v, "nbytes", 0))
+                for v in entry.host_attrs.values()
+            ))
+            with self._cache_lock:
+                # replace(), not drop_stream(): in-flight batches may hold
+                # pins on this stream — the swap keeps their pin counts, so
+                # the fresh weights stay eviction-proof mid-batch. A refresh
+                # is neither an eviction-driven reload nor a budget-starved
+                # weight stream — it gets its own counter.
+                tup = tuple(
+                    jnp.asarray(entry.host_attrs[n]) for n in entry.attr_names
+                )
+                entry.uploads += 1
+                entry.was_cached = self._cache.replace(entry.cache_key, 0, tup)
+        counter_inc("serving.weight_refreshes", 1, model=name)
+        return self.stats(name)
+
+    def mutate(self, name: str, fn) -> Dict[str, Any]:
+        """Apply an in-place mutation to a LIVE served model safely:
+        `fn(model)` runs under the entry's execution lock (no dispatcher
+        batch is mid-install), then the HBM weights refresh. THE supported
+        way to drive the §7b incremental add/delete APIs against a model
+        that is actively serving — calling model.add_items() directly on a
+        served model races the dispatcher's install/restore window."""
+        entry = self._entry(name)
+        with entry.exec_lock:
+            fn(entry.model)
+        return self.refresh_weights(name)
+
     def _predict_padded(self, entry: _ServedModel,
                         stage: np.ndarray) -> Dict[str, np.ndarray]:
         """Run one padded bucket through the model's predict path with the
@@ -254,21 +318,25 @@ class ModelRegistry:
             self._cache.pin(entry.cache_key)
             tup = self._ensure_resident(entry)
         try:
-            saved = {
-                n: entry.model._model_attributes[n] for n in entry.attr_names
-            }
-            entry.model._model_attributes.update(
-                zip(entry.attr_names, tup)
-            )
-            try:
-                # no nested TransformRun per batch (the ServingRun is the
-                # scope; predict_dispatch counters/spans still fan out), and
-                # the bucket-table signatures are storm-exempt — a finite
-                # bucket set is the fix the sentinel recommends
-                with suppress_transform_runs(), bucketed_signatures():
-                    outputs = entry.model._serving_predict(stage)
-            finally:
-                entry.model._model_attributes.update(saved)
+            # exec_lock: no mutation (registry.mutate / refresh_weights) may
+            # interleave with the install->predict->restore window below
+            with entry.exec_lock:
+                saved = {
+                    n: entry.model._model_attributes[n]
+                    for n in entry.attr_names
+                }
+                entry.model._model_attributes.update(
+                    zip(entry.attr_names, tup)
+                )
+                try:
+                    # no nested TransformRun per batch (the ServingRun is the
+                    # scope; predict_dispatch counters/spans still fan out),
+                    # and the bucket-table signatures are storm-exempt — a
+                    # finite bucket set is the fix the sentinel recommends
+                    with suppress_transform_runs(), bucketed_signatures():
+                        outputs = entry.model._serving_predict(stage)
+                finally:
+                    entry.model._model_attributes.update(saved)
             return {k: np.asarray(v) for k, v in outputs.items()}
         finally:
             with self._cache_lock:
