@@ -50,6 +50,7 @@ UNITS = [
     "cache",
     "telemetry_overhead",
     "serving_qps",
+    "serving_failover",
     "large_k",
     "autotune",
     "knn",

@@ -1368,6 +1368,163 @@ def bench_serving_qps(ctx) -> Dict:
         registry.close()
 
 
+# -------------------------------------------------------- serving_failover
+
+
+def bench_serving_failover(ctx) -> Dict:
+    """Fault-tolerant serving fleet under a mid-run replica kill
+    (serving/fleet.py, docs/design.md §7c). Two closed-loop windows against a
+    2-replica fleet: a no-fault baseline, then a window during which a
+    deterministic chaos kill (`serving_execute:replica=0:action=kill`) takes
+    replica 0 down mid-window — the fleet must replay the stranded requests
+    onto the survivor, restart the dead replica from the registry's pinned
+    weights, and rejoin it with ZERO new compiles. Emits the three gated
+    contract keys (ci/bench_check.py): `serving_failover_failed_requests`
+    (must be 0 — failover means no client ever sees the kill),
+    `serving_failover_rejoin_compiles` (must be 0 — recovery pre-warm replays
+    through the process-wide compiled-kernel cache), and
+    `serving_failover_qps_frac` (fault-window qps over baseline qps; must
+    hold >= 0.8 — losing half the fleet for half a window costs tail latency,
+    not live throughput)."""
+    import threading
+
+    import pandas as pd
+
+    from spark_rapids_ml_tpu import config as _srml_config
+    from spark_rapids_ml_tpu import serving
+    from spark_rapids_ml_tpu.clustering import KMeans
+    from spark_rapids_ml_tpu.profiling import counter_totals
+    from spark_rapids_ml_tpu.reliability import reset_chaos
+
+    on_tpu = ctx["on_tpu"]
+    n_fit, d = ctx["serving_shape"]
+    clients = 6 if on_tpu else 4
+    window_s = 5.0 if on_tpu else 2.5
+    max_req = 128 if on_tpu else 48
+
+    rng = np.random.default_rng(13)
+    centers = rng.normal(0, 5, (8, d)).astype(np.float32)
+    Xh = (centers[rng.integers(0, 8, n_fit)]
+          + rng.normal(0, 1, (n_fit, d))).astype(np.float32)
+    model = KMeans(k=8, maxIter=5, seed=1).fit(
+        pd.DataFrame({"features": list(Xh[:4096])})
+    )
+
+    heartbeat = ctx.get("heartbeat") or (lambda tag: None)
+    _srml_config.set("serving.replicas", 2)
+    _srml_config.set("serving.heartbeat_timeout_s", 0.5)
+    registry = serving.ModelRegistry()
+    try:
+        registry.register("km", model)
+        heartbeat("failover_prewarm")
+
+        def window(duration_s: float, mid_kill: bool):
+            """One closed-loop window; returns (latencies, failures). With
+            `mid_kill`, the chaos spec arms at the half-window mark, killing
+            exactly one batch of replica 0 on its next dispatch."""
+            stop_at = time.perf_counter() + duration_s
+            lock = threading.Lock()
+            lats: List[float] = []
+            fails: List[str] = []
+
+            def client(seed: int) -> None:
+                r = np.random.default_rng(seed)
+                local: List[float] = []
+                while time.perf_counter() < stop_at:
+                    rows = int(r.integers(1, max_req + 1))
+                    off = int(r.integers(0, n_fit - rows))
+                    t = time.perf_counter()
+                    try:
+                        out = registry.predict(
+                            "km", Xh[off: off + rows], timeout=15.0
+                        )
+                        if out["prediction"].shape != (rows,):
+                            raise RuntimeError("row-count mismatch")
+                    except Exception as e:
+                        with lock:
+                            fails.append(
+                                f"{type(e).__name__}: {str(e)[:80]}"
+                            )
+                        return
+                    local.append(time.perf_counter() - t)
+                with lock:
+                    lats.extend(local)
+
+            threads = [threading.Thread(target=client, args=(seed,))
+                       for seed in range(clients)]
+            [t.start() for t in threads]
+            if mid_kill:
+                time.sleep(duration_s / 2.0)
+                _srml_config.set(
+                    "reliability.chaos_spec",
+                    "serving_execute:replica=0:action=kill",
+                )
+                reset_chaos()
+            [t.join() for t in threads]
+            return lats, fails
+
+        window(0.5, mid_kill=False)  # untimed warm lap (thread ramp)
+        lat0, fails0 = window(window_s, mid_kill=False)
+        heartbeat("failover_baseline")
+
+        compiles_before = {
+            k: v for k, v in counter_totals().items()
+            if k.startswith("device.compile{")
+        }
+        lat1, fails1 = window(window_s, mid_kill=True)
+        _srml_config.unset("reliability.chaos_spec")
+        reset_chaos()
+        heartbeat("failover_fault_window")
+
+        # the dead replica must restart and rejoin — with zero new compiles
+        rejoin_deadline = time.perf_counter() + 10.0
+        st = registry.stats("km")
+        while time.perf_counter() < rejoin_deadline:
+            st = registry.stats("km")
+            if all(r["state"] == "LIVE" for r in st["replicas"]):
+                break
+            time.sleep(0.05)
+        compiles_after = {
+            k: v for k, v in counter_totals().items()
+            if k.startswith("device.compile{")
+        }
+        rejoin_compiles = sum(
+            compiles_after.get(k, 0) - compiles_before.get(k, 0)
+            for k in compiles_after
+        )
+        restarts = sum(int(r["restarts"]) for r in st["replicas"])
+        states = [r["state"] for r in st["replicas"]]
+
+        qps0 = len(lat0) / window_s
+        qps1 = len(lat1) / window_s
+        def p99(xs):
+            if not xs:
+                return None
+            return round(float(np.percentile(np.asarray(xs) * 1e3, 99)), 3)
+        return {
+            "serving_failover_replicas": 2,
+            "serving_failover_requests": int(len(lat1)),
+            "serving_failover_failed_requests": int(len(fails0) + len(fails1)),
+            "serving_failover_fail_samples": (fails0 + fails1)[:3],
+            "serving_failover_restarts": int(restarts),
+            "serving_failover_states": states,
+            "serving_failover_rejoin_compiles": int(rejoin_compiles),
+            "serving_failover_qps_nofault": round(qps0, 1),
+            "serving_failover_qps": round(qps1, 1),
+            "serving_failover_qps_frac": (
+                round(qps1 / qps0, 4) if qps0 > 0 else None
+            ),
+            "serving_failover_nofault_p99_ms": p99(lat0),
+            "serving_failover_p99_ms": p99(lat1),
+        }
+    finally:
+        registry.close()
+        _srml_config.unset("reliability.chaos_spec")
+        _srml_config.unset("serving.replicas")
+        _srml_config.unset("serving.heartbeat_timeout_s")
+        reset_chaos()
+
+
 # ----------------------------------------------------------------- large_k
 
 
@@ -1624,6 +1781,7 @@ FAMILIES: List = [
     ("cache", bench_cache),
     ("telemetry_overhead", bench_telemetry_overhead),
     ("serving_qps", bench_serving_qps),
+    ("serving_failover", bench_serving_failover),
     ("large_k", bench_large_k),
     ("autotune", bench_autotune),
     ("knn", bench_knn),

@@ -62,13 +62,26 @@ _COMM_RE = re.compile(
 _OVERHEAD_RE = re.compile(
     r'\\?"(\w+_overhead_pct)\\?"\s*:\s*(-?[0-9]+(?:\.[0-9]+)?)'
 )
-# serving plane (`serving_p99_ms`, serving/ design §7): tail latency of the
-# sustained-QPS closed-loop scenario — lower-is-better like wall times, but
-# behind an ABSOLUTE noise floor (see _NOISE_FLOORS: single-digit-ms CPU
-# tails are scheduler jitter; ratio-judging two jitter samples is noise)
+# serving plane (`serving_p99_ms` / `serving_failover_p99_ms`, serving/
+# design §7/§7c): tail latency of the closed-loop scenarios — lower-is-better
+# like wall times, but behind an ABSOLUTE noise floor (see _NOISE_FLOORS:
+# single-digit-ms CPU tails are scheduler jitter; ratio-judging two jitter
+# samples is noise)
 _SERVING_P99_RE = re.compile(
-    r'\\?"(serving_p99_ms)\\?"\s*:\s*([0-9]+(?:\.[0-9]+)?(?:[eE][+-]?[0-9]+)?)'
+    r'\\?"(serving\w*_p99_ms)\\?"\s*:\s*'
+    r"([0-9]+(?:\.[0-9]+)?(?:[eE][+-]?[0-9]+)?)"
 )
+# failover-fleet CONTRACT keys (serving/fleet.py, §7c): judged against
+# absolute invariants on the NEWEST artifact carrying them — a mid-run
+# replica kill must lose zero requests, the restarted replica must rejoin
+# with zero compiles, and fault-window throughput must hold >= the frac
+# floor of the no-fault baseline. Never ratio-judged: the contract either
+# holds or the fleet is broken.
+_FAILOVER_RE = re.compile(
+    r'\\?"(serving_failover_(?:failed_requests|rejoin_compiles|qps_frac))'
+    r'\\?"\s*:\s*(-?[0-9]+(?:\.[0-9]+)?(?:[eE][+-]?[0-9]+)?)'
+)
+DEFAULT_FAILOVER_QPS_FRAC_MIN = 0.8
 # autotune plane (`autotune_speedup`, docs/design.md §6i): tuned-vs-default
 # ratio of the better-tuned unit — HIGHER is better like mfu, behind an
 # absolute noise floor (both rounds hovering at ~1.0 means the table holds
@@ -142,6 +155,7 @@ def extract(path: str) -> Dict[str, object]:
     scenarios: Dict[str, float] = {}
     overheads: Dict[str, float] = {}
     overhead_noise: Dict[str, float] = {}
+    failover: Dict[str, float] = {}
     platform: Optional[str] = None
     try:
         doc = json.loads(raw)
@@ -160,8 +174,13 @@ def extract(path: str) -> Dict[str, object]:
             v, (int, float)
         ):
             scenarios[k] = float(v)  # comm plane: lower-is-better default
-        elif k == "serving_p99_ms" and isinstance(v, (int, float)):
+        elif k.startswith("serving") and k.endswith("_p99_ms") \
+                and isinstance(v, (int, float)):
             scenarios[k] = float(v)  # serving tail: lower-is-better + floor
+        elif k.startswith("serving_failover_") and k.split("_", 2)[-1] in (
+            "failed_requests", "rejoin_compiles", "qps_frac"
+        ) and isinstance(v, (int, float)):
+            failover[k] = float(v)  # absolute contract keys, never ratios
         elif k.endswith("_speedup") and isinstance(v, (int, float)):
             scenarios[k] = float(v)  # autotune plane: higher-is-better + floor
         elif k.endswith("_rows_per_s") and isinstance(v, (int, float)):
@@ -191,6 +210,8 @@ def extract(path: str) -> Dict[str, object]:
             scenarios[name] = float(v)
         for name, v in _SERVING_P99_RE.findall(text):
             scenarios[name] = float(v)
+        for name, v in _FAILOVER_RE.findall(text):
+            failover[name] = float(v)
         for name, v in _SPEEDUP_RE.findall(text):
             scenarios[name] = float(v)
         for name, v in _ROWS_PER_S_RE.findall(text):
@@ -212,6 +233,7 @@ def extract(path: str) -> Dict[str, object]:
         "scenarios": scenarios,
         "overheads": overheads,
         "overhead_noise": overhead_noise,
+        "failover": failover,
     }
 
 
@@ -301,15 +323,64 @@ def check_overheads(artifacts: List[Dict[str, object]],
     return n_over
 
 
-def _verdict(overhead_failures: int) -> int:
-    """Final exit verdict for paths that skipped the wall-time comparison:
-    the log's LAST line must agree with the exit code, so an overhead failure
-    reported pages earlier by check_overheads is restated here."""
-    if overhead_failures:
+def check_failover(artifacts: List[Dict[str, object]],
+                   advisory: bool = False) -> int:
+    """Absolute contract check for the failover-fleet keys (serving/fleet.py,
+    §7c) on the NEWEST artifact carrying them: a mid-run replica kill must
+    lose ZERO requests, the restarted replica must rejoin with ZERO compiles,
+    and fault-window qps must hold >= the frac floor (default 0.8, env
+    SRML_FAILOVER_QPS_FRAC_MIN) of the no-fault baseline. One artifact
+    suffices — the contract either holds or the fleet is broken."""
+    frac_min = float(os.environ.get(
+        "SRML_FAILOVER_QPS_FRAC_MIN", str(DEFAULT_FAILOVER_QPS_FRAC_MIN)
+    ))
+    with_failover = [a for a in artifacts if a.get("failover")]
+    if not with_failover:
+        return 0
+    newest = with_failover[-1]
+    fo: Dict[str, float] = newest["failover"]  # type: ignore[assignment]
+    n_bad = 0
+    checks = (
+        ("serving_failover_failed_requests", lambda v: v == 0, "== 0"),
+        ("serving_failover_rejoin_compiles", lambda v: v == 0, "== 0"),
+        ("serving_failover_qps_frac", lambda v: v >= frac_min,
+         f">= {frac_min:g}"),
+    )
+    for name, ok_fn, want in checks:
+        v = fo.get(name)
+        if v is None:
+            continue  # a truncated tail may carry only some of the keys
+        ok = ok_fn(v)
+        n_bad += int(not ok)
         print(
-            f"bench_check: FAIL — {overhead_failures} telemetry-overhead "
-            "key(s) over budget (see overhead lines above)"
+            f"bench_check: {name} = {v:g} (want {want}, {newest['name']})"
+            + ("  ok" if ok else "  CONTRACT VIOLATED")
         )
+    if n_bad and advisory:
+        print(
+            f"bench_check: ADVISORY — {n_bad} failover contract key(s) "
+            "violated; not failing (SRML_BENCH_CHECK_ADVISORY=1; set 0 to "
+            "enforce)"
+        )
+        return 0
+    return n_bad
+
+
+def _verdict(overhead_failures: int, failover_failures: int = 0) -> int:
+    """Final exit verdict for paths that skipped the wall-time comparison:
+    the log's LAST line must agree with the exit code, so an overhead or
+    failover failure reported pages earlier is restated here."""
+    if overhead_failures or failover_failures:
+        parts = []
+        if overhead_failures:
+            parts.append(
+                f"{overhead_failures} telemetry-overhead key(s) over budget"
+            )
+        if failover_failures:
+            parts.append(
+                f"{failover_failures} failover contract key(s) violated"
+            )
+        print(f"bench_check: FAIL — {'; '.join(parts)} (see lines above)")
         return 1
     print("bench_check: OK")
     return 0
@@ -319,6 +390,7 @@ def check(root: str, threshold: float = DEFAULT_THRESHOLD,
           advisory: bool = False) -> int:
     artifacts = [extract(p) for p in discover(root)]
     overhead_failures = check_overheads(artifacts, advisory=advisory)
+    failover_failures = check_failover(artifacts, advisory=advisory)
     artifacts = [a for a in artifacts if a["scenarios"]]
     if len(artifacts) < 2:
         print(
@@ -326,7 +398,7 @@ def check(root: str, threshold: float = DEFAULT_THRESHOLD,
             f"wall times ({len(artifacts)} found) — skipping wall-time "
             "comparison."
         )
-        return _verdict(overhead_failures)
+        return _verdict(overhead_failures, failover_failures)
     old, new = artifacts[-2], artifacts[-1]
     print(
         f"bench_check: comparing {old['name']} (platform={old['platform']}) "
@@ -339,13 +411,13 @@ def check(root: str, threshold: float = DEFAULT_THRESHOLD,
             "across backends (tunnel health, not code); skipping wall-time "
             "comparison."
         )
-        return _verdict(overhead_failures)
+        return _verdict(overhead_failures, failover_failures)
     rows = compare(old, new, threshold)
     print(render_table(rows))
     regressed = [r for r in rows if r["verdict"] == "REGRESSED"]
     if not regressed:
         print("bench_check: no scenario regressed beyond the threshold")
-        return _verdict(overhead_failures)
+        return _verdict(overhead_failures, failover_failures)
     names = ", ".join(r["scenario"] for r in regressed)
     if advisory:
         print(

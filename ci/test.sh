@@ -397,6 +397,95 @@ print(f"SERVING SMOKE OK: 180 concurrent HTTP requests exact, 0 warm-path "
       f"occupancy={summary['km']['batch_occupancy']}, no leaks")
 PY
   rm -rf "$SRML_SERVING_SMOKE_DIR"
+  # serving chaos smoke (docs/design.md §7c): unit tests first, then the
+  # failover acceptance end-to-end — a 2-replica fleet takes a DETERMINISTIC
+  # chaos kill (spec-string grammar, times=1) in the middle of a request
+  # window and must show ZERO failed client requests (queued + in-flight work
+  # replays onto the survivor), the dead replica restarting from the
+  # registry's pinned weights and rejoining LIVE with ZERO new
+  # device.compile entries, and bounded p99 inflation versus the no-fault
+  # window.
+  python -m pytest tests/test_serving_fleet.py -q
+  python - <<'PY'
+import threading, time
+import numpy as np, pandas as pd
+from spark_rapids_ml_tpu import config
+from spark_rapids_ml_tpu.clustering import KMeans
+from spark_rapids_ml_tpu.profiling import counter_totals
+from spark_rapids_ml_tpu.reliability import reset_chaos
+from spark_rapids_ml_tpu.serving import ModelRegistry
+from spark_rapids_ml_tpu.serving.fleet import LIVE
+
+rng = np.random.default_rng(0)
+X = np.concatenate(
+    [rng.normal(-3, 1, (128, 8)), rng.normal(3, 1, (128, 8))]
+).astype(np.float32)
+km = KMeans(k=2, maxIter=6, seed=5).fit(pd.DataFrame({"features": list(X)}))
+
+config.set("serving.replicas", 2)
+config.set("serving.heartbeat_timeout_s", 0.3)
+registry = ModelRegistry()
+registry.register("km", km)  # 2 replicas, each HBM-uploaded + pre-warmed
+fleet = registry._models["km"].fleet
+assert fleet is not None and fleet.live_count() == 2
+ref = km._serving_predict(X)["prediction"]
+compiles = lambda: {k: v for k, v in counter_totals().items()
+                    if k.startswith("device.compile{")}
+
+failed, lat_lock = [], threading.Lock()
+
+def window(tag):
+    lats = []
+    def client(seed):
+        r = np.random.default_rng(seed)
+        for i in range(20):
+            n = int(r.integers(1, 48)); off = int(r.integers(0, 256 - n))
+            t0 = time.perf_counter()
+            try:
+                out = registry.predict("km", X[off:off + n], timeout=20.0)
+                assert np.array_equal(out["prediction"], ref[off:off + n])
+            except Exception as e:
+                with lat_lock:
+                    failed.append((tag, seed, i, type(e).__name__, str(e)))
+                continue
+            with lat_lock:
+                lats.append(time.perf_counter() - t0)
+    threads = [threading.Thread(target=client, args=(s,)) for s in range(4)]
+    [t.start() for t in threads]; [t.join() for t in threads]
+    lats.sort()
+    return lats[min(len(lats) - 1, int(0.99 * len(lats)))]
+
+p99_nofault = window("baseline")
+c0 = compiles()
+# deterministic incident: replica 0's NEXT dispatched batch is killed
+config.set("reliability.chaos_spec", "serving_execute:replica=0:action=kill")
+reset_chaos()
+p99_fault = window("fault")
+config.unset("reliability.chaos_spec"); reset_chaos()
+assert not failed, f"failover dropped requests: {failed[:5]}"
+deadline = time.monotonic() + 15.0
+while time.monotonic() < deadline and not (
+    fleet.live_count() == 2 and all(r.state == LIVE for r in fleet._replicas)
+):
+    time.sleep(0.05)
+assert fleet.live_count() == 2, registry.stats("km")["replicas"]
+assert sum(r.restarts for r in fleet._replicas) >= 1, "no replica restarted"
+for i in range(8):  # post-rejoin traffic lands on warm executables
+    out = registry.predict("km", X[: 4 + i], timeout=20.0)
+    assert np.array_equal(out["prediction"], ref[: 4 + i])
+new = {k: v - c0.get(k, 0) for k, v in compiles().items() if v != c0.get(k, 0)}
+assert not new, f"replica recovery compiled: {new}"
+bound = max(0.5, 20 * p99_nofault)
+assert p99_fault <= bound, (
+    f"p99 inflated past bound under failover: {p99_fault:.3f}s "
+    f"(no-fault {p99_nofault:.3f}s, bound {bound:.3f}s)"
+)
+registry.close()
+config.unset("serving.replicas"); config.unset("serving.heartbeat_timeout_s")
+print(f"CHAOS SMOKE OK: mid-run replica kill, 160/160 requests exact, "
+      f"restart+rejoin with 0 compiles, p99 {p99_nofault*1e3:.1f}ms -> "
+      f"{p99_fault*1e3:.1f}ms (bound {bound*1e3:.0f}ms)")
+PY
   # ann-lifecycle smoke (docs/design.md §7b): unit tests first, then the
   # acceptance end-to-end — a pipelined streamed build whose exported run
   # report proves per-batch overlap telemetry, save through the index store,

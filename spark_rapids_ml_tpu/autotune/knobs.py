@@ -35,7 +35,7 @@ _PRECISION_VALUES = ("float32", "bfloat16", "int8")
 @dataclass(frozen=True)
 class Knob:
     name: str
-    kind: str  # int | str | int_pair
+    kind: str  # int | float | str | int_pair
     description: str
     config_key: Optional[str] = None  # config key that PINS the knob
     # config values that mean "choose for me" rather than a real pin: a
@@ -111,6 +111,20 @@ KNOBS: Dict[str, Knob] = {
             "smallest serving padding bucket (serving/batcher.py::bucket_rows)",
             config_key="serving.bucket_min_rows", dims=(),
             grid=(8, 16, 32, 64),
+        ),
+        Knob(
+            "serving.replicas", "int",
+            "dispatcher replicas per served model "
+            "(serving/fleet.py::resolve_replicas)",
+            config_key="serving.replicas", auto_values=(0,), dims=(),
+            grid=(1, 2, 4),
+        ),
+        Knob(
+            "serving.hedge_after_p99_frac", "float",
+            "queue-wait fraction of the observed p99 beyond which a queued "
+            "request hedges to a second replica (serving/fleet.py; 0 off)",
+            config_key="serving.hedge_after_p99_frac", dims=(),
+            grid=(1.0, 1.5, 2.0),
         ),
         Knob(
             "cache.budget_bytes", "int",
@@ -231,6 +245,9 @@ def _coerce_value(knob: Knob, raw: Any) -> Optional[Any]:
     try:
         if knob.kind == "int":
             v = int(raw)
+            return v if v > 0 else None
+        if knob.kind == "float":
+            v = float(raw)
             return v if v > 0 else None
         if knob.kind == "str":
             v = str(raw)

@@ -97,6 +97,7 @@ _DEFAULTS: Dict[str, Any] = {
     "reliability.deadline_s": None,         # per-stage wall-clock deadline
     "reliability.checkpoint_batches": 16,   # streamed-fit snapshot cadence
     "reliability.fault_spec": "",           # fault grammar, reliability/faults.py
+    "reliability.chaos_spec": "",           # replica chaos grammar, reliability/chaos.py
     "reliability.degrade_to_collect": True, # barrier fit failure -> collect mode
     # observability subsystem (observability/): typed metrics registry, per-fit
     # FitRun trace trees (model.fit_report_), driver-side aggregation of
@@ -192,6 +193,17 @@ _DEFAULTS: Dict[str, Any] = {
     # per-request wall-clock budget the HTTP handler waits on a future before
     # answering 504 (the request may still complete; its slot is not replayed)
     "serving.request_timeout_s": 30.0,
+    # fault-tolerant serving fleet (serving/fleet.py + serving/router.py,
+    # docs/design.md §7c). replicas: dispatcher replicas per served model
+    # (0 = auto: tuning table, else 1 — the single-dispatcher plane);
+    # heartbeat_timeout_s: how long a replica may go without a dispatcher
+    # heartbeat before the health monitor marks it DEAD and replays its queue
+    # onto survivors; hedge_after_p99_frac: issue a duplicate of a still-
+    # queued request to a second replica once its queue wait exceeds this
+    # fraction of the observed p99 latency (0 disables hedging)
+    "serving.replicas": 0,
+    "serving.hedge_after_p99_frac": 0.0,
+    "serving.heartbeat_timeout_s": 2.0,
     # ANN index lifecycle (ops/ann_streaming.py + ops/ann_lifecycle.py,
     # docs/design.md §7b). build_batch_rows: row-batch geometry of the
     # pipelined out-of-core builds; 0 = auto (tuning table, else
@@ -261,6 +273,7 @@ _ENV_KEYS: Dict[str, str] = {
     "reliability.deadline_s": "SRML_TPU_DEADLINE_S",
     "reliability.checkpoint_batches": "SRML_TPU_CHECKPOINT_BATCHES",
     "reliability.fault_spec": "SRML_TPU_FAULT_SPEC",
+    "reliability.chaos_spec": "SRML_TPU_CHAOS_SPEC",
     "reliability.degrade_to_collect": "SRML_TPU_DEGRADE_TO_COLLECT",
     "observability.enabled": "SRML_TPU_OBSERVABILITY_ENABLED",
     "observability.metrics_dir": "SRML_TPU_METRICS_DIR",
@@ -290,6 +303,9 @@ _ENV_KEYS: Dict[str, str] = {
     "serving.hbm_budget_bytes": "SRML_TPU_SERVING_HBM_BUDGET",
     "serving.queue_depth": "SRML_TPU_SERVING_QUEUE_DEPTH",
     "serving.request_timeout_s": "SRML_TPU_SERVING_REQUEST_TIMEOUT_S",
+    "serving.replicas": "SRML_TPU_SERVING_REPLICAS",
+    "serving.hedge_after_p99_frac": "SRML_TPU_SERVING_HEDGE_AFTER_P99_FRAC",
+    "serving.heartbeat_timeout_s": "SRML_TPU_SERVING_HEARTBEAT_TIMEOUT_S",
     "ann.build_batch_rows": "SRML_TPU_ANN_BUILD_BATCH_ROWS",
     "ann.prefetch_depth": "SRML_TPU_ANN_PREFETCH_DEPTH",
     "ann.list_bucket_rows": "SRML_TPU_ANN_LIST_BUCKET_ROWS",

@@ -51,8 +51,18 @@ _pinned = False  # start_metrics_server() keeps it up across runs
 # /v1/... inference endpoints, serving/http.py) attach their handlers HERE
 # instead of starting a second HTTP server — one socket, one refcounted
 # lifecycle, zero threads when nothing is enabled. A mount handler takes
-# (method, path, body_bytes_or_None) and returns (status_code, json_doc).
+# (method, path, body_bytes_or_None) and returns (status_code, json_doc) or
+# (status_code, json_doc, headers_dict) — the 3-tuple form lets a mount set
+# response headers (the serving plane's `Retry-After` on 429/503 shedding).
 _mounts: dict = {}
+
+# /healthz providers: subsystems with their own liveness story (the serving
+# fleet's per-replica health states) contribute a named section to the
+# /healthz document, so one probe answers both "is the process up" and "who
+# is actually serving". A provider is a zero-arg callable returning a
+# JSON-serializable doc; a provider that raises reports its error in place
+# (liveness probes must never 500 because one subsystem is sick).
+_health_providers: dict = {}
 
 # bound on POST bodies a mount can receive (a predict batch of feature rows
 # is comfortably under this; an unbounded read is a trivial memory DoS)
@@ -69,6 +79,30 @@ def register_mount(prefix: str, handler: Any) -> None:
 def unregister_mount(prefix: str) -> None:
     with _lock:
         _mounts.pop(str(prefix), None)
+
+
+def register_health_provider(name: str, provider: Any) -> None:
+    """Contribute a named section to the /healthz document (e.g. the serving
+    fleet's per-replica health view). Re-registering a name replaces it."""
+    with _lock:
+        _health_providers[str(name)] = provider
+
+
+def unregister_health_provider(name: str) -> None:
+    with _lock:
+        _health_providers.pop(str(name), None)
+
+
+def _health_sections() -> dict:
+    with _lock:
+        providers = dict(_health_providers)
+    out = {}
+    for name, provider in providers.items():
+        try:
+            out[name] = provider()
+        except Exception as e:  # a sick subsystem must not break liveness
+            out[name] = {"error": f"{type(e).__name__}: {e}"}
+    return out
 
 
 def _find_mount(path: str):
@@ -105,31 +139,42 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, format: str, *args: Any) -> None:  # BaseHTTPRequestHandler contract
         pass
 
-    def _send(self, code: int, body: bytes, content_type: str) -> None:
+    def _send(self, code: int, body: bytes, content_type: str,
+              headers: Optional[dict] = None) -> None:
         self.send_response(code)
         self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(str(k), str(v))
         self.end_headers()
         try:
             self.wfile.write(body)
         except (BrokenPipeError, ConnectionResetError):
             pass  # scraper went away mid-write; nothing to clean up
 
-    def _send_json(self, doc: Any, code: int = 200) -> None:
+    def _send_json(self, doc: Any, code: int = 200,
+                   headers: Optional[dict] = None) -> None:
         from .export import _json_fallback
 
         body = json.dumps(doc, default=_json_fallback).encode()
-        self._send(code, body, "application/json")
+        self._send(code, body, "application/json", headers=headers)
 
     def _dispatch_mount(self, method: str, path: str,
                         body: Optional[bytes]) -> bool:
         """Route to a registered path-prefix mount (the serving plane's /v1/
-        endpoints). Returns False when no mount claims the path."""
+        endpoints). Returns False when no mount claims the path. A mount may
+        return (code, doc) or (code, doc, headers) — the latter carries
+        response headers like the shed path's `Retry-After`."""
         handler = _find_mount(path)
         if handler is None:
             return False
-        code, doc = handler(method, path, body)
-        self._send_json(doc, int(code))
+        result = handler(method, path, body)
+        if len(result) == 3:
+            code, doc, headers = result
+        else:
+            code, doc = result
+            headers = None
+        self._send_json(doc, int(code), headers=headers)
         return True
 
     def _read_body(self) -> Optional[bytes]:
@@ -171,14 +216,16 @@ class _Handler(BaseHTTPRequestHandler):
             elif path == "/healthz":
                 from .runs import PROCESS_TOKEN, active_runs
 
-                self._send_json({
+                doc = {
                     "status": "ok",
                     "process": PROCESS_TOKEN,
                     "uptime_s": round(
                         time.monotonic() - self.server.started_monotonic, 3
                     ),
                     "open_runs": len(active_runs()),
-                })
+                }
+                doc.update(_health_sections())
+                self._send_json(doc)
             elif path == "/runs":
                 from .runs import active_runs
 
@@ -368,5 +415,6 @@ def _reset_for_tests() -> None:
         _refs = 0
         _pinned = False
         _mounts.clear()
+        _health_providers.clear()
     if srv is not None:
         srv.close()

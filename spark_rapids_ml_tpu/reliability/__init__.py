@@ -10,6 +10,14 @@
 # is visible, not silent. See docs/design.md "Reliability".
 #
 
+from .chaos import (
+    ChaosSpec,
+    ReplicaKilled,
+    chaos_enabled,
+    chaos_point,
+    parse_chaos_spec,
+    reset_chaos,
+)
 from .checkpoint import resumable_accumulate
 from .faults import (
     DeviceError,
@@ -25,15 +33,21 @@ from .faults import (
 from .policy import RetryPolicy
 
 __all__ = [
+    "ChaosSpec",
     "DeviceError",
     "FaultSpec",
+    "ReplicaKilled",
     "RetryPolicy",
     "StreamBatchError",
+    "chaos_enabled",
+    "chaos_point",
     "fault_point",
     "is_device_error",
     "is_stage_retryable",
     "is_transient",
+    "parse_chaos_spec",
     "parse_fault_spec",
+    "reset_chaos",
     "reset_faults",
     "resumable_accumulate",
 ]

@@ -1,0 +1,243 @@
+#
+# Deterministic replica chaos — the fleet-level extension of the fault
+# harness (reliability/faults.py). `fault_point` raises a chosen exception at
+# a chosen call; a serving FLEET needs richer failure verbs: kill a replica's
+# dispatcher outright, hang it long enough for the heartbeat monitor to
+# declare it dead, or slow it into the hedging cutoff. This module adds those
+# verbs behind the same config-driven, spec-string grammar so a failover test
+# (and the failover bench scenario) replays identically on every run.
+#
+# Grammar (SRML_TPU_CHAOS_SPEC / config "reliability.chaos_spec"):
+#
+#   spec      := clause (';' clause)*
+#   clause    := site (':' field)*
+#   field     := 'replica=' INT   -- fire only on this replica index
+#              | 'batch=' INT     -- fire only at this site-visit ordinal
+#              | 'after=' INT     -- fire at any ordinal >= this one
+#              | 'action=' NAME   -- kill | hang | slow   (default kill)
+#              | 'sleep=' FLOAT   -- hang/slow duration seconds
+#                                    (hang default: 4x serving.heartbeat_
+#                                     timeout_s, so the monitor always fires;
+#                                     slow default: 0.05)
+#              | 'times=' INT     -- firings before the clause exhausts
+#                                    (default 1: one transient incident)
+#
+#   e.g.  SRML_TPU_CHAOS_SPEC="serving_execute:replica=1:after=3:action=kill"
+#         SRML_TPU_CHAOS_SPEC="serving_heartbeat:replica=0:action=hang"
+#         SRML_TPU_CHAOS_SPEC="serving_dispatch:action=slow:sleep=0.02:times=8"
+#
+# Chaos sites planted in the serving fleet (docs/design.md §7c):
+#   serving_dispatch   serving/router.py   request routing (pre-enqueue)
+#   serving_execute    serving/fleet.py    per-replica batch execution
+#   serving_heartbeat  serving/fleet.py    health-monitor heartbeat read
+#
+# The same three names are ALSO `fault_point` sites at the same calls, so the
+# plain fault grammar (raise=/sleep=) composes with the chaos verbs — a test
+# can raise OSError in one replica's execute path while chaos-killing another.
+#
+# `kill` raises ReplicaKilled — the fleet's dispatcher loop treats it (and
+# only it) as replica death rather than a batch failure: the replica leaves
+# rotation, its queue replays onto survivors, and recovery restarts it from
+# the registry's pinned weights. Firing budgets live process-wide keyed by
+# the spec string (exactly like faults.py), reset by tests via reset_chaos().
+#
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+from .. import config as _config
+from .. import profiling
+from ..utils import get_logger
+
+_logger = get_logger("reliability.chaos")
+
+CHAOS_SITES = ("serving_dispatch", "serving_execute", "serving_heartbeat")
+
+_ACTIONS = ("kill", "hang", "slow")
+
+_SLOW_DEFAULT_S = 0.05
+_HANG_HEARTBEAT_MULTIPLE = 4.0
+
+
+class ReplicaKilled(RuntimeError):
+    """A chaos `kill` verb fired: the replica's dispatcher must die (leave
+    rotation, replay its queue), not merely fail one batch. Carries the site
+    and replica index for the failover assertions."""
+
+    def __init__(self, site: str, replica: Optional[int] = None,
+                 batch: Optional[int] = None):
+        super().__init__(
+            f"chaos kill at site '{site}'"
+            + (f" replica {replica}" if replica is not None else "")
+            + (f" batch {batch}" if batch is not None else "")
+        )
+        self.site = site
+        self.replica = replica
+        self.batch = batch
+
+
+@dataclass(frozen=True)
+class ChaosSpec:
+    """One parsed clause of the chaos grammar."""
+
+    site: str
+    action: str = "kill"
+    replica: Optional[int] = None  # None: any replica
+    batch: Optional[int] = None  # fire only at this site-visit ordinal
+    after: Optional[int] = None  # fire at any ordinal >= this one
+    sleep: Optional[float] = None  # hang/slow duration override
+    times: int = 1
+
+
+def parse_chaos_spec(raw: str) -> List[ChaosSpec]:
+    specs: List[ChaosSpec] = []
+    for clause in raw.split(";"):
+        clause = clause.strip()
+        if not clause:
+            continue
+        fields = clause.split(":")
+        site = fields[0].strip()
+        if not site:
+            raise ValueError(f"chaos clause with empty site: {clause!r}")
+        action, replica, batch, after, sleep, times = "kill", None, None, None, None, 1
+        for field in fields[1:]:
+            key, sep, value = field.partition("=")
+            key = key.strip()
+            if not sep:
+                raise ValueError(
+                    f"malformed chaos field {field!r} in {clause!r}"
+                )
+            if key == "replica":
+                replica = int(value)
+            elif key == "batch":
+                batch = int(value)
+            elif key == "after":
+                after = int(value)
+            elif key == "action":
+                if value not in _ACTIONS:
+                    raise ValueError(
+                        f"unknown chaos action {value!r} in {clause!r}; "
+                        f"known: {list(_ACTIONS)}"
+                    )
+                action = value
+            elif key == "sleep":
+                sleep = float(value)
+                if sleep < 0:
+                    raise ValueError(f"negative sleep in chaos clause {clause!r}")
+            elif key == "times":
+                times = int(value)
+            else:
+                raise ValueError(f"unknown chaos field {key!r} in {clause!r}")
+        if batch is not None and after is not None:
+            raise ValueError(
+                f"chaos clause {clause!r} combines batch= with after=; "
+                "batch= fires at exactly one ordinal, after= at every "
+                "ordinal from one on — pick one"
+            )
+        specs.append(ChaosSpec(site, action, replica, batch, after, sleep, times))
+    return specs
+
+
+# (spec string, parsed clauses, remaining firing counts) — re-parsed whenever
+# the configured spec string changes, reset explicitly via reset_chaos(). The
+# lock keeps firing budgets exact across replica dispatcher threads.
+_armed: Optional[Tuple[str, List[ChaosSpec], List[int]]] = None
+_armed_lock = threading.Lock()
+
+
+def _active() -> Optional[Tuple[str, List[ChaosSpec], List[int]]]:
+    global _armed
+    raw = _config.get("reliability.chaos_spec") or ""
+    if not raw:
+        _armed = None
+        return None
+    if _armed is None or _armed[0] != raw:
+        specs = parse_chaos_spec(raw)
+        _armed = (raw, specs, [s.times for s in specs])
+    return _armed
+
+
+def reset_chaos() -> None:
+    """Re-arm the configured spec (firing counts restart from `times`)."""
+    global _armed
+    _armed = None
+
+
+def chaos_enabled() -> bool:
+    return bool(_config.get("reliability.chaos_spec") or "")
+
+
+def _hang_seconds(spec: ChaosSpec) -> float:
+    if spec.sleep is not None:
+        return spec.sleep
+    return _HANG_HEARTBEAT_MULTIPLE * float(
+        _config.get("serving.heartbeat_timeout_s")
+    )
+
+
+def chaos_point(site: str, replica: Optional[int] = None,
+                batch: Optional[int] = None) -> None:
+    """A named chaos site. No-op unless a configured clause matches, in which
+    case the clause's verb executes and its firing budget decrements —
+    deterministic: same spec + same call sequence = same incident. `kill`
+    raises ReplicaKilled; `hang`/`slow` sleep and return."""
+    fire: Optional[ChaosSpec] = None
+    left = 0
+    with _armed_lock:
+        state = _active()
+        if state is None:
+            return
+        _, specs, remaining = state
+        for i, spec in enumerate(specs):
+            if spec.site != site or remaining[i] <= 0:
+                continue
+            if spec.replica is not None and replica != spec.replica:
+                continue
+            if spec.batch is not None and batch != spec.batch:
+                continue
+            if spec.after is not None and (batch is None or batch < spec.after):
+                continue
+            remaining[i] -= 1
+            fire, left = spec, remaining[i]
+            break
+    if fire is None:
+        return
+    profiling.count("reliability.chaos")
+    profiling.count(f"reliability.chaos.{site}")
+    from ..observability import event as _obs_event
+
+    _obs_event(
+        "chaos", site=site, action=fire.action, replica=replica, batch=batch,
+    )
+    if fire.action == "kill":
+        _logger.warning(
+            "chaos injection: killing replica at site '%s'%s%s (%d firings left)",
+            site,
+            f" replica {replica}" if replica is not None else "",
+            f" batch {batch}" if batch is not None else "", left,
+        )
+        raise ReplicaKilled(site, replica, batch)
+    sleep_s = _hang_seconds(fire) if fire.action == "hang" else (
+        fire.sleep if fire.sleep is not None else _SLOW_DEFAULT_S
+    )
+    _logger.warning(
+        "chaos injection: %s %.3fs at site '%s'%s (%d firings left)",
+        fire.action, sleep_s, site,
+        f" replica {replica}" if replica is not None else "", left,
+    )
+    time.sleep(sleep_s)
+
+
+__all__ = [
+    "CHAOS_SITES",
+    "ChaosSpec",
+    "ReplicaKilled",
+    "chaos_enabled",
+    "chaos_point",
+    "parse_chaos_spec",
+    "reset_chaos",
+]

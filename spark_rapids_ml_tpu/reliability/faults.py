@@ -34,6 +34,18 @@
 #   barrier_init      spark/integration.py  jax.distributed process-group init
 #   barrier_rank      spark/integration.py  per-rank fit body (batch = RANK:
 #                     with sleep=, delays one chosen rank — straggler injection)
+#   serving_dispatch  serving/fleet.py + serving/registry.py  request routing
+#                     (batch = request ordinal; pre-enqueue — a raise here
+#                     rejects one request)
+#   serving_execute   serving/batcher.py    dispatcher batch execution (batch =
+#                     that batcher's batch ordinal; in fleet mode each replica's
+#                     batcher counts its own)
+#   serving_heartbeat serving/fleet.py      health-monitor heartbeat read
+#                     (batch = replica index)
+#
+# The same three serving sites are also CHAOS sites (reliability/chaos.py):
+# the chaos grammar adds fleet-level verbs — kill/hang/slow a whole replica —
+# on top of this module's raise/sleep.
 #
 # Firing state lives process-wide and is keyed by the spec string, so a fault
 # with times=1 fires exactly once per configured spec — the injected failure is

@@ -5,10 +5,17 @@
 # endpoints mounted on the live telemetry plane's server.
 #
 #   batcher.py    per-model request queue + dispatcher thread: latency/size
-#                 cutoffs, power-of-two row buckets, per-request scatter
+#                 cutoffs, power-of-two row buckets, per-request scatter,
+#                 client deadlines (batch-close expiry), drain-rate
+#                 Retry-After hints, dispatcher heartbeats
 #   registry.py   HBM-resident model registry over ops/device_cache.py
 #                 (pin-while-serving, LRU eviction, transparent reloads) +
 #                 bucketed AOT pre-warm through compiled_kernel
+#   fleet.py      fault-tolerant replica fleet (serving.replicas > 1): health
+#                 state machine, failover replay, hedging, restart-from-
+#                 pinned-weights with zero warm-path compiles (§7c)
+#   router.py     health-weighted least-outstanding routing + per-tenant fair
+#                 admission + bounded shedding for the fleet
 #   http.py       lifecycle (start_serving/stop_serving, ServingRun scope) +
 #                 the /v1/ mount on observability/server.py
 #
@@ -24,6 +31,7 @@
 #
 
 from .batcher import (
+    DeadlineExpired,
     MicroBatcher,
     QueueFull,
     RequestTooLarge,
@@ -32,6 +40,8 @@ from .batcher import (
     bucket_table,
     pad_to_bucket,
 )
+from .fleet import ReplicaFleet, resolve_replicas
+from .router import NoLiveReplicas, Router
 from .http import (
     MOUNT_PREFIX,
     ServingRun,
@@ -50,15 +60,20 @@ from .http import (
 from .registry import ModelRegistry
 
 __all__ = [
+    "DeadlineExpired",
     "MOUNT_PREFIX",
     "MicroBatcher",
     "ModelRegistry",
+    "NoLiveReplicas",
     "QueueFull",
+    "ReplicaFleet",
     "RequestTooLarge",
+    "Router",
     "ServingError",
     "ServingRun",
     "bucket_rows",
     "bucket_table",
+    "resolve_replicas",
     "get_registry",
     "pad_to_bucket",
     "predict",

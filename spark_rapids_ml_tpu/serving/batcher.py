@@ -21,8 +21,17 @@
 # signatures is finite and pre-warmable: steady-state serving never compiles
 # and the PR-4 recompile sentinel (`transform.recompile_storm`) cannot fire.
 #
-# Telemetry (all label-aware `{model=}`): per-request `serving.queue_s` /
-# `serving.total_s` histograms, per-batch `serving.pad_s` / `serving.execute_s`
+# Deadlines ride WITH the request (docs/design.md §7c): `submit()` takes the
+# caller's absolute deadline, an already-expired request fails fast at submit,
+# and a request whose deadline passes while queued is expired at batch-CLOSE
+# time — never padded, dispatched, and then discarded (counted
+# `serving.expired{model=}`). Backpressure is bounded and advisory: a full
+# queue sheds with a `Retry-After` hint derived from the EMA drain rate
+# (counted `serving.shed_total{model=}`), not a bare 429.
+#
+# Telemetry (all label-aware; `{model=}`, plus `{replica=}` when the batcher
+# runs as a fleet replica): per-request `serving.queue_s` / `serving.total_s`
+# histograms, per-batch `serving.pad_s` / `serving.execute_s`
 # / `serving.batch_occupancy` (real rows / bucket rows — proof the batcher is
 # actually coalescing), counters `serving.requests` / `serving.rows` /
 # `serving.batches` / `serving.padded_rows` / `serving.errors` /
@@ -41,6 +50,7 @@ import numpy as np
 
 from .. import config as _config
 from ..observability.runs import counter_inc, observe, span
+from ..reliability.faults import fault_point
 from ..utils import get_logger
 
 _logger = get_logger("serving.batcher")
@@ -51,11 +61,23 @@ class ServingError(RuntimeError):
 
 
 class QueueFull(ServingError):
-    """Backpressure: the per-model queue reached `serving.queue_depth`."""
+    """Backpressure: the per-model queue reached `serving.queue_depth`.
+    Carries `retry_after_s` — the drain-rate-derived backoff hint the HTTP
+    surface returns as a `Retry-After` header instead of a bare 429."""
+
+    def __init__(self, msg: str, retry_after_s: Optional[float] = None):
+        super().__init__(msg)
+        self.retry_after_s = retry_after_s
 
 
 class RequestTooLarge(ServingError):
     """A single request exceeded `serving.max_batch_rows`."""
+
+
+class DeadlineExpired(ServingError):
+    """The request's client deadline passed before it could be dispatched
+    (at submit, or while queued, checked at batch-close time). Deliberately
+    NOT retryable: the client has already given up on the answer."""
 
 
 def bucket_rows(n: int, min_rows: Optional[int] = None,
@@ -115,42 +137,66 @@ def pad_to_bucket(X: np.ndarray, bucket: int,
 
 
 class _Request:
-    __slots__ = ("X", "n_rows", "future", "enqueue_ts")
+    __slots__ = ("X", "n_rows", "future", "enqueue_ts", "deadline_ts")
 
-    def __init__(self, X: np.ndarray):
+    def __init__(self, X: np.ndarray, deadline_ts: Optional[float] = None):
         self.X = X
         self.n_rows = int(X.shape[0])
         self.future: "Future[Dict[str, np.ndarray]]" = Future()
         self.enqueue_ts = time.perf_counter()
+        # absolute time.perf_counter() deadline, threaded from the client's
+        # predict(..., timeout=) so queue time counts against the budget
+        self.deadline_ts = deadline_ts
 
 
 class MicroBatcher:
     """One served model's queue + dispatcher thread. `execute` is the bound
     predict closure the registry supplies (residency pin + padded predict);
     `warm_buckets` is the registry's set of pre-warmed bucket sizes (read-only
-    here, used for the bucket_hit/bucket_miss counters)."""
+    here, used for the bucket_hit/bucket_miss counters). `labels` overrides
+    the metric label set — the serving fleet runs one MicroBatcher per
+    replica with `{"model": name, "replica": str(i)}` so every series splits
+    per replica while still aggregating under the model label."""
 
     def __init__(self, name: str, n_cols: int,
                  execute: Callable[[np.ndarray, int], Dict[str, np.ndarray]],
-                 warm_buckets: Optional[set] = None):
+                 warm_buckets: Optional[set] = None,
+                 labels: Optional[Dict[str, str]] = None,
+                 thread_suffix: str = ""):
         self.name = name
         self.n_cols = int(n_cols)
         self._execute = execute
         self.warm_buckets = warm_buckets if warm_buckets is not None else set()
+        self.labels: Dict[str, str] = (
+            dict(labels) if labels is not None else {"model": name}
+        )
         self._queue: "deque[_Request]" = deque()
         self._cond = threading.Condition()
         self._stop = False
         self._staging: Dict[int, np.ndarray] = {}
+        # dispatcher liveness: last_beat is stamped by the dispatcher loop on
+        # every wakeup, so a thread hung inside execute (or dead) goes stale
+        # and the fleet's health monitor can declare it within
+        # serving.heartbeat_timeout_s. Drain-rate EMA feeds Retry-After.
+        self.last_beat = time.perf_counter()
+        self._drain_rate: Optional[float] = None  # requests/s, EMA
+        self._last_drain_ts = time.perf_counter()
+        self.batches_done = 0  # execute ordinal (the serving_execute site)
         self._thread = threading.Thread(
-            target=self._loop, name=f"srml-serving-{name}", daemon=True
+            target=self._loop,
+            name=f"srml-serving-{name}{thread_suffix}", daemon=True,
         )
         self._thread.start()
 
     # ------------------------------------------------------------ client side
 
-    def submit(self, X: np.ndarray) -> "Future[Dict[str, np.ndarray]]":
+    def submit(self, X: np.ndarray,
+               deadline_ts: Optional[float] = None
+               ) -> "Future[Dict[str, np.ndarray]]":
         """Enqueue one request; the returned Future resolves to this request's
-        named output arrays (exactly `n_rows` leading rows each)."""
+        named output arrays (exactly `n_rows` leading rows each). A request
+        whose `deadline_ts` has already passed fails fast HERE — it never
+        occupies a queue slot it cannot use."""
         X = np.asarray(X, np.float32)
         if X.ndim == 1:
             X = X[None, :]
@@ -166,15 +212,22 @@ class MicroBatcher:
                 f"request of {X.shape[0]} rows exceeds serving.max_batch_rows="
                 f"{_config.get('serving.max_batch_rows')}; split it client-side"
             )
-        req = _Request(X)
+        if deadline_ts is not None and time.perf_counter() >= deadline_ts:
+            counter_inc("serving.expired", 1, **self.labels)
+            raise DeadlineExpired(
+                f"request deadline expired before enqueue on '{self.name}'"
+            )
+        req = _Request(X, deadline_ts=deadline_ts)
         with self._cond:
             if self._stop:
                 raise ServingError(f"model '{self.name}' is shutting down")
             if len(self._queue) >= int(_config.get("serving.queue_depth")):
-                counter_inc("serving.rejected", 1, model=self.name)
+                counter_inc("serving.rejected", 1, **self.labels)
+                counter_inc("serving.shed_total", 1, **self.labels)
                 raise QueueFull(
                     f"model '{self.name}' queue is full "
-                    f"(serving.queue_depth={_config.get('serving.queue_depth')})"
+                    f"(serving.queue_depth={_config.get('serving.queue_depth')})",
+                    retry_after_s=self.retry_after_s(locked=True),
                 )
             self._queue.append(req)
             self._cond.notify()
@@ -183,6 +236,44 @@ class MicroBatcher:
     def pending(self) -> int:
         with self._cond:
             return len(self._queue)
+
+    def heartbeat_age_s(self) -> float:
+        """Seconds since the dispatcher loop last proved it was making
+        progress — the fleet health monitor's staleness signal."""
+        return time.perf_counter() - self.last_beat
+
+    def alive(self) -> bool:
+        return self._thread.is_alive() and not self._stop
+
+    def drain_rate(self) -> Optional[float]:
+        """EMA requests/second the dispatcher is completing (None until the
+        first batch lands)."""
+        return self._drain_rate
+
+    def retry_after_s(self, locked: bool = False) -> float:
+        """How long a shed client should wait before retrying: current queue
+        depth over the EMA drain rate, clamped to a sane [0.05s, 30s] band.
+        With no drain history yet, one latency-cutoff interval is the best
+        available guess."""
+        if locked:
+            depth = len(self._queue)
+        else:
+            with self._cond:
+                depth = len(self._queue)
+        rate = self._drain_rate
+        if not rate or rate <= 0:
+            return max(float(_config.get("serving.max_wait_ms")) / 1000.0, 0.05)
+        return float(min(max(depth / rate, 0.05), 30.0))
+
+    def steal_pending(self) -> List[_Request]:
+        """Pop every still-queued request. The fleet's failover path calls
+        this on a replica declared DEAD so the stranded requests can be
+        replayed onto surviving replicas instead of rotting in a queue no
+        dispatcher will ever drain."""
+        with self._cond:
+            out = list(self._queue)
+            self._queue.clear()
+        return out
 
     def stop(self, timeout: float = 10.0) -> None:
         """Stop accepting requests, drain what is queued, join the thread."""
@@ -195,9 +286,11 @@ class MicroBatcher:
 
     def _loop(self) -> None:
         while True:
+            self.last_beat = time.perf_counter()
             with self._cond:
                 while not self._queue and not self._stop:
                     self._cond.wait(0.05)
+                    self.last_beat = time.perf_counter()
                 if not self._queue and self._stop:
                     return
                 first = self._queue.popleft()
@@ -228,13 +321,58 @@ class MicroBatcher:
                 self._cond.wait(min(remaining, 0.05))
         return batch
 
+    def _note_drain(self, n: int) -> None:
+        """Fold `n` completed requests into the drain-rate EMA (dispatcher
+        thread only; readers tolerate a torn float)."""
+        now = time.perf_counter()
+        dt = now - self._last_drain_ts
+        self._last_drain_ts = now
+        if dt <= 0:
+            return
+        inst = n / dt
+        self._drain_rate = (
+            inst if self._drain_rate is None
+            else 0.8 * self._drain_rate + 0.2 * inst
+        )
+
+    def _expire_overdue(self, batch: List[_Request]) -> List[_Request]:
+        """Batch-close deadline check: fail every request whose client
+        deadline has already passed (the answer would be discarded anyway)
+        and return the still-live remainder — expired rows are never padded
+        or dispatched."""
+        now = time.perf_counter()
+        live: List[_Request] = []
+        for r in batch:
+            if r.deadline_ts is not None and now >= r.deadline_ts:
+                counter_inc("serving.expired", 1, **self.labels)
+                if r.future.set_running_or_notify_cancel():
+                    r.future.set_exception(DeadlineExpired(
+                        f"request deadline expired after "
+                        f"{now - r.enqueue_ts:.3f}s in '{self.name}' queue"
+                    ))
+            else:
+                live.append(r)
+        return live
+
     def _run_batch(self, batch: List[_Request]) -> None:
+        n_closed = len(batch)
+        batch = self._expire_overdue(batch)
+        if not batch:
+            self._note_drain(n_closed)
+            return
         t_start = time.perf_counter()
+        self.last_beat = t_start
         n = sum(r.n_rows for r in batch)
         for r in batch:
-            observe("serving.queue_s", t_start - r.enqueue_ts, model=self.name)
+            observe("serving.queue_s", t_start - r.enqueue_ts, **self.labels)
         bucket = bucket_rows(n)
         try:
+            # the mid-batch failure site: an injected raise here fails exactly
+            # this batch's futures (retryably, for OSError-class faults) and
+            # the dispatcher loop carries on — the queue must never wedge
+            b_ord = self.batches_done
+            self.batches_done = b_ord + 1
+            fault_point("serving_execute", batch=b_ord)
             stage = self._staging.get(bucket)
             if stage is None:
                 stage = self._staging[bucket] = np.empty(
@@ -247,25 +385,26 @@ class MicroBatcher:
             if bucket > n:
                 stage[n:] = stage[n - 1]
             t_padded = time.perf_counter()
-            observe("serving.pad_s", t_padded - t_start, model=self.name)
-            counter_inc("serving.padded_rows", bucket - n, model=self.name)
+            observe("serving.pad_s", t_padded - t_start, **self.labels)
+            counter_inc("serving.padded_rows", bucket - n, **self.labels)
             counter_inc(
                 "serving.bucket_hit" if bucket in self.warm_buckets
-                else "serving.bucket_miss", 1, model=self.name,
+                else "serving.bucket_miss", 1, **self.labels,
             )
             with span("serving.batch",
-                      {"model": self.name, "rows": n, "bucket": bucket}):
+                      {"rows": n, "bucket": bucket, **self.labels}):
                 outputs = self._execute(stage, n)
             t_done = time.perf_counter()
-            observe("serving.execute_s", t_done - t_padded, model=self.name)
-            observe("serving.batch_occupancy", n / bucket, model=self.name)
+            observe("serving.execute_s", t_done - t_padded, **self.labels)
+            observe("serving.batch_occupancy", n / bucket, **self.labels)
         except Exception as e:
-            counter_inc("serving.errors", 1, model=self.name)
+            counter_inc("serving.errors", 1, **self.labels)
             _logger.warning("serving batch failed for %s: %s", self.name, e)
             for r in batch:
                 if not r.future.set_running_or_notify_cancel():
                     continue
                 r.future.set_exception(e)
+            self._note_drain(n_closed)
             return
         # scatter per-request slices back to the waiting futures: exact row
         # counts, no cross-request bleed (sliced COPIES so one request's
@@ -283,7 +422,8 @@ class MicroBatcher:
             off += r.n_rows
             if r.future.set_running_or_notify_cancel():
                 r.future.set_result(out_r)
-            observe("serving.total_s", now - r.enqueue_ts, model=self.name)
-        counter_inc("serving.batches", 1, model=self.name)
-        counter_inc("serving.requests", len(batch), model=self.name)
-        counter_inc("serving.rows", n, model=self.name)
+            observe("serving.total_s", now - r.enqueue_ts, **self.labels)
+        counter_inc("serving.batches", 1, **self.labels)
+        counter_inc("serving.requests", len(batch), **self.labels)
+        counter_inc("serving.rows", n, **self.labels)
+        self._note_drain(n_closed)

@@ -32,10 +32,16 @@ from .. import config as _config
 from ..observability import server as _obs_server
 from ..observability.export import SERVING_REPORT_FILENAME
 from ..observability.registry import interpolate_quantile, split_label_key
-from ..observability.runs import FitRun
+from ..observability.runs import FitRun, counter_inc
 from ..utils import get_logger
-from .batcher import QueueFull, RequestTooLarge, ServingError
+from .batcher import (
+    DeadlineExpired,
+    QueueFull,
+    RequestTooLarge,
+    ServingError,
+)
 from .registry import ModelRegistry
+from .router import NoLiveReplicas
 
 _logger = get_logger("serving.http")
 
@@ -97,13 +103,37 @@ def mutate_model(name: str, fn) -> Dict[str, Any]:
     return get_registry().mutate(name, fn)
 
 
-def predict(name: str, X: np.ndarray,
-            timeout: Optional[float] = None) -> Dict[str, np.ndarray]:
-    return get_registry().predict(name, X, timeout=timeout)
+def predict(name: str, X: np.ndarray, timeout: Optional[float] = None,
+            tenant: Optional[str] = None) -> Dict[str, np.ndarray]:
+    return get_registry().predict(name, X, timeout=timeout, tenant=tenant)
 
 
-def submit(name: str, X: np.ndarray):
-    return get_registry().submit(name, X)
+def submit(name: str, X: np.ndarray, deadline_ts: Optional[float] = None,
+           tenant: Optional[str] = None):
+    return get_registry().submit(name, X, deadline_ts=deadline_ts,
+                                 tenant=tenant)
+
+
+def _serving_health() -> Dict[str, Any]:
+    """The /healthz `serving` section: who is registered and — for fleets —
+    which replicas are actually in rotation (the health state machine's view,
+    serving/fleet.py)."""
+    with _lock:
+        reg = _registry
+    if reg is None:
+        return {"started": False}
+    out: Dict[str, Any] = {"started": True, "models": {}}
+    for name in reg.models():
+        try:
+            st = reg.stats(name)
+        except KeyError:
+            continue  # unregistered between models() and stats()
+        view: Dict[str, Any] = {"pending": st.get("pending", 0)}
+        if "replicas" in st:
+            view["live_replicas"] = st.get("live_replicas")
+            view["replicas"] = st.get("replicas")
+        out["models"][name] = view
+    return out
 
 
 def start_serving(port: Optional[int] = None) -> Optional[Tuple[str, int]]:
@@ -126,6 +156,7 @@ def start_serving(port: Optional[int] = None) -> Optional[Tuple[str, int]]:
         run = ServingRun("serving", site="driver")
         run.__enter__()
         _obs_server.register_mount(MOUNT_PREFIX, _http_handler)
+        _obs_server.register_health_provider("serving", _serving_health)
         with _lock:
             _run = run
             _started = True
@@ -151,6 +182,7 @@ def stop_serving() -> Optional[Dict[str, Any]]:
         report = None
         if was_started:
             _obs_server.unregister_mount(MOUNT_PREFIX)
+            _obs_server.unregister_health_provider("serving")
         if registry is not None:
             registry.close()
         if run is not None:
@@ -172,14 +204,40 @@ def serving_address() -> Optional[Tuple[str, int]]:
 # ------------------------------------------------------------------- handlers
 
 
-def _http_handler(method: str, path: str,
-                  body: Optional[bytes]) -> Tuple[int, Any]:
+def _model_from_path(path: str) -> str:
+    """Best-effort model name for error labeling ("-" when the path carries
+    none) — error metrics must label by model without trusting the request."""
+    if not path.startswith("/v1/models/"):
+        return "-"
+    name = path[len("/v1/models/"):]
+    if name.endswith(":predict"):
+        name = name[: -len(":predict")]
+    return name or "-"
+
+
+def _retry_headers(retry_after_s: Optional[float]) -> Optional[Dict[str, str]]:
+    """A `Retry-After` header from the shed path's drain-rate hint (HTTP
+    wants integer seconds; round UP so the client never retries early into
+    the same full queue)."""
+    if retry_after_s is None:
+        return None
+    import math
+
+    return {"Retry-After": str(max(1, int(math.ceil(retry_after_s))))}
+
+
+def _http_handler(method: str, path: str, body: Optional[bytes]):
     """The /v1/ mount (observability/server.py dispatches here). Never raises:
-    every error maps to a status + JSON body."""
+    every error maps to a status + a JSON body carrying a structured
+    `error_kind` (the exception class — what a client should branch on,
+    instead of parsing the message), plus `Retry-After` on 429/503 shedding.
+    Unexpected 500s additionally count `serving.errors{model=,kind=}` so an
+    error-rate alert can tell schema junk from handler bugs."""
     with _lock:
         reg = _registry
     if reg is None:
-        return 503, {"error": "serving is not started"}
+        return 503, {"error": "serving is not started",
+                     "error_kind": "NotStarted"}
     try:
         if method == "GET" and path == "/v1/models":
             return 200, {"models": reg.stats_all()}
@@ -194,18 +252,33 @@ def _http_handler(method: str, path: str,
             "POST /v1/models/<name>:predict",
         ]}
     except KeyError as e:
-        return 404, {"error": str(e.args[0]) if e.args else "not found"}
+        return 404, {"error": str(e.args[0]) if e.args else "not found",
+                     "error_kind": "KeyError"}
     except QueueFull as e:
-        return 429, {"error": str(e)}
+        return 429, {"error": str(e), "error_kind": "QueueFull",
+                     "retry_after_s": e.retry_after_s}, \
+            _retry_headers(e.retry_after_s)
+    except NoLiveReplicas as e:
+        return 503, {"error": str(e), "error_kind": "NoLiveReplicas",
+                     "retry_after_s": e.retry_after_s}, \
+            _retry_headers(e.retry_after_s)
+    except DeadlineExpired as e:
+        # the client's own deadline passed while the request queued: gone
+        # before it could be served — a timeout, not a client-input error
+        return 504, {"error": str(e), "error_kind": "DeadlineExpired"}
     except (RequestTooLarge, ServingError, ValueError) as e:
-        return 400, {"error": str(e)}
+        return 400, {"error": str(e), "error_kind": type(e).__name__}
     except FutureTimeout:
         return 504, {"error": "request timed out "
                               f"(serving.request_timeout_s="
-                              f"{_config.get('serving.request_timeout_s')})"}
+                              f"{_config.get('serving.request_timeout_s')})",
+                     "error_kind": "Timeout"}
     except Exception as e:
+        kind = type(e).__name__
+        counter_inc("serving.errors", 1, model=_model_from_path(path),
+                    kind=kind)
         _logger.warning("serving handler error: %s", e)
-        return 500, {"error": f"{type(e).__name__}: {e}"}
+        return 500, {"error": f"{kind}: {e}", "error_kind": kind}
 
 
 def _handle_predict(reg: ModelRegistry, name: str,
@@ -227,7 +300,15 @@ def _handle_predict(reg: ModelRegistry, name: str,
         return 400, {"error": 'body must carry "instances" (list of feature '
                               "rows)"}
     X = np.asarray(inst, dtype=np.float32)
-    out = reg.predict(name, X)
+    # optional request metadata: "tenant" feeds the fleet's fair admission,
+    # "timeout_s" becomes the request's deadline (queue time counts)
+    tenant = doc.get("tenant")
+    timeout = doc.get("timeout_s")
+    out = reg.predict(
+        name, X,
+        timeout=float(timeout) if timeout is not None else None,
+        tenant=str(tenant) if tenant is not None else None,
+    )
     rows = 1 if X.ndim == 1 else int(X.shape[0])
     return 200, {
         "model": name,
@@ -251,23 +332,44 @@ def serving_summary(report: Dict[str, Any]) -> Dict[str, Dict[str, Any]]:
     counters = metrics.get("counters") or {}
     duration = float(report.get("duration_s") or 0.0)
 
-    def _counter(name: str, model: str) -> int:
-        return int(counters.get(f"{name}{{model={model}}}", 0))
+    def _counter(name: str, want: Dict[str, str]) -> int:
+        # label-set match, not exact-key match: fleet replicas add a
+        # `replica` label to every series, and per-replica rows must read
+        # their own counters while single-mode rows keep reading theirs
+        total = 0
+        for key, v in counters.items():
+            cname, labels = split_label_key(key)
+            if cname == name and labels == want:
+                total += int(v)
+        return total
+
+    def _hist(name: str, want: Dict[str, str]):
+        for key, st in hists.items():
+            hname, labels = split_label_key(key)
+            if hname == name and labels == want:
+                return st
+        return None
 
     for key, st in hists.items():
         hname, labels = split_label_key(key)
         if hname != "serving.total_s" or "model" not in labels:
             continue
         model = labels["model"]
+        # fleet mode: one row per replica, keyed "<model>#r<i>"
+        row_key = (
+            f"{model}#r{labels['replica']}" if "replica" in labels else model
+        )
         bounds = st.get("bounds") or []
-        occ = hists.get(f"serving.batch_occupancy{{model={model}}}")
-        requests = _counter("serving.requests", model)
-        out[model] = {
+        occ = _hist("serving.batch_occupancy", labels)
+        requests = _counter("serving.requests", labels)
+        out[row_key] = {
             "requests": requests,
-            "batches": _counter("serving.batches", model),
-            "rows": _counter("serving.rows", model),
-            "reloads": _counter("serving.model_reloads", model),
-            "errors": _counter("serving.errors", model),
+            "batches": _counter("serving.batches", labels),
+            "rows": _counter("serving.rows", labels),
+            "reloads": _counter(
+                "serving.model_reloads", {"model": row_key}
+            ),
+            "errors": _counter("serving.errors", labels),
             "p50_ms": round(interpolate_quantile(st, 0.50, bounds) * 1e3, 3),
             "p95_ms": round(interpolate_quantile(st, 0.95, bounds) * 1e3, 3),
             "p99_ms": round(interpolate_quantile(st, 0.99, bounds) * 1e3, 3),

@@ -33,6 +33,8 @@
 
 from __future__ import annotations
 
+import copy
+import itertools
 import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
@@ -46,8 +48,11 @@ from ..observability.inference import (
 )
 from ..observability.runs import counter_inc, gauge_set, observe, span
 from ..ops.device_cache import DeviceBatchCache
+from ..reliability.chaos import chaos_point
+from ..reliability.faults import fault_point
 from ..utils import get_logger
 from .batcher import MicroBatcher, ServingError, bucket_table, pad_to_bucket
+from .fleet import ReplicaFleet, ReplicaHandle, resolve_replicas
 
 _logger = get_logger("serving.registry")
 
@@ -81,6 +86,15 @@ class _ServedModel:
         self.warm: set = set()
         self.registered_ts = time.time()
         self.batcher: Optional[MicroBatcher] = None
+        # fault-tolerant fleet mode (serving.replicas > 1): the fleet replaces
+        # the single batcher; this entry becomes the PINNED MASTER copy —
+        # the host-attr + resident-weight source every replica (re)spawns
+        # from — and replica_entries holds the per-replica clone entries
+        self.fleet: Optional[ReplicaFleet] = None
+        self.replica_entries: Dict[int, "_ServedModel"] = {}
+        # request ordinal for the single-dispatcher serving_dispatch site
+        # (the fleet keeps its own ordinal)
+        self.dispatch_seq = itertools.count()
         # serializes the dispatcher's install->predict->restore window against
         # model mutation + weight refresh (§7b): an add/delete landing while
         # device arrays are installed would either raise (read-only views) or
@@ -172,23 +186,41 @@ class ModelRegistry:
         do_warm = (
             bool(_config.get("serving.prewarm")) if prewarm is None else prewarm
         )
-        if do_warm:
-            self._prewarm(entry)
-        entry.batcher = MicroBatcher(
-            name, n_cols,
-            execute=lambda stage, n_valid, _e=entry: self._predict_padded(
-                _e, stage
-            ),
-            warm_buckets=entry.warm,
-        )
+        n_replicas = resolve_replicas()
+        if n_replicas > 1:
+            # fault-tolerant fleet (docs/design.md §7c): the parent entry
+            # stays the pinned master (host attrs + resident device tuple —
+            # what dead replicas restart from); each replica serves its own
+            # clone with its own weight stream and dispatcher. Replica
+            # pre-warms replay through the process-wide compiled-kernel
+            # cache, so replicas beyond the first — and every recovery
+            # respawn — add zero compiles.
+            entry.fleet = ReplicaFleet(
+                name, n_cols, n_replicas,
+                spawn=lambda i, _e=entry, _w=do_warm: self._spawn_replica(
+                    _e, i, _w
+                ),
+                retire=lambda i, _e=entry: self._drop_replica(_e, i),
+            )
+        else:
+            if do_warm:
+                self._prewarm(entry)
+            entry.batcher = MicroBatcher(
+                name, n_cols,
+                execute=lambda stage, n_valid, _e=entry: self._predict_padded(
+                    _e, stage
+                ),
+                warm_buckets=entry.warm,
+            )
         with self._lock:
             self._models[name] = entry
             gauge_set("serving.models", len(self._models))
         counter_inc("serving.registered", 1, model=name)
         _logger.info(
-            "serving model '%s' (%s, %d cols, %.1f KiB weights, buckets %s)",
+            "serving model '%s' (%s, %d cols, %.1f KiB weights, buckets %s, "
+            "%d replica%s)",
             name, type(model).__name__, n_cols, entry.nbytes / 1024,
-            list(entry.buckets),
+            list(entry.buckets), n_replicas, "s" if n_replicas != 1 else "",
         )
         return self.stats(name)
 
@@ -202,10 +234,91 @@ class ModelRegistry:
         return True
 
     def _retire(self, entry: _ServedModel) -> None:
+        if entry.fleet is not None:
+            # close() joins every replica dispatcher and calls our retire
+            # callback per replica, dropping each clone's weight stream
+            entry.fleet.close()
         if entry.batcher is not None:
             entry.batcher.stop()
         with self._cache_lock:
             self._cache.drop_stream(entry.cache_key)
+
+    # ---------------------------------------------------------- fleet replicas
+
+    def _spawn_replica(self, parent: _ServedModel, index: int,
+                       do_warm: bool) -> ReplicaHandle:
+        """Fleet spawn callback: build replica `index` of a served model from
+        the parent's CURRENT pinned weights — shallow model clone with its own
+        attribute dict (install/restore never crosses replicas), its own HBM
+        weight stream, and the full bucketed AOT pre-warm (cache hits after
+        the first replica's compile, so respawn adds zero compiles)."""
+        clone = copy.copy(parent.model)
+        clone._model_attributes = dict(parent.model._model_attributes)
+        attr_names = tuple(
+            n for n in clone._serving_device_attrs()
+            if n in clone._model_attributes
+            and clone._model_attributes[n] is not None
+        )
+        rentry = _ServedModel(
+            f"{parent.name}#r{index}", clone, attr_names,
+            parent.n_cols, parent.buckets,
+        )
+        with self._cache_lock:
+            self._ensure_resident(rentry)
+        if do_warm:
+            self._prewarm(rentry)
+            parent.warm.update(rentry.warm)
+        parent.replica_entries[index] = rentry
+        return ReplicaHandle(
+            execute=lambda stage, n_valid, _e=rentry: self._predict_padded(
+                _e, stage
+            ),
+            warm=rentry.warm,
+        )
+
+    def _drop_replica(self, parent: _ServedModel, index: int) -> None:
+        """Fleet retire callback: free a (dead or closing) replica's HBM
+        weight stream. The parent master entry is untouched."""
+        rentry = parent.replica_entries.pop(index, None)
+        if rentry is None:
+            return
+        with self._cache_lock:
+            self._cache.drop_stream(rentry.cache_key)
+
+    def _resync_replica(self, parent: _ServedModel,
+                        rentry: _ServedModel) -> None:
+        """Propagate a parent mutation/refresh into one live replica: re-clone
+        the attribute dict, re-derive the device attr set, and swap the
+        replica's cached device tuple in place (replace() keeps in-flight
+        pins, exactly like the parent refresh path)."""
+        import jax.numpy as jnp
+
+        with rentry.exec_lock:
+            rentry.model._model_attributes = dict(
+                parent.model._model_attributes
+            )
+            rentry.attr_names = tuple(
+                n for n in rentry.model._serving_device_attrs()
+                if n in rentry.model._model_attributes
+                and rentry.model._model_attributes[n] is not None
+            )
+            rentry.host_attrs = {
+                n: rentry.model._model_attributes[n]
+                for n in rentry.attr_names
+            }
+            rentry.nbytes = int(sum(
+                int(getattr(v, "nbytes", 0))
+                for v in rentry.host_attrs.values()
+            ))
+            with self._cache_lock:
+                tup = tuple(
+                    jnp.asarray(rentry.host_attrs[n])
+                    for n in rentry.attr_names
+                )
+                rentry.uploads += 1
+                rentry.was_cached = self._cache.replace(
+                    rentry.cache_key, 0, tup
+                )
 
     def close(self) -> None:
         """Unregister everything (serving session teardown): every dispatcher
@@ -293,6 +406,9 @@ class ModelRegistry:
                 )
                 entry.uploads += 1
                 entry.was_cached = self._cache.replace(entry.cache_key, 0, tup)
+        if entry.fleet is not None:
+            for rentry in list(entry.replica_entries.values()):
+                self._resync_replica(entry, rentry)
         counter_inc("serving.weight_refreshes", 1, model=name)
         return self.stats(name)
 
@@ -374,25 +490,48 @@ class ModelRegistry:
         with self._lock:
             return sorted(self._models)
 
-    def submit(self, name: str, X: np.ndarray):
-        """Enqueue one request; returns the Future of its output dict."""
+    def submit(self, name: str, X: np.ndarray,
+               deadline_ts: Optional[float] = None,
+               tenant: Optional[str] = None):
+        """Enqueue one request; returns the Future of its output dict.
+        `deadline_ts` is the client's absolute perf_counter() deadline (rides
+        with the request — queue time counts against it); `tenant` feeds the
+        fleet's fair admission (ignored in single-dispatcher mode, where
+        there is one queue and no fairness to arbitrate)."""
         entry = self._entry(name)
+        if entry.fleet is not None:
+            return entry.fleet.submit(X, deadline_ts=deadline_ts,
+                                      tenant=tenant)
         assert entry.batcher is not None
-        return entry.batcher.submit(X)
+        seq = next(entry.dispatch_seq)
+        fault_point("serving_dispatch", batch=seq)
+        chaos_point("serving_dispatch", batch=seq)
+        return entry.batcher.submit(X, deadline_ts=deadline_ts)
 
     def predict(self, name: str, X: np.ndarray,
-                timeout: Optional[float] = None) -> Dict[str, np.ndarray]:
+                timeout: Optional[float] = None,
+                tenant: Optional[str] = None) -> Dict[str, np.ndarray]:
         """Blocking request: submit + wait (the in-process twin of the HTTP
-        POST /v1/models/<name>:predict path)."""
+        POST /v1/models/<name>:predict path). The timeout becomes the
+        request's ABSOLUTE deadline, threaded into the queue: an overdue
+        request expires at batch-close (DeadlineExpired) instead of being
+        executed for a client that already hung up. The small grace on the
+        Future wait lets that structured expiry win over a bare timeout."""
         if timeout is None:
             timeout = float(_config.get("serving.request_timeout_s"))
-        return self.submit(name, X).result(timeout=timeout)
+        deadline_ts = time.perf_counter() + float(timeout)
+        fut = self.submit(name, X, deadline_ts=deadline_ts, tenant=tenant)
+        return fut.result(timeout=float(timeout) + 0.25)
 
     def stats(self, name: str) -> Dict[str, Any]:
         entry = self._entry(name)
         with self._cache_lock:
             is_resident = self._cache.contains(entry.cache_key, 0)
-        return {
+        if entry.fleet is not None:
+            pending = entry.fleet.pending()
+        else:
+            pending = entry.batcher.pending() if entry.batcher else 0
+        out = {
             "name": entry.name,
             "model": type(entry.model).__name__,
             "n_cols": entry.n_cols,
@@ -402,9 +541,13 @@ class ModelRegistry:
             "resident": is_resident,
             "uploads": entry.uploads,
             "reloads": entry.reloads,
-            "pending": entry.batcher.pending() if entry.batcher else 0,
+            "pending": pending,
             "registered_ts": entry.registered_ts,
         }
+        if entry.fleet is not None:
+            out["replicas"] = entry.fleet.health_view()
+            out["live_replicas"] = entry.fleet.live_count()
+        return out
 
     def stats_all(self) -> List[Dict[str, Any]]:
         return [self.stats(name) for name in self.models()]

@@ -1,0 +1,162 @@
+#
+# Fleet router — the admission + replica-selection half of the fault-tolerant
+# serving tier (docs/design.md §7c; serving/fleet.py is the replica/health
+# half).
+#
+# Three jobs, all bounded:
+#
+#   * ROUTING: health-weighted least-outstanding-requests. Every routable
+#     replica (LIVE or DEGRADED — the fleet decides, the router only asks
+#     `replica.routable()`) is scored by its in-flight + queued load times a
+#     health weight (DEGRADED replicas cost more, so traffic drains away from
+#     a replica that has started failing before it is declared DEAD); the
+#     cheapest replica wins. Index-ordered tie-break keeps routing
+#     deterministic under equal load.
+#
+#   * ADMISSION with per-tenant fairness: total outstanding work is capped at
+#     `serving.queue_depth` across the whole fleet, and within that cap each
+#     ACTIVE tenant (one with work in flight) is held to an equal share — a
+#     tenant flooding the queue sheds against its own share, not against the
+#     other tenants' latency. Untagged requests pool under the "-" tenant.
+#
+#   * BOUNDED SHEDDING: every rejection is a `QueueFull` carrying a
+#     `retry_after_s` derived from the fleet's aggregate EMA drain rate (the
+#     HTTP surface turns it into 429 + `Retry-After`), never an unbounded
+#     queue or a bare reject. With no routable replica at all the router
+#     raises `NoLiveReplicas` (503 + `Retry-After`) — distinct from
+#     backpressure because the right client reaction differs: back off versus
+#     fail over to another serving endpoint.
+#
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from .. import config as _config
+from ..observability.runs import counter_inc
+from .batcher import QueueFull, ServingError
+
+
+class NoLiveReplicas(ServingError):
+    """No LIVE or DEGRADED replica can take the request (all DEAD or
+    RECOVERING). Maps to HTTP 503 + Retry-After: the condition is expected to
+    clear as soon as the health monitor finishes a restart."""
+
+    def __init__(self, msg: str, retry_after_s: Optional[float] = None):
+        super().__init__(msg)
+        self.retry_after_s = retry_after_s
+
+
+class Router:
+    """Routing + admission over a fleet's replica list. The replica objects
+    are duck-typed: `index`, `routable()`, `health_weight()`, `outstanding`,
+    and `batcher` (for queued depth + drain rate) is all the router reads —
+    it never imports the fleet, so the two halves stay cycle-free."""
+
+    def __init__(self, name: str, replicas: Sequence[Any]):
+        self._name = name
+        self._replicas = replicas
+        self._lock = threading.Lock()
+        self._tenant_outstanding: Dict[str, int] = {}
+
+    # ---------------------------------------------------------------- routing
+
+    def pick(self, exclude: Tuple[int, ...] = ()) -> Optional[Any]:
+        """The cheapest routable replica by health-weighted load, or None.
+        Load = in-flight + still-queued requests; weight grows for DEGRADED
+        replicas so they shed traffic while they still count as capacity."""
+        best = None
+        best_cost: Optional[float] = None
+        for rep in self._replicas:
+            if rep.index in exclude or not rep.routable():
+                continue
+            load = rep.outstanding + rep.batcher.pending()
+            cost = (load + 1) * rep.health_weight()
+            if best_cost is None or cost < best_cost:
+                best, best_cost = rep, cost
+        return best
+
+    def has_routable(self, exclude: Tuple[int, ...] = ()) -> bool:
+        return self.pick(exclude) is not None
+
+    # -------------------------------------------------------------- admission
+
+    def _fleet_retry_after_s(self) -> float:
+        """Aggregate Retry-After hint: total backlog over the summed EMA
+        drain rate of every routable replica, clamped like the per-batcher
+        hint. Falls back to one latency-cutoff interval pre-history."""
+        backlog = 0
+        rate = 0.0
+        for rep in self._replicas:
+            backlog += rep.outstanding + rep.batcher.pending()
+            if rep.routable():
+                rate += rep.batcher.drain_rate() or 0.0
+        if rate <= 0:
+            return max(
+                float(_config.get("serving.max_wait_ms")) / 1000.0, 0.05
+            )
+        return float(min(max(backlog / rate, 0.05), 30.0))
+
+    def admit(self, tenant: str) -> None:
+        """Admission control, called before dispatch. Raises QueueFull (with
+        the drain-rate Retry-After) when the fleet-wide cap or this tenant's
+        fair share is spent; on success the tenant's outstanding count is
+        charged (release() refunds it exactly once per request)."""
+        depth = int(_config.get("serving.queue_depth"))
+        with self._lock:
+            total = sum(self._tenant_outstanding.values())
+            if total >= depth:
+                counter_inc("serving.shed_total", 1, model=self._name)
+                raise QueueFull(
+                    f"fleet '{self._name}' is saturated "
+                    f"({total} outstanding >= serving.queue_depth={depth})",
+                    retry_after_s=self._fleet_retry_after_s(),
+                )
+            active = sum(1 for v in self._tenant_outstanding.values() if v > 0)
+            if self._tenant_outstanding.get(tenant, 0) <= 0:
+                active += 1  # this request would activate the tenant
+            share = max(1, depth // max(1, active))
+            if self._tenant_outstanding.get(tenant, 0) >= share:
+                counter_inc("serving.shed_total", 1, model=self._name)
+                counter_inc(
+                    "serving.tenant_shed", 1, model=self._name, tenant=tenant,
+                )
+                raise QueueFull(
+                    f"tenant '{tenant}' exceeded its fair share of fleet "
+                    f"'{self._name}' ({share} of {depth} slots across "
+                    f"{active} active tenants)",
+                    retry_after_s=self._fleet_retry_after_s(),
+                )
+            self._tenant_outstanding[tenant] = (
+                self._tenant_outstanding.get(tenant, 0) + 1
+            )
+
+    def release(self, tenant: str) -> None:
+        """Refund one admitted request (terminal resolution — success, final
+        failure, or shed after admission)."""
+        with self._lock:
+            left = self._tenant_outstanding.get(tenant, 0) - 1
+            if left > 0:
+                self._tenant_outstanding[tenant] = left
+            else:
+                self._tenant_outstanding.pop(tenant, None)
+
+    def no_live(self) -> NoLiveReplicas:
+        counter_inc("serving.no_live_replicas", 1, model=self._name)
+        return NoLiveReplicas(
+            f"fleet '{self._name}' has no live replica (all dead or "
+            "recovering); retry shortly",
+            retry_after_s=max(
+                float(_config.get("serving.heartbeat_timeout_s")), 0.05
+            ),
+        )
+
+    # ------------------------------------------------------------------ views
+
+    def tenants(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._tenant_outstanding)
+
+
+__all__: List[str] = ["NoLiveReplicas", "Router"]
