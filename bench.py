@@ -48,6 +48,7 @@ UNITS = [
     "dbscan",
     "fit_e2e",
     "cache",
+    "ingest",
     "telemetry_overhead",
     "serving_qps",
     "serving_failover",

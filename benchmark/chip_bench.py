@@ -1141,6 +1141,118 @@ def bench_cache(ctx) -> Dict:
     return out
 
 
+def bench_ingest(ctx) -> Dict:
+    """Zero-copy ingest plane + whole-pipeline fusion (docs/design.md §6k).
+
+    Part A — ingest throughput: a single-pass streamed moments fit over a
+    contiguous float32 matrix, cache disabled so every batch genuinely crosses
+    host->device. Reports `ingest_gb_per_s_per_chip` (higher-is-better, gated
+    by ci/bench_check.py) plus the counter-level acceptance proof: on this
+    path the staged blocks are VIEWS, so `ingest.bytes_copied` must be ZERO
+    (`ingest_error` is set otherwise and CI flags it).
+
+    Part B — fusion speedup: the same scale->PCA->KMeans pipeline fit staged
+    (transform materialized between stages) vs fused (one streamed program per
+    batch, chain ops in-program). `pipeline_fusion_speedup` is the
+    median-of-ratios over alternating-order pairs; `pipeline_fusion_parity`
+    asserts the two paths produced BIT-IDENTICAL centers — a speedup that
+    changes the model is a bug, not a win."""
+    import pandas as pd
+
+    from spark_rapids_ml_tpu import config, profiling
+    from spark_rapids_ml_tpu.ops.streaming import streaming_moments
+
+    mesh = ctx["mesh"]
+    n, d = ctx["ingest_shape"]
+    rng = np.random.default_rng(47)
+    Xh = rng.normal(0, 1, (n, d)).astype(np.float32)
+    batch_rows = max(n // 8, 1)
+
+    def one_pass():
+        profiling.reset_counters()
+        t0 = time.perf_counter()
+        streaming_moments(Xh, None, batch_rows=batch_rows, mesh=mesh)
+        return time.perf_counter() - t0, profiling.counter_totals()
+
+    config.set("cache.enabled", False)
+    try:
+        one_pass()  # compile warm-up
+        (t_a, totals_a), (t_b, totals_b) = one_pass(), one_pass()
+        t_ingest, totals = min((t_a, totals_a), (t_b, totals_b))
+    finally:
+        config.unset("cache.enabled")
+    bytes_copied = int(totals.get("ingest.bytes_copied", 0))
+    out = {
+        "ingest_shape": [n, d],
+        "ingest_gb_per_s_per_chip": round(
+            Xh.nbytes / t_ingest / 1e9 / ctx["n_chips"], 3
+        ),
+        "ingest_bytes_zero_copy": int(totals.get("ingest.bytes_zero_copy", 0)),
+        "ingest_bytes_copied": bytes_copied,
+        "ingest_copies_avoided": int(totals.get("ingest.copies_avoided", 0)),
+    }
+    if bytes_copied != 0:
+        out["ingest_error"] = (
+            f"contiguous f32 pass-1 staged {bytes_copied} bytes through host "
+            "copies; the zero-copy plane expected 0"
+        )
+
+    # part B: staged vs fused featurize->fit chain
+    from spark_rapids_ml_tpu.clustering import KMeans
+    from spark_rapids_ml_tpu.feature import PCA, StandardScaler
+    from spark_rapids_ml_tpu.pipeline import Pipeline
+
+    df = pd.DataFrame({"features": list(Xh)})
+
+    def fit_chain(fuse: bool):
+        config.set("pipeline.fuse", fuse)
+        try:
+            pipe = Pipeline(
+                stages=[
+                    StandardScaler(
+                        inputCol="features", outputCol="scaled", withMean=True
+                    ),
+                    PCA(k=min(8, d), inputCol="scaled", outputCol="pcs"),
+                    KMeans(k=8, seed=0, maxIter=4, featuresCol="pcs"),
+                ]
+            )
+            t0 = time.perf_counter()
+            model = pipe.fit(df)
+            return time.perf_counter() - t0, model
+        finally:
+            config.unset("pipeline.fuse")
+
+    config.set("stream_threshold_bytes", 1 << 16)
+    config.set("pipeline.fuse_min_rows", 1)
+    try:
+        fit_chain(True)  # compile warm-up for both paths' kernels
+        fit_chain(False)
+        ratios, parity = [], True
+        for order in ((False, True), (True, False)):  # alternating order
+            times = {}
+            models = {}
+            for fuse in order:
+                times[fuse], models[fuse] = fit_chain(fuse)
+            ratios.append(times[False] / max(times[True], 1e-9))
+            parity = parity and bool(
+                np.array_equal(
+                    np.asarray(models[True].stages[-1].cluster_centers_),
+                    np.asarray(models[False].stages[-1].cluster_centers_),
+                )
+            )
+    finally:
+        config.unset("stream_threshold_bytes")
+        config.unset("pipeline.fuse_min_rows")
+    out["pipeline_fusion_speedup"] = round(float(np.median(ratios)), 3)
+    out["pipeline_fusion_parity"] = parity
+    if not parity:
+        out["ingest_error"] = (
+            "fused and staged chains disagree on the fitted centers — "
+            "bit-parity is the fusion contract"
+        )
+    return out
+
+
 def bench_telemetry_overhead(ctx) -> Dict:
     """Live telemetry plane cost (observability/server.py + flight.py, §6g):
     the SAME multi-pass streamed KMeans fit with the HTTP endpoint + flight
@@ -1779,6 +1891,7 @@ FAMILIES: List = [
     ("dbscan", bench_dbscan),
     ("fit_e2e", bench_fit_e2e),
     ("cache", bench_cache),
+    ("ingest", bench_ingest),
     ("telemetry_overhead", bench_telemetry_overhead),
     ("serving_qps", bench_serving_qps),
     ("serving_failover", bench_serving_failover),
@@ -1814,6 +1927,10 @@ def make_ctx(X, w, mesh, on_tpu: bool, platform: str, repo_root: str) -> Dict:
         "dbscan_shape": (200_000, 32) if big else (5_000, 8),
         "e2e_shape": (2_000_000, 256) if big else (50_000, 32),
         "cache_shape": (2_000_000, 128) if big else (60_000, 32),
+        # ingest unit: big enough that the single-pass moments fit streams
+        # (clears the stream threshold) and the fusion chain runs several
+        # batches; small enough to stay cheap on the CPU fallback
+        "ingest_shape": (4_000_000, 128) if big else (30_000, 16),
         # sized so one fit runs long enough (~0.5 s on the CPU fallback) for
         # the ON/OFF delta to clear scheduler noise, while batches stay small
         # enough that per-batch telemetry writes are still the dominant cost

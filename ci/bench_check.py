@@ -98,6 +98,14 @@ _SPEEDUP_RE = re.compile(
 _ROWS_PER_S_RE = re.compile(
     r'\\?"(\w+_rows_per_s)\\?"\s*:\s*([0-9]+(?:\.[0-9]+)?(?:[eE][+-]?[0-9]+)?)'
 )
+# zero-copy ingest plane (`ingest_gb_per_s_per_chip`, docs/design.md §6k):
+# streamed host->device ingest bandwidth of the single-pass moments fit —
+# HIGHER is better like mfu. The exact `_gb_per_s_per_chip` suffix anchors
+# the match so no wall-time key can collide
+_GBPS_RE = re.compile(
+    r'\\?"(\w+_gb_per_s_per_chip)\\?"\s*:\s*'
+    r"([0-9]+(?:\.[0-9]+)?(?:[eE][+-]?[0-9]+)?)"
+)
 # measurement-noise companion (`*_overhead_noise_pct`, the MAD of the
 # scenario's pair deltas): when the noise floor reaches the budget the point
 # estimate carries no signal, so the check reports INCONCLUSIVE instead of
@@ -110,7 +118,9 @@ _PLATFORM_RE = re.compile(r'\\?"platform\\?"\s*:\s*\\?"(\w+)\\?"')
 
 
 def _higher_is_better(name: str) -> bool:
-    return name.endswith(("_mfu", "_speedup", "_rows_per_s"))
+    return name.endswith(
+        ("_mfu", "_speedup", "_rows_per_s", "_gb_per_s_per_chip")
+    )
 
 
 # absolute noise floors for the comm keys: near zero (CPU-mesh comm_frac sits
@@ -185,6 +195,8 @@ def extract(path: str) -> Dict[str, object]:
             scenarios[k] = float(v)  # autotune plane: higher-is-better + floor
         elif k.endswith("_rows_per_s") and isinstance(v, (int, float)):
             scenarios[k] = float(v)  # ann build throughput: higher-is-better
+        elif k.endswith("_gb_per_s_per_chip") and isinstance(v, (int, float)):
+            scenarios[k] = float(v)  # ingest bandwidth: higher-is-better
         elif k.endswith("_overhead_noise_pct") and isinstance(v, (int, float)):
             overhead_noise[k[: -len("_noise_pct")] + "_pct"] = float(v)
         elif k.endswith("_overhead_pct") and isinstance(v, (int, float)):
@@ -215,6 +227,8 @@ def extract(path: str) -> Dict[str, object]:
         for name, v in _SPEEDUP_RE.findall(text):
             scenarios[name] = float(v)
         for name, v in _ROWS_PER_S_RE.findall(text):
+            scenarios[name] = float(v)
+        for name, v in _GBPS_RE.findall(text):
             scenarios[name] = float(v)
         for name, v in _OVERHEAD_NOISE_RE.findall(text):
             overhead_noise[name[: -len("_noise_pct")] + "_pct"] = float(v)

@@ -47,6 +47,48 @@ else
   # streamed estimator + exact hit/miss/eviction counter accounting + zero
   # pass-2 uploads) — a wrong cache silently corrupts every multi-pass fit
   python -m pytest tests/test_device_cache.py -q
+  # ingest-fusion tier (docs/design.md §6k): staging-pool/Arrow units and the
+  # fused-vs-staged bit-parity matrix first, then an end-to-end smoke — an
+  # Arrow-backed fused featurize->fit chain on the 8-dev mesh must export a
+  # run report whose counters prove the host copied ZERO bytes (every staged
+  # block was a view) and that the chain actually fused
+  python -m pytest tests/test_ingest_fusion.py -q
+  SRML_INGEST_SMOKE_DIR="$(mktemp -d)"
+  SRML_TPU_METRICS_DIR="$SRML_INGEST_SMOKE_DIR" \
+  SRML_TPU_STREAM_THRESHOLD_BYTES=1024 SRML_TPU_STREAM_BATCH_ROWS=64 \
+  SRML_TPU_PIPELINE_FUSE_MIN_ROWS=1 \
+  python - <<'PY'
+import os
+import numpy as np
+import pyarrow as pa
+from spark_rapids_ml_tpu.clustering import KMeans
+from spark_rapids_ml_tpu.feature import StandardScaler
+from spark_rapids_ml_tpu.observability import load_run_reports
+from spark_rapids_ml_tpu.pipeline import Pipeline
+
+rng = np.random.default_rng(0)
+X = rng.normal(size=(600, 8)).astype(np.float32)
+tbl = pa.table(
+    {"features": pa.FixedSizeListArray.from_arrays(pa.array(X.reshape(-1)), 8)}
+)
+Pipeline(stages=[
+    StandardScaler(inputCol="features", outputCol="scaled", withMean=True),
+    KMeans(k=3, seed=2, maxIter=6, featuresCol="scaled"),
+]).fit(tbl)
+reps = load_run_reports(os.environ["SRML_TPU_METRICS_DIR"])
+rep = next(r for r in reversed(reps) if r["algo"] == "Pipeline")
+assert rep["status"] == "ok", rep["status"]
+c = rep["metrics"]["counters"]
+fused = sum(v for k, v in c.items() if k.startswith("pipeline.fused_stages"))
+assert fused == 2, c
+assert c.get("ingest.bytes_copied", 0) == 0, c  # Arrow path: zero host copies
+assert c.get("ingest.bytes_zero_copy", 0) >= X.nbytes, c
+ing = rep["ingest"]
+assert ing["bytes_per_row_after"] == 0.0 and ing["bytes_per_row_before"] > 0, ing
+print("INGEST-FUSION SMOKE OK: chain fused (%d stages), zero host-copy "
+      "bytes, %.0f B/row of staging copies avoided"
+      % (fused, ing["bytes_per_row_before"]))
+PY
   # observability tier: registry/FitRun/exporter units, then an end-to-end
   # smoke — a streamed KMeans fit must append a parseable JSONL run report
   # whose counters prove pass 2+ uploaded ZERO bytes (the cache-tier

@@ -70,6 +70,22 @@ PALLAS_HISTOGRAM_MAX_SEG_TILE = 2048
 # (`stream_batch_rows`, 1M rows) remains the fallback when the caller already
 # sized batches for a whole fit.
 ANN_BUILD_BATCH_ROWS = 1 << 16
+# --------------------------------------------------------- ingest / fusion
+# (ops/ingest.py + pipeline.py, docs/design.md §6k)
+#
+# INGEST_STAGING_POOL_ROWS: rows per pooled staging buffer backing the counted
+# copy fallback of the zero-copy ingest plane. Provenance: matches
+# ANN_BUILD_BATCH_ROWS' rationale — 64k f32 rows at the BASELINE 256-col shape
+# is a 64 MiB buffer; one per (dtype, width) key covers the double-buffered
+# prefetch without the pool itself rivaling the HBM cache budget.
+INGEST_STAGING_POOL_ROWS = 1 << 16
+# PIPELINE_FUSE_MIN_ROWS: rows below which the pipeline fuser leaves a
+# featurize->fit chain staged. Provenance: under ~4k rows a staged chain's
+# extra host round-trip is < 1 ms on every measured platform — less than the
+# fused chain's extra accumulator compile — and the staged trace is the one
+# worth reading when debugging toy inputs.
+PIPELINE_FUSE_MIN_ROWS = 4096
+
 # ANN_LIST_BUCKET_MIN_ROWS: smallest bucketed IVF list capacity. Provenance:
 # mirrors `serving.bucket_min_rows`'s floor rationale — below 8 slots the
 # pow-2 ladder would re-layout on nearly every add; at 8 the padded-slot waste

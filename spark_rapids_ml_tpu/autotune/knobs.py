@@ -149,6 +149,23 @@ KNOBS: Dict[str, Knob] = {
             grid=(8, 16, 32, 64),
         ),
         Knob(
+            "ingest.staging_pool_rows", "int",
+            "rows per pooled staging buffer backing the zero-copy ingest "
+            "plane's counted copy fallback "
+            "(ops/ingest.py::resolve_staging_pool_rows)",
+            config_key="ingest.staging_pool_rows", auto_values=(0,),
+            dims=("n", "d"),
+            grid=(1 << 14, 1 << 15, 1 << 16, 1 << 17, 1 << 18),
+        ),
+        Knob(
+            "pipeline.fuse_min_rows", "int",
+            "rows below which the pipeline fuser leaves a featurize->fit "
+            "chain staged (pipeline.py::_resolve_fuse_min_rows)",
+            config_key="pipeline.fuse_min_rows", auto_values=(0,),
+            dims=("n",),
+            grid=(1 << 10, 1 << 12, 1 << 14, 1 << 16),
+        ),
+        Knob(
             "ann.compact_tombstone_pct", "int",
             "tombstoned-slot percentage of occupied slots that triggers IVF "
             "list compaction (ops/ann_lifecycle.py::needs_compaction)",

@@ -223,6 +223,25 @@ _DEFAULTS: Dict[str, Any] = {
     # DeviceIndexCache): per-segment HBM budget; a segment uploads on FIRST
     # search, not at load — cold-start never stages the whole index
     "ann.index_cache_bytes": 1 << 30,
+    # zero-copy ingest plane (ops/ingest.py, docs/design.md §6k): contiguous
+    # right-dtype host blocks enter the device DMA path as views (no host
+    # staging copy); exotic inputs fall back to a counted staging copy. Off =
+    # every batch slice staged through np.ascontiguousarray, the pre-§6k path
+    "ingest.zero_copy": True,
+    # staging-buffer pool geometry (rows per pooled buffer) for the counted
+    # copy fallback; 0 = auto (tuning table, else autotune/defaults.py).
+    # Buffer REUSE engages only on backends whose device_put copies (TPU/GPU);
+    # CPU jax aliases host memory, so reuse there would corrupt cached batches
+    "ingest.staging_pool_rows": 0,
+    # whole-pipeline fusion (pipeline.py, docs/design.md §6k): compile
+    # featurize->fit chains (scale/PCA feeding KMeans/logreg/linreg) into one
+    # streamed program per batch — intermediates never round-trip to host.
+    # Bit-parity with the staged path is the contract; off = staged fits
+    "pipeline.fuse": True,
+    # rows below which fusion is skipped (staged fit overhead is negligible
+    # and the staged trace is simpler to debug); 0 = auto (tuning table, else
+    # autotune/defaults.py)
+    "pipeline.fuse_min_rows": 0,
     # closed-loop autotuner (spark_rapids_ml_tpu/autotune/, docs/design.md
     # §6i): telemetry-driven knob search persisted as per-platform tuning
     # tables. mode:
@@ -311,6 +330,10 @@ _ENV_KEYS: Dict[str, str] = {
     "ann.list_bucket_rows": "SRML_TPU_ANN_LIST_BUCKET_ROWS",
     "ann.compact_tombstone_pct": "SRML_TPU_ANN_COMPACT_TOMBSTONE_PCT",
     "ann.index_cache_bytes": "SRML_TPU_ANN_INDEX_CACHE_BYTES",
+    "ingest.zero_copy": "SRML_TPU_INGEST_ZERO_COPY",
+    "ingest.staging_pool_rows": "SRML_TPU_INGEST_STAGING_POOL_ROWS",
+    "pipeline.fuse": "SRML_TPU_PIPELINE_FUSE",
+    "pipeline.fuse_min_rows": "SRML_TPU_PIPELINE_FUSE_MIN_ROWS",
     "autotune.mode": "SRML_TPU_AUTOTUNE_MODE",
     "autotune.dir": "SRML_TPU_TUNE_DIR",
     "autotune.replicates": "SRML_TPU_AUTOTUNE_REPLICATES",

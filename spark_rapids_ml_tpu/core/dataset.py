@@ -30,6 +30,13 @@ try:  # scipy is available in this image; keep soft anyway
 except ImportError:  # pragma: no cover
     _SCIPY = False
 
+try:  # pyarrow is optional: it feeds the zero-copy ingest fast path (§6k)
+    import pyarrow as pa
+
+    _PYARROW = True
+except ImportError:  # pragma: no cover
+    _PYARROW = False
+
 
 def _is_spark_df(dataset: Any) -> bool:
     mod = type(dataset).__module__
@@ -46,6 +53,10 @@ def _is_sparse(x: Any) -> bool:
     return _SCIPY and sp.issparse(x)
 
 
+def _is_arrow(dataset: Any) -> bool:
+    return _PYARROW and isinstance(dataset, (pa.Table, pa.RecordBatch))
+
+
 @dataclass
 class FeatureData:
     """Extracted, host-side training data: the product of `_pre_process_data`."""
@@ -54,7 +65,7 @@ class FeatureData:
     label: Optional[np.ndarray] = None  # (n,)
     weight: Optional[np.ndarray] = None  # (n,)
     row_id: Optional[np.ndarray] = None  # (n,) int64
-    input_kind: str = "numpy"  # numpy | pandas | spark | sparse
+    input_kind: str = "numpy"  # numpy | pandas | spark | sparse | arrow
     feature_layout: str = "array"  # array | multi_cols | vector | sparse
     extra: Dict[str, Any] = field(default_factory=dict)
 
@@ -69,6 +80,157 @@ class FeatureData:
     @property
     def is_sparse(self) -> bool:
         return _is_sparse(self.features)
+
+
+def _arrow_combined(col: Any) -> Any:
+    """ChunkedArray/Array -> one contiguous Array. A single chunk is handed
+    back as-is (zero-copy); combining multiple chunks copies — counted into
+    the ingest ledger (ops/ingest.py) like any other host conversion."""
+    if not isinstance(col, pa.ChunkedArray):
+        return col
+    if col.num_chunks == 1:
+        return col.chunk(0)
+    import time
+
+    from ..ops.ingest import count_conversion
+
+    t0 = time.perf_counter()
+    out = col.combine_chunks()
+    count_conversion(col.nbytes, time.perf_counter() - t0)
+    return out
+
+
+def _arrow_numpy(arr: Any) -> Optional[np.ndarray]:
+    """Zero-copy numpy view of a primitive Arrow array; None when the buffer
+    layout forbids one (nulls, non-primitive types)."""
+    try:
+        return arr.to_numpy(zero_copy_only=True)
+    except (pa.ArrowInvalid, pa.ArrowNotImplementedError, TypeError):
+        return None
+
+
+def _arrow_converted(arr: Any, dtype: np.dtype) -> np.ndarray:
+    """Counted host-conversion fallback for an Arrow column."""
+    import time
+
+    from ..ops.ingest import count_conversion
+
+    t0 = time.perf_counter()
+    out = np.asarray(arr.to_numpy(zero_copy_only=False), dtype=dtype)
+    count_conversion(out.nbytes, time.perf_counter() - t0)
+    return out
+
+
+def _extract_arrow(
+    dataset: Any,
+    input_col: Optional[str],
+    input_cols: Optional[List[str]],
+    label_col: Optional[str],
+    weight_col: Optional[str],
+    id_col: Optional[str],
+    float32: bool,
+) -> FeatureData:
+    """Arrow Table/RecordBatch fast path (docs/design.md §6k): a null-free
+    FixedSizeList feature column whose value buffer is device-castable maps
+    to a (n, d) numpy VIEW in its SOURCE dtype — no densify-to-f32 — and the
+    consuming accumulator kernels cast on device. Anything else (nulls,
+    chunked buffers, exotic dtypes, multi-column layouts) falls back to a
+    counted host conversion."""
+    from ..ops.ingest import _device_castable
+
+    dtype = np.float32 if float32 else np.float64
+    names = list(dataset.schema.names)
+    if dataset.num_rows == 0:
+        raise RuntimeError(
+            "Fit/transform input is empty (the reference raises on empty "
+            "partitions too, core.py:959-962)."
+        )
+    label = weight = row_id = None
+    if input_cols:
+        missing = [c for c in input_cols if c not in names]
+        if missing:
+            raise ValueError(
+                f"feature columns {missing} not found in dataset columns {names}"
+            )
+        stacked = [
+            _arrow_combined(dataset.column(c)).to_numpy(zero_copy_only=False)
+            for c in input_cols
+        ]
+        import time
+
+        from ..ops.ingest import count_conversion
+
+        t0 = time.perf_counter()
+        X = np.stack(stacked, axis=1)
+        if not _device_castable(X.dtype, dtype):
+            X = X.astype(dtype)
+        count_conversion(X.nbytes, time.perf_counter() - t0)
+        layout = "multi_cols"
+    elif input_col:
+        if input_col not in names:
+            raise ValueError(
+                f"feature column '{input_col}' not found in dataset columns "
+                f"{names}"
+            )
+        arr = _arrow_combined(dataset.column(input_col))
+        X = None
+        if pa.types.is_fixed_size_list(arr.type) and arr.null_count == 0:
+            # flatten() (not .values) honors slice offsets; zero-copy when
+            # the child carries no nulls
+            flat = _arrow_numpy(arr.flatten())
+            if flat is not None and _device_castable(flat.dtype, dtype):
+                d = int(arr.type.list_size)
+                X = flat.reshape(-1, d)
+                from ..observability import counter_inc as obs_counter_inc
+
+                obs_counter_inc("ingest.bytes_zero_copy", X.nbytes)
+                obs_counter_inc("ingest.copies_avoided", 1)
+        if X is None:
+            # counted fallback through the pandas cell-stack path
+            X = _stack_feature_column(dataset.column(input_col).to_pandas())
+            import time
+
+            from ..ops.ingest import count_conversion
+
+            t0 = time.perf_counter()
+            X = np.ascontiguousarray(X, dtype=dtype)
+            count_conversion(X.nbytes, time.perf_counter() - t0)
+        layout = "array"
+    else:
+        raise ValueError(
+            "input_col or input_cols must be provided for Arrow input"
+        )
+    for col_name, kind in (
+        (label_col, "label"), (weight_col, "weight"), (id_col, "id")
+    ):
+        if col_name is not None and col_name not in names:
+            raise ValueError(
+                f"{kind} column '{col_name}' not found in dataset columns "
+                f"{names}"
+            )
+    if label_col is not None:
+        arr = _arrow_combined(dataset.column(label_col))
+        label = _arrow_numpy(arr) if arr.null_count == 0 else None
+        if label is None or label.dtype != dtype:
+            label = _arrow_converted(arr, dtype)
+    if weight_col is not None:
+        arr = _arrow_combined(dataset.column(weight_col))
+        weight = _arrow_numpy(arr) if arr.null_count == 0 else None
+        if weight is None or weight.dtype != dtype:
+            weight = _arrow_converted(arr, dtype)
+    if id_col is not None:
+        arr = _arrow_combined(dataset.column(id_col))
+        row_id = _arrow_numpy(arr) if arr.null_count == 0 else None
+        if row_id is None or row_id.dtype != np.int64:
+            row_id = _arrow_converted(arr, np.dtype(np.int64))
+    return FeatureData(
+        features=X,
+        label=label,
+        weight=weight,
+        row_id=row_id,
+        input_kind="arrow",
+        feature_layout=layout,
+    )
 
 
 def _stack_feature_column(col: Any) -> np.ndarray:
@@ -107,6 +269,12 @@ def extract_feature_data(
     if _is_sparse(dataset):
         X = dataset.tocsr().astype(dtype)
         return FeatureData(features=X, input_kind="sparse", feature_layout="sparse")
+
+    if _is_arrow(dataset):
+        return _extract_arrow(
+            dataset, input_col, input_cols, label_col, weight_col, id_col,
+            float32,
+        )
 
     if isinstance(dataset, np.ndarray):
         X = np.atleast_2d(np.asarray(dataset, dtype=dtype))
@@ -189,6 +357,26 @@ def densify(features: Any, float32: bool = True) -> np.ndarray:
         csr.shape[1],
         dtype=np.float32 if float32 else np.float64,
     )
+
+
+def ensure_dtype(X: np.ndarray, float32: bool = True) -> np.ndarray:
+    """Host-cast a deferred-dtype dense block to the compute dtype, counted as
+    an ingest conversion (docs/design.md §6k). The Arrow extraction fast path
+    may hand back int/low-width float arrays unconverted — the STREAMED plane
+    wants them that way (its kernels cast in-program, ops/ingest.py); the
+    staged in-core and transform planes normalize here instead."""
+    X = np.asarray(X)
+    dt = np.float32 if float32 else np.float64
+    if X.dtype == dt:
+        return X
+    import time
+
+    from ..ops.ingest import count_conversion
+
+    t0 = time.perf_counter()
+    out = X.astype(dt)
+    count_conversion(out.nbytes, time.perf_counter() - t0)
+    return out
 
 
 def ensure_id_col(dataset: Any, id_col_name: str) -> Any:

@@ -33,6 +33,7 @@ from .dataset import (  # re-exported surface
     FeatureData,
     append_output_columns,
     densify,
+    ensure_dtype,
     extract_feature_data,
 )
 from .params import ParamMap
@@ -204,7 +205,13 @@ class _TpuCaller(_TpuClass, _TpuParams):
         num_workers = self.num_workers
         mesh = get_mesh(num_workers)
 
-        X = densify(fd.features, float32=self._float32_inputs)
+        # the Arrow fast path may defer dtype conversion (core/dataset.py); the
+        # staged in-core plane materializes the whole matrix anyway, so the
+        # counted host cast happens here (streamed fits cast in-program instead)
+        X = ensure_dtype(
+            densify(fd.features, float32=self._float32_inputs),
+            float32=self._float32_inputs,
+        )
         X = np.asarray(X, order=self._fit_array_order())  # type: ignore[arg-type]
         Xp, pad_weight, (label_p, sw_p) = pad_rows(X, num_workers, fd.label, fd.weight)
         row_weight = pad_weight if sw_p is None else pad_weight * sw_p
@@ -747,7 +754,10 @@ class _TpuModel(_TpuClass, _TpuParams):
                 if fd.is_sparse and self._supports_sparse_transform():
                     outputs = self._transform_sparse(fd.features)
                 else:
-                    X = densify(fd.features, float32=self._float32_inputs)
+                    X = ensure_dtype(
+                        densify(fd.features, float32=self._float32_inputs),
+                        float32=self._float32_inputs,
+                    )
                     outputs = self._transform_arrays(X)
                 out = append_output_columns(dataset, outputs)
         if run is not None:
@@ -854,7 +864,10 @@ def model_eval_frames(
         weight_col=weight_col,
         float32=m0._float32_inputs,
     )
-    X = densify(fd.features, float32=m0._float32_inputs)
+    X = ensure_dtype(
+        densify(fd.features, float32=m0._float32_inputs),
+        float32=m0._float32_inputs,
+    )
 
     def _colify(v):
         return v if np.ndim(v) == 1 else list(v)

@@ -1,5 +1,17 @@
 # Public API module mirroring the reference's `spark_rapids_ml.feature`
 # (reference python/src/spark_rapids_ml/feature.py).
-from .models.feature import PCA, PCAModel, VectorAssembler
+from .models.feature import (
+    PCA,
+    PCAModel,
+    StandardScaler,
+    StandardScalerModel,
+    VectorAssembler,
+)
 
-__all__ = ["PCA", "PCAModel", "VectorAssembler"]
+__all__ = [
+    "PCA",
+    "PCAModel",
+    "StandardScaler",
+    "StandardScalerModel",
+    "VectorAssembler",
+]

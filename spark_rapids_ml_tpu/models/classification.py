@@ -394,14 +394,15 @@ class LogisticRegression(
     def _create_pyspark_model(self, attrs: Dict[str, Any]) -> "LogisticRegressionModel":
         return LogisticRegressionModel(**attrs)
 
-    def _streaming_fit(self, fd) -> Dict[str, Any]:
+    def _streaming_fit(self, fd, chain_ops=None) -> Dict[str, Any]:
         """Out-of-core fit: X stays host-resident, every L-BFGS objective/gradient
         evaluation streams batches through the device (ops/streaming.py) — the
         LogisticRegression analog of the reference's UVM/SAM path (reference
         utils.py:184-241) that BASELINE config 3 (500M x 256) requires.
         L1/elastic-net runs the streamed FISTA; routes in-core (with a warning)
         only for coefficient bounds, sparse features, and single-class
-        degenerate fits."""
+        degenerate fits. `chain_ops` carries upstream featurizer transforms when
+        this fit is the terminal stage of a fused pipeline chain (pipeline.py)."""
         from .. import config as _config
         from ..core.dataset import _is_sparse, densify as _densify
         from ..ops.streaming import streaming_logreg_fit
@@ -417,6 +418,13 @@ class LogisticRegression(
         )
         classes, n_classes = _validate_labels(fd.label)
         if bounds_set or _is_sparse(fd.features) or len(classes) <= 1:
+            if chain_ops:
+                # the fuser gates on fuse-eligibility, so only a direct caller
+                # can land here; in-core would silently drop the chain
+                raise ValueError(
+                    "This LogisticRegression configuration fits in-core and "
+                    "cannot run a fused featurize->fit chain."
+                )
             self.logger.warning(
                 "streamed LogisticRegression covers dense multi-class fits "
                 "only (no coefficient bounds); fitting in-core despite "
@@ -446,6 +454,7 @@ class LogisticRegression(
             batch_rows=int(_config.get("stream_batch_rows")),
             mesh=get_mesh(self.num_workers),
             float32=self._float32_inputs,
+            chain_ops=chain_ops,
         )
         attrs["num_classes"] = n_classes
         return attrs

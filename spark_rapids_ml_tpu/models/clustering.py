@@ -233,11 +233,14 @@ class KMeans(_KMeansClass, _TpuEstimator, _KMeansParams):
     def _create_pyspark_model(self, attrs: Dict[str, Any]) -> "KMeansModel":
         return KMeansModel(**attrs)
 
-    def _streaming_fit(self, fd) -> Dict[str, Any]:
+    def _streaming_fit(self, fd, chain_ops=None) -> Dict[str, Any]:
         """Out-of-core exact Lloyd (ops/streaming.py): full-pass center updates with
         one batch resident at a time — the KMeans analog of the reference's UVM/SAM
         large-dataset path (utils.py:184-241). Selected automatically when the design
-        matrix exceeds stream_threshold_bytes (core/estimator.py)."""
+        matrix exceeds stream_threshold_bytes (core/estimator.py). `chain_ops`
+        carries upstream featurizer transforms when this fit is the terminal
+        stage of a fused pipeline chain (pipeline.py): they apply in-program, so
+        raw batches upload once and intermediates never touch the host."""
         from .. import config as _config
         from ..core.dataset import densify as _densify
         from ..ops.streaming import streaming_kmeans_fit
@@ -259,6 +262,7 @@ class KMeans(_KMeansClass, _TpuEstimator, _KMeansParams):
             mesh=get_mesh(self.num_workers),
             metric=str(p.get("metric", "euclidean")),
             float32=self._float32_inputs,
+            chain_ops=chain_ops,
         )
 
     def _fit_fallback_model(self, twin: type, fd) -> Dict[str, Any]:

@@ -280,9 +280,11 @@ class LinearRegression(_LinearRegressionClass, _TpuEstimatorSupervised, _LinearR
     def _create_pyspark_model(self, attrs: Dict[str, Any]) -> "LinearRegressionModel":
         return LinearRegressionModel(**attrs)
 
-    def _streaming_fit(self, fd) -> Dict[str, Any]:
+    def _streaming_fit(self, fd, chain_ops=None) -> Dict[str, Any]:
         """Out-of-core fit: stream batches, accumulate (XᵀWX, XᵀWy) on device
-        (ops/streaming.py) — numerically identical to the in-core stats pass."""
+        (ops/streaming.py) — numerically identical to the in-core stats pass.
+        `chain_ops` carries upstream featurizer transforms when this fit is the
+        terminal stage of a fused pipeline chain (pipeline.py)."""
         from .. import config as _config
         from ..core.dataset import densify as _densify
         from ..ops.linear import solve_from_stats
@@ -291,6 +293,13 @@ class LinearRegression(_LinearRegressionClass, _TpuEstimatorSupervised, _LinearR
 
         p = self._tpu_params
         if p.get("loss", "squared_loss") == "huber":
+            if chain_ops:
+                # the fuser gates on fuse-eligibility, so only a direct caller
+                # can land here; in-core would silently drop the chain
+                raise ValueError(
+                    "loss='huber' fits in-core and cannot run a fused "
+                    "featurize->fit chain."
+                )
             # huber has no sufficient-statistics form; fit in-core (the robust loss
             # needs the residuals every iteration)
             self.logger.warning(
@@ -307,6 +316,7 @@ class LinearRegression(_LinearRegressionClass, _TpuEstimatorSupervised, _LinearR
             batch_rows=int(_config.get("stream_batch_rows")),
             mesh=mesh,
             float32=self._float32_inputs,
+            chain_ops=chain_ops,
         )
         attrs = solve_from_stats(
             A, b, xbar, ybar, sw,

@@ -698,6 +698,16 @@ class FitRun:
             autotune_section = _autotune.report_section(self.registry)
         except Exception as e:
             _logger.warning("autotune report section failed: %s", e)
+        # ingest section (docs/design.md §6k/§6f): this run's zero-copy vs
+        # copied staging byte split and the before/after bytes-per-row cost
+        # analysis. Best-effort, like the autotune section.
+        ingest_section = None
+        try:
+            from ..ops import ingest as _ingest
+
+            ingest_section = _ingest.report_section(self.registry)
+        except Exception as e:
+            _logger.warning("ingest report section failed: %s", e)
         ranks_section = None
         if have_workers:
             try:
@@ -710,6 +720,7 @@ class FitRun:
         return {
             **({"device": device_section} if device_section else {}),
             **({"autotune": autotune_section} if autotune_section else {}),
+            **({"ingest": ingest_section} if ingest_section else {}),
             **({"ranks": ranks_section} if have_ranks else {}),
             "schema": 1,
             "kind": self.kind,

@@ -80,7 +80,7 @@ def _atomic_write(path: str, data: bytes) -> None:
 
 def _write_array(dirname: str, name: str, arr: np.ndarray) -> Dict[str, Any]:
     """One array -> one mmap-friendly `.npy` segment file, atomically."""
-    arr = np.ascontiguousarray(arr)
+    arr = np.ascontiguousarray(arr)  # noqa: fence/host-staging-copy
     fname = f"{name}.npy"
     fd, tmp = tempfile.mkstemp(dir=dirname, suffix=".tmp")
     try:
@@ -298,7 +298,7 @@ class MutableIvfState:
         fill = np.where(has, max_cell - rev.argmax(axis=1), 0)
         item_cells = np.full((int(n_items),), -1, np.int32)
         cells_of = np.repeat(np.arange(nlist), max_cell).reshape(nlist, max_cell)
-        item_cells[cell_ids[live]] = cells_of[live].astype(np.int32)
+        item_cells[cell_ids[live]] = cells_of[live].astype(np.int32)  # noqa: fence/host-staging-copy
         return cls(item_cells, fill.astype(np.int32), tombstones=0)
 
     def live_items(self) -> int:
@@ -382,7 +382,7 @@ def ivf_add(attrs: Dict[str, Any], state: MutableIvfState,
     mapping)."""
     from .knn import normalize_rows_or_raise
 
-    X_new = np.ascontiguousarray(np.asarray(X_new), np.float32)
+    X_new = np.ascontiguousarray(np.asarray(X_new), np.float32)  # noqa: fence/host-staging-copy
     if cosine:
         X_new = normalize_rows_or_raise(X_new)
     positions = np.asarray(positions, np.int64)

@@ -207,7 +207,7 @@ def streaming_ivfflat_build(
     from .kmeans import kmeans_fit, kmeans_predict
 
     n, d = X.shape
-    Xs = np.ascontiguousarray(X[_strided_sample_indices(n, sample_rows)],
+    Xs = np.ascontiguousarray(X[_strided_sample_indices(n, sample_rows)],  # noqa: fence/host-staging-copy
                               dtype=np.float32)
     if cosine:
         Xs = _normalize_batch_or_raise(Xs)
@@ -228,7 +228,7 @@ def streaming_ivfflat_build(
     assign = np.empty((n,), np.int32)
 
     def _dispatch_assign(bi, s, e):
-        Xb = np.ascontiguousarray(X[s:e], dtype=np.float32)
+        Xb = np.ascontiguousarray(X[s:e], dtype=np.float32)  # noqa: fence/host-staging-copy
         if cosine:
             Xb = _normalize_batch_or_raise(Xb)
         return kmeans_predict(jnp.asarray(Xb), centers_j)
@@ -299,7 +299,7 @@ def streaming_ivfpq_build(
     # shuffled); the in-core build trains on ALL residuals, so codebooks differ
     # in detail but the recall/quality contract is preserved (tested)
     sub_idx = _strided_sample_indices(n, sample_rows)
-    X_sub = np.ascontiguousarray(X[sub_idx], np.float32)
+    X_sub = np.ascontiguousarray(X[sub_idx], np.float32)  # noqa: fence/host-staging-copy
     if cosine:
         X_sub = _normalize_batch_or_raise(X_sub)
     resid_s = X_sub - coarse[assign[sub_idx]]
@@ -325,7 +325,7 @@ def streaming_ivfpq_build(
     codes_flat = np.zeros((n, m_subvectors), np.uint8)
 
     def _dispatch_encode(bi, s, e):
-        Xb_enc = np.ascontiguousarray(X[s:e], np.float32)
+        Xb_enc = np.ascontiguousarray(X[s:e], np.float32)  # noqa: fence/host-staging-copy
         if cosine:
             Xb_enc = _normalize_batch_or_raise(Xb_enc)
         resid_b = jnp.asarray(Xb_enc - coarse[assign[s:e]])
@@ -381,7 +381,7 @@ def streaming_cagra_build(
     {"items", "graph"} match ops/knn.py::cagra_build's contract)."""
     from .knn import _optimize_graph_reverse_edges
 
-    X = np.ascontiguousarray(np.asarray(X), dtype=np.float32)
+    X = np.ascontiguousarray(np.asarray(X), dtype=np.float32)  # noqa: fence/host-staging-copy
     if cosine:
         # the graph AND the returned items must live on the unit sphere (the
         # searcher walks euclidean distances over them) — one normalized copy,
@@ -407,7 +407,7 @@ def streaming_cagra_build(
     rows = np.arange(n)[:, None]
     not_self = idx != rows
     order = np.argsort(~not_self, axis=1, kind="stable")
-    graph = np.take_along_axis(idx, order, axis=1)[:, :deg].astype(np.int32)
+    graph = np.take_along_axis(idx, order, axis=1)[:, :deg].astype(np.int32)  # noqa: fence/host-staging-copy
     graph = np.maximum(graph, 0)  # any -1 from an undersized probe -> node 0
     graph = _optimize_graph_reverse_edges(X, graph, deg)
     from .knn import center_norms_sq
@@ -495,7 +495,7 @@ def streaming_ivfflat_search(
     out_i = np.full((nq, k_eff), -1, np.int64)
 
     def _dispatch_search(bi, s, e):
-        qb = jnp.asarray(np.ascontiguousarray(Q[s:e], dtype=np.float32))
+        qb = jnp.asarray(np.ascontiguousarray(Q[s:e], dtype=np.float32))  # noqa: fence/host-staging-copy
         if probe_fused:
             from .pallas_select import fused_probe
 
@@ -563,7 +563,7 @@ def streaming_pq_refine(
         vecs = jnp.asarray(flat[cand_pos[s:e]])  # the host page-in
         with obs_span("knn.rerank", {"start": s, "rows": e - s}):
             return _refine_exact_tile(
-                jnp.asarray(np.ascontiguousarray(Q[s:e], np.float32)),
+                jnp.asarray(np.ascontiguousarray(Q[s:e], np.float32)),  # noqa: fence/host-staging-copy
                 vecs,
                 jnp.asarray(cand_ids[s:e]),
                 k_eff,

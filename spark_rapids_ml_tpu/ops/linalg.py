@@ -43,6 +43,15 @@ def weighted_moments(X: jax.Array, w: jax.Array) -> Tuple[jax.Array, jax.Array, 
     return mean, jnp.maximum(var, 0.0), wsum
 
 
+@compiled_kernel("scaler.transform")
+def scaler_transform(X: jax.Array, shift: jax.Array, scale: jax.Array) -> jax.Array:
+    """StandardScalerModel's column standardization. Bit-parity contract with the
+    fused pipeline's "scale" chain op (ops/streaming.py::_apply_chain): identical
+    expression, identical cast discipline — the staged transform->refit path and
+    the fused featurize->fit chain must agree BITWISE (docs/design.md §6k)."""
+    return (X.astype(shift.dtype) - shift) / scale
+
+
 @compiled_kernel("linalg.weighted_covariance")
 def weighted_covariance(X: jax.Array, w: jax.Array) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Centered covariance C = Σ w_i (x_i-μ)(x_i-μ)ᵀ / (Σw - 1) via sufficient

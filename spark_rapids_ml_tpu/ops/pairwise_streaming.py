@@ -329,7 +329,7 @@ def streaming_exact_knn(
             def _scan_query_block(qs=qs, qe=qe):
                 # running state re-initializes per attempt, so a transient tile
                 # failure replays this query block exactly (deterministic merge)
-                qb = jnp.asarray(np.ascontiguousarray(Q[qs:qe], np.float32))
+                qb = jnp.asarray(np.ascontiguousarray(Q[qs:qe], np.float32))  # noqa: fence/host-staging-copy
                 best_d = jnp.full((qe - qs, k_eff), INVALID_D2, jnp.float32)
                 best_i = jnp.full((qe - qs, k_eff), -1, jnp.int32)
                 for s, nv, xb, x2b in blocks():
@@ -344,8 +344,8 @@ def streaming_exact_knn(
                     with obs_span(
                         "knn.rerank", {"start": qs, "rows": qe - qs}
                     ):
-                        qh = np.ascontiguousarray(Q[qs:qe], np.float32)
-                        vecs = X[ids].astype(np.float32, copy=False)
+                        qh = np.ascontiguousarray(Q[qs:qe], np.float32)  # noqa: fence/host-staging-copy
+                        vecs = X[ids].astype(np.float32, copy=False)  # noqa: fence/host-staging-copy
                         d2 = ((qh[:, None, :] - vecs) ** 2).sum(-1)
                         order = np.argsort(d2, axis=1, kind="stable")
                         ids = np.take_along_axis(ids, order, axis=1)
@@ -433,7 +433,7 @@ def _streamed_min_core_labels(
         qe = min(qs + query_block, n)
 
         def _minlabel_query_block(qs=qs, qe=qe):
-            qb = jnp.asarray(np.ascontiguousarray(X[qs:qe], np.float32))
+            qb = jnp.asarray(np.ascontiguousarray(X[qs:qe], np.float32))  # noqa: fence/host-staging-copy
             acc = jnp.full((qe - qs,), _I32MAX, jnp.int32)
             for s, nv, xb, x2b, lb, cb in blocks():
                 acc = jnp.minimum(acc, tile(qb, xb, x2b, lb, cb, nv))
@@ -477,7 +477,7 @@ def _streaming_dbscan_fit_predict(
 ):
     from .dbscan import _compact_labels
 
-    X = np.ascontiguousarray(np.asarray(X), dtype=np.float32)
+    X = np.ascontiguousarray(np.asarray(X), dtype=np.float32)  # noqa: fence/host-staging-copy
     n = X.shape[0]
     if metric == "cosine":
         norms = np.linalg.norm(X, axis=1, keepdims=True)
@@ -527,7 +527,7 @@ def _streaming_dbscan_fit_predict(
         qe = min(qs + query_block, n)
 
         def _core_query_block(qs=qs, qe=qe):
-            qb = jnp.asarray(np.ascontiguousarray(X[qs:qe], np.float32))
+            qb = jnp.asarray(np.ascontiguousarray(X[qs:qe], np.float32))  # noqa: fence/host-staging-copy
             acc = jnp.zeros((qe - qs,), jnp.int32)
             for s, nv, xb, x2b in count_blocks():
                 acc = acc + count_tile(qb, xb, x2b, nv)

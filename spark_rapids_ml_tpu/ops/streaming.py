@@ -37,6 +37,63 @@ from ..reliability import (
     resumable_accumulate,
 )
 from ._precision import pdot
+from .ingest import StagingPool, stage_block
+
+
+# ----------------------------------------------------------------- fused chains
+#
+# A "chain" is the featurize prefix of a fused featurize->fit pipeline
+# (pipeline.py::_try_fused_fit, docs/design.md §6k): a tuple of host-side
+# ("scale", mean, std) / ("project", components) ops applied ON DEVICE inside
+# every accumulator kernel — after the in-program ingest cast, before any
+# statistic — so the intermediate (scaled / projected X) exists only inside
+# the compiled program: it never round-trips to host and never materializes a
+# second HBM copy. The expressions are EXACTLY the staged transforms'
+# (StandardScalerModel: (X - mean) / std; PCAModel: pdot(X, components.T));
+# bit-parity with the staged path is the contract the fuser ships under.
+
+
+def chain_out_dim(d: int, chain_ops) -> int:
+    """Feature width after the chain (a projection rewrites it to its
+    component count; scaling preserves it)."""
+    for op in chain_ops or ():
+        if op[0] == "project":
+            d = int(np.asarray(op[1]).shape[0])
+    return d
+
+
+def _prep_chain(chain_ops, dt):
+    """Split host chain ops into the (static kinds, device operand arrays)
+    pair the accumulator kernels take. Operands are staged once per fit in
+    compute dtype — the staged transforms' own operand dtype."""
+    if not chain_ops:
+        return (), ()
+    kinds = []
+    arrays = []
+    for op in chain_ops:
+        kinds.append(str(op[0]))
+        arrays.extend(jnp.asarray(np.asarray(a, dtype=dt)) for a in op[1:])
+    return tuple(kinds), tuple(arrays)
+
+
+def _apply_chain(X, dt, chain, chain_arrays):
+    """The FIRST fused step of every accumulator kernel: the in-program
+    ingest cast (identity when the batch already arrived in compute dtype)
+    followed by the featurize chain."""
+    X = X.astype(dt)
+    i = 0
+    for kind in chain:
+        if kind == "scale":
+            mean, std = chain_arrays[i], chain_arrays[i + 1]
+            i += 2
+            X = (X - mean) / std
+        elif kind == "project":
+            comps = chain_arrays[i]
+            i += 1
+            X = pdot(X, comps.T)
+        else:
+            raise ValueError(f"unknown chain op '{kind}'")
+    return X
 
 
 def _prefetch(iterable, depth: int = 1, site: Optional[str] = None, start_batch: int = 0):
@@ -173,9 +230,14 @@ def _accumulate_stream(carry, accum, n, batch_rows, mesh, slicer, site: str = "i
 # per batch. Batch operands are NEVER donated — cached batches (device_cache)
 # must survive the call to replay on later passes. The checkpoint-resume layer
 # snapshots carry COPIES for the same reason (reliability/checkpoint.py).
-@compiled_kernel("streaming.accum_linreg", donate_argnums=(0,))
-def _accum_linreg(carry, X, y, w):
+@compiled_kernel("streaming.accum_linreg", static_argnames=("chain",),
+                 donate_argnums=(0,))
+def _accum_linreg(carry, X, y, w, chain_arrays=(), chain=()):
     A, b, sx, sy, sw = carry
+    dt = A.dtype
+    X = _apply_chain(X, dt, chain, chain_arrays)
+    y = y.astype(dt)
+    w = w.astype(dt)
     Xw = X * w[:, None]
     return (
         A + pdot(Xw.T, X),
@@ -186,9 +248,13 @@ def _accum_linreg(carry, X, y, w):
     )
 
 
-@compiled_kernel("streaming.accum_cov", donate_argnums=(0,))
-def _accum_cov(carry, X, w):
+@compiled_kernel("streaming.accum_cov", static_argnames=("chain",),
+                 donate_argnums=(0,))
+def _accum_cov(carry, X, w, chain_arrays=(), chain=()):
     S2, sx, sw = carry
+    dt = S2.dtype
+    X = _apply_chain(X, dt, chain, chain_arrays)
+    w = w.astype(dt)
     return (
         S2 + pdot((X * w[:, None]).T, X),
         sx + pdot(w, X),
@@ -203,14 +269,20 @@ def streaming_linreg_stats(
     batch_rows: int,
     mesh=None,
     float32: bool = True,
+    chain_ops=None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
     """Streamed (XᵀWX, XᵀWy, x̄, ȳ, Σw): the same statistics as
     ops/linear.linreg_sufficient_stats but with O(batch) device residency.
     Each batch is device_put (sharded over the mesh when given) and accumulated.
     dtype follows float32 (float64 additionally needs jax x64 mode, matching the
-    in-core path's device behavior)."""
+    in-core path's device behavior). `chain_ops` fuses a featurize prefix into
+    the per-batch program (docs/design.md §6k)."""
+    from .device_cache import batch_cache
+
     dt = np.float32 if float32 else np.float64
-    d = X.shape[1]
+    n = X.shape[0]
+    d = chain_out_dim(X.shape[1], chain_ops)
+    kinds, chain_arrays = _prep_chain(chain_ops, dt)
     A = jnp.zeros((d, d), dt)
     b = jnp.zeros((d,), dt)
     sx = jnp.zeros((d,), dt)
@@ -218,21 +290,32 @@ def streaming_linreg_stats(
     sw = jnp.zeros((), dt)
     carry = (A, b, sx, sy, sw)
 
-    n = X.shape[0]
+    pool = StagingPool()
+    ones = np.ones((min(batch_rows, n),), dt) if w is None else None
 
     def slicer(s, e):
         return (
-            np.ascontiguousarray(X[s:e], dtype=dt),
-            np.ascontiguousarray(y[s:e], dtype=dt),
-            np.ones((e - s,), dt)
+            stage_block(X, s, e, dt, pool, slot="X"),
+            stage_block(y, s, e, dt, pool, slot="y"),
+            ones[: e - s]
             if w is None
-            else np.ascontiguousarray(w[s:e], dtype=dt),
+            else stage_block(w, s, e, dt, pool, slot="w"),
         )
 
-    carry = _accumulate_stream(
-        carry, lambda c, batch: _accum_linreg(c, *batch), n, batch_rows, mesh,
-        slicer, progress_phase="linreg.batches",
-    )
+    with batch_cache() as cache:
+        ckey = (
+            cache.stream_key(
+                tuple(a for a in (X, y, w) if a is not None), batch_rows, mesh
+            )
+            if cache is not None
+            else None
+        )
+        carry = _accumulate_stream(
+            carry,
+            lambda c, batch: _accum_linreg(c, *batch, chain_arrays, kinds),
+            n, batch_rows, mesh, slicer, cache=cache, cache_key=ckey,
+            progress_phase="linreg.batches",
+        )
     A, b, sx, sy, sw = carry
     return A, b, sx / sw, sy / sw, sw
 
@@ -243,34 +326,109 @@ def streaming_covariance(
     batch_rows: int,
     mesh=None,
     float32: bool = True,
+    chain_ops=None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Streamed weighted covariance (cov, mean, Σw) for PCA — the same math as
-    ops/linalg.weighted_covariance, dtype per `float32` (see streaming_linreg_stats)."""
+    ops/linalg.weighted_covariance, dtype per `float32` (see
+    streaming_linreg_stats). `chain_ops` fuses a featurize prefix into the
+    per-batch program; the active HBM batch-cache scope is shared, so the
+    other passes of a fused chain replay these batches."""
+    from .device_cache import batch_cache
+
     dt = np.float32 if float32 else np.float64
-    d = X.shape[1]
+    n = X.shape[0]
+    d = chain_out_dim(X.shape[1], chain_ops)
+    kinds, chain_arrays = _prep_chain(chain_ops, dt)
     carry = (
         jnp.zeros((d, d), dt),
         jnp.zeros((d,), dt),
         jnp.zeros((), dt),
     )
-    n = X.shape[0]
+
+    pool = StagingPool()
+    ones = np.ones((min(batch_rows, n),), dt) if w is None else None
 
     def slicer(s, e):
         return (
-            np.ascontiguousarray(X[s:e], dtype=dt),
-            np.ones((e - s,), dt)
+            stage_block(X, s, e, dt, pool, slot="X"),
+            ones[: e - s]
             if w is None
-            else np.ascontiguousarray(w[s:e], dtype=dt),
+            else stage_block(w, s, e, dt, pool, slot="w"),
         )
 
-    carry = _accumulate_stream(
-        carry, lambda c, batch: _accum_cov(c, *batch), n, batch_rows, mesh,
-        slicer, progress_phase="pca.batches",
-    )
+    with batch_cache() as cache:
+        ckey = (
+            cache.stream_key(
+                tuple(a for a in (X, w) if a is not None), batch_rows, mesh
+            )
+            if cache is not None
+            else None
+        )
+        carry = _accumulate_stream(
+            carry,
+            lambda c, batch: _accum_cov(c, *batch, chain_arrays, kinds),
+            n, batch_rows, mesh, slicer, cache=cache, cache_key=ckey,
+            progress_phase="pca.batches",
+        )
     S2, sx, sw = carry
     mean = sx / sw
     cov = (S2 - sw * jnp.outer(mean, mean)) / (sw - 1.0)
     return cov, mean, sw
+
+
+def streaming_moments(
+    X: np.ndarray,
+    w: Optional[np.ndarray],
+    batch_rows: int,
+    mesh=None,
+    float32: bool = True,
+    chain_ops=None,
+) -> Tuple[np.ndarray, np.ndarray, float]:
+    """Streamed weighted feature moments -> (mean, var, Σw), Spark Summarizer
+    semantics (variance normalized by Σw-1, matching ops/linalg
+    weighted_moments and the streamed-logreg standardization pass). This is
+    the StandardScaler fit statistic; `chain_ops` lets a fused pipeline
+    compute the moments of an already-chained (e.g. projected) feature space.
+    Shares the active HBM batch-cache scope: in a fused chain the fit passes
+    that follow replay the batches this pass uploaded."""
+    from .device_cache import batch_cache
+
+    dt = np.float32 if float32 else np.float64
+    n = X.shape[0]
+    d = chain_out_dim(X.shape[1], chain_ops)
+    kinds, chain_arrays = _prep_chain(chain_ops, dt)
+    carry = (jnp.zeros((d,), dt), jnp.zeros((d,), dt), jnp.zeros((), dt))
+
+    pool = StagingPool()
+    ones = np.ones((min(batch_rows, n),), dt) if w is None else None
+
+    def slicer(s, e):
+        return (
+            stage_block(X, s, e, dt, pool, slot="X"),
+            ones[: e - s]
+            if w is None
+            else stage_block(w, s, e, dt, pool, slot="w"),
+        )
+
+    with batch_cache() as cache:
+        ckey = (
+            cache.stream_key(
+                tuple(a for a in (X, w) if a is not None), batch_rows, mesh
+            )
+            if cache is not None
+            else None
+        )
+        carry = _accumulate_stream(
+            carry,
+            lambda c, batch: _accum_moments(c, *batch, chain_arrays, kinds),
+            n, batch_rows, mesh, slicer, cache=cache, cache_key=ckey,
+            progress_phase="scaler.batches",
+        )
+    sx, sxx, sw_j = carry
+    wsum = float(sw_j)
+    mean = np.asarray(sx) / wsum
+    var = np.maximum((np.asarray(sxx) - wsum * mean * mean) / (wsum - 1.0), 0.0)
+    return mean, var, wsum
 
 
 def _kahan_add(acc, comp, term):
@@ -288,12 +446,12 @@ def _kahan_add(acc, comp, term):
 
 @compiled_kernel(
     "streaming.logreg_value_grad",
-    static_argnames=("fit_intercept", "multinomial"),
+    static_argnames=("fit_intercept", "multinomial", "chain"),
     donate_argnums=(0, 1, 2, 3),
 )
 def _logreg_accum_value_grad(
-    acc_v, comp_v, acc_g, comp_g, params, X, y_enc, w, scale, fit_intercept,
-    multinomial,
+    acc_v, comp_v, acc_g, comp_g, params, X, y_enc, w, scale, chain_arrays,
+    fit_intercept, multinomial, chain=(),
 ):
     """One batch of the UNNORMALIZED cross-entropy value+grad folded into the
     running device accumulators (no /Σw, no penalty — the caller normalizes and
@@ -303,6 +461,10 @@ def _logreg_accum_value_grad(
     compensations) is donated: each batch update reuses the buffers in place of
     a fresh allocation, and the running loss/grad never round-trips to host
     mid-pass."""
+    dt = acc_g.dtype
+    X = _apply_chain(X, dt, chain, chain_arrays)
+    y_enc = y_enc.astype(dt)
+    w = w.astype(dt)
 
     def f(p):
         if multinomial:
@@ -319,9 +481,13 @@ def _logreg_accum_value_grad(
     return acc_v, comp_v, acc_g, comp_g
 
 
-@compiled_kernel("streaming.accum_moments", donate_argnums=(0,))
-def _accum_moments(carry, X, w):
+@compiled_kernel("streaming.accum_moments", static_argnames=("chain",),
+                 donate_argnums=(0,))
+def _accum_moments(carry, X, w, chain_arrays=(), chain=()):
     sx, sxx, sw = carry
+    dt = sx.dtype
+    X = _apply_chain(X, dt, chain, chain_arrays)
+    w = w.astype(dt)
     return (sx + pdot(w, X), sxx + pdot(w, X * X), sw + jnp.sum(w))
 
 
@@ -408,6 +574,7 @@ def streaming_logreg_fit(
     batch_rows: int,
     mesh=None,
     float32: bool = True,
+    chain_ops=None,
 ):
     """Out-of-core distributed L-BFGS logistic regression: X stays HOST-resident;
     each objective/gradient evaluation streams batches through the device and
@@ -440,15 +607,18 @@ def streaming_logreg_fit(
         return _streaming_logreg_fit(
             X, y, w, n_classes, reg, l1_ratio, fit_intercept, standardize,
             max_iter, tol, multinomial, batch_rows, mesh, float32, cache,
+            chain_ops,
         )
 
 
 def _streaming_logreg_fit(
     X, y, w, n_classes, reg, l1_ratio, fit_intercept, standardize, max_iter,
-    tol, multinomial, batch_rows, mesh, float32, cache,
+    tol, multinomial, batch_rows, mesh, float32, cache, chain_ops=None,
 ):
     dt = np.float32 if float32 else np.float64
-    n, d = X.shape
+    n = X.shape[0]
+    d = chain_out_dim(X.shape[1], chain_ops)
+    kinds, chain_arrays = _prep_chain(chain_ops, dt)
     reg_l1 = reg * l1_ratio
     reg_l2 = reg * (1.0 - l1_ratio)
     ckey = (
@@ -459,13 +629,16 @@ def _streaming_logreg_fit(
         else None
     )
 
+    pool = StagingPool()
+    ones = np.ones((min(batch_rows, n),), dt) if w is None else None
+
     def _slicer(s, e):
         return (
-            np.ascontiguousarray(X[s:e], dtype=dt),
-            np.ascontiguousarray(y[s:e], dtype=dt),
-            np.ones((e - s,), dt)
+            stage_block(X, s, e, dt, pool, slot="X"),
+            stage_block(y, s, e, dt, pool, slot="y"),
+            ones[: e - s]
             if w is None
-            else np.ascontiguousarray(w[s:e], dtype=dt),
+            else stage_block(w, s, e, dt, pool, slot="w"),
         )
 
     # streamed standardization moments (Spark Summarizer wsum-1 variance,
@@ -474,7 +647,10 @@ def _streaming_logreg_fit(
         carry = (jnp.zeros((d,), dt), jnp.zeros((d,), dt), jnp.zeros((), dt))
         with obs_span("logreg.moments"):
             carry = _accumulate_stream(
-                carry, lambda c, batch: _accum_moments(c, batch[0], batch[2]),
+                carry,
+                lambda c, batch: _accum_moments(
+                    c, batch[0], batch[2], chain_arrays, kinds
+                ),
                 n, batch_rows, mesh, _slicer, cache=cache, cache_key=ckey,
                 progress_phase="logreg.moments",
             )
@@ -525,8 +701,8 @@ def _streaming_logreg_fit(
             # resume layer's snapshots are copies (reliability/checkpoint.py),
             # never aliases of a buffer a later batch will donate
             return _logreg_accum_value_grad(
-                *carry, params, Xb, y_enc, wb, scale,
-                bool(fit_intercept), bool(multinomial),
+                *carry, params, Xb, y_enc, wb, scale, chain_arrays,
+                bool(fit_intercept), bool(multinomial), kinds,
             )
 
         acc_v, _, acc_g, _ = _accumulate_stream(
@@ -555,9 +731,17 @@ def _streaming_logreg_fit(
         from .linalg import power_iteration_lmax
 
         carry = (jnp.zeros((d, d), dt), jnp.zeros((d,), dt), jnp.zeros((), dt))
+        # X/scale rides the fused program as one more ("scale", 0, scale)
+        # chain link — (x - 0)/scale is bit-equal to x/scale, and the scaled
+        # batch never materializes outside the accumulator
+        gram_kinds = kinds + ("scale",)
+        gram_arrays = chain_arrays + (jnp.zeros((d,), dt), scale)
         with obs_span("logreg.gram"):
             carry = _accumulate_stream(
-                carry, lambda c, batch: _accum_cov(c, batch[0] / scale, batch[2]),
+                carry,
+                lambda c, batch: _accum_cov(
+                    c, batch[0], batch[2], gram_arrays, gram_kinds
+                ),
                 n, batch_rows, mesh, _slicer, cache=cache, cache_key=ckey,
                 progress_phase="logreg.gram",
             )
@@ -681,12 +865,16 @@ def _finish_logreg(x, shape, scale_h, fit_intercept, multinomial, n_iter, fx):
     }
 
 
-@compiled_kernel("streaming.accum_kmeans", static_argnames=("cosine",),
+@compiled_kernel("streaming.accum_kmeans", static_argnames=("cosine", "chain"),
                  donate_argnums=(0,))
-def _accum_kmeans(carry, centers, X, w, cosine: bool = False):
+def _accum_kmeans(carry, centers, X, w, chain_arrays=(), cosine: bool = False,
+                  chain=()):
     """One batch of a streamed Lloyd iteration: accumulate per-cluster weighted sums,
     counts and inertia against FIXED centers."""
     sums, counts, inertia = carry
+    dt = sums.dtype
+    X = _apply_chain(X, dt, chain, chain_arrays)
+    w = w.astype(dt)
     if cosine:
         d2 = 1.0 - pdot(X, centers.T)
     else:
@@ -715,6 +903,7 @@ def streaming_kmeans_fit(
     metric: str = "euclidean",
     init_sample_rows: int = 1 << 18,
     float32: bool = True,
+    chain_ops=None,
 ):
     """Out-of-core EXACT Lloyd: each iteration streams every batch through the device
     against fixed centers and accumulates (Σ one-hotᵀWX, counts, inertia); centers
@@ -731,48 +920,75 @@ def streaming_kmeans_fit(
     with batch_cache() as cache:
         return _streaming_kmeans_fit(
             X, w, k, max_iter, tol, seed, batch_rows, mesh, metric,
-            init_sample_rows, float32, cache,
+            init_sample_rows, float32, cache, chain_ops,
         )
 
 
 def _streaming_kmeans_fit(
     X, w, k, max_iter, tol, seed, batch_rows, mesh, metric, init_sample_rows,
-    float32, cache,
+    float32, cache, chain_ops=None,
 ):
     from .kmeans import _normalize_rows, kmeans_init
 
     dt = np.float32 if float32 else np.float64
     n, d = X.shape
     cosine = metric == "cosine"
+    if cosine and chain_ops:
+        raise ValueError(
+            "cosine KMeans is not fuse-eligible (host-side normalization); "
+            "the pipeline fuser must leave it staged"
+        )
+    d = chain_out_dim(d, chain_ops)
+    kinds, chain_arrays = _prep_chain(chain_ops, dt)
+    # the cache key pins the RAW sources: a None weight materializes to the
+    # same implicit all-ones below, so leaving it out of the key lets every
+    # pass — and every candidate of a CV loop over the same X — replay the
+    # same HBM-resident batches
+    ckey = (
+        cache.stream_key(
+            tuple(a for a in (X, w) if a is not None), batch_rows, mesh
+        )
+        if cache is not None
+        else None
+    )
     if w is None:
         w = np.ones((n,), dt)
-    ckey = (
-        cache.stream_key((X, w), batch_rows, mesh) if cache is not None else None
-    )
 
     # init on a subsample (rows are not assumed shuffled: use a strided sample)
     with obs_span("kmeans.init", {"sample_rows": min(n, init_sample_rows)}):
         step = max(1, n // min(n, init_sample_rows))
-        Xs = np.ascontiguousarray(X[::step], dtype=dt)
-        ws = np.ascontiguousarray(w[::step], dtype=dt)
+        # strided: never contiguous past step 1, and k-means|| owns the buffer
+        Xs = np.ascontiguousarray(X[::step], dtype=dt)  # noqa: fence/host-staging-copy
+        ws = np.ascontiguousarray(w[::step], dtype=dt)  # noqa: fence/host-staging-copy
         Xs_j = jnp.asarray(Xs if not cosine else np.asarray(
             Xs / np.maximum(np.linalg.norm(Xs, axis=1, keepdims=True), 1e-30)))
+        if kinds:
+            # same in-program expressions the per-batch accumulators run, so
+            # the init sample sees bit-identical features to the staged path
+            Xs_j = _apply_chain(Xs_j, dt, kinds, chain_arrays)
         centers = jnp.asarray(
             kmeans_init(Xs_j, jnp.asarray(ws), k, "k-means||", 2, seed)
         )
         if cosine:
             centers = _normalize_rows(centers)
 
+    pool = StagingPool()
+
     def _slicer(s, e):
-        Xb = np.ascontiguousarray(X[s:e], dtype=dt)
         if cosine:
+            # normalization mutates: the block must own its buffer
+            Xb = stage_block(X, s, e, dt, pool, slot="X", force_copy=True)
             norms = np.linalg.norm(Xb, axis=1, keepdims=True)
             if np.any(norms <= 0):
                 raise ValueError(
                     "Cosine distance is not defined for zero-length vectors."
                 )
-            Xb = Xb / norms
-        return Xb, np.ascontiguousarray(w[s:e], dtype=dt)
+            np.divide(Xb, norms, out=Xb)
+            return Xb, stage_block(w, s, e, dt, pool, slot="w")
+        return (
+            stage_block(X, s, e, dt, pool, slot="X"),
+            stage_block(w, s, e, dt, pool, slot="w"),
+        )
 
     inertia = np.inf
     n_iter = 0
@@ -792,7 +1008,7 @@ def _streaming_kmeans_fit(
             carry = _accumulate_stream(
                 carry,
                 lambda c, batch, centers=centers: _accum_kmeans(
-                    c, centers, batch[0], batch[1], cosine
+                    c, centers, batch[0], batch[1], chain_arrays, cosine, kinds
                 ),
                 n, batch_rows, mesh, _slicer, cache=cache, cache_key=ckey,
                 progress_phase="kmeans.batches",

