@@ -52,6 +52,7 @@ UNITS = [
     "telemetry_overhead",
     "serving_qps",
     "serving_failover",
+    "continual",
     "large_k",
     "autotune",
     "knn",

@@ -1637,6 +1637,105 @@ def bench_serving_failover(ctx) -> Dict:
         reset_chaos()
 
 
+# --------------------------------------------------------------- continual
+
+
+def bench_continual(ctx) -> Dict:
+    """Continuous-learning plane (continual/, docs/design.md §7d): streamed
+    partial_fit throughput against a LIVE served KMeans. A warmed updater
+    folds a window of fixed-geometry update batches — `continual_update_rows_per_s`
+    is the sustained fold rate (auto-gated higher-is-better) — then a drifted
+    stream drives the governed drift->validate->promote cycle and
+    `continual_staleness_s` reports the recorded data-to-traffic latency of
+    the promotion that lands. `continual_warm_compiles` counts NEW
+    `device.compile` entries across BOTH phases; the fixed-block re-blocking
+    contract requires it to be ZERO."""
+    import pandas as pd
+
+    from spark_rapids_ml_tpu import config as _srml_config
+    from spark_rapids_ml_tpu import serving
+    from spark_rapids_ml_tpu.clustering import KMeans
+    from spark_rapids_ml_tpu.continual import ContinualLoop, DriftDetector
+    from spark_rapids_ml_tpu.observability import current_run
+    from spark_rapids_ml_tpu.observability.runs import global_registry
+    from spark_rapids_ml_tpu.profiling import counter_totals
+
+    batch_rows, n_batches = ctx["continual_rows"]
+    d = 64 if ctx["on_tpu"] else 16
+    heartbeat = ctx.get("heartbeat") or (lambda tag: None)
+
+    rng = np.random.default_rng(17)
+    centers = rng.normal(0, 5, (8, d)).astype(np.float32)
+    shifted = centers + rng.normal(0, 8, centers.shape).astype(np.float32)
+
+    def batch(cs, seed):
+        r = np.random.default_rng(seed)
+        return (cs[r.integers(0, 8, batch_rows)]
+                + r.normal(0, 1, (batch_rows, d))).astype(np.float32)
+
+    model = KMeans(k=8, maxIter=5, seed=1).fit(
+        pd.DataFrame({"features": list(batch(centers, 0)[:4096])})
+    )
+    _srml_config.set("continual.update_batch_rows", min(batch_rows, 1 << 14))
+    _srml_config.set("continual.decay", 0.5)
+    registry = serving.ModelRegistry()
+    try:
+        registry.register("km", model)
+        holdout = batch(shifted, 1)[:2048]
+        loop = ContinualLoop(
+            "km", model.partial_fit_updater(name="km"), (holdout,),
+            registry=registry,
+            detector=DriftDetector(model="km", signal="inertia", mads=6.0,
+                                   min_baseline=2),
+            promote_every=10 ** 9,  # phase 1 measures pure fold throughput
+        )
+        loop.feed(batch(centers, 2))  # warm-up: compiles the update kernels
+        compiles_before = {k: v for k, v in counter_totals().items()
+                           if k.startswith("device.compile{")}
+        heartbeat("continual_warm")
+
+        t0 = time.perf_counter()
+        for i in range(n_batches):
+            out = loop.feed(batch(centers, 10 + i))
+            assert out["promotion"] is None
+        fold_s = time.perf_counter() - t0
+        heartbeat("continual_window")
+
+        # drifted stream: drift fires, governed promotion lands, staleness
+        # gauge records the pending window's data-to-traffic latency
+        promotions = 0
+        for i in range(4):
+            out = loop.feed(batch(shifted, 50 + i))
+            if out["promotion"] and out["promotion"].get("promoted"):
+                promotions += 1
+        compiles_after = {k: v for k, v in counter_totals().items()
+                         if k.startswith("device.compile{")}
+        warm_compiles = sum(compiles_after.get(k, 0) - compiles_before.get(k, 0)
+                            for k in compiles_after)
+
+        run = current_run()
+        snap = (run.registry if run is not None
+                else global_registry()).snapshot()
+        staleness = snap["gauges"].get("continual.staleness_s{model=km}")
+        drifts = sum(v for k, v in snap["counters"].items()
+                     if k.startswith("continual.drift{"))
+        return {
+            "continual_shape": [batch_rows, d],
+            "continual_batches": n_batches,
+            "continual_update_rows_per_s": round(
+                batch_rows * n_batches / fold_s, 1),
+            "continual_promotions": promotions,
+            "continual_drifts": int(drifts),
+            "continual_staleness_s": (round(float(staleness), 6)
+                                      if staleness is not None else None),
+            "continual_warm_compiles": int(warm_compiles),
+        }
+    finally:
+        registry.close()
+        _srml_config.unset("continual.update_batch_rows")
+        _srml_config.unset("continual.decay")
+
+
 # ----------------------------------------------------------------- large_k
 
 
@@ -1895,6 +1994,7 @@ FAMILIES: List = [
     ("telemetry_overhead", bench_telemetry_overhead),
     ("serving_qps", bench_serving_qps),
     ("serving_failover", bench_serving_failover),
+    ("continual", bench_continual),
     ("large_k", bench_large_k),
     ("autotune", bench_autotune),
     ("knn", bench_knn),
@@ -1940,4 +2040,8 @@ def make_ctx(X, w, mesh, on_tpu: bool, platform: str, repo_root: str) -> Dict:
         # latency under micro-batching, not fit throughput; request sizes are
         # drawn up to 256 rows and the model is a k=8 KMeans on this data
         "serving_shape": (200_000, 64) if big else (20_000, 16),
+        # continual unit: (update-batch rows, timed window batches) — sized so
+        # the fold window dominates the fit/prewarm setup while one batch
+        # stays within the fixed-geometry re-blocking budget
+        "continual_rows": (1 << 16, 16) if big else (8_192, 6),
     }

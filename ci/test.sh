@@ -634,7 +634,95 @@ print("ANN LIFECYCLE SMOKE (2/2) OK: fresh-process load searches "
       "0 warm-path compiles and 2 weight refreshes")
 PY
   rm -rf "$SRML_ANN_SMOKE_DIR"
-  python -m pytest tests/ -q --ignore=tests/test_reliability.py --ignore=tests/test_device_cache.py --ignore=tests/test_observability.py --ignore=tests/test_transform_observability.py --ignore=tests/test_telemetry_plane.py --ignore=tests/test_comm_plane.py --ignore=tests/test_serving.py --ignore=tests/test_ann_lifecycle.py
+  # continual smoke (docs/design.md §7d): unit tests first, then the
+  # closed-loop acceptance end-to-end — drifted batches streamed at a LIVE
+  # served KMeans must fire the drift detector deterministically, the
+  # governed promotion must land through the exec-locked mutate path
+  # (generation bump, weight refresh), post-promotion predictions must
+  # reflect the shifted centers, and the whole drift->promote cycle must
+  # add ZERO device.compile entries — every claim counter-asserted from
+  # the exported run-report JSONL, like a dashboard would.
+  python -m pytest tests/test_continual.py -q
+  SRML_CONTINUAL_SMOKE_DIR="$(mktemp -d)"
+  SRML_TPU_METRICS_DIR="$SRML_CONTINUAL_SMOKE_DIR" python - <<'PY'
+import os
+import numpy as np, pandas as pd
+from spark_rapids_ml_tpu import config, serving
+from spark_rapids_ml_tpu.clustering import KMeans
+from spark_rapids_ml_tpu.continual import ContinualLoop, DriftDetector
+from spark_rapids_ml_tpu.observability import fit_run, load_run_reports
+
+OLD = np.array([[0.0, 0.0, 0.0], [6.0, 6.0, 6.0]], np.float32)
+NEW = np.array([[12.0, 12.0, 12.0], [-6.0, 9.0, 0.0]], np.float32)
+
+def blob(centers, n, seed):
+    r = np.random.default_rng(seed)
+    return (r.normal(0, 0.3, (n, centers.shape[1])).astype(np.float32)
+            + centers[r.integers(0, len(centers), n)])
+
+km = KMeans(k=2, maxIter=8, seed=3).fit(
+    pd.DataFrame({"features": list(blob(OLD, 512, 1))}))
+config.set("continual.update_batch_rows", 128)
+config.set("continual.decay", 0.5)  # 1-batch half-life: forget the old blobs
+reg = serving.ModelRegistry()
+holdout = blob(NEW, 256, seed=2)
+loop = ContinualLoop(
+    "km", km.partial_fit_updater(name="km"), (holdout,), registry=reg,
+    # mads=6: the 200-row smoke batches carry ~6% sampling noise against a
+    # 4-value MAD baseline, and the drifted signal is ~400x the threshold —
+    # headroom costs nothing in discriminative power
+    detector=DriftDetector(model="km", signal="inertia", mads=6.0,
+                           min_baseline=4),
+    promote_every=10**9,  # drift is the ONLY promotion trigger here
+)
+with fit_run(algo="ContinualWarm", site="ci"):
+    reg.register("km", km)  # HBM upload + bucketed pre-warm compiles HERE
+    reg.predict("km", blob(OLD, 16, seed=3))
+    for i in range(6):  # in-distribution: calibrates the detector, no drift
+        out = loop.feed(blob(OLD, 200, seed=10 + i))
+        assert out["drift"] is None and out["promotion"] is None, out
+with fit_run(algo="ContinualSteady", site="ci"):
+    gen = None
+    for i in range(4):  # the shifted stream: drift -> promote, repeatedly
+        out = loop.feed(blob(NEW, 200, seed=20 + i))
+        if i == 0:
+            assert out["drift"] is not None, "no drift on the shifted batch"
+            assert out["promotion"] and out["promotion"]["promoted"], out
+        if out["promotion"] and out["promotion"].get("promoted"):
+            gen = out["promotion"]["generation"]
+    pred = reg.predict("km", holdout)["prediction"]
+reg.close()
+
+# the promoted centers sit on the SHIFTED blobs, and live predictions agree
+# with an exact host-side assignment against them
+centers = np.asarray(km._model_attributes["cluster_centers"])
+d = np.linalg.norm(centers[:, None, :] - NEW[None], axis=-1)
+assert (d.min(axis=0) < 1.0).all(), centers
+want = np.linalg.norm(
+    holdout[:, None, :].astype(np.float64) - centers[None], axis=-1
+).argmin(axis=1)
+assert np.array_equal(np.asarray(pred), want)
+
+steady = [r for r in load_run_reports(os.environ["SRML_TPU_METRICS_DIR"])
+          if r["algo"] == "ContinualSteady"][-1]
+c = steady["metrics"]["counters"]
+compiles = sum(v for k, v in c.items() if k.startswith("device.compile{"))
+assert compiles == 0, c
+assert c.get("continual.drift{model=km,signal=inertia}", 0) >= 1, c
+promos = c.get("continual.promotions{model=km}", 0)
+assert promos >= 1, c
+assert c.get("serving.weight_refreshes{model=km}", 0) == promos, c
+g = steady["metrics"]["gauges"]
+assert g.get("serving.model_generation{model=km}") == gen, g
+assert g.get("continual.staleness_s{model=km}", 0) > 0, g
+config.unset("continual.update_batch_rows")
+config.unset("continual.decay")
+print("CONTINUAL SMOKE OK: drift fired on the shifted batch, governed "
+      f"promotion landed (generation {gen}) with 0 warm-path compiles, "
+      "and live predictions follow the promoted centers")
+PY
+  rm -rf "$SRML_CONTINUAL_SMOKE_DIR"
+  python -m pytest tests/ -q --ignore=tests/test_reliability.py --ignore=tests/test_device_cache.py --ignore=tests/test_observability.py --ignore=tests/test_transform_observability.py --ignore=tests/test_telemetry_plane.py --ignore=tests/test_comm_plane.py --ignore=tests/test_serving.py --ignore=tests/test_ann_lifecycle.py --ignore=tests/test_continual.py
 fi
 
 # small benchmark smoke (reference runs a small bench pre-merge)

@@ -97,3 +97,28 @@ ANN_LIST_BUCKET_MIN_ROWS = 8
 # width-bound, not item-bound), while compaction — a full re-layout — stays
 # rare under churny delete/add traffic.
 ANN_COMPACT_TOMBSTONE_PCT = 30
+
+# ------------------------------------------------- continuous-learning plane
+# (spark_rapids_ml_tpu/continual/, docs/design.md §7d)
+#
+# CONTINUAL_DECAY: per-update discount applied to the persistent sufficient-
+# statistics carry before each partial_fit fold. Provenance: 1.0 is the
+# streaming-kmeans paper's a=1 "infinite memory" setting (arxiv 1505.06807)
+# — forgetting is an opt-in policy decision, so the default never silently
+# down-weights history; half-life h maps to decay = 0.5 ** (1 / h) updates.
+CONTINUAL_DECAY = 1.0
+# CONTINUAL_UPDATE_BATCH_ROWS: fixed block geometry partial_fit re-blocks
+# every update batch to (zero-weight padding on the ragged tail). Provenance:
+# 16k f32 rows at the BASELINE 256-col shape is a 16 MiB block — small enough
+# that an update cycle stays sub-second (continual updates are latency-bound,
+# unlike the 64k-row throughput-bound ANN builds), and a single power-of-two
+# geometry keeps the whole update stream inside ONE compiled executable per
+# accumulator kernel.
+CONTINUAL_UPDATE_BATCH_ROWS = 1 << 14
+# CONTINUAL_DRIFT_MADS: MADs of separation above the baseline median a fresh
+# per-row signal needs to fire drift. Provenance: mirrors the measurement
+# discipline everywhere else in the tree — `autotune.noise_mads` and
+# ci/bench_check.py both demand 3 MADs before calling two samples different,
+# and drift is the same judgment (is this batch's loss a new distribution or
+# the old one's noise?).
+CONTINUAL_DRIFT_MADS = 3.0

@@ -166,6 +166,32 @@ KNOBS: Dict[str, Knob] = {
             grid=(1 << 10, 1 << 12, 1 << 14, 1 << 16),
         ),
         Knob(
+            "continual.decay", "float",
+            "per-update discount on the partial_fit sufficient-statistics "
+            "carry — 1.0 = infinite memory, half-life h updates = "
+            "0.5 ** (1 / h) (continual/partial_fit.py::resolve_decay)",
+            config_key="continual.decay", auto_values=(0.0,), dims=(),
+            grid=(0.9, 0.99, 0.999, 1.0),
+        ),
+        Knob(
+            "continual.update_batch_rows", "int",
+            "fixed block geometry partial_fit re-blocks every update batch "
+            "to, zero-weight padded, so the update stream stays inside one "
+            "compiled executable per accumulator "
+            "(continual/partial_fit.py::resolve_update_batch_rows)",
+            config_key="continual.update_batch_rows", auto_values=(0,),
+            dims=("n", "d"),
+            grid=(1 << 12, 1 << 13, 1 << 14, 1 << 15, 1 << 16),
+        ),
+        Knob(
+            "continual.drift_mads", "float",
+            "MADs above the baseline median a fresh per-row signal must land "
+            "to fire `continual.drift` "
+            "(continual/drift.py::resolve_drift_mads)",
+            config_key="continual.drift_mads", auto_values=(0.0,), dims=(),
+            grid=(2.0, 3.0, 4.0, 5.0),
+        ),
+        Knob(
             "ann.compact_tombstone_pct", "int",
             "tombstoned-slot percentage of occupied slots that triggers IVF "
             "list compaction (ops/ann_lifecycle.py::needs_compaction)",

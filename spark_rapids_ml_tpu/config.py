@@ -242,6 +242,25 @@ _DEFAULTS: Dict[str, Any] = {
     # and the staged trace is simpler to debug); 0 = auto (tuning table, else
     # autotune/defaults.py)
     "pipeline.fuse_min_rows": 0,
+    # continuous-learning plane (spark_rapids_ml_tpu/continual/, docs/
+    # design.md §7d): streamed partial_fit + drift detection + governed
+    # promotion. decay: per-update discount on the persistent sufficient-
+    # statistics carry (1.0 = infinite memory, the 1505.06807 a=1 default;
+    # 0.0 = auto: tuning table, else autotune/defaults.py). update_batch_rows:
+    # fixed block geometry of partial_fit ingest — every update batch is
+    # re-blocked to this row count (zero-weight padding) so a steady update
+    # stream re-enters ONE compiled executable per kernel (0 = auto).
+    # drift_mads: MADs above the baseline median a per-row signal must land
+    # to fire `continual.drift` (0.0 = auto). promote_every: attempt a
+    # governed promotion after this many updates even without drift.
+    # min_baseline: self-calibration floor — observations absorbed into the
+    # noise baseline before the detector may fire (when no fit-time
+    # convergence tail seeded it)
+    "continual.decay": 0.0,
+    "continual.update_batch_rows": 0,
+    "continual.drift_mads": 0.0,
+    "continual.promote_every": 4,
+    "continual.min_baseline": 8,
     # closed-loop autotuner (spark_rapids_ml_tpu/autotune/, docs/design.md
     # §6i): telemetry-driven knob search persisted as per-platform tuning
     # tables. mode:
@@ -334,6 +353,11 @@ _ENV_KEYS: Dict[str, str] = {
     "ingest.staging_pool_rows": "SRML_TPU_INGEST_STAGING_POOL_ROWS",
     "pipeline.fuse": "SRML_TPU_PIPELINE_FUSE",
     "pipeline.fuse_min_rows": "SRML_TPU_PIPELINE_FUSE_MIN_ROWS",
+    "continual.decay": "SRML_TPU_CONTINUAL_DECAY",
+    "continual.update_batch_rows": "SRML_TPU_CONTINUAL_UPDATE_BATCH_ROWS",
+    "continual.drift_mads": "SRML_TPU_CONTINUAL_DRIFT_MADS",
+    "continual.promote_every": "SRML_TPU_CONTINUAL_PROMOTE_EVERY",
+    "continual.min_baseline": "SRML_TPU_CONTINUAL_MIN_BASELINE",
     "autotune.mode": "SRML_TPU_AUTOTUNE_MODE",
     "autotune.dir": "SRML_TPU_TUNE_DIR",
     "autotune.replicates": "SRML_TPU_AUTOTUNE_REPLICATES",

@@ -1,0 +1,163 @@
+#
+# Governed promotion: the update -> validate -> promote loop that closes the
+# continuous-learning plane (docs/design.md §7d).
+#
+# A candidate (the attrs the updater's carry implies) must BEAT the incumbent
+# anchor on a fixed holdout slice before it touches traffic; the swap then
+# rides `serving.mutate_model` — fn(model) under the per-entry exec lock,
+# weight refresh, fleet replica fan-out, and a monotone
+# `serving.model_generation{model=}` bump — and never recompiles: the
+# promoted attrs keep every operand shape, and the holdout scores reuse the
+# warmed update kernels at the same fixed block geometry. Rejected candidates
+# leave the carry accumulating toward the next attempt; `rollback()` restores
+# the pre-promotion attrs through the same governed path.
+#
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional
+
+from .. import config as _config
+from ..observability import counter_inc, event, gauge_set, span as obs_span
+from .drift import DriftDetector
+from .partial_fit import PartialFitUpdater
+
+
+class PromotionGovernor:
+    """Validate-then-promote for one (served model, updater) pair.
+
+    `holdout` is the fixed validation slice: (X,), (X, y) or (X, y, w) —
+    whatever the updater's score() needs. `served=False` runs the same
+    contract against the bare model object (no registry) for offline use."""
+
+    def __init__(self, name: str, updater: PartialFitUpdater, holdout,
+                 registry=None, served: bool = True, tolerance: float = 0.0):
+        self.name = name
+        self.updater = updater
+        self.holdout = tuple(holdout)
+        self._registry = registry
+        self._served = bool(served)
+        self.tolerance = float(tolerance)
+        self._previous: Optional[Dict[str, Any]] = None
+
+    def _mutate(self, attrs: Dict[str, Any]) -> Dict[str, Any]:
+        def fn(model):
+            model._model_attributes.update(attrs)
+
+        if not self._served:
+            fn(self.updater._model)
+            return {}
+        if self._registry is not None:
+            return self._registry.mutate(self.name, fn)
+        from ..serving.http import mutate_model
+
+        return mutate_model(self.name, fn)
+
+    def try_promote(self) -> Dict[str, Any]:
+        """One validate->promote attempt. Returns the decision record."""
+        with obs_span("continual.promote", {"model": self.name}):
+            try:
+                attrs = self.updater.candidate()
+            except RuntimeError as e:
+                counter_inc("continual.rejected", 1, model=self.name)
+                return {"promoted": False, "reason": str(e)}
+            cand = self.updater.score(attrs, *self.holdout)
+            incumbent = self.updater.anchor_attrs()
+            cur = self.updater.score(incumbent, *self.holdout)
+            if cand > cur * (1.0 + self.tolerance):
+                counter_inc("continual.rejected", 1, model=self.name)
+                return {
+                    "promoted": False, "reason": "holdout_regression",
+                    "candidate_score": cand, "incumbent_score": cur,
+                }
+            stats = self._mutate(attrs)
+            self._previous = incumbent
+            self.updater.rebase(attrs)
+            counter_inc("continual.promotions", 1, model=self.name)
+            event("continual.promotion", model=self.name,
+                  generation=stats.get("generation"),
+                  candidate_score=cand, incumbent_score=cur)
+            return {
+                "promoted": True,
+                "generation": stats.get("generation"),
+                "candidate_score": cand,
+                "incumbent_score": cur,
+            }
+
+    def rollback(self) -> Dict[str, Any]:
+        """Restore the pre-promotion attrs through the same governed mutate
+        path (exec lock, refresh, replica fan-out, generation bump)."""
+        if self._previous is None:
+            raise RuntimeError("nothing to roll back: no promotion recorded")
+        attrs = self._previous
+        stats = self._mutate(attrs)
+        self.updater.rebase(attrs)
+        self._previous = None
+        counter_inc("continual.rollbacks", 1, model=self.name)
+        return {"rolled_back": True, "generation": stats.get("generation")}
+
+
+class ContinualLoop:
+    """The scheduled feed loop: update -> drift-check -> (maybe) promote.
+
+    Synchronous and deterministic — `feed()` folds one update batch, feeds
+    the drift detector, and attempts a governed promotion either on drift or
+    every `continual.promote_every` updates. `continual.staleness_s{model=}`
+    records data-to-traffic latency: the age of the oldest unpromoted update
+    at the moment a promotion lands."""
+
+    def __init__(self, name: str, updater: PartialFitUpdater, holdout,
+                 registry=None, served: bool = True,
+                 detector: Optional[DriftDetector] = None,
+                 promote_every: Optional[int] = None,
+                 tolerance: float = 0.0):
+        self.name = name
+        self.updater = updater
+        # explicit None-check: a freshly-seeded detector has len() == 0 and
+        # would read as falsy under `or`
+        self.detector = (detector if detector is not None
+                         else DriftDetector(model=name, signal=updater.signal))
+        self.governor = PromotionGovernor(name, updater, holdout,
+                                          registry=registry, served=served,
+                                          tolerance=tolerance)
+        self.promote_every = (
+            int(_config.get("continual.promote_every"))
+            if promote_every is None else int(promote_every)
+        )
+        self._since_promote = 0
+        self._pending_since: Optional[float] = None
+
+    def feed(self, X, y=None, w=None) -> Dict[str, Any]:
+        rep = self.updater.update(X, y=y, w=w)
+        if self._pending_since is None:
+            self._pending_since = time.time()
+        drift = self.detector.observe(rep["value"])
+        self._since_promote += 1
+        out: Dict[str, Any] = {"update": rep, "drift": drift,
+                               "promotion": None}
+        if drift is not None or self._since_promote >= self.promote_every:
+            res = self.governor.try_promote()
+            self._since_promote = 0
+            if res.get("promoted"):
+                staleness = time.time() - self._pending_since
+                gauge_set("continual.staleness_s", round(staleness, 6),
+                          model=self.name)
+                res["staleness_s"] = staleness
+                self._pending_since = None
+            out["promotion"] = res
+        return out
+
+    def run(self, batches) -> list:
+        """Drain an iterable of update batches: each item is X, (X, y) or
+        (X, y, w)."""
+        results = []
+        for item in batches:
+            if isinstance(item, tuple):
+                results.append(self.feed(*item))
+            else:
+                results.append(self.feed(item))
+        return results
+
+
+__all__ = ["ContinualLoop", "PromotionGovernor"]

@@ -519,6 +519,14 @@ class LogisticRegressionModel(
     def numClasses(self) -> int:
         return self._model_attributes["num_classes"]
 
+    def partial_fit_updater(self, **kwargs):
+        """Streamed continual-learning updater anchored on this model:
+        proximal-gradient steps warm-started from the served coefficients
+        (continual/partial_fit.py, docs/design.md §7d)."""
+        from ..continual.partial_fit import LogisticRegressionUpdater
+
+        return LogisticRegressionUpdater(self, **kwargs)
+
     @property
     def numFeatures(self) -> int:
         return int(self._model_attributes["coefficients"].shape[1])

@@ -332,6 +332,14 @@ class KMeansModel(_KMeansClass, _TpuModelWithPredictionCol, _KMeansParams):
         """Spark MLlib KMeansModel surface."""
         return list(self._model_attributes["cluster_centers"])
 
+    def partial_fit_updater(self, **kwargs):
+        """Streamed continual-learning updater anchored on this model: mini-
+        batch discounted center updates per arXiv 1505.06807 (continual/
+        partial_fit.py, docs/design.md §7d)."""
+        from ..continual.partial_fit import KMeansUpdater
+
+        return KMeansUpdater(self, **kwargs)
+
     @property
     def hasSummary(self) -> bool:
         """True on a freshly-fit model (the reference always returns False,

@@ -198,6 +198,14 @@ class PCAModel(_PCAClass, _TpuModelWithColumns, _PCAParams):
         """Principal components as a (d, k) matrix, Spark's PCAModel.pc layout."""
         return self._model_attributes["components"].T
 
+    def partial_fit_updater(self, **kwargs):
+        """Streamed continual-learning updater anchored on this model:
+        incremental PCA via the streamed covariance accumulators (continual/
+        partial_fit.py, docs/design.md §7d)."""
+        from ..continual.partial_fit import PCAUpdater
+
+        return PCAUpdater(self, **kwargs)
+
     @property
     def explainedVariance(self) -> np.ndarray:
         """Proportion of variance explained per component (Spark semantics)."""

@@ -426,6 +426,14 @@ class LinearRegressionModel(
     def numFeatures(self) -> int:
         return int(self._model_attributes["coefficients"].shape[0])
 
+    def partial_fit_updater(self, **kwargs):
+        """Streamed continual-learning updater anchored on this model: exact
+        re-solves from decayed normal-equation statistics (continual/
+        partial_fit.py, docs/design.md §7d)."""
+        from ..continual.partial_fit import LinearRegressionUpdater
+
+        return LinearRegressionUpdater(self, **kwargs)
+
     @property
     def scale(self) -> float:
         """Huber scale sigma for huber fits; 1.0 for squared-error fits. (The

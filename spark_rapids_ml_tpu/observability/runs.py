@@ -232,6 +232,13 @@ def progress(phase: str, done: Any, total: Any = None,
         gauge_set("fit.eta_s", round(float(eta), 3), phase=phase)
 
 
+# Process-wide monotonic sequence over ALL convergence records — fit-time
+# iterations and later partial_fit updates land on ONE ordered axis, so drift
+# trend windows can be compared across a fit run and the continual updates
+# that follow it (iteration numbers restart per fit; `seq` never does).
+_conv_seq = itertools.count()
+
+
 def convergence(algo: str, iteration: Any, **fields: Any) -> None:
     """Append one per-iteration convergence record (KMeans inertia + center
     shift, logreg/linreg loss + grad norm, ...) to every open run — exported in
@@ -239,6 +246,7 @@ def convergence(algo: str, iteration: Any, **fields: Any) -> None:
     Numeric fields coerce to plain floats so records stay JSON-clean."""
     rec: Dict[str, Any] = {
         "ts": round(time.time(), 6),
+        "seq": next(_conv_seq),
         "algo": algo,
         "iteration": int(iteration),
     }
@@ -486,6 +494,11 @@ class FitRun:
             return st["eta_s"]
 
     def note_convergence(self, rec: Dict[str, Any]) -> None:
+        # Copy before annotating: `rec` is shared across every open run, and
+        # `rel_s` (run-relative timestamp) is per-run by definition.
+        rec = dict(rec)
+        if self.started_ts is not None and "ts" in rec:
+            rec["rel_s"] = round(float(rec["ts"]) - self.started_ts, 6)
         with self._lock:
             if len(self._convergence) >= self.max_convergence:
                 self._dropped_convergence += 1

@@ -18,7 +18,7 @@ from .chaos import (
     parse_chaos_spec,
     reset_chaos,
 )
-from .checkpoint import resumable_accumulate
+from .checkpoint import copy_carry, resumable_accumulate
 from .faults import (
     DeviceError,
     FaultSpec,
@@ -41,6 +41,7 @@ __all__ = [
     "StreamBatchError",
     "chaos_enabled",
     "chaos_point",
+    "copy_carry",
     "fault_point",
     "is_device_error",
     "is_stage_retryable",

@@ -46,6 +46,13 @@ def _copy_carry(carry: Any) -> Any:
     )
 
 
+# Public alias: the continual plane (continual/partial_fit.py) snapshots its
+# persistent partial_fit carries with the exact same donation-safe copy the
+# checkpoint loop uses, so snapshot/restore and checkpoint-resume share one
+# definition of "a safe copy of a carry".
+copy_carry = _copy_carry
+
+
 def resumable_accumulate(
     site: str,
     stream_factory: Callable[[int], Iterable[Any]],

@@ -99,7 +99,11 @@ def refresh_model(name: str) -> Dict[str, Any]:
 def mutate_model(name: str, fn) -> Dict[str, Any]:
     """Apply `fn(model)` to a LIVE served model under its execution lock and
     refresh its HBM weights — the race-free way to drive incremental
-    add/delete against a model that is actively serving (§7b)."""
+    add/delete (§7b) and continual-promotion weight swaps (§7d) against a
+    model that is actively serving. The returned stats carry the bumped
+    monotone `generation` ordinal (also `serving.model_generation{model=}`
+    and `/v1/models/<name>`) — the audit key joining this mutation to the
+    serving reports that observed its weights."""
     return get_registry().mutate(name, fn)
 
 

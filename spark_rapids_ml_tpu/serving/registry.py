@@ -85,6 +85,12 @@ class _ServedModel:
         self.was_cached = False
         self.warm: set = set()
         self.registered_ts = time.time()
+        # monotone weight-version ordinal: bumped on EVERY refresh_weights
+        # (so mutate_model and continual promotions too — both land through
+        # refresh), exported as `serving.model_generation{model=}` and in
+        # stats()/`/v1/models/<name>` — the audit key joining a promotion
+        # event to the serving reports that observed its weights
+        self.generation = 0
         self.batcher: Optional[MicroBatcher] = None
         # fault-tolerant fleet mode (serving.replicas > 1): the fleet replaces
         # the single batcher; this entry becomes the PINNED MASTER copy —
@@ -409,6 +415,8 @@ class ModelRegistry:
         if entry.fleet is not None:
             for rentry in list(entry.replica_entries.values()):
                 self._resync_replica(entry, rentry)
+        entry.generation += 1
+        gauge_set("serving.model_generation", entry.generation, model=name)
         counter_inc("serving.weight_refreshes", 1, model=name)
         return self.stats(name)
 
@@ -542,6 +550,7 @@ class ModelRegistry:
             "uploads": entry.uploads,
             "reloads": entry.reloads,
             "pending": pending,
+            "generation": entry.generation,
             "registered_ts": entry.registered_ts,
         }
         if entry.fleet is not None:

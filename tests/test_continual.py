@@ -1,0 +1,479 @@
+"""Continuous-learning plane (continual/): streamed partial_fit bit-identity
+(chunked == concatenated == fault-resumed, per estimator), deterministic drift
+detection, and governed live promotion (exec-locked mutate, monotone
+generation, zero warm-path compiles — counter-asserted from exported JSONL).
+
+The load-bearing contracts (ISSUE 18 acceptance):
+  * N update batches applied one-at-a-time == one update over their
+    concatenation == the fault-injected resumed stream, bit-for-bit
+    (assert_array_equal, the checkpoint-resume equality discipline).
+  * A steady stream of update batches adds ZERO new `device.compile` entries
+    after warm-up (fixed block geometry).
+  * Promotion under live traffic: no failed requests, generation strictly
+    increases, no warm-path compiles.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu import config, profiling
+from spark_rapids_ml_tpu.continual import (
+    ContinualLoop,
+    DriftDetector,
+    KMeansUpdater,
+    LinearRegressionUpdater,
+    LogisticRegressionUpdater,
+    PCAUpdater,
+    PromotionGovernor,
+    baseline_from_convergence,
+    partial_fit_updater,
+)
+from spark_rapids_ml_tpu.models.classification import LogisticRegressionModel
+from spark_rapids_ml_tpu.models.clustering import KMeansModel
+from spark_rapids_ml_tpu.models.feature import PCAModel
+from spark_rapids_ml_tpu.models.regression import LinearRegressionModel
+from spark_rapids_ml_tpu.reliability import reset_faults
+
+BLOCK = 64  # fixed update-block geometry for every test (small, many blocks)
+
+CONTINUAL_KEYS = (
+    "continual.decay",
+    "continual.update_batch_rows",
+    "continual.drift_mads",
+    "continual.promote_every",
+    "continual.min_baseline",
+    "reliability.fault_spec",
+    "reliability.backoff_base_s",
+    "reliability.backoff_max_s",
+    "reliability.enabled",
+    "observability.enabled",
+    "observability.metrics_dir",
+    "serving.prewarm",
+)
+
+
+@pytest.fixture(autouse=True)
+def continual_env():
+    config.set("continual.update_batch_rows", BLOCK)
+    config.set("reliability.backoff_base_s", 0.001)
+    config.set("reliability.backoff_max_s", 0.002)
+    profiling.reset_counters()
+    reset_faults()
+    yield
+    from spark_rapids_ml_tpu import serving
+
+    serving.stop_serving()
+    for key in CONTINUAL_KEYS:
+        config.unset(key)
+    reset_faults()
+
+
+rng = np.random.default_rng(42)
+OLD_CENTERS = np.array([[0.0, 0.0], [5.0, 5.0]], np.float32)
+NEW_CENTERS = np.array([[10.0, 10.0], [-5.0, 8.0]], np.float32)
+
+
+def _blob(centers, n=128, scale=0.3, seed=None):
+    r = np.random.default_rng(seed) if seed is not None else rng
+    return (r.normal(0, scale, (n, centers.shape[1])).astype(np.float32)
+            + centers[r.integers(0, len(centers), n)])
+
+
+# --------------------------------------------------- per-estimator factories
+#
+# Each case returns (make_updater, batches): batches sized a multiple of
+# BLOCK so chunked and concatenated streams fold identical device blocks.
+
+
+def _kmeans_case():
+    def mk():
+        m = KMeansModel(cluster_centers=OLD_CENTERS, inertia=1.0, n_iter=3,
+                        cluster_sizes=np.array([50, 50]))
+        return KMeansUpdater(m, name="km")
+
+    b = [(_blob(OLD_CENTERS, 128, seed=i), None, None) for i in range(4)]
+    return mk, b
+
+
+def _linreg_case():
+    true = np.array([2.0, -1.0, 0.5], np.float32)
+
+    def mk():
+        m = LinearRegressionModel(coefficients=np.zeros(3, np.float32),
+                                  intercept=0.0, n_iter=1)
+        return LinearRegressionUpdater(m, name="lr")
+
+    b = []
+    for i in range(4):
+        r = np.random.default_rng(100 + i)
+        X = r.normal(size=(128, 3)).astype(np.float32)
+        y = (X @ true + 0.3).astype(np.float32)
+        b.append((X, y, None))
+    return mk, b
+
+
+def _logreg_case():
+    def mk():
+        m = LogisticRegressionModel(
+            coefficients=np.array([[1.0, -1.0]], np.float32),
+            intercepts=np.array([0.0], np.float32),
+            n_iter=2, objective=0.5, num_classes=2,
+        )
+        return LogisticRegressionUpdater(m, name="lg")
+
+    b = []
+    for i in range(4):
+        r = np.random.default_rng(200 + i)
+        X = r.normal(size=(128, 2)).astype(np.float32)
+        y = (X @ np.array([2.0, -2.0], np.float32) > 0).astype(np.float32)
+        b.append((X, y, None))
+    return mk, b
+
+
+def _pca_case():
+    def mk():
+        m = PCAModel(
+            mean=np.zeros(3, np.float32),
+            components=np.eye(2, 3, dtype=np.float32),
+            explained_variance=np.ones(2),
+            explained_variance_ratio=np.full(2, 0.5),
+            singular_values=np.ones(2),
+        )
+        return PCAUpdater(m, name="pc")
+
+    b = [(np.random.default_rng(300 + i).normal(size=(128, 3))
+          .astype(np.float32), None, None) for i in range(4)]
+    return mk, b
+
+
+CASES = {
+    "kmeans": _kmeans_case,
+    "linreg": _linreg_case,
+    "logreg": _logreg_case,
+    "pca": _pca_case,
+}
+
+
+def _candidate_attrs_identical(a, b):
+    assert set(a) == set(b)
+    for k in a:
+        if k == "n_iter":
+            continue  # the update counter: 4 chunked updates vs 1 concat
+        va, vb = a[k], b[k]
+        if isinstance(va, np.ndarray):
+            np.testing.assert_array_equal(va, vb, err_msg=k)
+        else:
+            assert va == vb, (k, va, vb)
+
+
+# ---------------------------------------------------------- bit-identity
+
+
+@pytest.mark.parametrize("case", sorted(CASES))
+def test_partial_fit_chunked_equals_concatenated(case):
+    mk, batches = CASES[case]()
+    u1 = mk()
+    for X, y, w in batches:
+        u1.update(X, y, w)
+    u2 = mk()
+    Xs = np.concatenate([b[0] for b in batches])
+    ys = (np.concatenate([b[1] for b in batches])
+          if batches[0][1] is not None else None)
+    u2.update(Xs, ys)
+    _candidate_attrs_identical(u1.candidate(), u2.candidate())
+
+
+@pytest.mark.parametrize("case", sorted(CASES))
+def test_partial_fit_fault_resumed_bit_identical(case):
+    mk, batches = CASES[case]()
+    clean = mk()
+    for X, y, w in batches:
+        clean.update(X, y, w)
+
+    config.set("reliability.fault_spec", "continual:batch=1:raise=OSError")
+    reset_faults()
+    faulted = mk()
+    for X, y, w in batches:
+        faulted.update(X, y, w)
+    config.unset("reliability.fault_spec")
+    reset_faults()
+
+    totals = profiling.counter_totals()
+    assert totals.get("reliability.fault.continual", 0) == 1
+    assert totals.get("reliability.resume.continual", 0) >= 1
+    _candidate_attrs_identical(clean.candidate(), faulted.candidate())
+
+
+@pytest.mark.parametrize("case", sorted(CASES))
+def test_snapshot_restore_roundtrip(case):
+    mk, batches = CASES[case]()
+    u = mk()
+    X, y, w = batches[0]
+    u.update(X, y, w)
+    before = u.candidate()
+    snap = u.snapshot()
+    for X2, y2, w2 in batches[1:]:
+        u.update(X2, y2, w2)
+    u.restore(snap)
+    _candidate_attrs_identical(before, u.candidate())
+    assert u.updates == 1
+
+
+def test_zero_new_compiles_after_warmup():
+    """Arbitrary batch sizes (ragged tails included) re-enter the warmed
+    executables: the fixed block geometry is the whole point."""
+    mk, _ = CASES["kmeans"]()
+    u = mk()
+    u.update(_blob(OLD_CENTERS, 128))  # warm-up: compiles once
+    c0 = dict(profiling.counter_totals())
+    for n in (5, 64, 97, 128, 200, 1):
+        u.update(_blob(OLD_CENTERS, n))
+    c1 = profiling.counter_totals()
+    fresh = [k for k in c1 if k.startswith("device.compile")
+             and c1[k] != c0.get(k, 0)]
+    assert not fresh, fresh
+
+
+def test_decay_discounts_history():
+    m = KMeansModel(cluster_centers=OLD_CENTERS, inertia=0.0, n_iter=1,
+                    cluster_sizes=np.array([4, 4]))
+    u = KMeansUpdater(m, name="km", decay=0.5)
+    X = np.tile(np.array([[1.0, 1.0]], np.float32), (8, 1))
+    u.update(X)
+    u.update(X)
+    cand = u.candidate()
+    # counts: 0.5*(0.5*(4,4) + batch1) + batch2; all 16 rows land in cluster 0
+    sizes = np.asarray(cand["cluster_sizes"], np.float64)
+    assert sizes[0] == pytest.approx(0.5 * (0.5 * 4 + 8) + 8)
+    assert sizes[1] == pytest.approx(0.25 * 4)
+    # center 0 = decayed weighted mean: sums 0.5*8 + 8 over counts 13
+    np.testing.assert_allclose(cand["cluster_centers"][0], [12 / 13] * 2,
+                               rtol=1e-6)
+
+
+def test_updater_factory_and_model_methods():
+    cases = {
+        "kmeans": KMeansUpdater, "linreg": LinearRegressionUpdater,
+        "logreg": LogisticRegressionUpdater, "pca": PCAUpdater,
+    }
+    for name, cls in cases.items():
+        mk, _ = CASES[name]()
+        model = mk()._model
+        assert isinstance(partial_fit_updater(model), cls)
+        assert isinstance(model.partial_fit_updater(), cls)
+    with pytest.raises(TypeError):
+        partial_fit_updater(object())
+
+
+# ------------------------------------------------------------------ drift
+
+
+def test_drift_detector_fires_deterministically():
+    det = DriftDetector(model="m", signal="inertia", mads=3.0, min_baseline=4)
+    for v in (0.18, 0.17, 0.19, 0.18, 0.20):
+        assert det.observe(v) is None  # in-distribution: silent, absorbed
+    thr = det.threshold()
+    assert thr is not None and thr < 1.0
+    fired = det.observe(70.0)
+    assert fired == {"value": 70.0, "threshold": thr}
+    # drifted observations are NOT absorbed: a sustained shift keeps firing
+    assert det.observe(70.0) is not None
+    totals = profiling.counter_totals()
+    assert totals.get("continual.drift{model=m,signal=inertia}", 0) == 2
+
+
+def test_drift_detector_calibrates_before_firing():
+    det = DriftDetector(model="m", signal="loss", min_baseline=8)
+    assert det.observe(50.0) is None  # would be drift, but no baseline yet
+    assert det.threshold() is None
+
+
+def test_drift_baseline_seeds_from_convergence_tail():
+    records = [
+        {"algo": "kmeans", "iteration": i, "inertia": 100.0 + i} for i in range(10)
+    ] + [
+        {"algo": "logreg", "iteration": 1, "loss": 5.0},
+        {"algo": "kmeans", "iteration": 11, "inertia": 999.0,
+         "phase": "partial_fit"},  # update records never seed the fit baseline
+    ]
+    base = baseline_from_convergence(records, "kmeans", "inertia",
+                                     n_rows=100, tail=4)
+    assert base == [(100.0 + i) / 100 for i in range(6, 10)]
+    det = DriftDetector(model="m", signal="inertia", baseline=base,
+                        min_baseline=4)
+    assert det.threshold() is not None  # fit tail seeds: fires from update 1
+
+
+# ------------------------------------------------- promotion + generation
+
+
+def test_generation_bumps_on_refresh_and_mutate_and_http():
+    from spark_rapids_ml_tpu.serving.http import _http_handler
+    from spark_rapids_ml_tpu.serving.registry import ModelRegistry
+    from spark_rapids_ml_tpu import serving
+
+    m = KMeansModel(cluster_centers=OLD_CENTERS, inertia=1.0, n_iter=3)
+    reg = ModelRegistry()
+    st = reg.register("km", m)
+    assert st["generation"] == 0
+    st = reg.refresh_weights("km")
+    assert st["generation"] == 1
+    st = reg.mutate("km", lambda mm: None)
+    assert st["generation"] == 2
+    totals = profiling.counter_totals()
+    assert totals.get("serving.model_generation{model=km}") == 2
+    reg.close()
+
+    # the module-level surface + /v1/models/<name> serve the same ordinal
+    serving.start_serving(port=0)
+    serving.register_model("km", m)
+    st = serving.mutate_model("km", lambda mm: None)
+    assert st["generation"] == 1
+    status, body = _http_handler("GET", "/v1/models/km", None)
+    assert status == 200 and body["generation"] == 1
+
+
+def test_promotion_governor_validates_and_rolls_back():
+    m = KMeansModel(cluster_centers=OLD_CENTERS, inertia=1.0, n_iter=3,
+                    cluster_sizes=np.array([50, 50]))
+    u = KMeansUpdater(m, name="km")
+    holdout = _blob(NEW_CENTERS, 128, seed=7)
+    gov = PromotionGovernor("km", u, (holdout,), served=False)
+
+    # in-distribution updates: candidate ~= anchor, promotion may land or
+    # reject, but a DRIFTED carry must promote and improve the holdout
+    for i in range(3):
+        u.update(_blob(NEW_CENTERS, 128, seed=10 + i))
+    res = gov.try_promote()
+    assert res["promoted"] is True
+    assert res["candidate_score"] < res["incumbent_score"]
+    promoted_centers = np.asarray(m._model_attributes["cluster_centers"])
+    assert not np.array_equal(promoted_centers, OLD_CENTERS)
+
+    back = gov.rollback()
+    assert back["rolled_back"] is True
+    np.testing.assert_array_equal(
+        np.asarray(m._model_attributes["cluster_centers"], np.float32),
+        OLD_CENTERS,
+    )
+    totals = profiling.counter_totals()
+    assert totals.get("continual.promotions{model=km}", 0) == 1
+    assert totals.get("continual.rollbacks{model=km}", 0) == 1
+
+
+def test_promotion_under_live_traffic(tmp_path):
+    """The closed-loop concurrency contract: continual promotions land under
+    concurrent predict traffic with zero failed requests, a strictly
+    increasing generation, and zero warm-path compiles — compile counters
+    asserted from the exported serving-run JSONL, not process state."""
+    from spark_rapids_ml_tpu import serving
+    from spark_rapids_ml_tpu.observability.export import load_serving_reports
+
+    config.set("observability.metrics_dir", str(tmp_path))
+    m = KMeansModel(cluster_centers=OLD_CENTERS, inertia=1.0, n_iter=3,
+                    cluster_sizes=np.array([50, 50]))
+    serving.start_serving(port=0)
+    serving.register_model("km", m, prewarm=True)
+
+    u = m.partial_fit_updater(name="km")
+    holdout = _blob(NEW_CENTERS, 128, seed=3)
+    loop = ContinualLoop(
+        "km", u, (holdout,), promote_every=2,
+        detector=DriftDetector(model="km", signal="inertia", min_baseline=2),
+    )
+    # warm-up: one full update + promote cycle compiles every kernel once
+    loop.feed(_blob(OLD_CENTERS, 96, seed=90))
+    loop.feed(_blob(OLD_CENTERS, 96, seed=91))
+    warm = dict(profiling.counter_totals())
+    compile_keys_before = {k: v for k, v in warm.items()
+                           if k.startswith("device.compile")}
+
+    failures = []
+    stop = threading.Event()
+
+    def client(seed):
+        r = np.random.default_rng(seed)
+        while not stop.is_set():
+            n = int(r.integers(1, 32))
+            try:
+                out = serving.predict("km", _blob(OLD_CENTERS, n, seed=seed))
+                if out["prediction"].shape != (n,):
+                    failures.append(("shape", n, out["prediction"].shape))
+            except Exception as e:  # every failure is a failure here
+                failures.append(("error", repr(e)))
+
+    threads = [threading.Thread(target=client, args=(s,)) for s in range(4)]
+    for t in threads:
+        t.start()
+
+    generations = []
+    try:
+        for i in range(8):
+            out = loop.feed(_blob(NEW_CENTERS if i >= 2 else OLD_CENTERS,
+                                  128, seed=40 + i))
+            promo = out["promotion"]
+            if promo and promo.get("promoted"):
+                generations.append(promo["generation"])
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+
+    assert not failures, failures[:5]
+    assert len(generations) >= 1
+    assert all(b > a for a, b in zip(generations, generations[1:]))
+
+    report = serving.stop_serving()
+    exported = load_serving_reports(str(tmp_path))
+    assert exported and exported[-1]["run_id"] == report["run_id"]
+    counters = exported[-1]["metrics"]["counters"]
+    # zero warm-path compiles: the exported report's compile counters match
+    # the post-warm-up snapshot exactly — nothing new compiled under traffic
+    compile_keys_after = {k: v for k, v in counters.items()
+                          if k.startswith("device.compile")}
+    assert compile_keys_after == compile_keys_before
+    # the report carries the audit trail: promotions and the generation gauge
+    assert counters.get("continual.promotions{model=km}", 0) == len(generations)
+    gauges = exported[-1]["metrics"]["gauges"]
+    assert gauges.get("serving.model_generation{model=km}") == generations[-1]
+    assert gauges.get("continual.staleness_s{model=km}", 0) > 0
+
+
+# ------------------------------------------------- convergence satellites
+
+
+def test_convergence_records_carry_seq_and_rel_s(tmp_path):
+    from spark_rapids_ml_tpu.observability import convergence, fit_run
+
+    config.set("observability.enabled", True)
+    config.set("observability.metrics_dir", str(tmp_path))
+    with fit_run("kmeans", site="test") as run:
+        convergence("kmeans", 1, inertia=10.0)
+        convergence("kmeans", 2, inertia=5.0)
+        report = run.report()
+    recs = report["convergence"]
+    assert len(recs) == 2
+    seqs = [r["seq"] for r in recs]
+    assert seqs[1] > seqs[0]  # process-monotonic ordering axis
+    rels = [r["rel_s"] for r in recs]
+    assert all(r >= 0 for r in rels) and rels[1] >= rels[0]
+
+
+def test_partial_fit_updates_share_convergence_axis(tmp_path):
+    from spark_rapids_ml_tpu.observability import fit_run
+
+    config.set("observability.enabled", True)
+    config.set("observability.metrics_dir", str(tmp_path))
+    mk, batches = CASES["kmeans"]()
+    with fit_run("kmeans", site="test") as run:
+        u = mk()
+        for X, y, w in batches[:2]:
+            u.update(X, y, w)
+        report = run.report()
+    recs = [r for r in report["convergence"] if r.get("phase") == "partial_fit"]
+    assert len(recs) == 2
+    assert recs[0]["algo"] == "kmeans" and "inertia" in recs[0]
+    assert recs[1]["seq"] > recs[0]["seq"]
+    assert recs[1]["rel_s"] >= recs[0]["rel_s"] >= 0
