@@ -52,6 +52,7 @@ UNITS = [
     "telemetry_overhead",
     "serving_qps",
     "serving_failover",
+    "tracing_overhead",
     "continual",
     "large_k",
     "autotune",

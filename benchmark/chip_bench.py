@@ -1873,6 +1873,99 @@ def bench_large_k(ctx) -> Dict:
     return out
 
 
+# --------------------------------------------------------- tracing_overhead
+
+
+def bench_tracing_overhead(ctx) -> Dict:
+    """Trace-plane cost (observability/tracing.py, docs/design.md §6l): the
+    SAME closed serving loop with request tracing ON (per-request RequestTrace,
+    queue/batch/execute/scatter spans, fan-in links, tail sampler, ring insert)
+    vs OFF (`tracing.enabled` false — start_trace returns None and every hook
+    degrades to a no-op branch). Emits `tracing_overhead_pct`, gated by
+    ci/bench_check.py against the same absolute <2% budget as
+    telemetry_overhead, with `tracing_overhead_noise_pct` riding along so an
+    underpowered measurement reports INCONCLUSIVE instead of flagging jitter.
+
+    Same estimator as bench_telemetry_overhead: median of per-pair deltas over
+    alternating-order pairs — a monotone warming trend otherwise flatters
+    whichever arm consistently runs second."""
+    import pandas as pd
+
+    from spark_rapids_ml_tpu import config as _srml_config
+    from spark_rapids_ml_tpu import serving
+    from spark_rapids_ml_tpu.clustering import KMeans
+    from spark_rapids_ml_tpu.observability import tracing as _tracing
+
+    on_tpu = ctx["on_tpu"]
+    n_fit, d = ctx["serving_shape"]
+    reqs = 400 if on_tpu else 150
+    heartbeat = ctx.get("heartbeat") or (lambda tag: None)
+
+    rng = np.random.default_rng(17)
+    centers = rng.normal(0, 5, (8, d)).astype(np.float32)
+    Xh = (centers[rng.integers(0, 8, n_fit)]
+          + rng.normal(0, 1, (n_fit, d))).astype(np.float32)
+    model = KMeans(k=8, maxIter=5, seed=1).fit(
+        pd.DataFrame({"features": list(Xh[:4096])})
+    )
+    # fixed request schedule: both arms serve the IDENTICAL byte-for-byte
+    # request stream, so the delta is the plane, not the workload
+    sizes = rng.integers(1, 49, reqs)
+    offs = rng.integers(0, n_fit - 64, reqs)
+
+    registry = serving.ModelRegistry()
+    try:
+        registry.register("km", model)  # uploads weights + pre-warms buckets
+        heartbeat("tracing_prewarm")
+
+        def run_once(on: bool) -> float:
+            # best of two inner passes (the timeit rule): scheduler stalls
+            # and GC pauses only ever ADD time, so the min of repeated
+            # identical passes is the least-noisy estimate of each arm —
+            # single passes here scatter by more than the budget itself
+            _srml_config.set("tracing.enabled", on)
+            best = None
+            for _ in range(2):
+                _tracing.reset_tracing()
+                t0 = time.perf_counter()
+                for n, off in zip(sizes, offs):
+                    out = registry.predict("km", Xh[off: off + n])
+                    assert out["prediction"].shape == (n,)
+                elapsed = time.perf_counter() - t0
+                best = elapsed if best is None else min(best, elapsed)
+            _tracing.reset_tracing()
+            return best
+
+        run_once(False)  # warmup both arms, untimed
+        run_once(True)
+        off_ts, on_ts, deltas = [], [], []
+        for rep in range(6):  # alternating-order pairs: warming drift cancels
+            if rep % 2 == 0:
+                t_off = run_once(False)
+                t_on = run_once(True)
+            else:
+                t_on = run_once(True)
+                t_off = run_once(False)
+            off_ts.append(t_off)
+            on_ts.append(t_on)
+            deltas.append((t_on - t_off) / t_off * 100.0)
+            heartbeat(f"tracing_rep{rep}")
+        med_delta = float(np.median(deltas))
+        return {
+            "tracing_shape": [n_fit, d],
+            "tracing_requests": reqs,
+            "tracing_off_s": round(float(np.median(off_ts)), 4),
+            "tracing_on_s": round(float(np.median(on_ts)), 4),
+            "tracing_overhead_pct": round(med_delta, 3),
+            "tracing_overhead_noise_pct": round(
+                float(np.median(np.abs(np.asarray(deltas) - med_delta))), 3
+            ),
+        }
+    finally:
+        _srml_config.unset("tracing.enabled")
+        registry.close()
+
+
 # ----------------------------------------------------------------- autotune
 
 
@@ -1994,6 +2087,7 @@ FAMILIES: List = [
     ("telemetry_overhead", bench_telemetry_overhead),
     ("serving_qps", bench_serving_qps),
     ("serving_failover", bench_serving_failover),
+    ("tracing_overhead", bench_tracing_overhead),
     ("continual", bench_continual),
     ("large_k", bench_large_k),
     ("autotune", bench_autotune),

@@ -722,7 +722,99 @@ print("CONTINUAL SMOKE OK: drift fired on the shifted batch, governed "
       "and live predictions follow the promoted centers")
 PY
   rm -rf "$SRML_CONTINUAL_SMOKE_DIR"
-  python -m pytest tests/ -q --ignore=tests/test_reliability.py --ignore=tests/test_device_cache.py --ignore=tests/test_observability.py --ignore=tests/test_transform_observability.py --ignore=tests/test_telemetry_plane.py --ignore=tests/test_comm_plane.py --ignore=tests/test_serving.py --ignore=tests/test_ann_lifecycle.py --ignore=tests/test_continual.py
+  # tracing smoke (docs/design.md §6l): unit tests first, then the causal
+  # acceptance end-to-end — a 2-replica served fleet takes a DETERMINISTIC
+  # mid-window chaos kill while every request carries a client traceparent.
+  # Asserted FROM the exported trace_reports.jsonl (like a trace backend
+  # would read it): every request has exactly ONE complete trace
+  # (ingress->queue->batch->execute->scatter, status ok), the failed-over
+  # traces carry the dead replica's replay link, and a /metrics histogram
+  # exemplar resolves to a stored trace at /traces/<id>.
+  python -m pytest tests/test_tracing.py -q
+  SRML_TRACING_SMOKE_DIR="$(mktemp -d)"
+  SRML_TPU_METRICS_DIR="$SRML_TRACING_SMOKE_DIR" python - <<'PY'
+import json, os, time, urllib.request
+import numpy as np, pandas as pd
+from spark_rapids_ml_tpu import config, serving
+from spark_rapids_ml_tpu.clustering import KMeans
+from spark_rapids_ml_tpu.observability import load_trace_reports
+from spark_rapids_ml_tpu.reliability import reset_chaos
+from spark_rapids_ml_tpu.serving.fleet import LIVE
+
+rng = np.random.default_rng(0)
+X = np.concatenate(
+    [rng.normal(-3, 1, (128, 8)), rng.normal(3, 1, (128, 8))]
+).astype(np.float32)
+km = KMeans(k=2, maxIter=6, seed=5).fit(pd.DataFrame({"features": list(X)}))
+
+config.set("serving.replicas", 2)
+config.set("serving.heartbeat_timeout_s", 0.3)
+host, port = serving.start_serving(port=0)
+serving.register_model("km", km)
+entry = serving.get_registry()._models["km"]
+# deterministic incident: replica 0's 3rd dispatched batch is killed mid-window
+config.set("reliability.chaos_spec",
+           "serving_execute:replica=0:after=2:action=kill")
+reset_chaos()
+
+trace_ids = []
+for i in range(12):
+    tid, sid = os.urandom(16).hex(), os.urandom(8).hex()
+    req = urllib.request.Request(
+        f"http://{host}:{port}/v1/models/km:predict",
+        data=json.dumps({"instances": X[: 3 + (i % 5)].tolist()}).encode(),
+        headers={"traceparent": f"00-{tid}-{sid}-01"}, method="POST")
+    doc = json.loads(urllib.request.urlopen(req, timeout=20).read())
+    assert doc["trace_id"] == tid, (doc.get("trace_id"), tid)
+    trace_ids.append(tid)
+config.unset("reliability.chaos_spec"); reset_chaos()
+deadline = time.monotonic() + 15.0
+while time.monotonic() < deadline and not (
+    entry.fleet.live_count() == 2
+    and all(r.state == LIVE for r in entry.fleet._replicas)
+):
+    time.sleep(0.05)
+
+# /metrics exemplar -> /traces/<id> BEFORE shutdown (live ring answers)
+text = urllib.request.urlopen(
+    f"http://{host}:{port}/metrics", timeout=10).read().decode()
+ex_ids = {ln.split('trace_id="')[1].split('"')[0]
+          for ln in text.splitlines()
+          if "serving_total_s_bucket" in ln and '# {trace_id="' in ln}
+resolved = [t for t in ex_ids if t in trace_ids]
+assert resolved, f"no /metrics exemplar from this window: {ex_ids}"
+ex_doc = json.loads(urllib.request.urlopen(
+    f"http://{host}:{port}/traces/{resolved[0]}", timeout=10).read())
+assert ex_doc["trace_id"] == resolved[0]
+serving.stop_serving()
+
+# the exported JSONL is the system of record: one complete trace per request
+docs = load_trace_reports(os.environ["SRML_TPU_METRICS_DIR"])
+by_id = {}
+for d in docs:
+    by_id.setdefault(d["trace_id"], []).append(d)
+for tid in trace_ids:
+    assert len(by_id.get(tid, [])) == 1, f"trace {tid}: {len(by_id.get(tid, []))} docs"
+    (doc,) = by_id[tid]
+    assert doc["status"] == "ok", doc["status"]
+    names = {s["name"] for s in doc["spans"]}
+    assert {"http.request", "serving.queue", "serving.batch",
+            "serving.execute", "serving.scatter"} <= names, names
+replayed = [d for tid in trace_ids for d in by_id[tid]
+            if any(e["kind"] == "failover_replay" for e in d["events"])]
+assert replayed, "chaos kill produced no failover-replay trace"
+for d in replayed:
+    (ev,) = [e for e in d["events"] if e["kind"] == "failover_replay"]
+    assert ev["replica"] == 0 and "failover" in d["flags"], d["events"]
+    # the dead attempt AND the survivor's serve are both in the trace
+    statuses = {s["status"] for s in d["spans"] if s["name"] == "serving.batch"}
+    assert statuses == {"error", "ok"}, statuses
+print(f"TRACING SMOKE OK: 12/12 requests each one complete trace in the "
+      f"JSONL, {len(replayed)} failed-over trace(s) carry the replica-0 "
+      "replay link, /metrics exemplar resolved live")
+PY
+  rm -rf "$SRML_TRACING_SMOKE_DIR"
+  python -m pytest tests/ -q --ignore=tests/test_reliability.py --ignore=tests/test_device_cache.py --ignore=tests/test_observability.py --ignore=tests/test_transform_observability.py --ignore=tests/test_telemetry_plane.py --ignore=tests/test_comm_plane.py --ignore=tests/test_serving.py --ignore=tests/test_ann_lifecycle.py --ignore=tests/test_continual.py --ignore=tests/test_tracing.py
 fi
 
 # small benchmark smoke (reference runs a small bench pre-merge)
@@ -738,7 +830,7 @@ SRML_DEVICE_SMOKE_DIR="$(mktemp -d)"
 SRML_BENCH_ROLE=worker \
 SRML_BENCH_PROGRESS="$SRML_DEVICE_SMOKE_DIR/progress.jsonl" \
 SRML_BENCH_DEADLINE_TS="$(python -c 'import time; print(time.time() + 600)')" \
-SRML_BENCH_SKIP="kmeans_headline,logreg,linreg,rf,umap,dbscan,fit_e2e,cache,telemetry_overhead,serving_qps,large_k,autotune,knn,ann,ann_build,wide256" \
+SRML_BENCH_SKIP="kmeans_headline,logreg,linreg,rf,umap,dbscan,fit_e2e,cache,telemetry_overhead,serving_qps,tracing_overhead,large_k,autotune,knn,ann,ann_build,wide256" \
 python bench.py
 SRML_BENCH_PROGRESS="$SRML_DEVICE_SMOKE_DIR/progress.jsonl" python - <<'PY'
 import json, os, sys

@@ -198,6 +198,14 @@ KNOBS: Dict[str, Knob] = {
             config_key="ann.compact_tombstone_pct", dims=(),
             grid=(10, 20, 30, 50),
         ),
+        Knob(
+            "tracing.sample_rate", "float",
+            "fraction of unflagged (non-error/hedged/failed-over/expired, "
+            "non-slow) request traces the tail sampler retains "
+            "(observability/tracing.py::sample_rate)",
+            config_key="tracing.sample_rate", dims=(),
+            grid=(0.05, 0.25, 1.0),
+        ),
     )
 }
 

@@ -261,6 +261,16 @@ _DEFAULTS: Dict[str, Any] = {
     "continual.drift_mads": 0.0,
     "continual.promote_every": 4,
     "continual.min_baseline": 8,
+    # trace plane (observability/tracing.py, docs/design.md §6l): per-request
+    # causal traces with tail-based sampling. sample_rate: deterministic
+    # hash-of-trace_id keep probability for unflagged, not-slow traces (the
+    # flagged classes — error/hedged/failover/expired/shed — ALWAYS keep).
+    # ring_traces: bounded per-process kept-trace ring served by /traces.
+    # slow_frac: rolling slowest fraction that keeps regardless of sampling.
+    "tracing.enabled": True,
+    "tracing.sample_rate": 1.0,
+    "tracing.ring_traces": 256,
+    "tracing.slow_frac": 0.05,
     # closed-loop autotuner (spark_rapids_ml_tpu/autotune/, docs/design.md
     # §6i): telemetry-driven knob search persisted as per-platform tuning
     # tables. mode:
@@ -358,6 +368,10 @@ _ENV_KEYS: Dict[str, str] = {
     "continual.drift_mads": "SRML_TPU_CONTINUAL_DRIFT_MADS",
     "continual.promote_every": "SRML_TPU_CONTINUAL_PROMOTE_EVERY",
     "continual.min_baseline": "SRML_TPU_CONTINUAL_MIN_BASELINE",
+    "tracing.enabled": "SRML_TPU_TRACING_ENABLED",
+    "tracing.sample_rate": "SRML_TPU_TRACING_SAMPLE_RATE",
+    "tracing.ring_traces": "SRML_TPU_TRACING_RING_TRACES",
+    "tracing.slow_frac": "SRML_TPU_TRACING_SLOW_FRAC",
     "autotune.mode": "SRML_TPU_AUTOTUNE_MODE",
     "autotune.dir": "SRML_TPU_TUNE_DIR",
     "autotune.replicates": "SRML_TPU_AUTOTUNE_REPLICATES",
@@ -405,14 +419,30 @@ def source(key: str) -> str:
     return "default"
 
 
+_epoch = 0
+
+
+def epoch() -> int:
+    """Monotonic mutation counter, bumped by every set()/unset(). Hot paths
+    (the trace plane's per-request config reads) cache derived values
+    against it instead of re-resolving per call. Mutating os.environ
+    directly without a set()/unset() in between does NOT bump it — export
+    env before process start, or go through set()."""
+    return _epoch
+
+
 def set(key: str, value: Any) -> None:  # spark-conf style name (shadows the builtin deliberately)
+    global _epoch
     if key not in _DEFAULTS:
         raise KeyError(f"Unknown config key '{key}'; known: {sorted(_DEFAULTS)}")
     _overrides[key] = value
+    _epoch += 1
 
 
 def unset(key: str) -> None:
+    global _epoch
     _overrides.pop(key, None)
+    _epoch += 1
 
 
 def all() -> Dict[str, Any]:  # spark-conf style name (shadows the builtin deliberately)

@@ -20,6 +20,7 @@ from typing import Any, Dict, Optional
 
 from .. import config as _config
 from ..observability import counter_inc, event, gauge_set, span as obs_span
+from ..observability import tracing as _tracing
 from .drift import DriftDetector
 from .partial_fit import PartialFitUpdater
 
@@ -129,24 +130,59 @@ class ContinualLoop:
         self._pending_since: Optional[float] = None
 
     def feed(self, X, y=None, w=None) -> Dict[str, Any]:
-        rep = self.updater.update(X, y=y, w=w)
-        if self._pending_since is None:
-            self._pending_since = time.time()
-        drift = self.detector.observe(rep["value"])
-        self._since_promote += 1
-        out: Dict[str, Any] = {"update": rep, "drift": drift,
-                               "promotion": None}
-        if drift is not None or self._since_promote >= self.promote_every:
-            res = self.governor.try_promote()
-            self._since_promote = 0
-            if res.get("promoted"):
-                staleness = time.time() - self._pending_since
-                gauge_set("continual.staleness_s", round(staleness, 6),
-                          model=self.name)
-                res["staleness_s"] = staleness
-                self._pending_since = None
-            out["promotion"] = res
-        return out
+        # one trace per feed cycle (§6l): update -> drift -> promote as child
+        # spans of a "continual.feed" root, so a generation bump seen by the
+        # serving plane is causally joinable back to the batch that caused it
+        rt = _tracing.start_trace("continual.feed", model=self.name)
+        t0 = time.perf_counter()
+        try:
+            rep = self.updater.update(X, y=y, w=w)
+            t_update = time.perf_counter()
+            if self._pending_since is None:
+                self._pending_since = time.time()
+            drift = self.detector.observe(rep["value"])
+            t_drift = time.perf_counter()
+            if rt is not None:
+                rt.add_span("continual.update", t0, t_update,
+                        parent_id=rt.root_span_id,
+                        attrs={"rows": rep.get("rows"),
+                               "value": rep.get("value")})
+                rt.add_span("continual.drift", t_update, t_drift,
+                        parent_id=rt.root_span_id)
+                if drift is not None:
+                    rt.add_event("drift_detected", model=self.name, **drift)
+                    rt.flag("drift")
+            self._since_promote += 1
+            out: Dict[str, Any] = {"update": rep, "drift": drift,
+                                   "promotion": None}
+            if drift is not None or self._since_promote >= self.promote_every:
+                res = self.governor.try_promote()
+                t_promote = time.perf_counter()
+                self._since_promote = 0
+                if res.get("promoted"):
+                    staleness = time.time() - self._pending_since
+                    gauge_set("continual.staleness_s", round(staleness, 6),
+                              model=self.name)
+                    res["staleness_s"] = staleness
+                    self._pending_since = None
+                if rt is not None:
+                    rt.add_span("continual.promote", t_drift, t_promote,
+                            parent_id=rt.root_span_id,
+                            attrs={"promoted": bool(res.get("promoted")),
+                                   "reason": res.get("reason")})
+                    if res.get("promoted"):
+                        rt.add_event("model_generation", model=self.name,
+                                     generation=res.get("generation"))
+                        rt.flag("promotion")
+                out["promotion"] = res
+            if rt is not None:
+                out["trace_id"] = rt.trace_id
+                rt.finish()
+            return out
+        except BaseException as e:
+            if rt is not None:
+                rt.finish(status=type(e).__name__)
+            raise
 
     def run(self, batches) -> list:
         """Drain an iterable of update batches: each item is X, (X, y) or

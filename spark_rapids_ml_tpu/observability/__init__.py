@@ -16,6 +16,9 @@
 #                 /runs/<id>/ranks (barrier timeline)
 #   flight.py     failure flight recorder: bounded ring buffer + postmortem
 #                 bundles (postmortem_<run_id>.json)
+#   tracing.py    causal request tracing (§6l): W3C traceparent ids, per-request
+#                 span trees with fan-in links, tail-based sampling ring,
+#                 trace_reports.jsonl export + /traces live endpoints
 #   comm.py       communication plane: HLO collective accounting, comm
 #                 roofline, per-rank skew + straggler detection, timeline
 #
@@ -72,8 +75,10 @@ from .inference import (
     transform_run,
 )
 from .export import (
+    TRACE_REPORT_FILENAME,
     load_run_reports,
     load_serving_reports,
+    load_trace_reports,
     load_transform_partials,
     load_transform_reports,
     render_prometheus,
@@ -100,6 +105,18 @@ from .flight import (
     dump_postmortem,
     load_postmortem,
     reset_flight_recorder,
+)
+from .tracing import (
+    RequestTrace,
+    TraceContext,
+    format_traceparent,
+    get_trace,
+    parse_traceparent,
+    reset_tracing,
+    ring_snapshot,
+    start_trace,
+    trace_index,
+    would_keep,
 )
 
 __all__ = [
@@ -146,8 +163,10 @@ __all__ = [
     "suppress_transform_runs",
     "transform_batch",
     "transform_run",
+    "TRACE_REPORT_FILENAME",
     "load_run_reports",
     "load_serving_reports",
+    "load_trace_reports",
     "load_transform_partials",
     "load_transform_reports",
     "render_prometheus",
@@ -168,4 +187,14 @@ __all__ = [
     "dump_postmortem",
     "load_postmortem",
     "reset_flight_recorder",
+    "RequestTrace",
+    "TraceContext",
+    "format_traceparent",
+    "get_trace",
+    "parse_traceparent",
+    "reset_tracing",
+    "ring_snapshot",
+    "start_trace",
+    "trace_index",
+    "would_keep",
 ]

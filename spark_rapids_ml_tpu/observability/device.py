@@ -100,6 +100,11 @@ _records: Dict[Tuple[str, Any], Dict[str, Any]] = {}
 # its compile; it just stops being remembered)
 _MAX_RECORDS = 4096
 
+# monotone process-wide compile count: unlike len(_records) (capped, clearable
+# per test) this NEVER decreases, so a before/after delta is a reliable
+# "did the warm path compile anything?" probe (trace plane compile-vs-cached)
+_compiles_total = 0
+
 # memory_stats support: None = unknown, False = probed and absent (never
 # re-probed, never warned — the graceful-degrade contract), True = live
 _hbm_supported: Optional[bool] = None
@@ -476,9 +481,11 @@ class CompiledKernel:
             collectives = None
         if collectives:
             record["collectives"] = collectives
+        global _compiles_total
         with _lock:
             if len(_records) < _MAX_RECORDS:
                 _records[(self.name, sig)] = record
+            _compiles_total += 1
         _runs.counter_inc("device.compile", 1, kernel=self.name)
         _runs.observe("device.compile_s", compile_s, kernel=self.name)
         if not cost.get("analyzed", False):
@@ -696,6 +703,14 @@ def compile_count(name: str) -> int:
     """Distinct compiled signatures recorded for a kernel name."""
     with _lock:
         return sum(1 for (k, _) in _records if k == name)
+
+
+def compiles_total() -> int:
+    """Monotone process-wide compile count (never reset; see the module-level
+    `_compiles_total` note). A zero before/after delta across a code path is
+    the compile-vs-cached verdict trace execute spans report."""
+    with _lock:
+        return _compiles_total
 
 
 def device_report_section(registry: Any = None) -> Optional[Dict[str, Any]]:

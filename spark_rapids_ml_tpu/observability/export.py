@@ -32,6 +32,7 @@ RUN_REPORT_FILENAME = "fit_reports.jsonl"
 TRANSFORM_REPORT_FILENAME = "transform_reports.jsonl"
 TRANSFORM_PARTIALS_FILENAME = "transform_partials.jsonl"
 SERVING_REPORT_FILENAME = "serving_reports.jsonl"
+TRACE_REPORT_FILENAME = "trace_reports.jsonl"
 
 _NAME_OK = re.compile(r"[^a-zA-Z0-9_:]")
 _PROM_PREFIX = "srml_tpu_"
@@ -189,6 +190,14 @@ def load_serving_reports(path_or_dir: str) -> List[Dict[str, Any]]:
     return load_run_reports(path_or_dir)
 
 
+def load_trace_reports(path_or_dir: str) -> List[Dict[str, Any]]:
+    """`load_run_reports` for the trace plane's JSONL (one line per KEPT
+    trace, written at tail-sampling time — observability/tracing.py)."""
+    if os.path.isdir(path_or_dir):
+        return load_run_reports(path_or_dir, filename=TRACE_REPORT_FILENAME)
+    return load_run_reports(path_or_dir)
+
+
 def _prom_name(name: str) -> str:
     return _PROM_PREFIX + _NAME_OK.sub("_", name)
 
@@ -249,12 +258,22 @@ def render_prometheus(snapshot: Mapping[str, Any]) -> str:
         pname = _prom_name(name)
         _typed(pname, "histogram")
         bounds = list(st.get("bounds") or [])
+        exemplars = st.get("exemplars") or []
         cum = 0
         for i, c in enumerate(st["buckets"]):
             cum += c
             le = repr(float(bounds[i])) if i < len(bounds) else "+Inf"
             le_label = 'le="%s"' % le
-            lines.append(f"{pname}_bucket{_prom_labels(labels, le_label)} {cum}")
+            line = f"{pname}_bucket{_prom_labels(labels, le_label)} {cum}"
+            # OpenMetrics exemplar: `# {trace_id="..."} value timestamp` —
+            # the per-bucket trace pointer a p99 spike resolves through
+            ex = exemplars[i] if i < len(exemplars) else None
+            if ex is not None:
+                line += (
+                    f' # {{trace_id="{_prom_escape(ex["trace_id"])}"}}'
+                    f' {ex["value"]} {ex["ts"]}'
+                )
+            lines.append(line)
         lines.append(f"{pname}_sum{_prom_labels(labels)} {st['sum']}")
         lines.append(f"{pname}_count{_prom_labels(labels)} {st['count']}")
     return "\n".join(lines) + ("\n" if lines else "")

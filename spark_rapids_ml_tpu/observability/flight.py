@@ -179,6 +179,14 @@ def dump_postmortem(run: Any = None, reason: str = "failure",
         )
         if device_section:
             bundle["device"] = device_section
+        # tail-sampled trace ring (§6l): the requests that died WITH the
+        # process — error/hedged/failed-over/slowest traces — ride along so a
+        # postmortem reader can walk causality without a live /traces endpoint
+        from .tracing import ring_snapshot
+
+        traces = ring_snapshot()
+        if traces:
+            bundle["traces"] = traces
         # per-rank barrier timeline (§6h): a degraded/failed barrier fit's
         # postmortem must show WHICH rank was slow, not just that one was
         if hasattr(run, "rank_view"):

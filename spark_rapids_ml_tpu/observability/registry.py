@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import math
 import threading
+import time
 from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple
 
 # default exponential latency buckets: 100us * 2^i, i in [0, 20) — covers one
@@ -142,7 +143,8 @@ class Histogram(_Metric):
         super().__init__(name, lock)
         self.bounds: Tuple[float, ...] = tuple(sorted(float(b) for b in buckets))
 
-    def observe(self, value: float, **labels: Any) -> None:
+    def observe(self, value: float, exemplar: Any = None,
+                **labels: Any) -> None:
         v = float(value)
         # leftmost bound with v <= bound; +inf slot otherwise. Bisection is
         # overkill at 20 bounds; a linear scan stays cache-friendly and cheap.
@@ -171,15 +173,36 @@ class Histogram(_Metric):
                 state["min"] = v
             if v > state["max"]:
                 state["max"] = v
+            # per-bucket exemplar slot (§6l): one trace_id per bucket,
+            # last-write-wins — the freshest trace that landed in this latency
+            # band, which is what a /metrics p99 spike resolves through
+            if exemplar is not None:
+                ex = state.get("exemplars")
+                if ex is None:
+                    ex = state["exemplars"] = (
+                        [None] * (len(self.bounds) + 1))
+                ex[idx] = {
+                    "value": v,
+                    "trace_id": str(exemplar),
+                    "labels": dict(labels),
+                    "ts": round(time.time(), 6),
+                }
 
     def state(self, **labels: Any) -> Optional[Dict[str, Any]]:
         with self._lock:
             st = self._values.get(self._key(labels))
-            return None if st is None else {
+            if st is None:
+                return None
+            out = {
                 "count": st["count"], "sum": st["sum"],
                 "buckets": list(st["buckets"]),
                 "min": st.get("min"), "max": st.get("max"),
             }
+            ex = st.get("exemplars")
+            if ex is not None:
+                out["exemplars"] = [
+                    dict(e) if e is not None else None for e in ex]
+            return out
 
     def quantile(self, q: float, **labels: Any) -> Optional[float]:
         """Estimated q-quantile with exponential-bucket interpolation (see
@@ -275,14 +298,18 @@ class MetricsRegistry:
                 if m.kind != kind:
                     continue
                 for key, v in m._values.items():
-                    out[key] = (
-                        {"count": v["count"], "sum": v["sum"],
-                         "buckets": list(v["buckets"]),
-                         "min": v.get("min"), "max": v.get("max"),
-                         "bounds": list(m.bounds)}  # type: ignore[attr-defined]
-                        if kind == "histogram"
-                        else v
-                    )
+                    if kind != "histogram":
+                        out[key] = v
+                        continue
+                    st = {"count": v["count"], "sum": v["sum"],
+                          "buckets": list(v["buckets"]),
+                          "min": v.get("min"), "max": v.get("max"),
+                          "bounds": list(m.bounds)}  # type: ignore[attr-defined]
+                    ex = v.get("exemplars")
+                    if ex is not None:
+                        st["exemplars"] = [
+                            dict(e) if e is not None else None for e in ex]
+                    out[key] = st
         return out
 
     def counter_totals(self) -> Dict[str, Any]:
@@ -351,6 +378,21 @@ class MetricsRegistry:
                     ]
                 else:  # mismatched bucket layouts: keep count/sum, drop shape
                     mine["buckets"][-1] += sum(theirs)
+                # exemplar slots keep last-write-wins across the merge too:
+                # per bucket, the later timestamp survives
+                theirs_ex = st.get("exemplars")
+                if theirs_ex and len(theirs_ex) == len(mine["buckets"]):
+                    ex = mine.get("exemplars")
+                    if ex is None:
+                        ex = mine["exemplars"] = (
+                            [None] * len(mine["buckets"]))
+                    for i, other in enumerate(theirs_ex):
+                        if other is None:
+                            continue
+                        ours = ex[i]
+                        if ours is None or (other.get("ts") or 0) >= (
+                                ours.get("ts") or 0):
+                            ex[i] = dict(other)
 
 
 def interpolate_quantile(state: Mapping[str, Any], q: float,

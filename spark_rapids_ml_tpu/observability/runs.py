@@ -143,6 +143,13 @@ def _sink_registries() -> List[MetricsRegistry]:
 
 
 def counter_inc(name: str, n: int = 1, **labels: Any) -> None:
+    # fast path: outside any fit run / worker scope (the serving loop's
+    # steady state) there is exactly one sink, so skip the fan-out list
+    # build and its lock. The unlocked emptiness reads are GIL-atomic; a
+    # racing run-open at worst misses one best-effort increment.
+    if not _active_runs and not getattr(_tls, "worker_scopes", None):
+        _GLOBAL.counter(name).inc(n, **labels)
+        return
     for reg in _sink_registries():
         reg.counter(name).inc(n, **labels)
 
@@ -169,9 +176,11 @@ def gauge_dec(name: str, n: Any = 1, **labels: Any) -> None:
 
 
 def observe(name: str, value: float,
-            buckets: Sequence[float] = DEFAULT_TIME_BUCKETS, **labels: Any) -> None:
+            buckets: Sequence[float] = DEFAULT_TIME_BUCKETS,
+            exemplar: Any = None, **labels: Any) -> None:
     for reg in _sink_registries():
-        reg.histogram(name, buckets=buckets).observe(value, **labels)
+        reg.histogram(name, buckets=buckets).observe(
+            value, exemplar=exemplar, **labels)
 
 
 def add_span_total(name: str, seconds: float) -> None:
@@ -300,7 +309,7 @@ class SpanNode:
 
 
 @contextlib.contextmanager
-def span(name: str, attrs: Optional[Mapping[str, Any]] = None) -> Iterator[None]:
+def span(name: str, attrs: Optional[Mapping[str, Any]] = None) -> Iterator[SpanNode]:
     """Cheap structured span: perf_counter + thread-local parent linkage, no
     jax import anywhere near it. Failure-safe by construction (try/finally):
     a span whose body raises records its elapsed time with status='error' and
@@ -319,7 +328,7 @@ def span(name: str, attrs: Optional[Mapping[str, Any]] = None) -> Iterator[None]
         run.note_span_open(node)
     _flight().note_span_open(node)
     try:
-        yield
+        yield node
     except BaseException:
         node.status = "error"
         raise
@@ -333,6 +342,25 @@ def span(name: str, attrs: Optional[Mapping[str, Any]] = None) -> Iterator[None]
                 stack.remove(node)
             except ValueError:
                 pass
+        # inclusive device accounting: raw kernel cost rolls up into the
+        # enclosing span on this thread, so a wrapper span opened ABOVE the
+        # dispatch layer (serving.batch around transform.predict) still
+        # carries the §6f cost of the kernels it caused. Raw fields only —
+        # each level gets its own roofline classification at its own close.
+        dev = node.attrs.get("device")
+        if dev and stack:
+            pdev = stack[-1].attrs.get("device")
+            if pdev is None:
+                pdev = stack[-1].attrs["device"] = {
+                    "flops": 0.0, "bytes": 0.0, "transcendentals": 0.0,
+                    "comm_bytes": 0.0, "calls": 0, "kernels": {},
+                }
+            for k in ("flops", "bytes", "transcendentals", "comm_bytes"):
+                pdev[k] = pdev.get(k, 0.0) + float(dev.get(k, 0.0) or 0.0)
+            pdev["calls"] = pdev.get("calls", 0) + int(dev.get("calls", 0) or 0)
+            agg = pdev.setdefault("kernels", {})
+            for kname, c in (dev.get("kernels") or {}).items():
+                agg[kname] = agg.get(kname, 0) + c
         # device plane (observability/device.py): roofline-classify any kernel
         # work attributed to this span + keep the HBM gauge fresh. Runs BEFORE
         # add_span so the stored span dicts carry the finalized attrs.
@@ -393,6 +421,16 @@ class FitRun:
         self.algo = algo
         self.site = site
         self.run_id = f"{self._id_prefix}-{next(_run_ids)}-{uuid.uuid4().hex[:8]}"
+        # every run is born with a trace context (docs/design.md §6l) so
+        # barrier-fit / transform-partition worker snapshots can join the
+        # driver's trace across process boundaries (the run_id discipline)
+        try:
+            from .tracing import format_traceparent, mint_span_id, mint_trace_id
+
+            self.traceparent: Optional[str] = format_traceparent(
+                mint_trace_id(), mint_span_id())
+        except Exception:
+            self.traceparent = None
         self.registry = MetricsRegistry()
         self.max_spans = (
             int(_config.get("observability.max_spans"))
@@ -738,6 +776,7 @@ class FitRun:
             "schema": 1,
             "kind": self.kind,
             "run_id": self.run_id,
+            "traceparent": self.traceparent,
             "algo": self.algo,
             "site": self.site,
             "process": PROCESS_TOKEN,
@@ -810,9 +849,11 @@ class WorkerScope:
     per-worker rows to exactly one run instead of guessing by process token."""
 
     def __init__(self, rank: Optional[int] = None, max_spans: int = 256,
-                 max_events: int = 512, run_id: Optional[str] = None):
+                 max_events: int = 512, run_id: Optional[str] = None,
+                 traceparent: Optional[str] = None):
         self.rank = rank
         self.run_id = run_id
+        self.traceparent = traceparent
         self.registry = MetricsRegistry()
         self.max_spans = max_spans
         self.max_events = max_events
@@ -884,6 +925,7 @@ class WorkerScope:
                 "process": PROCESS_TOKEN,
                 "rank": self.rank,
                 "run_id": self.run_id,
+                "traceparent": self.traceparent,
                 "started_ts": round(self.started_ts, 6),
                 "wall_s": round(time.perf_counter() - self._t0, 6),
                 "phases": {k: dict(v) for k, v in self._phases.items()},
@@ -910,12 +952,14 @@ def note_rank_phase(phase: str, wall_s: Optional[float] = None,
 
 @contextlib.contextmanager
 def worker_scope(rank: Optional[int] = None,
-                 run_id: Optional[str] = None) -> Iterator[WorkerScope]:
+                 run_id: Optional[str] = None,
+                 traceparent: Optional[str] = None) -> Iterator[WorkerScope]:
     """Open a thread-local capture scope (stackable; inner scopes see the same
     writes). The barrier UDF wraps its whole body in one so each task's metric
     delta travels to the driver regardless of which process it ran in;
-    `run_id` stamps the driver's trace context on the exported snapshot."""
-    scope = WorkerScope(rank=rank, run_id=run_id)
+    `run_id` (and since §6l the W3C `traceparent`) stamps the driver's trace
+    context on the exported snapshot."""
+    scope = WorkerScope(rank=rank, run_id=run_id, traceparent=traceparent)
     _worker_scopes().append(scope)
     try:
         yield scope

@@ -164,11 +164,16 @@ class _Handler(BaseHTTPRequestHandler):
         """Route to a registered path-prefix mount (the serving plane's /v1/
         endpoints). Returns False when no mount claims the path. A mount may
         return (code, doc) or (code, doc, headers) — the latter carries
-        response headers like the shed path's `Retry-After`."""
+        response headers like the shed path's `Retry-After`. Mounts that
+        accept a 4th argument get the request headers (the serving plane
+        reads `traceparent` there); older 3-arg mounts keep working."""
         handler = _find_mount(path)
         if handler is None:
             return False
-        result = handler(method, path, body)
+        try:
+            result = handler(method, path, body, dict(self.headers.items()))
+        except TypeError:
+            result = handler(method, path, body)
         if len(result) == 3:
             code, doc, headers = result
         else:
@@ -251,10 +256,25 @@ class _Handler(BaseHTTPRequestHandler):
                     self._send_json({"error": "no open run with that id"}, 404)
                 else:
                     self._send_json(run.live_view())
+            elif path == "/traces":
+                from .tracing import trace_index
+
+                self._send_json({"traces": trace_index()})
+            elif path.startswith("/traces/"):
+                from .tracing import get_trace
+
+                doc = get_trace(path[len("/traces/"):])
+                if doc is None:
+                    self._send_json(
+                        {"error": "no retained trace with that id "
+                                  "(dropped by sampling, evicted from the "
+                                  "ring, or never minted)"}, 404)
+                else:
+                    self._send_json(doc)
             else:
                 self._send_json({"error": "unknown path", "paths": [
                     "/metrics", "/healthz", "/runs", "/runs/<run_id>",
-                    "/runs/<run_id>/ranks"
+                    "/runs/<run_id>/ranks", "/traces", "/traces/<trace_id>"
                 ], "mounts": sorted(_mounts)}, 404)
         except Exception as e:
             # a scrape must never take the process down; report the error to

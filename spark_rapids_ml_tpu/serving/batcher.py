@@ -49,6 +49,9 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 from .. import config as _config
+from ..observability import tracing as _tracing
+from ..observability.device import compiles_total as _compiles_total
+from ..observability.device import kernel_cost as _kernel_cost
 from ..observability.runs import counter_inc, observe, span
 from ..reliability.faults import fault_point
 from ..utils import get_logger
@@ -137,9 +140,11 @@ def pad_to_bucket(X: np.ndarray, bucket: int,
 
 
 class _Request:
-    __slots__ = ("X", "n_rows", "future", "enqueue_ts", "deadline_ts")
+    __slots__ = ("X", "n_rows", "future", "enqueue_ts", "deadline_ts",
+                 "trace")
 
-    def __init__(self, X: np.ndarray, deadline_ts: Optional[float] = None):
+    def __init__(self, X: np.ndarray, deadline_ts: Optional[float] = None,
+                 trace: Optional["_tracing.RequestTrace"] = None):
         self.X = X
         self.n_rows = int(X.shape[0])
         self.future: "Future[Dict[str, np.ndarray]]" = Future()
@@ -147,6 +152,9 @@ class _Request:
         # absolute time.perf_counter() deadline, threaded from the client's
         # predict(..., timeout=) so queue time counts against the budget
         self.deadline_ts = deadline_ts
+        # the request's causal trace (docs/design.md §6l), carried by
+        # reference so queue/batch/execute/scatter spans land on it
+        self.trace = trace
 
 
 class MicroBatcher:
@@ -191,7 +199,8 @@ class MicroBatcher:
     # ------------------------------------------------------------ client side
 
     def submit(self, X: np.ndarray,
-               deadline_ts: Optional[float] = None
+               deadline_ts: Optional[float] = None,
+               trace: Optional["_tracing.RequestTrace"] = None
                ) -> "Future[Dict[str, np.ndarray]]":
         """Enqueue one request; the returned Future resolves to this request's
         named output arrays (exactly `n_rows` leading rows each). A request
@@ -214,10 +223,12 @@ class MicroBatcher:
             )
         if deadline_ts is not None and time.perf_counter() >= deadline_ts:
             counter_inc("serving.expired", 1, **self.labels)
+            if trace is not None:
+                trace.add_event("deadline_expired", at="submit", **self.labels)
             raise DeadlineExpired(
                 f"request deadline expired before enqueue on '{self.name}'"
             )
-        req = _Request(X, deadline_ts=deadline_ts)
+        req = _Request(X, deadline_ts=deadline_ts, trace=trace)
         with self._cond:
             if self._stop:
                 raise ServingError(f"model '{self.name}' is shutting down")
@@ -345,6 +356,12 @@ class MicroBatcher:
         for r in batch:
             if r.deadline_ts is not None and now >= r.deadline_ts:
                 counter_inc("serving.expired", 1, **self.labels)
+                if r.trace is not None:
+                    r.trace.add_span("serving.queue", r.enqueue_ts, now,
+                                 parent_id=r.trace.root_span_id,
+                                 attrs=dict(self.labels), status="expired")
+                    r.trace.add_event("deadline_expired", at="batch_close",
+                                      **self.labels)
                 if r.future.set_running_or_notify_cancel():
                     r.future.set_exception(DeadlineExpired(
                         f"request deadline expired after "
@@ -353,6 +370,53 @@ class MicroBatcher:
             else:
                 live.append(r)
         return live
+
+    def _trace_batch(self, traced: List[_Request], fan_in: List[Dict],
+                     batch_sid: str, exec_sid: str, bnode: Any,
+                     compiles0: int, anno: Dict[str, Any],
+                     n: int, bucket: int,
+                     t_start: float, t_padded: float, t_done: float) -> None:
+        """Append the shared batch + execute spans to every member trace.
+        The batch span is the fan-in point (links -> each member's root); the
+        execute child joins the §6f kernel layer: executable signature,
+        compile-vs-cached verdict, analyzed flops/bytes from the device plane
+        attribution that landed on the `serving.batch` SpanNode."""
+        batch_attrs: Dict[str, Any] = {
+            "rows": n, "bucket": bucket,
+            "occupancy": round(n / bucket, 6), **self.labels,
+        }
+        if anno:
+            batch_attrs.update(anno)
+        exec_attrs: Dict[str, Any] = {
+            "compiled": _compiles_total() - compiles0,
+        }
+        dev = (bnode.attrs or {}).get("device") if bnode is not None else None
+        if dev:
+            for k in ("flops", "bytes", "comm_bytes", "calls",
+                      "roofline", "intensity_flop_per_byte", "mfu"):
+                if dev.get(k) is not None:
+                    exec_attrs[k] = dev[k]
+            kernels = dev.get("kernels") or {}
+            if kernels:
+                sigs = {}
+                for kname in kernels:
+                    rec = _kernel_cost(kname)
+                    if rec is not None and rec.get("signature"):
+                        sigs[kname] = rec["signature"]
+                exec_attrs["kernels"] = dict(kernels)
+                if sigs:
+                    exec_attrs["signatures"] = sigs
+        for r in traced:
+            r.trace.add_span("serving.batch", t_start, t_done,
+                         parent_id=r.trace.root_span_id,
+                         attrs=batch_attrs, links=fan_in, span_id=batch_sid)
+            r.trace.add_span("serving.execute", t_padded, t_done,
+                         parent_id=batch_sid, attrs=exec_attrs,
+                         span_id=exec_sid)
+            if anno.get("generation") is not None:
+                r.trace.add_event("model_generation",
+                                  generation=anno["generation"],
+                                  **self.labels)
 
     def _run_batch(self, batch: List[_Request]) -> None:
         n_closed = len(batch)
@@ -366,6 +430,25 @@ class MicroBatcher:
         for r in batch:
             observe("serving.queue_s", t_start - r.enqueue_ts, **self.labels)
         bucket = bucket_rows(n)
+        # trace plumbing (§6l): members carrying a RequestTrace get a queue
+        # span now; the micro-batch itself becomes ONE shared span (same
+        # span_id across every member trace) with fan-in links to the N
+        # request roots it coalesced — that link set is what attributes
+        # padding/occupancy cost per request
+        traced = [r for r in batch if r.trace is not None]
+        batch_sid = _tracing.mint_span_id() if traced else None
+        exec_sid = _tracing.mint_span_id() if traced else None
+        fan_in = [
+            {"trace_id": r.trace.trace_id, "span_id": r.trace.root_span_id}
+            for r in traced
+        ]
+        for r in traced:
+            # labels dict is frozen for the batcher's lifetime, so it is safe
+            # to capture by reference (document() copies at export)
+            r.trace.add_span("serving.queue", r.enqueue_ts, t_start,
+                         parent_id=r.trace.root_span_id,
+                         attrs=self.labels)
+        compiles0 = _compiles_total() if traced else 0
         try:
             # the mid-batch failure site: an injected raise here fails exactly
             # this batch's futures (retryably, for OSError-class faults) and
@@ -392,7 +475,7 @@ class MicroBatcher:
                 else "serving.bucket_miss", 1, **self.labels,
             )
             with span("serving.batch",
-                      {"rows": n, "bucket": bucket, **self.labels}):
+                      {"rows": n, "bucket": bucket, **self.labels}) as bnode:
                 outputs = self._execute(stage, n)
             t_done = time.perf_counter()
             observe("serving.execute_s", t_done - t_padded, **self.labels)
@@ -400,12 +483,28 @@ class MicroBatcher:
         except Exception as e:
             counter_inc("serving.errors", 1, **self.labels)
             _logger.warning("serving batch failed for %s: %s", self.name, e)
+            t_err = time.perf_counter()
+            _tracing.take_batch_annotations()  # don't leak onto a later batch
+            for r in traced:
+                r.trace.add_event("error", kind_detail=type(e).__name__,
+                                  **self.labels)
+                r.trace.add_span("serving.batch", t_start, t_err,
+                             parent_id=r.trace.root_span_id,
+                             attrs={"rows": n, "bucket": bucket,
+                                    **self.labels},
+                             links=fan_in, status="error",
+                             span_id=batch_sid)
             for r in batch:
                 if not r.future.set_running_or_notify_cancel():
                     continue
                 r.future.set_exception(e)
             self._note_drain(n_closed)
             return
+        anno = _tracing.take_batch_annotations()  # drained every batch
+        if traced:
+            self._trace_batch(traced, fan_in, batch_sid, exec_sid, bnode,
+                              compiles0, anno, n, bucket,
+                              t_start, t_padded, t_done)
         # scatter per-request slices back to the waiting futures: exact row
         # counts, no cross-request bleed (sliced COPIES so one request's
         # result does not keep the whole bucket's outputs alive)
@@ -420,9 +519,23 @@ class MicroBatcher:
                 else:  # per-model scalars/metadata ride along unsliced
                     out_r[key] = arr
             off += r.n_rows
+            if r.trace is not None:
+                # srml-metric: serving.scatter — trace span family (§6l)
+                r.trace.add_span("serving.scatter", t_done, now,
+                             parent_id=r.trace.root_span_id,
+                             attrs={"rows": r.n_rows, **self.labels})
             if r.future.set_running_or_notify_cancel():
                 r.future.set_result(out_r)
-            observe("serving.total_s", now - r.enqueue_ts, **self.labels)
+            total_s = now - r.enqueue_ts
+            # exemplar iff the pointed-at trace will survive tail sampling —
+            # a /metrics exemplar must resolve at /traces/<id>
+            ex = (
+                r.trace.trace_id
+                if r.trace is not None and _tracing.would_keep(r.trace,
+                                                               total_s)
+                else None
+            )
+            observe("serving.total_s", total_s, exemplar=ex, **self.labels)
         counter_inc("serving.batches", 1, **self.labels)
         counter_inc("serving.requests", len(batch), **self.labels)
         counter_inc("serving.rows", n, **self.labels)

@@ -133,12 +133,15 @@ class _FleetRequest:
     replicas currently hold a copy, and the replay/hedge state."""
 
     __slots__ = ("X", "tenant", "deadline_ts", "enqueue_ts", "client", "lock",
-                 "attempts", "hedged", "primary", "inflight", "released")
+                 "attempts", "hedged", "primary", "inflight", "released",
+                 "trace")
 
-    def __init__(self, X: Any, tenant: str, deadline_ts: Optional[float]):
+    def __init__(self, X: Any, tenant: str, deadline_ts: Optional[float],
+                 trace: Any = None):
         self.X = X
         self.tenant = tenant
         self.deadline_ts = deadline_ts
+        self.trace = trace  # RequestTrace or None (§6l)
         self.enqueue_ts = time.perf_counter()
         self.client: "Future[Dict[str, Any]]" = Future()
         self.lock = threading.Lock()
@@ -253,9 +256,19 @@ class ReplicaFleet:
             self.name, rep.index, cause,
         )
         assert rep.batcher is not None
+        steal_now = time.perf_counter()
         for r in rep.batcher.steal_pending():
             # the inner futures carry fleet callbacks: failing them with
             # ReplicaKilled routes each stolen request into the replay path
+            if r.trace is not None:
+                # the dead dispatcher will never close this queue span itself
+                r.trace.add_span("serving.queue", r.enqueue_ts, steal_now,
+                             parent_id=r.trace.root_span_id,
+                             attrs={"model": self.name,
+                                    "replica": str(rep.index)},
+                             status="stolen")
+                r.trace.add_event("queue_steal", model=self.name,
+                                  replica=rep.index, cause=cause)
             if r.future.set_running_or_notify_cancel():
                 r.future.set_exception(
                     ReplicaKilled("serving_dispatch", rep.index)
@@ -348,14 +361,20 @@ class ReplicaFleet:
     # ------------------------------------------------------------- client side
 
     def submit(self, X: Any, deadline_ts: Optional[float] = None,
-               tenant: Optional[str] = None) -> "Future[Dict[str, Any]]":
+               tenant: Optional[str] = None,
+               trace: Any = None) -> "Future[Dict[str, Any]]":
         """Admit + route one request; the returned Future survives replica
         death (replayed), hedging (first resolution wins), and restarts
         (parked until a replica recovers) — it fails only on non-retryable
         errors, an exhausted RetryPolicy, or the client's own deadline."""
         tenant = tenant or "-"
-        self.router.admit(tenant)  # raises QueueFull (429 + Retry-After)
-        freq = _FleetRequest(X, tenant, deadline_ts)
+        try:
+            self.router.admit(tenant)  # raises QueueFull (429 + Retry-After)
+        except QueueFull:
+            if trace is not None:
+                trace.add_event("tenant_shed", model=self.name, tenant=tenant)
+            raise
+        freq = _FleetRequest(X, tenant, deadline_ts, trace=trace)
         with self._lock:
             self._outstanding.add(freq)
         try:
@@ -417,7 +436,8 @@ class ReplicaFleet:
         """One replica attempt; False on that replica's backpressure."""
         assert rep.batcher is not None
         try:
-            inner = rep.batcher.submit(freq.X, deadline_ts=freq.deadline_ts)
+            inner = rep.batcher.submit(freq.X, deadline_ts=freq.deadline_ts,
+                                       trace=freq.trace)
         except QueueFull:
             return False
         with self._lock:
@@ -453,6 +473,9 @@ class ReplicaFleet:
                 self._latencies.append(time.perf_counter() - freq.enqueue_ts)
                 if hedge_win:
                     counter_inc("serving.hedge_wins", 1, model=self.name)
+                    if freq.trace is not None:
+                        freq.trace.add_event("hedge_won", model=self.name,
+                                             replica=rep.index)
             return
         if isinstance(exc, ReplicaKilled):
             self._declare_dead(rep, "killed")
@@ -490,6 +513,11 @@ class ReplicaFleet:
             "serving_replay", model=self.name, replica=failed_idx,
             attempt=attempts, error=type(exc).__name__,
         )
+        if freq.trace is not None:
+            freq.trace.add_event(
+                "failover_replay", model=self.name, replica=failed_idx,
+                attempt=attempts, error=type(exc).__name__,
+            )
         try:
             self._dispatch(freq, exclude=(failed_idx,))
         except Exception as e:
@@ -555,6 +583,9 @@ class ReplicaFleet:
                 if freq.client.done():
                     continue
             if freq.deadline_ts is not None and now >= freq.deadline_ts:
+                if freq.trace is not None:
+                    freq.trace.add_event("deadline_expired", at="parked",
+                                         model=self.name)
                 self._settle_err(freq, DeadlineExpired(
                     "request deadline expired while no replica was live"
                 ))
@@ -609,6 +640,11 @@ class ReplicaFleet:
                 "serving_hedge", model=self.name, replica=rep2.index,
                 waited_s=round(now - freq.enqueue_ts, 4),
             )
+            if freq.trace is not None:
+                freq.trace.add_event(
+                    "hedge_issued", model=self.name, replica=rep2.index,
+                    waited_s=round(now - freq.enqueue_ts, 4),
+                )
             try:
                 self._enqueue_on(rep2, freq)
             except Exception:  # hedge is optional: the primary is still live

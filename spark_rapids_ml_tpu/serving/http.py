@@ -30,6 +30,7 @@ import numpy as np
 
 from .. import config as _config
 from ..observability import server as _obs_server
+from ..observability import tracing as _tracing
 from ..observability.export import SERVING_REPORT_FILENAME
 from ..observability.registry import interpolate_quantile, split_label_key
 from ..observability.runs import FitRun, counter_inc
@@ -230,13 +231,68 @@ def _retry_headers(retry_after_s: Optional[float]) -> Optional[Dict[str, str]]:
     return {"Retry-After": str(max(1, int(math.ceil(retry_after_s))))}
 
 
-def _http_handler(method: str, path: str, body: Optional[bytes]):
-    """The /v1/ mount (observability/server.py dispatches here). Never raises:
-    every error maps to a status + a JSON body carrying a structured
-    `error_kind` (the exception class — what a client should branch on,
-    instead of parsing the message), plus `Retry-After` on 429/503 shedding.
-    Unexpected 500s additionally count `serving.errors{model=,kind=}` so an
-    error-rate alert can tell schema junk from handler bugs."""
+def _http_handler(method: str, path: str, body: Optional[bytes],
+                  headers: Optional[Dict[str, str]] = None):
+    """The /v1/ mount (observability/server.py dispatches here). Never
+    raises; every response — success AND 4xx/5xx — carries `traceparent`
+    (the client's valid one echoed, a malformed one counted
+    `tracing.bad_traceparent` and REPLACED, never 400'd) plus
+    `x-srml-generation` (the served model's weight-version ordinal) when the
+    path names a registered model. :predict POSTs additionally mint (or
+    adopt) a full RequestTrace, finished here with the response code."""
+    hdrs = {str(k).lower(): v for k, v in (headers or {}).items()}
+    ctx = None
+    raw = hdrs.get("traceparent")
+    if raw is not None:
+        ctx = _tracing.parse_traceparent(raw)
+        if ctx is None:
+            counter_inc("tracing.bad_traceparent", 1)
+    rt = None
+    if method == "POST" and path.endswith(":predict"):
+        rt = _tracing.start_trace(
+            "http.request", ctx=ctx, method=method, path=path,
+            model=_model_from_path(path),
+        )
+    result = _dispatch_serving(method, path, body, rt)
+    code, doc = result[0], result[1]
+    extra = result[2] if len(result) > 2 and result[2] else {}
+    base: Dict[str, str] = {}
+    if rt is not None:
+        base["traceparent"] = rt.traceparent
+    elif ctx is not None:
+        base["traceparent"] = _tracing.format_traceparent(
+            ctx.trace_id, ctx.span_id, ctx.sampled)
+    else:
+        c = _tracing.mint_context()
+        base["traceparent"] = _tracing.format_traceparent(
+            c.trace_id, c.span_id)
+    model = _model_from_path(path)
+    if model != "-":
+        with _lock:
+            reg = _registry
+        if reg is not None:
+            try:
+                base["x-srml-generation"] = str(reg.generation(model))
+            except KeyError:
+                pass
+    if rt is not None:
+        rt.add_event("http_response", code=code)
+        rt.finish(status=(
+            "ok" if code < 400
+            else str((doc or {}).get("error_kind") or f"http_{code}")
+        ))
+    base.update(extra)
+    return code, doc, base
+
+
+def _dispatch_serving(method: str, path: str, body: Optional[bytes],
+                      rt: Optional["_tracing.RequestTrace"]):
+    """Route + error mapping: every error maps to a status + a JSON body
+    carrying a structured `error_kind` (the exception class — what a client
+    should branch on, instead of parsing the message), plus `Retry-After` on
+    429/503 shedding. Unexpected 500s additionally count
+    `serving.errors{model=,kind=}` so an error-rate alert can tell schema
+    junk from handler bugs."""
     with _lock:
         reg = _registry
     if reg is None:
@@ -250,7 +306,7 @@ def _http_handler(method: str, path: str, body: Optional[bytes]):
         if method == "POST" and path.startswith("/v1/models/") \
                 and path.endswith(":predict"):
             name = path[len("/v1/models/"): -len(":predict")]
-            return _handle_predict(reg, name, body)
+            return _handle_predict(reg, name, body, rt)
         return 404, {"error": "unknown serving path", "paths": [
             "GET /v1/models", "GET /v1/models/<name>",
             "POST /v1/models/<name>:predict",
@@ -285,8 +341,9 @@ def _http_handler(method: str, path: str, body: Optional[bytes]):
         return 500, {"error": f"{kind}: {e}", "error_kind": kind}
 
 
-def _handle_predict(reg: ModelRegistry, name: str,
-                    body: Optional[bytes]) -> Tuple[int, Any]:
+def _handle_predict(reg: ModelRegistry, name: str, body: Optional[bytes],
+                    rt: Optional["_tracing.RequestTrace"] = None,
+                    ) -> Tuple[int, Any]:
     if not body:
         return 400, {"error": "empty request body; send "
                               '{"instances": [[...], ...]}'}
@@ -312,13 +369,17 @@ def _handle_predict(reg: ModelRegistry, name: str,
         name, X,
         timeout=float(timeout) if timeout is not None else None,
         tenant=str(tenant) if tenant is not None else None,
+        trace=rt,
     )
     rows = 1 if X.ndim == 1 else int(X.shape[0])
-    return 200, {
+    resp: Dict[str, Any] = {
         "model": name,
         "rows": rows,
         "outputs": {k: np.asarray(v).tolist() for k, v in out.items()},
     }
+    if rt is not None:
+        resp["trace_id"] = rt.trace_id
+    return 200, resp
 
 
 # ------------------------------------------------------------------ summaries

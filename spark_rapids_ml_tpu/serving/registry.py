@@ -42,6 +42,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from .. import config as _config
+from ..observability import tracing as _tracing
 from ..observability.inference import (
     bucketed_signatures,
     suppress_transform_runs,
@@ -276,9 +277,8 @@ class ModelRegistry:
             parent.warm.update(rentry.warm)
         parent.replica_entries[index] = rentry
         return ReplicaHandle(
-            execute=lambda stage, n_valid, _e=rentry: self._predict_padded(
-                _e, stage
-            ),
+            execute=lambda stage, n_valid, _e=rentry, _p=parent:
+                self._predict_padded(_e, stage, gen_entry=_p),
             warm=rentry.warm,
         )
 
@@ -432,12 +432,18 @@ class ModelRegistry:
             fn(entry.model)
         return self.refresh_weights(name)
 
-    def _predict_padded(self, entry: _ServedModel,
-                        stage: np.ndarray) -> Dict[str, np.ndarray]:
+    def _predict_padded(self, entry: _ServedModel, stage: np.ndarray,
+                        gen_entry: Optional[_ServedModel] = None
+                        ) -> Dict[str, np.ndarray]:
         """Run one padded bucket through the model's predict path with the
         HBM-resident weights installed. The entry is PINNED for the duration:
         budget pressure from other models' uploads cannot evict weights an
-        in-flight batch references."""
+        in-flight batch references. `gen_entry` names the entry whose
+        `generation` answers for this batch (the parent master in fleet mode
+        — replica clones keep generation 0); it lands as a thread-local batch
+        annotation the calling dispatcher's trace plumbing picks up."""
+        gen = gen_entry if gen_entry is not None else entry
+        _tracing.annotate_batch(generation=gen.generation)
         with self._cache_lock:
             self._cache.pin(entry.cache_key)
             tup = self._ensure_resident(entry)
@@ -500,25 +506,45 @@ class ModelRegistry:
 
     def submit(self, name: str, X: np.ndarray,
                deadline_ts: Optional[float] = None,
-               tenant: Optional[str] = None):
+               tenant: Optional[str] = None,
+               trace: Optional["_tracing.RequestTrace"] = None):
         """Enqueue one request; returns the Future of its output dict.
         `deadline_ts` is the client's absolute perf_counter() deadline (rides
         with the request — queue time counts against it); `tenant` feeds the
         fleet's fair admission (ignored in single-dispatcher mode, where
-        there is one queue and no fairness to arbitrate)."""
+        there is one queue and no fairness to arbitrate). `trace` carries the
+        caller's RequestTrace (HTTP ingress mints one); with no caller trace
+        and tracing enabled, one is minted HERE and finished when the Future
+        resolves — every request gets exactly one complete trace."""
         entry = self._entry(name)
-        if entry.fleet is not None:
-            return entry.fleet.submit(X, deadline_ts=deadline_ts,
-                                      tenant=tenant)
-        assert entry.batcher is not None
-        seq = next(entry.dispatch_seq)
-        fault_point("serving_dispatch", batch=seq)
-        chaos_point("serving_dispatch", batch=seq)
-        return entry.batcher.submit(X, deadline_ts=deadline_ts)
+        owns = False
+        if trace is None:
+            trace = _tracing.start_trace("serving.request", model=name)
+            owns = trace is not None
+        try:
+            if entry.fleet is not None:
+                fut = entry.fleet.submit(X, deadline_ts=deadline_ts,
+                                         tenant=tenant, trace=trace)
+            else:
+                assert entry.batcher is not None
+                seq = next(entry.dispatch_seq)
+                fault_point("serving_dispatch", batch=seq)
+                chaos_point("serving_dispatch", batch=seq)
+                fut = entry.batcher.submit(X, deadline_ts=deadline_ts,
+                                           trace=trace)
+        except BaseException as e:
+            if owns:
+                trace.finish(status=type(e).__name__)
+            raise
+        if owns:
+            _tracing.finish_future(trace, fut)
+        return fut
 
     def predict(self, name: str, X: np.ndarray,
                 timeout: Optional[float] = None,
-                tenant: Optional[str] = None) -> Dict[str, np.ndarray]:
+                tenant: Optional[str] = None,
+                trace: Optional["_tracing.RequestTrace"] = None
+                ) -> Dict[str, np.ndarray]:
         """Blocking request: submit + wait (the in-process twin of the HTTP
         POST /v1/models/<name>:predict path). The timeout becomes the
         request's ABSOLUTE deadline, threaded into the queue: an overdue
@@ -528,8 +554,14 @@ class ModelRegistry:
         if timeout is None:
             timeout = float(_config.get("serving.request_timeout_s"))
         deadline_ts = time.perf_counter() + float(timeout)
-        fut = self.submit(name, X, deadline_ts=deadline_ts, tenant=tenant)
+        fut = self.submit(name, X, deadline_ts=deadline_ts, tenant=tenant,
+                          trace=trace)
         return fut.result(timeout=float(timeout) + 0.25)
+
+    def generation(self, name: str) -> int:
+        """Current weight-version ordinal of a served model — the value the
+        HTTP surface echoes as `x-srml-generation` on every response."""
+        return int(self._entry(name).generation)
 
     def stats(self, name: str) -> Dict[str, Any]:
         entry = self._entry(name)

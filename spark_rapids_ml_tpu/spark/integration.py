@@ -104,12 +104,15 @@ _DEVICE_PROGRAM_LOCK = threading.Lock()
 BARRIER_FIT_SCHEMA = "model binary, metrics binary"
 
 
-def _barrier_train_udf(estimator_payload: bytes, run_id: str = None) -> Callable:
+def _barrier_train_udf(estimator_payload: bytes, run_id: str = None,
+                       traceparent: str = None) -> Callable:
     """Build the barrier mapInPandas UDF. Runs on executors; requires pyspark.
     `run_id` is the driver FitRun's trace context (docs/design.md §6g): it
     travels inside the closure, is stamped on every task's worker scope, and
     comes back on the metrics snapshot so the driver-side merge joins each row
-    to exactly one run."""
+    to exactly one run. `traceparent` is the same run's W3C trace context
+    (§6l) riding alongside, so a worker snapshot is joinable to the driver's
+    causal trace plane as well."""
     import pickle
 
     def train_udf(pdf_iter):
@@ -127,7 +130,8 @@ def _barrier_train_udf(estimator_payload: bytes, run_id: str = None) -> Callable
         rank = ctx.partitionId()
         n_tasks = ctx.getTaskInfos().__len__()
 
-        with worker_scope(rank=rank, run_id=run_id) as wscope:
+        with worker_scope(rank=rank, run_id=run_id,
+                          traceparent=traceparent) as wscope:
             attrs = _barrier_task_body(
                 est, ctx, rank, n_tasks, pdf_iter, init_process_group, get_mesh,
                 _obs_span,
@@ -490,7 +494,9 @@ def fit_on_spark(estimator: Any, spark_df: Any, num_hosts: int) -> Any:
 
     run = current_run()
     udf = _barrier_train_udf(
-        pickle.dumps(estimator), run_id=run.run_id if run is not None else None
+        pickle.dumps(estimator),
+        run_id=run.run_id if run is not None else None,
+        traceparent=getattr(run, "traceparent", None),
     )
     rdd = df.mapInPandas(udf, schema=BARRIER_FIT_SCHEMA).rdd
     try:

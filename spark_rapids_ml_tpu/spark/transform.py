@@ -185,6 +185,7 @@ def transform_on_spark(model: Any, spark_df: Any) -> Any:
         # the closure must stay picklable for real executors: primitives only,
         # never the run object itself
         run_id = run.run_id if run is not None else None
+        run_traceparent = getattr(run, "traceparent", None)
         driver_token = PROCESS_TOKEN
 
         def transform_udf(pdf_iter):
@@ -204,7 +205,9 @@ def transform_on_spark(model: Any, spark_df: Any) -> Any:
             # run_id = the driver TransformRun's trace context (§6g): stamped
             # on the scope so the snapshot — merged live or landed in the
             # transform_partials.jsonl sidecar — joins to exactly one run
-            with worker_scope(rank=rank, run_id=run_id) as wscope, _suppress():
+            with worker_scope(rank=rank, run_id=run_id,
+                              traceparent=run_traceparent) as wscope, \
+                    _suppress():
                 # delivery rides a finally: an early generator close (downstream
                 # limit()) or a mid-partition transform error must still ship
                 # the partial scope — the error case is exactly when the

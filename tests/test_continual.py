@@ -331,8 +331,11 @@ def test_generation_bumps_on_refresh_and_mutate_and_http():
     serving.register_model("km", m)
     st = serving.mutate_model("km", lambda mm: None)
     assert st["generation"] == 1
-    status, body = _http_handler("GET", "/v1/models/km", None)
+    status, body, headers = _http_handler("GET", "/v1/models/km", None)
     assert status == 200 and body["generation"] == 1
+    # every serving response now carries the generation ordinal as a header
+    assert headers["x-srml-generation"] == "1"
+    assert headers["traceparent"].startswith("00-")
 
 
 def test_promotion_governor_validates_and_rolls_back():

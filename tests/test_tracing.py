@@ -1,0 +1,697 @@
+"""Causal request-trace plane (observability/tracing.py; docs/design.md §6l).
+
+The load-bearing contracts (ISSUE acceptance):
+  * END-TO-END: one request submitted with a client traceparent keeps its
+    trace id; `/traces/<id>` reconstructs ingress -> queue -> batch (fan-in
+    links + occupancy) -> execute (kernel signature, zero warm compiles) ->
+    scatter with monotonic, non-overlapping parent/child timing;
+  * CHAOS JOINS: deterministic kill/hedge specs produce traces whose
+    failover-replay and hedge-win links are asserted exactly — the same spec
+    yields the same trace topology twice;
+  * NO BLEED: 8 threads x mixed request sizes produce 8+ disjoint traces,
+    each scattering exactly its own rows, with every batch span fan-in link
+    naming the member's own root;
+  * HTTP: `traceparent` and `x-srml-generation` echo on EVERY response
+    (4xx included); malformed traceparent is counted and replaced, never
+    400'd;
+  * TAIL SAMPLING: flagged traces always keep, the rolling-slowest keep as
+    "slow", the hash arm is deterministic per trace id;
+  * EXEMPLARS: a `/metrics` histogram exemplar resolves to a stored trace.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_ml_tpu import config, profiling, serving
+from spark_rapids_ml_tpu.observability import tracing
+from spark_rapids_ml_tpu.observability.export import (
+    load_trace_reports,
+    render_prometheus,
+)
+from spark_rapids_ml_tpu.observability.registry import MetricsRegistry
+from spark_rapids_ml_tpu.reliability import reset_chaos, reset_faults
+from spark_rapids_ml_tpu.serving import ModelRegistry
+from spark_rapids_ml_tpu.serving.fleet import ReplicaFleet, ReplicaHandle
+from spark_rapids_ml_tpu.reliability import ReplicaKilled
+
+TRACING_KEYS = (
+    "tracing.enabled",
+    "tracing.sample_rate",
+    "tracing.ring_traces",
+    "tracing.slow_frac",
+    "serving.replicas",
+    "serving.heartbeat_timeout_s",
+    "serving.hedge_after_p99_frac",
+    "serving.max_batch_rows",
+    "serving.max_wait_ms",
+    "serving.bucket_min_rows",
+    "serving.queue_depth",
+    "serving.request_timeout_s",
+    "reliability.chaos_spec",
+    "reliability.fault_spec",
+    "observability.http_port",
+    "observability.metrics_dir",
+)
+
+
+@pytest.fixture(autouse=True)
+def tracing_env():
+    tracing.reset_tracing()
+    yield
+    serving.stop_serving()
+    for key in TRACING_KEYS:
+        config.unset(key)
+    reset_faults()
+    reset_chaos()
+    tracing.reset_tracing()
+
+
+rng = np.random.default_rng(13)
+X_BLOBS = np.concatenate(
+    [rng.normal(-3, 1, (96, 6)), rng.normal(3, 1, (96, 6))]
+).astype(np.float32)
+
+
+@pytest.fixture(scope="module")
+def km():
+    from spark_rapids_ml_tpu.clustering import KMeans
+
+    pdf = pd.DataFrame({"features": list(X_BLOBS)})
+    return KMeans(k=3, maxIter=4, seed=5).fit(pdf)
+
+
+def _ctr(prefix: str, also: str = "") -> int:
+    return sum(
+        v for k, v in profiling.counter_totals().items()
+        if k.startswith(prefix) and also in k
+    )
+
+
+def _span_window(s):
+    return s["start_ts"], s["start_ts"] + s["duration_s"]
+
+
+def _spans_by_name(doc, name):
+    return [s for s in doc["spans"] if s["name"] == name]
+
+
+# ------------------------------------------------------------- id grammar
+
+
+def test_traceparent_parse_format_roundtrip():
+    tid, sid = "ab" * 16, "cd" * 8
+    ctx = tracing.parse_traceparent(f"00-{tid}-{sid}-01")
+    assert ctx.trace_id == tid and ctx.span_id == sid and ctx.sampled
+    assert tracing.parse_traceparent(f"00-{tid}-{sid}-00").sampled is False
+    # case-insensitive per W3C; stored lowercase
+    assert tracing.parse_traceparent(f"00-{tid.upper()}-{sid}-01").trace_id == tid
+    assert tracing.format_traceparent(tid, sid) == f"00-{tid}-{sid}-01"
+
+
+@pytest.mark.parametrize("bad", [
+    None,
+    b"00-" + b"ab" * 16,
+    "",
+    "garbage",
+    "00-" + "ab" * 16,                          # missing span/flags
+    "00-" + "ab" * 15 + "-" + "cd" * 8 + "-01",  # short trace id
+    "00-" + "ab" * 16 + "-" + "cd" * 7 + "-01",  # short span id
+    "00-" + "zz" * 16 + "-" + "cd" * 8 + "-01",  # non-hex
+    "ff-" + "ab" * 16 + "-" + "cd" * 8 + "-01",  # forbidden version
+    "00-" + "0" * 32 + "-" + "cd" * 8 + "-01",   # all-zero trace id
+    "00-" + "ab" * 16 + "-" + "0" * 16 + "-01",  # all-zero span id
+])
+def test_traceparent_malformed_returns_none(bad):
+    assert tracing.parse_traceparent(bad) is None
+
+
+# ------------------------------------------------------- tail sampling
+
+
+def test_tail_sampling_flags_always_keep():
+    config.set("tracing.sample_rate", 0.0)
+    for kind, flag in tracing._FLAG_EVENTS.items():
+        rt = tracing.start_trace("t")
+        rt.add_event(kind)
+        rt.finish()
+        doc = tracing.get_trace(rt.trace_id)
+        assert doc is not None and doc["keep_reason"] == flag, kind
+    # non-ok finish flags error even without an explicit event
+    rt = tracing.start_trace("t")
+    rt.finish(status="OSError")
+    assert tracing.get_trace(rt.trace_id)["keep_reason"] == "error"
+    # unflagged at rate 0: dropped
+    rt = tracing.start_trace("t")
+    rt.finish()
+    assert tracing.get_trace(rt.trace_id) is None
+    assert _ctr("tracing.traces_dropped") >= 1
+
+
+def test_hash_sampling_is_deterministic_per_trace_id():
+    low = tracing.TraceContext("0" * 7 + "1" + "a" * 24, "cd" * 8)
+    high = tracing.TraceContext("f" * 32, "cd" * 8)
+    config.set("tracing.sample_rate", 0.5)
+    for _ in range(3):  # same id -> same verdict, every time
+        assert tracing.would_keep(tracing.RequestTrace("t", ctx=low))
+        assert not tracing.would_keep(tracing.RequestTrace("t", ctx=high))
+    rt = tracing.start_trace("t", ctx=high)
+    rt.finish()
+    assert tracing.get_trace(rt.trace_id) is None
+
+
+def test_slow_arm_keeps_rolling_tail():
+    config.set("tracing.sample_rate", 0.0)
+    config.set("tracing.slow_frac", 0.05)
+    for _ in range(20):  # build the duration window with fast traces
+        tracing.start_trace("t").finish()
+    rt = tracing.start_trace("t")
+    time.sleep(0.05)
+    rt.finish()
+    doc = tracing.get_trace(rt.trace_id)
+    assert doc is not None and doc["keep_reason"] == "slow"
+
+
+def test_ring_is_bounded_oldest_evicts():
+    config.set("tracing.ring_traces", 4)
+    ids = []
+    for _ in range(7):
+        rt = tracing.start_trace("t")
+        rt.flag("keepme")
+        rt.finish()
+        ids.append(rt.trace_id)
+    idx = [d["trace_id"] for d in tracing.trace_index()]
+    assert idx == ids[-4:]
+    assert tracing.get_trace(ids[0]) is None
+
+
+def test_finish_is_idempotent_and_post_finish_appends_drop():
+    rt = tracing.start_trace("t")
+    rt.finish()
+    rt.finish(status="OSError")  # loser: first finish won
+    assert rt.status == "ok"
+    assert rt.add_span("late", 0.0, 1.0) is None
+    doc = tracing.get_trace(rt.trace_id)
+    assert [s["name"] for s in doc["spans"]] == ["t"]  # synthesized root only
+
+
+# ------------------------------------------------------- exemplars
+
+
+def test_histogram_exemplar_slots_and_prometheus_render():
+    reg = MetricsRegistry()
+    h = reg.histogram("serving.total_s", buckets=(0.1, 1.0))
+    h.observe(0.05, exemplar="aa" * 16, model="m")
+    h.observe(0.06, exemplar="bb" * 16, model="m")  # last write wins
+    h.observe(5.0, model="m")                        # no exemplar: slot empty
+    st = h.state(model="m")
+    ex = st["exemplars"]
+    assert ex[0]["trace_id"] == "bb" * 16 and ex[0]["value"] == 0.06
+    assert ex[-1] is None
+    text = render_prometheus(reg.snapshot())
+    assert '# {trace_id="' + "bb" * 16 + '"} 0.06' in text
+
+    # merge: latest-ts exemplar wins per slot
+    other = MetricsRegistry()
+    oh = other.histogram("serving.total_s", buckets=(0.1, 1.0))
+    oh.observe(0.07, exemplar="cc" * 16, model="m")
+    reg.merge_snapshot(other.snapshot())
+    assert reg.histogram("serving.total_s", buckets=(0.1, 1.0)).state(
+        model="m")["exemplars"][0]["trace_id"] == "cc" * 16
+
+
+# ------------------------------------- end-to-end single-dispatcher trace
+
+
+def test_single_request_trace_topology_and_kernel_join(km):
+    config.set("serving.bucket_min_rows", 4)
+    registry = ModelRegistry()
+    try:
+        registry.register("km", km, prewarm=True)
+        rt = tracing.start_trace("serving.request", model="km")
+        fut = registry.submit("km", X_BLOBS[:8], trace=rt)
+        fut.result(timeout=20.0)
+        rt.finish()
+        doc = tracing.get_trace(rt.trace_id)
+        assert doc is not None and doc["status"] == "ok"
+
+        (root,) = [s for s in doc["spans"] if s["parent_span_id"] is None]
+        assert root["span_id"] == rt.root_span_id
+        (queue,) = _spans_by_name(doc, "serving.queue")
+        (batch,) = _spans_by_name(doc, "serving.batch")
+        (execute,) = _spans_by_name(doc, "serving.execute")
+        (scatter,) = _spans_by_name(doc, "serving.scatter")
+
+        # parentage: queue/batch/scatter under root, execute under batch
+        for s in (queue, batch, scatter):
+            assert s["parent_span_id"] == root["span_id"]
+        assert execute["parent_span_id"] == batch["span_id"]
+
+        # monotonic, non-overlapping stage timing inside the root window
+        r0, r1 = _span_window(root)
+        q0, q1 = _span_window(queue)
+        b0, b1 = _span_window(batch)
+        e0, e1 = _span_window(execute)
+        s0, s1 = _span_window(scatter)
+        eps = 5e-3
+        assert r0 - eps <= q0 and s1 <= r1 + eps
+        assert q1 <= b0 + eps and b1 <= s0 + eps  # siblings don't overlap
+        assert b0 - eps <= e0 and e1 <= b1 + eps  # child inside parent
+
+        # fan-in: the batch span links to this request's root
+        assert {"trace_id": rt.trace_id, "span_id": rt.root_span_id} \
+            in batch["links"]
+        attrs = batch["attrs"]
+        assert attrs["rows"] == 8 and attrs["bucket"] >= 8
+        assert attrs["occupancy"] == pytest.approx(
+            attrs["rows"] / attrs["bucket"])
+
+        # §6f join: warm path compiled nothing; kernel signatures ride along
+        ex_attrs = execute["attrs"]
+        assert ex_attrs["compiled"] == 0
+        assert ex_attrs.get("kernels"), "execute span lost its kernel names"
+        assert ex_attrs.get("signatures"), "kernel signature join missing"
+
+        # the generation that answered is a causal event
+        gens = [e for e in doc["events"] if e["kind"] == "model_generation"]
+        assert gens and gens[0]["generation"] == 0
+
+        # the serving latency histogram carries this trace as an exemplar
+        from spark_rapids_ml_tpu.observability.runs import global_registry
+
+        hists = global_registry().snapshot()["histograms"]
+        exes = [e for key, st in hists.items()
+                if key.startswith("serving.total_s")
+                for e in (st.get("exemplars") or []) if e]
+        assert rt.trace_id in {e["trace_id"] for e in exes}
+    finally:
+        registry.close()
+
+
+def test_registry_owned_trace_finishes_with_future(km):
+    config.set("serving.bucket_min_rows", 4)
+    registry = ModelRegistry()
+    try:
+        registry.register("km", km, prewarm=False)
+        before = {d["trace_id"] for d in tracing.trace_index()}
+        registry.predict("km", X_BLOBS[:4], timeout=20.0)
+        new = [d for d in tracing.trace_index()
+               if d["trace_id"] not in before]
+        assert len(new) == 1 and new[0]["status"] == "ok"
+        assert new[0]["name"] == "serving.request"
+    finally:
+        registry.close()
+
+
+# --------------------------------------------- chaos joins (deterministic)
+
+
+def _fleet_config(hb=0.2):
+    config.set("serving.heartbeat_timeout_s", hb)
+    config.set("serving.max_wait_ms", 1.0)
+    config.set("serving.max_batch_rows", 64)
+    config.set("serving.bucket_min_rows", 4)
+    config.set("serving.queue_depth", 16)
+
+
+def _topology(doc):
+    """Comparable trace shape: span (name, status) multiset + event kinds +
+    flags — what 'same spec => same topology' means."""
+    return (
+        sorted((s["name"], s["status"]) for s in doc["spans"]),
+        sorted(e["kind"] for e in doc["events"]),
+        list(doc["flags"]),
+    )
+
+
+def _run_kill_scenario():
+    """2-replica stub fleet; replica 0's first execute dies. Sequential
+    submits make routing deterministic: the killed request replays onto
+    replica 1 and must succeed."""
+    _fleet_config(hb=5.0)  # long heartbeat: only the injected kill fires
+    calls = {0: 0, 1: 0}
+
+    def execute(stage, n_valid, idx):
+        calls[idx] += 1
+        if idx == 0 and calls[0] == 1:
+            raise ReplicaKilled("serving_execute", 0)
+        return {"y": stage[:, 0].copy() + idx}
+
+    def spawn(i):
+        return ReplicaHandle(
+            execute=lambda stage, n_valid, _i=i: execute(stage, n_valid, _i),
+            warm=set(),
+        )
+
+    fleet = ReplicaFleet("stub", 3, 2, spawn=spawn, retire=lambda i: None)
+    docs = []
+    try:
+        for i in range(4):
+            rt = tracing.start_trace("serving.request", model="stub")
+            fut = fleet.submit(X_BLOBS[: 4 + i, :3].copy(), trace=rt)
+            fut.result(timeout=20.0)
+            rt.finish()
+            docs.append(tracing.get_trace(rt.trace_id))
+    finally:
+        fleet.close()
+    return docs
+
+
+def test_chaos_kill_trace_shows_attempt_and_replay_same_spec_same_topology():
+    first = _run_kill_scenario()
+    tracing.reset_tracing()
+    second = _run_kill_scenario()
+
+    for docs in (first, second):
+        assert all(d is not None and d["status"] == "ok" for d in docs)
+        replayed = [d for d in docs
+                    if any(e["kind"] == "failover_replay"
+                           for e in d["events"])]
+        assert len(replayed) == 1, [d["events"] for d in docs]
+        doc = replayed[0]
+        assert "failover" in doc["flags"]
+        # the dead attempt's error event also flags; either arm keeps it
+        assert doc["keep_reason"] in ("error", "failover")
+        (ev,) = [e for e in doc["events"] if e["kind"] == "failover_replay"]
+        # the dead replica's attempt is named on the replay link...
+        assert ev["replica"] == 0 and ev["error"] == "ReplicaKilled"
+        assert ev["attempt"] == 1
+        # ...and the surviving replica's serve is visible: the trace holds
+        # BOTH attempts' shared batch spans (dead + survivor)
+        batches = _spans_by_name(doc, "serving.batch")
+        assert len(batches) == 2
+        assert {s["status"] for s in batches} == {"error", "ok"}
+        for b in batches:
+            assert {"trace_id": doc["trace_id"],
+                    "span_id": doc["spans"][0]["span_id"]} in b["links"]
+
+    # deterministic: the same spec produced the same per-request topology
+    assert [_topology(d) for d in first] == [_topology(d) for d in second]
+
+
+def test_hedge_trace_carries_issue_and_win_links():
+    _fleet_config(hb=5.0)
+    config.set("serving.hedge_after_p99_frac", 0.5)
+    stall = threading.Event()
+
+    def execute(stage, n_valid, idx):
+        if idx == 0:
+            stall.wait(10.0)  # primary wedges; the hedge must win
+        return {"y": stage[:, 0].copy() + idx}
+
+    def spawn(i):
+        return ReplicaHandle(
+            execute=lambda stage, n_valid, _i=i: execute(stage, n_valid, _i),
+            warm=set(),
+        )
+
+    fleet = ReplicaFleet("stub", 3, 2, spawn=spawn, retire=lambda i: None)
+    try:
+        fleet._latencies.extend([0.01] * 30)  # prime the hedge p99
+        rt = tracing.start_trace("serving.request", model="stub")
+        fut = fleet.submit(X_BLOBS[:4, :3].copy(), trace=rt)
+        out = fut.result(timeout=20.0)
+        rt.finish()
+        assert np.allclose(out["y"], X_BLOBS[:4, 0] + 1)  # replica 1 won
+        doc = tracing.get_trace(rt.trace_id)
+        kinds = [e["kind"] for e in doc["events"]]
+        assert "hedge_issued" in kinds and "hedge_won" in kinds
+        (issued,) = [e for e in doc["events"] if e["kind"] == "hedge_issued"]
+        (won,) = [e for e in doc["events"] if e["kind"] == "hedge_won"]
+        assert issued["replica"] == 1 and won["replica"] == 1
+        assert issued["waited_s"] >= 0.0
+        assert doc["keep_reason"] == "hedged"
+    finally:
+        stall.set()
+        fleet.close()
+
+
+# ------------------------------------------------------------ no bleed
+
+
+def test_no_cross_request_span_bleed_8_threads_mixed_sizes(km):
+    config.set("serving.bucket_min_rows", 4)
+    registry = ModelRegistry()
+    try:
+        registry.register("km", km, prewarm=True)
+        results = {}
+        lock = threading.Lock()
+
+        def client(tid):
+            sizes = [3 + (tid + j) % 7 for j in range(4)]
+            for j, n in enumerate(sizes):
+                rt = tracing.start_trace("serving.request", model="km")
+                fut = registry.submit("km", X_BLOBS[:n], trace=rt)
+                fut.result(timeout=20.0)
+                rt.finish()
+                with lock:
+                    results[(tid, j)] = (n, rt.trace_id, rt.root_span_id)
+
+        threads = [threading.Thread(target=client, args=(t,))
+                   for t in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30.0)
+        assert len(results) == 32
+        ids = [tid for _, tid, _ in results.values()]
+        assert len(set(ids)) == 32  # disjoint traces
+
+        for (n, trace_id, root_sid) in results.values():
+            doc = tracing.get_trace(trace_id)
+            assert doc is not None, "trace lost under concurrency"
+            # exactly one of each per-request stage — no duplicated or
+            # foreign spans bled in from a sibling request
+            (queue,) = _spans_by_name(doc, "serving.queue")
+            (scatter,) = _spans_by_name(doc, "serving.scatter")
+            (batch,) = _spans_by_name(doc, "serving.batch")
+            assert scatter["attrs"]["rows"] == n
+            assert batch["attrs"]["rows"] >= n
+            # this trace's root is among its own batch's fan-in links
+            assert {"trace_id": trace_id, "span_id": root_sid} \
+                in batch["links"]
+            # every fan-in link points at a real concurrent request
+            for link in batch["links"]:
+                assert link["trace_id"] in set(ids)
+    finally:
+        registry.close()
+
+
+# ------------------------------------------------------------------ HTTP
+
+
+def _post(url, doc, headers=None):
+    req = urllib.request.Request(
+        url, data=json.dumps(doc).encode(), method="POST",
+        headers={"Content-Type": "application/json", **(headers or {})},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=20) as resp:
+            return resp.status, json.loads(resp.read()), dict(resp.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read()), dict(e.headers)
+
+
+def _get(url):
+    try:
+        with urllib.request.urlopen(url, timeout=20) as resp:
+            return resp.status, json.loads(resp.read()), dict(resp.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read()), dict(e.headers)
+
+
+def test_http_traceparent_echo_generation_header_and_traces_endpoint(km):
+    config.set("serving.bucket_min_rows", 4)
+    host, port = serving.start_serving(port=0)
+    serving.register_model("km", km, prewarm=True)
+    base = f"http://{host}:{port}"
+
+    client_tid = "ab" * 16
+    tp = f"00-{client_tid}-{'cd' * 8}-01"
+    status, body, headers = _post(
+        f"{base}/v1/models/km:predict",
+        {"instances": X_BLOBS[:5].tolist()},
+        headers={"traceparent": tp},
+    )
+    assert status == 200
+    # same trace id echoed, server's own root span id in the parent slot
+    assert headers["traceparent"].startswith(f"00-{client_tid}-")
+    assert headers["traceparent"] != tp
+    assert headers["x-srml-generation"] == "0"
+    assert body["trace_id"] == client_tid
+
+    # /traces/<id> reconstructs the request, client span id preserved
+    status, doc, _ = _get(f"{base}/traces/{client_tid}")
+    assert status == 200 and doc["trace_id"] == client_tid
+    assert doc["client_span_id"] == "cd" * 8
+    names = {s["name"] for s in doc["spans"]}
+    assert {"http.request", "serving.queue", "serving.batch",
+            "serving.execute", "serving.scatter"} <= names
+    status, idx, _ = _get(f"{base}/traces")
+    assert status == 200
+    assert client_tid in {t["trace_id"] for t in idx["traces"]}
+
+    # unknown trace: 404, never 500
+    status, _, _ = _get(f"{base}/traces/{'9' * 32}")
+    assert status == 404
+
+    # a /metrics exemplar resolves to a stored trace
+    with urllib.request.urlopen(f"{base}/metrics", timeout=20) as resp:
+        text = resp.read().decode()
+    ex_ids = set()
+    for line in text.splitlines():
+        if "serving_total_s_bucket" in line and "# {trace_id=" in line:
+            ex_ids.add(line.split('trace_id="')[1].split('"')[0])
+    assert ex_ids, "no exemplar rendered in /metrics"
+    # this request's trace is an exemplar, and it resolves to a stored trace
+    # (exemplars from earlier (reset) tests may linger in the global registry
+    # — only the live ring answers /traces/<id>)
+    assert client_tid in ex_ids
+    ok, _, _ = _get(f"{base}/traces/{client_tid}")
+    assert ok == 200
+
+    # malformed traceparent: counted + replaced, request still served
+    bad0 = _ctr("tracing.bad_traceparent")
+    status, body, headers = _post(
+        f"{base}/v1/models/km:predict",
+        {"instances": X_BLOBS[:3].tolist()},
+        headers={"traceparent": "not-a-traceparent"},
+    )
+    assert status == 200
+    assert _ctr("tracing.bad_traceparent") == bad0 + 1
+    assert tracing.parse_traceparent(headers["traceparent"]) is not None
+    assert headers["traceparent"].split("-")[1] != client_tid
+
+    # EVERY response carries the headers — 4xx/5xx included
+    status, _, headers = _get(f"{base}/v1/models/missing")
+    assert status == 404
+    assert tracing.parse_traceparent(headers["traceparent"]) is not None
+    assert "x-srml-generation" not in headers  # unknown model: no ordinal
+    status, _, headers = _post(f"{base}/v1/models/km:predict", {"bogus": 1})
+    assert status == 400
+    assert tracing.parse_traceparent(headers["traceparent"]) is not None
+    assert headers["x-srml-generation"] == "0"
+
+
+def test_http_serves_with_tracing_disabled(km):
+    config.set("tracing.enabled", False)
+    config.set("serving.bucket_min_rows", 4)
+    host, port = serving.start_serving(port=0)
+    serving.register_model("km", km, prewarm=False)
+    status, body, headers = _post(
+        f"http://{host}:{port}/v1/models/km:predict",
+        {"instances": X_BLOBS[:4].tolist()},
+    )
+    assert status == 200 and "trace_id" not in body
+    # a minted traceparent still echoes (replacement id, no stored trace)
+    assert tracing.parse_traceparent(headers["traceparent"]) is not None
+    assert tracing.trace_index() == []
+
+
+# --------------------------------------------------- export / postmortem
+
+
+def test_trace_reports_jsonl_roundtrip(km, tmp_path):
+    config.set("observability.metrics_dir", str(tmp_path))
+    config.set("serving.bucket_min_rows", 4)
+    registry = ModelRegistry()
+    try:
+        registry.register("km", km, prewarm=False)
+        ids = []
+        for n in (3, 5, 7):
+            rt = tracing.start_trace("serving.request", model="km")
+            registry.submit("km", X_BLOBS[:n], trace=rt).result(timeout=20.0)
+            rt.finish()
+            ids.append(rt.trace_id)
+    finally:
+        registry.close()
+    docs = load_trace_reports(str(tmp_path))
+    by_id = {d["trace_id"]: d for d in docs}
+    assert set(ids) <= set(by_id)
+    for tid in ids:
+        doc = by_id[tid]
+        assert doc["kind"] == "trace" and doc["status"] == "ok"
+        assert {s["name"] for s in doc["spans"]} >= {"serving.queue",
+                                                     "serving.batch"}
+
+
+def test_flight_postmortem_embeds_trace_ring(tmp_path):
+    from spark_rapids_ml_tpu.observability import (
+        dump_postmortem,
+        load_postmortem,
+    )
+
+    config.set("observability.metrics_dir", str(tmp_path))
+    rt = tracing.start_trace("t")
+    rt.add_event("error")
+    rt.finish(status="OSError")
+    path = dump_postmortem(None, reason="test")
+    assert path is not None
+    bundle = load_postmortem(path)
+    assert rt.trace_id in {t["trace_id"] for t in bundle["traces"]}
+
+
+# ------------------------------------------------- continual-loop traces
+
+
+def test_continual_feed_cycle_mints_trace_with_promotion_event():
+    from spark_rapids_ml_tpu.continual import ContinualLoop, DriftDetector
+    from spark_rapids_ml_tpu.models.clustering import KMeansModel
+
+    config.set("continual.update_batch_rows", 64)
+    centers = np.array([[0.0, 0.0], [5.0, 5.0]], np.float32)
+    m = KMeansModel(cluster_centers=centers, inertia=1.0, n_iter=3,
+                    cluster_sizes=np.array([50, 50]))
+    u = m.partial_fit_updater(name="km")
+    r = np.random.default_rng(3)
+    holdout = (centers[r.integers(0, 2, 128)]
+               + r.normal(0, 0.3, (128, 2))).astype(np.float32)
+    loop = ContinualLoop(
+        "km", u, (holdout,), served=False, promote_every=2,
+        detector=DriftDetector(model="km", signal="inertia", min_baseline=2),
+    )
+    batch = (centers[r.integers(0, 2, 96)]
+             + r.normal(0, 0.3, (96, 2))).astype(np.float32)
+    out1 = loop.feed(batch)
+    assert tracing.get_trace(out1["trace_id"]) is not None
+    out2 = loop.feed(batch)  # promote_every=2: promotion attempt here
+    assert out2["promotion"] is not None
+    doc = tracing.get_trace(out2["trace_id"])
+    names = [s["name"] for s in doc["spans"]]
+    assert "continual.update" in names and "continual.promote" in names
+    if out2["promotion"].get("promoted"):
+        assert doc["keep_reason"] == "promotion"
+        assert any(e["kind"] == "model_generation" for e in doc["events"])
+    config.unset("continual.update_batch_rows")
+
+
+# ------------------------------------------- run / worker-scope context
+
+
+def test_fit_run_and_worker_scope_carry_traceparent():
+    from spark_rapids_ml_tpu.observability import fit_run, worker_scope
+
+    with fit_run("kmeans") as run:
+        assert tracing.parse_traceparent(run.traceparent) is not None
+        tp = run.traceparent
+    assert run.report()["traceparent"] == tp
+    with worker_scope(rank=2, run_id=run.run_id, traceparent=tp) as w:
+        pass
+    assert w.snapshot()["traceparent"] == tp
+
+
+def test_sample_rate_resolution_order(tmp_path, monkeypatch):
+    # default: the defaults-module constant
+    assert tracing.sample_rate() == 1.0
+    # config pin wins over everything
+    config.set("tracing.sample_rate", 0.25)
+    assert tracing.sample_rate() == 0.25
+    config.unset("tracing.sample_rate")
+    monkeypatch.setenv("SRML_TPU_TRACING_SAMPLE_RATE", "0.5")
+    assert tracing.sample_rate() == 0.5

@@ -74,7 +74,7 @@ _EMIT_FUNCS: Dict[str, Set[str]] = {
     "gauge_set": {"value"},
     "gauge_inc": {"n"},
     "gauge_dec": {"n"},
-    "observe": {"buckets", "value"},
+    "observe": {"buckets", "value", "exemplar"},
     "add_span_total": set(),
     "legacy_count": set(),
     "count": set(),
