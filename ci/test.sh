@@ -814,7 +814,33 @@ print(f"TRACING SMOKE OK: 12/12 requests each one complete trace in the "
       "replay link, /metrics exemplar resolved live")
 PY
   rm -rf "$SRML_TRACING_SMOKE_DIR"
-  python -m pytest tests/ -q --ignore=tests/test_reliability.py --ignore=tests/test_device_cache.py --ignore=tests/test_observability.py --ignore=tests/test_transform_observability.py --ignore=tests/test_telemetry_plane.py --ignore=tests/test_comm_plane.py --ignore=tests/test_serving.py --ignore=tests/test_ann_lifecycle.py --ignore=tests/test_continual.py --ignore=tests/test_tracing.py
+  # multihost smoke tier (docs/design.md §10): partitioner units first, then
+  # 2 REAL OS processes x 4 CPU devices rendezvous over a local
+  # jax.distributed coordinator (SRML_TPU_COORDINATOR env bootstrap). Ragged
+  # per-process staging through Partitioner.stage_inputs must be bit-exact
+  # (each process holds exactly its own padded rows of the global array), the
+  # fit must agree with the single-process moments (bit-identical where the
+  # backend runs cross-process programs; via the deterministic partial-moment
+  # combine on CPU jaxlibs without multiprocess collectives), and the
+  # compiled fit programs must stay allreduce-shaped: collective bytes
+  # proportional to model state, invariant to data size, skew-free per rank.
+  python -m pytest tests/test_partitioner.py -q
+  python - <<'PY'
+from benchmark.chip_bench import dryrun_partitioner_multiproc
+
+rep = dryrun_partitioner_multiproc(n_proc=2, devices_per_proc=4)
+assert rep["processes"] == 2 and rep["stage_bitexact"], rep
+assert rep["parity_ok"], rep
+assert rep["allreduce_shaped"] and rep["collective_byte_skew"] == 1.0, rep
+assert not rep["stragglers"], rep
+print("MULTIHOST SMOKE OK: 2 procs x 4 devices, ragged staging bit-exact, "
+      "fit parity %s, collective bytes data-size-invariant (%s)"
+      % ("bit-identical" if rep["cross_process_compute"] else
+         "via partial-moment combine (no CPU multiprocess collectives)",
+         {k: v["bytes_by_rows"] for k, v in
+          rep["collectives"]["programs"].items()}))
+PY
+  python -m pytest tests/ -q --ignore=tests/test_reliability.py --ignore=tests/test_device_cache.py --ignore=tests/test_observability.py --ignore=tests/test_transform_observability.py --ignore=tests/test_telemetry_plane.py --ignore=tests/test_comm_plane.py --ignore=tests/test_serving.py --ignore=tests/test_ann_lifecycle.py --ignore=tests/test_continual.py --ignore=tests/test_tracing.py --ignore=tests/test_partitioner.py
 fi
 
 # small benchmark smoke (reference runs a small bench pre-merge)

@@ -199,6 +199,23 @@ KNOBS: Dict[str, Knob] = {
             grid=(10, 20, 30, 50),
         ),
         Knob(
+            "partition.feature_axis", "int",
+            "feature-axis width of the 2-D SPMD partitioner mesh (wide-k "
+            "kNN / feature-sharded covariance layouts; "
+            "parallel/partitioner.py::resolve_feature_axis)",
+            config_key="partition.feature_axis", auto_values=(0,),
+            dims=("n", "d"),
+            grid=(1, 2, 4),
+        ),
+        Knob(
+            "partition.batch_rows_per_process", "int",
+            "LOCAL rows each process stages per streamed batch on multi-host "
+            "runs (parallel/partitioner.py::resolve_batch_rows_per_process)",
+            config_key="partition.batch_rows_per_process", auto_values=(0,),
+            dims=("n", "d"),
+            grid=(1 << 14, 1 << 15, 1 << 16, 1 << 17, 1 << 18),
+        ),
+        Knob(
             "tracing.sample_rate", "float",
             "fraction of unflagged (non-error/hedged/failed-over/expired, "
             "non-slow) request traces the tail sampler retains "

@@ -242,6 +242,16 @@ _DEFAULTS: Dict[str, Any] = {
     # and the staged trace is simpler to debug); 0 = auto (tuning table, else
     # autotune/defaults.py)
     "pipeline.fuse_min_rows": 0,
+    # partitioner plane (parallel/partitioner.py, docs/design.md §10): the
+    # single owner of mesh + shardings. feature_axis: width of the 2-D
+    # SPMDPartitioner's feature axis (wide-k kNN / feature-sharded
+    # covariance); 0 = auto (tuning table per (n, d) bucket, else 1 = pure
+    # data-parallel). batch_rows_per_process: LOCAL rows each process stages
+    # per streamed batch on multi-host runs; 0 = auto (tuning table, else
+    # stream_batch_rows split evenly across the pod). Both resolve at host
+    # resolution points only — never inside a trace
+    "partition.feature_axis": 0,
+    "partition.batch_rows_per_process": 0,
     # continuous-learning plane (spark_rapids_ml_tpu/continual/, docs/
     # design.md §7d): streamed partial_fit + drift detection + governed
     # promotion. decay: per-update discount on the persistent sufficient-
@@ -363,6 +373,8 @@ _ENV_KEYS: Dict[str, str] = {
     "ingest.staging_pool_rows": "SRML_TPU_INGEST_STAGING_POOL_ROWS",
     "pipeline.fuse": "SRML_TPU_PIPELINE_FUSE",
     "pipeline.fuse_min_rows": "SRML_TPU_PIPELINE_FUSE_MIN_ROWS",
+    "partition.feature_axis": "SRML_TPU_PARTITION_FEATURE_AXIS",
+    "partition.batch_rows_per_process": "SRML_TPU_PARTITION_BATCH_ROWS_PER_PROCESS",
     "continual.decay": "SRML_TPU_CONTINUAL_DECAY",
     "continual.update_batch_rows": "SRML_TPU_CONTINUAL_UPDATE_BATCH_ROWS",
     "continual.drift_mads": "SRML_TPU_CONTINUAL_DRIFT_MADS",

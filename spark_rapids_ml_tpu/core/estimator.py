@@ -25,8 +25,8 @@ from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Tupl
 
 import numpy as np
 
-from ..parallel.mesh import get_mesh, shard_array
 from ..parallel.partition import PartitionDescriptor, pad_rows
+from ..parallel.partitioner import active_partitioner
 from ..utils import get_logger
 from .backend_params import _TpuClass, _TpuParams
 from .dataset import (  # re-exported surface
@@ -170,7 +170,8 @@ class _TpuCaller(_TpuClass, _TpuParams):
         from ..ops.sparse import csr_to_ell, pad_ell_rows
 
         num_workers = self.num_workers
-        mesh = get_mesh(num_workers)
+        part = active_partitioner(num_workers)
+        mesh = part.mesh
         values, indices = csr_to_ell(fd.features, float32=self._float32_inputs)
         values, indices, pad_weight, (label_p, sw_p) = pad_ell_rows(
             values, indices, num_workers, fd.label, fd.weight
@@ -185,10 +186,10 @@ class _TpuCaller(_TpuClass, _TpuParams):
         )
         return FitInputs(
             features=None,
-            sparse_values=shard_array(values, mesh),
-            sparse_indices=shard_array(indices, mesh),
-            row_weight=shard_array(row_weight, mesh),
-            label=shard_array(label_p, mesh) if label_p is not None else None,
+            sparse_values=part.shard(values),
+            sparse_indices=part.shard(indices),
+            row_weight=part.shard(row_weight),
+            label=part.shard(label_p) if label_p is not None else None,
             desc=desc,
             mesh=mesh,
             params=dict(self._tpu_params),
@@ -203,7 +204,8 @@ class _TpuCaller(_TpuClass, _TpuParams):
         if self._sparse_fit_wanted(fd):
             return self._build_sparse_fit_inputs(fd)
         num_workers = self.num_workers
-        mesh = get_mesh(num_workers)
+        part = active_partitioner(num_workers)
+        mesh = part.mesh
 
         # the Arrow fast path may defer dtype conversion (core/dataset.py); the
         # staged in-core plane materializes the whole matrix anyway, so the
@@ -230,9 +232,9 @@ class _TpuCaller(_TpuClass, _TpuParams):
         )
 
         return FitInputs(
-            features=shard_array(Xp, mesh),
-            row_weight=shard_array(row_weight, mesh),
-            label=shard_array(label_p, mesh) if label_p is not None else None,
+            features=part.shard(Xp),
+            row_weight=part.shard(row_weight),
+            label=part.shard(label_p) if label_p is not None else None,
             desc=desc,
             mesh=mesh,
             params=dict(self._tpu_params),

@@ -406,7 +406,7 @@ class LogisticRegression(
         from .. import config as _config
         from ..core.dataset import _is_sparse, densify as _densify
         from ..ops.streaming import streaming_logreg_fit
-        from ..parallel.mesh import get_mesh
+        from ..parallel.partitioner import active_partitioner
 
         p = self._tpu_params
         bounds_set = any(
@@ -452,7 +452,7 @@ class LogisticRegression(
             tol=float(p["tol"]),
             multinomial=multinomial,
             batch_rows=int(_config.get("stream_batch_rows")),
-            mesh=get_mesh(self.num_workers),
+            mesh=active_partitioner(self.num_workers).mesh,
             float32=self._float32_inputs,
             chain_ops=chain_ops,
         )

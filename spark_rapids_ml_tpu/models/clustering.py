@@ -244,7 +244,7 @@ class KMeans(_KMeansClass, _TpuEstimator, _KMeansParams):
         from .. import config as _config
         from ..core.dataset import densify as _densify
         from ..ops.streaming import streaming_kmeans_fit
-        from ..parallel.mesh import get_mesh
+        from ..parallel.partitioner import active_partitioner
 
         p = self._tpu_params
         if int(p["n_clusters"]) > fd.n_rows:
@@ -259,7 +259,7 @@ class KMeans(_KMeansClass, _TpuEstimator, _KMeansParams):
             tol=float(p["tol"]),
             seed=int(p["random_state"]) if p["random_state"] is not None else 1,
             batch_rows=int(_config.get("stream_batch_rows")),
-            mesh=get_mesh(self.num_workers),
+            mesh=active_partitioner(self.num_workers).mesh,
             metric=str(p.get("metric", "euclidean")),
             float32=self._float32_inputs,
             chain_ops=chain_ops,

@@ -23,7 +23,7 @@ from ..core.params import (
     Param,
     TypeConverters,
 )
-from ..parallel.mesh import get_mesh, shard_array
+from ..parallel.partitioner import active_partitioner
 from ..parallel.partition import pad_rows
 from ..ops.dbscan import dbscan_fit_predict
 
@@ -199,15 +199,16 @@ class DBSCANModel(_DBSCANClass, _TpuModel, _DBSCANParams):
                 eps=self.getOrDefault("eps"),
                 min_samples=self.getOrDefault("min_samples"),
                 metric=self.getOrDefault("metric"),
-                mesh=get_mesh(self.num_workers),
+                mesh=active_partitioner(self.num_workers).mesh,
             )
             return {self.getOrDefault("predictionCol"): labels}
         from ..observability.inference import predict_dispatch
 
-        mesh = get_mesh(self.num_workers)
-        Xp, valid, _ = pad_rows(X, mesh.devices.size)
-        Xd = shard_array(Xp, mesh)
-        vd = shard_array(valid > 0, mesh)
+        part = active_partitioner(self.num_workers)
+        mesh = part.mesh
+        Xp, valid, _ = pad_rows(X, part.num_workers)
+        Xd = part.shard(Xp)
+        vd = part.shard(valid > 0)
         labels = predict_dispatch(
             self,
             dbscan_fit_predict,

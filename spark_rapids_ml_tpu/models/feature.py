@@ -142,13 +142,13 @@ class PCA(_PCAClass, _TpuEstimator, _PCAParams):
         from .. import config as _config
         from ..ops.pca import pca_attrs_from_cov
         from ..ops.streaming import chain_out_dim, streaming_covariance
-        from ..parallel.mesh import get_mesh
+        from ..parallel.partitioner import active_partitioner
 
         k = self.getOrDefault("k")
         d_eff = chain_out_dim(fd.n_cols, chain_ops)
         if k > d_eff:
             raise ValueError(f"k={k} exceeds the number of features {d_eff}")
-        mesh = get_mesh(self.num_workers)
+        mesh = active_partitioner(self.num_workers).mesh
         cov, mean, wsum = streaming_covariance(
             densify(fd.features, self._float32_inputs),
             fd.weight,
@@ -389,14 +389,14 @@ class StandardScaler(_StandardScalerClass, _TpuEstimator, _StandardScalerParams)
         in-chain scaler fit calls too, so both arms produce identical stats."""
         from .. import config as _config
         from ..ops.streaming import streaming_moments
-        from ..parallel.mesh import get_mesh
+        from ..parallel.partitioner import active_partitioner
 
         dt = np.float32 if self._float32_inputs else np.float64
         mean, var, _ = streaming_moments(
             densify(fd.features, self._float32_inputs),
             fd.weight,
             batch_rows=int(_config.get("stream_batch_rows")),
-            mesh=get_mesh(self.num_workers),
+            mesh=active_partitioner(self.num_workers).mesh,
             float32=self._float32_inputs,
             chain_ops=chain_ops,
         )

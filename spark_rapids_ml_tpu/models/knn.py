@@ -23,7 +23,7 @@ from ..core.estimator import FitInputs, _TpuEstimator, _TpuModel
 from ..core.params import Param, TypeConverters
 from ..core.backend_params import DictTypeConverters, HasFeaturesCols
 from ..core.params import HasInputCol
-from ..parallel.mesh import get_mesh, shard_array
+from ..parallel.partitioner import active_partitioner
 from ..parallel.partition import pad_rows
 from ..ops.knn import (
     exact_knn_distributed,
@@ -451,7 +451,7 @@ class NearestNeighborsModel(_NearestNeighborsClass, _TpuModel, _NNParams):
 
             dists, gidx = predict_dispatch(
                 self, streaming_exact_knn,
-                Q, np.asarray(items), k, mesh=get_mesh(self.num_workers),
+                Q, np.asarray(items), k, mesh=active_partitioner(self.num_workers).mesh,
             )
             ids = np.where(gidx >= 0, item_ids[np.maximum(gidx, 0)], -1)
             knn_df = pd.DataFrame(
@@ -462,14 +462,15 @@ class NearestNeighborsModel(_NearestNeighborsClass, _TpuModel, _NNParams):
                 }
             )
             return self._item_df, query_df, knn_df
-        mesh = get_mesh(self.num_workers)
-        Xp, valid, _ = pad_rows(items, mesh.devices.size)
+        part = active_partitioner(self.num_workers)
+        mesh = part.mesh
+        Xp, valid, _ = pad_rows(items, part.num_workers)
         if item_valid is not None:
             # incremental tier: tombstoned/slack rows are invalid like padding
             valid = np.asarray(valid).copy()
             valid[: len(items)] *= np.asarray(item_valid, valid.dtype)
-        Xd = shard_array(Xp, mesh)
-        vd = shard_array(valid, mesh)
+        Xd = part.shard(Xp)
+        vd = part.shard(valid)
         # cached item norms (computed once at fit) shard alongside the items —
         # no query block recomputes Σ X² (padding rows are invalid-masked, so
         # their zero norm never participates); x2 is the LOCAL sliced above,
@@ -477,7 +478,7 @@ class NearestNeighborsModel(_NearestNeighborsClass, _TpuModel, _NNParams):
         if x2 is not None:
             x2p = np.zeros((Xp.shape[0],), np.float32)
             x2p[: len(items)] = np.asarray(x2)
-            x2d = shard_array(x2p, mesh)
+            x2d = part.shard(x2p)
         else:
             x2d = None
         if len(Q) >= _RING_QUERY_THRESHOLD and mesh.devices.size > 1:
@@ -487,8 +488,8 @@ class NearestNeighborsModel(_NearestNeighborsClass, _TpuModel, _NNParams):
 
             from ..observability.inference import predict_dispatch
 
-            Qp, qvalid, _ = pad_rows(Q, mesh.devices.size)
-            Qd = shard_array(Qp, mesh)
+            Qp, qvalid, _ = pad_rows(Q, part.num_workers)
+            Qd = part.shard(Qp)
             # the query block is not the leading arg here: shape_of pins the
             # recompile-sentinel signature to the PADDED query shard
             dists, gidx = predict_dispatch(

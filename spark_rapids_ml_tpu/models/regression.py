@@ -289,7 +289,7 @@ class LinearRegression(_LinearRegressionClass, _TpuEstimatorSupervised, _LinearR
         from ..core.dataset import densify as _densify
         from ..ops.linear import solve_from_stats
         from ..ops.streaming import streaming_linreg_stats
-        from ..parallel.mesh import get_mesh
+        from ..parallel.partitioner import active_partitioner
 
         p = self._tpu_params
         if p.get("loss", "squared_loss") == "huber":
@@ -308,7 +308,7 @@ class LinearRegression(_LinearRegressionClass, _TpuEstimatorSupervised, _LinearR
             )
             inputs = self._build_fit_inputs(fd)
             return self._get_tpu_fit_func(None)(inputs)
-        mesh = get_mesh(self.num_workers)
+        mesh = active_partitioner(self.num_workers).mesh
         A, b, xbar, ybar, sw = streaming_linreg_stats(
             _densify(fd.features, self._float32_inputs),
             fd.label,

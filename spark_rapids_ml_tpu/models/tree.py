@@ -246,8 +246,8 @@ class _RandomForestEstimator(_RandomForestClass, _TpuEstimatorSupervised, _Rando
         from .. import config as _config
         from ..core.dataset import densify as _densify
         from ..ops.trees import streaming_forest_fit
-        from ..parallel.mesh import get_mesh, shard_array
         from ..parallel.partition import pad_rows
+        from ..parallel.partitioner import active_partitioner
 
         p = self._tpu_params
         if int(p["n_bins"]) > 256:
@@ -261,12 +261,13 @@ class _RandomForestEstimator(_RandomForestClass, _TpuEstimatorSupervised, _Rando
         stats, n_classes = self._row_stats(
             SimpleNamespace(host_label=fd.label, host_row_weight=fd.weight)
         )
-        mesh = get_mesh(self.num_workers)
-        n_dev = mesh.devices.size
+        part = active_partitioner(self.num_workers)
+        mesh = part.mesh
+        n_dev = part.num_workers
 
         def shard_fn(arr: np.ndarray):
             padded, _, _ = pad_rows(arr, n_dev)
-            return shard_array(padded, mesh)
+            return part.shard(padded)
 
         attrs = streaming_forest_fit(
             np.asarray(X),
@@ -298,15 +299,16 @@ class _RandomForestEstimator(_RandomForestClass, _TpuEstimatorSupervised, _Rando
             X = inputs.host_features
             stats, n_classes = self._row_stats(inputs)
             d = X.shape[1]
-            from ..parallel.mesh import shard_array
             from ..parallel.partition import pad_rows
+            from ..parallel.partitioner import partitioner_for
 
             mesh = inputs.mesh
-            n_dev = mesh.devices.size
+            part = partitioner_for(mesh)
+            n_dev = part.num_workers
 
             def shard_fn(arr: np.ndarray):
                 padded, _, _ = pad_rows(arr, n_dev)
-                return shard_array(padded, mesh)
+                return part.shard(padded)
 
             param_sets = extra_params if extra_params is not None else [base]
             results = []

@@ -278,7 +278,7 @@ class UMAP(_UMAPClass, _TpuEstimator, _UMAPParams):
             # sparse UMAP fit keeps the CSR on host end-to-end (the kNN graph comes
             # from blocked sparse-sparse products, ops/umap_ops.sparse_knn_graph —
             # reference sparse path umap.py:955-972); no mesh staging needed
-            from ..parallel.mesh import get_mesh
+            from ..parallel.partitioner import active_partitioner
             from ..parallel.partition import PartitionDescriptor
 
             desc = PartitionDescriptor.build(
@@ -288,7 +288,7 @@ class UMAP(_UMAPClass, _TpuEstimator, _UMAPParams):
                 features=None,
                 row_weight=None,
                 desc=desc,
-                mesh=get_mesh(self.num_workers),
+                mesh=active_partitioner(self.num_workers).mesh,
                 params=dict(self._tpu_params),
                 host_features=fd.features,
                 host_label=fd.label,

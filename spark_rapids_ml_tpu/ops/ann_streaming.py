@@ -27,6 +27,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..observability import span as obs_span
+from ..parallel.partitioner import put_device_local
 from ..observability.runs import (
     WorkerScope,
     counter_inc as obs_counter_inc,
@@ -231,7 +232,7 @@ def streaming_ivfflat_build(
         Xb = np.ascontiguousarray(X[s:e], dtype=np.float32)  # noqa: fence/host-staging-copy
         if cosine:
             Xb = _normalize_batch_or_raise(Xb)
-        return kmeans_predict(jnp.asarray(Xb), centers_j)
+        return kmeans_predict(put_device_local(Xb), centers_j)
 
     def _finalize_assign(bi, s, e, out):
         assign[s:e] = np.asarray(out)
@@ -328,7 +329,7 @@ def streaming_ivfpq_build(
         Xb_enc = np.ascontiguousarray(X[s:e], np.float32)  # noqa: fence/host-staging-copy
         if cosine:
             Xb_enc = _normalize_batch_or_raise(Xb_enc)
-        resid_b = jnp.asarray(Xb_enc - coarse[assign[s:e]])
+        resid_b = put_device_local(Xb_enc - coarse[assign[s:e]])
         return [
             kmeans_predict(
                 resid_b[:, m_i * sub_d : (m_i + 1) * sub_d], cb_j[m_i]
@@ -495,7 +496,7 @@ def streaming_ivfflat_search(
     out_i = np.full((nq, k_eff), -1, np.int64)
 
     def _dispatch_search(bi, s, e):
-        qb = jnp.asarray(np.ascontiguousarray(Q[s:e], dtype=np.float32))  # noqa: fence/host-staging-copy
+        qb = put_device_local(np.ascontiguousarray(Q[s:e], dtype=np.float32))  # noqa: fence/host-staging-copy
         if probe_fused:
             from .pallas_select import fused_probe
 
@@ -506,9 +507,10 @@ def streaming_ivfflat_search(
             probe = np.asarray(
                 _probe_cells(qb, centers_j, nprobe, cn_j)
             )  # (bq, nprobe)
-        # the host gather IS the out-of-core page-in
-        probed_items = jnp.asarray(cells[probe])
-        probed_ids = jnp.asarray(cell_ids[probe])
+        # the host gather IS the out-of-core page-in; placement goes through
+        # the active Partitioner's local-device path (process-local staging)
+        probed_items = put_device_local(cells[probe])
+        probed_ids = put_device_local(cell_ids[probe])
         # span covers the fused scan+select kernel dispatch — named for what
         # it times (the standalone `knn.select`/`knn.rerank` spans are
         # reserved for separately-dispatched selection/re-rank programs)
@@ -560,12 +562,12 @@ def streaming_pq_refine(
     cand_ids = np.asarray(cand_item_ids)
 
     def _dispatch_refine(bi, s, e):
-        vecs = jnp.asarray(flat[cand_pos[s:e]])  # the host page-in
+        vecs = put_device_local(flat[cand_pos[s:e]])  # the host page-in
         with obs_span("knn.rerank", {"start": s, "rows": e - s}):
             return _refine_exact_tile(
-                jnp.asarray(np.ascontiguousarray(Q[s:e], np.float32)),  # noqa: fence/host-staging-copy
+                put_device_local(np.ascontiguousarray(Q[s:e], np.float32)),  # noqa: fence/host-staging-copy
                 vecs,
-                jnp.asarray(cand_ids[s:e]),
+                put_device_local(cand_ids[s:e]),
                 k_eff,
             )
 

@@ -41,9 +41,11 @@ from ..observability import counter_inc as obs_counter_inc
 
 __all__ = [
     "StagingPool",
+    "process_local_span",
     "report_section",
     "resolve_staging_pool_rows",
     "stage_block",
+    "stage_local_block",
 ]
 
 _device_put_copies_cache: Optional[bool] = None
@@ -171,6 +173,38 @@ def stage_block(arr: np.ndarray, s: int, e: int, dtype: Any,
     obs_counter_inc("ingest.bytes_copied", out.nbytes)
     obs_counter_inc("ingest.host_convert_s", time.perf_counter() - t0)
     return out
+
+
+def process_local_span(s: int, e: int, partitioner: Any = None
+                       ) -> Tuple[int, int]:
+    """The sub-range of global rows [s, e) owned by THIS process under the
+    active Partitioner's contiguous rank layout (docs/design.md §10): rank r
+    of P stages rows [s + r*ceil(rows/P), ...) — so in a multi-host fit no
+    host ever materializes a global batch; each process feeds only its slice
+    to `stage_block` and `Partitioner.shard_inputs` assembles the global
+    array from the per-process pieces. Single-process this is [s, e)."""
+    from ..parallel.partitioner import active_partitioner
+
+    part = partitioner if partitioner is not None else active_partitioner()
+    rows = max(0, int(e) - int(s))
+    p = max(1, int(part.process_count))
+    r = int(part.process_index)
+    per = -(-rows // p)
+    ls = min(rows, r * per)
+    le = min(rows, ls + per)
+    return int(s) + ls, int(s) + le
+
+
+def stage_local_block(arr: np.ndarray, s: int, e: int, dtype: Any,
+                      pool: Optional[StagingPool] = None, *, slot: Any = None,
+                      force_copy: bool = False,
+                      partitioner: Any = None) -> np.ndarray:
+    """`stage_block` restricted to this process's slice of global rows
+    [s, e) — the per-process local-batch ingest step of the multi-host path
+    (the zero-copy/counted-copy accounting applies unchanged to the slice)."""
+    ls, le = process_local_span(s, e, partitioner)
+    return stage_block(arr, ls, le, dtype, pool, slot=slot,
+                       force_copy=force_copy)
 
 
 def count_conversion(nbytes: int, seconds: float) -> None:

@@ -425,16 +425,11 @@ def kmeans_fit(
     from .. import observability as _obs
 
     if use_fused:
-        from jax.sharding import NamedSharding
-
+        from ..parallel.partitioner import mesh_of
         from ._precision import parity_precision
         from .pallas_kmeans import lloyd_fit_pallas
 
-        mesh = (
-            X.sharding.mesh
-            if isinstance(getattr(X, "sharding", None), NamedSharding)
-            else None
-        )
+        mesh = mesh_of(X)
         prec = (
             jax.lax.Precision.DEFAULT
             if bool(_config.get("fast_math"))

@@ -37,7 +37,7 @@ from typing import Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh
 from ..utils.jax_compat import pvary, shard_map
 
 from ._precision import FAST
@@ -332,15 +332,18 @@ def _knn_local_then_merge_fn(
             nq if nq is not None else shard_rows,
             shard_rows, d if d is not None else 1, kc,
         )
-    in_specs = (P(), P(DATA_AXIS, None), P(DATA_AXIS))
+    from ..parallel.partitioner import partitioner_for
+
+    part = partitioner_for(mesh)
+    in_specs = (part.state_spec(), part.data_spec(2), part.data_spec(1))
     if with_x2:
-        in_specs = in_specs + (P(DATA_AXIS),)
+        in_specs = in_specs + (part.data_spec(1),)
 
     @functools.partial(
         shard_map,
         mesh=mesh,
         in_specs=in_specs,
-        out_specs=P(),
+        out_specs=part.state_spec(),
         check_vma=False,  # post-all_gather results are replicated; size-1 aux axes
         # defeat the static replication checker
     )
@@ -983,15 +986,18 @@ def exact_knn_ring(
     _sel.record_selection(strategy, site="exact_knn_ring")
     _count_x2(x2_sharded, "exact_knn_ring", False)
 
-    in_specs = (P(DATA_AXIS, None), P(DATA_AXIS, None), P(DATA_AXIS))
+    from ..parallel.partitioner import partitioner_for
+
+    part = partitioner_for(mesh)
+    in_specs = (part.data_spec(2), part.data_spec(2), part.data_spec(1))
     if x2_sharded is not None:
-        in_specs = in_specs + (P(DATA_AXIS),)
+        in_specs = in_specs + (part.data_spec(1),)
 
     @functools.partial(
         shard_map,
         mesh=mesh,
         in_specs=in_specs,
-        out_specs=(P(DATA_AXIS, None), P(DATA_AXIS, None)),
+        out_specs=(part.data_spec(2), part.data_spec(2)),
     )
     def _ring(q_local, x_local, valid_local, *maybe_x2):
         rank = jax.lax.axis_index(DATA_AXIS)

@@ -37,7 +37,6 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..utils.jax_compat import shard_map
-from jax.sharding import PartitionSpec as P
 
 from ..observability import (
     convergence as obs_convergence,
@@ -81,8 +80,9 @@ def _shard_blocks(X: np.ndarray, block: int, mesh, extras=None, cache=None,
     data axis (host->device traffic stays one copy of the data per sweep; the
     per-tile merge rides ICI collectives instead), row-aligned extras shard the
     same way. `block` must be a mesh-size multiple."""
-    from ..parallel.mesh import shard_array
+    from ..parallel.partitioner import partitioner_for
 
+    part = partitioner_for(mesh)
     n = X.shape[0]
 
     def gen():
@@ -93,13 +93,13 @@ def _shard_blocks(X: np.ndarray, block: int, mesh, extras=None, cache=None,
             def build(s=s, e=e):
                 xb = np.zeros((block,) + X.shape[1:], np.float32)
                 xb[: e - s] = X[s:e]
-                xd = shard_array(xb, mesh)
+                xd = part.shard(xb)
                 obs_counter_inc("knn.x2_tile_computes")
                 devs = [xd, _tile_norms(xd)]  # norm rides the cached tuple
                 for a in extras or ():
                     ab = np.zeros((block,) + a.shape[1:], a.dtype)
                     ab[: e - s] = a[s:e]
-                    devs.append(shard_array(ab, mesh))
+                    devs.append(part.shard(ab))
                 return (s, e - s, *devs)
 
             yield _cached_tile(cache, cache_key, s // block, build)
@@ -115,7 +115,9 @@ def _mk_tile_topk_mesh(mesh, block: int, k: int, strategy: str, tile: int,
     replicated running top-k (always exact — merge_topk) — the same
     local-then-merge shape as ops/knn.py::_knn_local_then_merge_fn."""
     from ..parallel.mesh import DATA_AXIS
+    from ..parallel.partitioner import partitioner_for
 
+    part = partitioner_for(mesh)
     n_dev = mesh.devices.size
     shard_rows = block // n_dev
     k_loc = min(k, shard_rows)
@@ -124,8 +126,12 @@ def _mk_tile_topk_mesh(mesh, block: int, k: int, strategy: str, tile: int,
     @functools.partial(
         shard_map,
         mesh=mesh,
-        in_specs=(P(), P(DATA_AXIS, None), P(DATA_AXIS), P(), P(), P(), P()),
-        out_specs=(P(), P()),
+        in_specs=(
+            part.state_spec(), part.data_spec(2), part.data_spec(1),
+            part.state_spec(), part.state_spec(), part.state_spec(),
+            part.state_spec(),
+        ),
+        out_specs=(part.state_spec(), part.state_spec()),
         check_vma=False,
     )
     def f(qb, xb_local, x2_local, nv, base, best_d, best_i):
@@ -149,7 +155,9 @@ def _mk_tile_topk_mesh(mesh, block: int, k: int, strategy: str, tile: int,
 @functools.lru_cache(maxsize=8)
 def _mk_tile_count_mesh(mesh, block: int):
     from ..parallel.mesh import DATA_AXIS
+    from ..parallel.partitioner import partitioner_for
 
+    part = partitioner_for(mesh)
     n_dev = mesh.devices.size
     shard_rows = block // n_dev
 
@@ -157,8 +165,11 @@ def _mk_tile_count_mesh(mesh, block: int):
     @functools.partial(
         shard_map,
         mesh=mesh,
-        in_specs=(P(), P(DATA_AXIS, None), P(DATA_AXIS), P(), P()),
-        out_specs=P(),
+        in_specs=(
+            part.state_spec(), part.data_spec(2), part.data_spec(1),
+            part.state_spec(), part.state_spec(),
+        ),
+        out_specs=part.state_spec(),
         check_vma=False,
     )
     def f(qb, xb_local, x2_local, nv, eps2):
@@ -174,7 +185,9 @@ def _mk_tile_count_mesh(mesh, block: int):
 @functools.lru_cache(maxsize=8)
 def _mk_tile_minlabel_mesh(mesh, block: int):
     from ..parallel.mesh import DATA_AXIS
+    from ..parallel.partitioner import partitioner_for
 
+    part = partitioner_for(mesh)
     n_dev = mesh.devices.size
     shard_rows = block // n_dev
 
@@ -183,10 +196,11 @@ def _mk_tile_minlabel_mesh(mesh, block: int):
         shard_map,
         mesh=mesh,
         in_specs=(
-            P(), P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),
-            P(), P(),
+            part.state_spec(), part.data_spec(2), part.data_spec(1),
+            part.data_spec(1), part.data_spec(1),
+            part.state_spec(), part.state_spec(),
         ),
-        out_specs=P(),
+        out_specs=part.state_spec(),
         check_vma=False,
     )
     def f(qb, xb_local, x2_local, labels_local, core_local, nv, eps2):
@@ -214,6 +228,8 @@ def _device_blocks(X: np.ndarray, block: int, extras=None, cache=None,
     """Yield (start, n_valid, device_block, *device_extras) with the ragged tail
     zero-padded to `block` (ONE compiled tile shape for the whole stream).
     `extras`: list of row-aligned host arrays uploaded alongside (labels, masks)."""
+    from ..parallel.partitioner import put_device_local
+
     n = X.shape[0]
 
     def gen():
@@ -224,13 +240,13 @@ def _device_blocks(X: np.ndarray, block: int, extras=None, cache=None,
             def build(s=s, e=e):
                 xb = np.zeros((block,) + X.shape[1:], np.float32)
                 xb[: e - s] = X[s:e]
-                xd = jax.device_put(jnp.asarray(xb))
+                xd = put_device_local(xb)
                 obs_counter_inc("knn.x2_tile_computes")
                 devs = [xd, _tile_norms(xd)]  # norm rides the cached tuple
                 for a in extras or ():
                     ab = np.zeros((block,) + a.shape[1:], a.dtype)
                     ab[: e - s] = a[s:e]
-                    devs.append(jax.device_put(jnp.asarray(ab)))
+                    devs.append(put_device_local(ab))
                 return (s, e - s, *devs)
 
             yield _cached_tile(cache, cache_key, s // block, build)

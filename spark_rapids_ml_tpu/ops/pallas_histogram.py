@@ -262,13 +262,12 @@ def node_bin_histogram(
             )
 
         if mesh is not None and mesh.devices.size > 1:
-            from jax.sharding import PartitionSpec as P
+            from ..parallel.partitioner import partitioner_for
 
-            from ..parallel.mesh import DATA_AXIS
-
+            part = partitioner_for(mesh)
             return _shard_psum(
                 mesh,
-                (P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS, None)),
+                (part.data_spec(2), part.data_spec(1), part.data_spec(2)),
                 _local_hist,
             )(Xb, node_id, values)
         return _local_hist(Xb, node_id, values)
@@ -314,12 +313,11 @@ def segment_histogram(
             )
 
         if mesh is not None and mesh.devices.size > 1:
-            from jax.sharding import PartitionSpec as P
+            from ..parallel.partitioner import partitioner_for
 
-            from ..parallel.mesh import DATA_AXIS
-
+            part = partitioner_for(mesh)
             return _shard_psum(
-                mesh, (P(DATA_AXIS, None), P(DATA_AXIS, None)), _local_hist
+                mesh, (part.data_spec(2), part.data_spec(2)), _local_hist
             )(seg_ids, values)
         return _local_hist(seg_ids, values)
 

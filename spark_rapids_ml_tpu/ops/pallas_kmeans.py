@@ -359,19 +359,20 @@ def _fit_fn(
     (default-precision matmul) only steers the convergence loop. This keeps the
     fast_math contract from ops/kmeans.lloyd_fit: ranking-class matmuls may run
     at bf16, anything reported as a model attribute stays parity-precision."""
-    from jax.sharding import PartitionSpec as P
-
     from ..parallel.mesh import DATA_AXIS
+    from ..parallel.partitioner import partitioner_for
     from ._precision import pdot
 
     if mesh is not None and mesh.devices.size > 1:
         from ..utils.jax_compat import shard_map
 
+        part = partitioner_for(mesh)
+
         @functools.partial(
             shard_map,
             mesh=mesh,
-            in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P()),
-            out_specs=(P(), P(), P()),
+            in_specs=(part.data_spec(2), part.data_spec(1), part.state_spec()),
+            out_specs=(part.state_spec(), part.state_spec(), part.state_spec()),
             check_vma=False,
         )
         def step(x_local, w_local, centers):

@@ -247,15 +247,22 @@ def normal_eq_prefix_mask(
     """
     if mesh is not None and mesh.devices.size > 1:
         from ..utils.jax_compat import shard_map
-        from jax.sharding import PartitionSpec as P
 
         from ..parallel.mesh import DATA_AXIS
+        from ..parallel.partitioner import partitioner_for
+
+        part = partitioner_for(mesh)
 
         @functools.partial(
             shard_map,
             mesh=mesh,
-            in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS)),
-            out_specs=(P(), P(), P(), P()),
+            in_specs=(part.data_spec(2), part.data_spec(1), part.data_spec(1)),
+            out_specs=(
+                part.state_spec(),
+                part.state_spec(),
+                part.state_spec(),
+                part.state_spec(),
+            ),
             check_vma=False,
         )
         def run(x_local, y_local, w_local):
@@ -306,15 +313,17 @@ def covariance_prefix_mask(
     """
     if mesh is not None and mesh.devices.size > 1:
         from ..utils.jax_compat import shard_map
-        from jax.sharding import PartitionSpec as P
 
         from ..parallel.mesh import DATA_AXIS
+        from ..parallel.partitioner import partitioner_for
+
+        part = partitioner_for(mesh)
 
         @functools.partial(
             shard_map,
             mesh=mesh,
-            in_specs=(P(DATA_AXIS, None), P(DATA_AXIS)),
-            out_specs=(P(), P(), P()),
+            in_specs=(part.data_spec(2), part.data_spec(1)),
+            out_specs=(part.state_spec(), part.state_spec(), part.state_spec()),
             check_vma=False,
         )
         def run(x_local, w_local):

@@ -158,11 +158,12 @@ def _batch_stream(n: int, batch_rows: int, mesh, slicer, start_row: int = 0,
     (`stream.upload_batches`/`stream.upload_bytes`) and timed
     (`stream.ingest_s.<site>` in span_totals) — the evidence that passes 2..N
     of a cached fit stop paying host->device ingest."""
-    from ..parallel.mesh import shard_array
     from ..parallel.partition import pad_rows
+    from ..parallel.partitioner import partitioner_for
 
     from .device_cache import cached_build
 
+    part = partitioner_for(mesh) if mesh is not None else None
     for s in range(start_row, n, batch_rows):
         e = min(s + batch_rows, n)
         batch_index = s // batch_rows
@@ -170,13 +171,13 @@ def _batch_stream(n: int, batch_rows: int, mesh, slicer, start_row: int = 0,
 
         def build(s=s, e=e):
             arrays = slicer(s, e)
-            if mesh is not None:
+            if part is not None:
                 X_, *extras = arrays
-                Xp, pad_w, extras_p = pad_rows(X_, mesh.devices.size, *extras)
+                Xp, pad_w, extras_p = pad_rows(X_, part.num_workers, *extras)
                 *mid, wv = extras_p
-                out = [shard_array(Xp, mesh)]
-                out += [shard_array(a, mesh) for a in mid]
-                out.append(shard_array(pad_w * wv, mesh))
+                out = [part.shard(Xp)]
+                out += [part.shard(a) for a in mid]
+                out.append(part.shard(pad_w * wv))
                 return tuple(out)
             return tuple(jnp.asarray(a) for a in arrays)
 

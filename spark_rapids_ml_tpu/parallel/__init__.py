@@ -8,4 +8,20 @@ from .mesh import (
     shard_array,
 )
 from .partition import PartitionDescriptor, even_partition_sizes, pad_rows
-from .bootstrap import init_process_group
+from .bootstrap import init_from_env, init_process_group, reset_process_group
+from .partitioner import (
+    DataParallelPartitioner,
+    Partitioner,
+    SPMDPartitioner,
+    active_partitioner,
+    mesh_of,
+    partitioner_for,
+    put_device_local,
+    replicate_rows,
+    reset_partitioner,
+    resolve_batch_rows_per_process,
+    resolve_feature_axis,
+    set_partitioner,
+    shard_rows,
+    use_partitioner,
+)

@@ -43,6 +43,17 @@ def init_process_group(
         return
     logger = get_logger("bootstrap")
 
+    if coordinator_address is None and allgather_fn is None:
+        # env-driven bootstrap: a launcher (mpirun wrapper, k8s pod spec,
+        # the CI multihost smoke) exports the rendezvous instead of running
+        # a barrier allGather
+        coordinator_address = os.environ.get("SRML_TPU_COORDINATOR") or None
+        if coordinator_address is not None:
+            if num_processes is None:
+                num_processes = int(os.environ.get("SRML_TPU_NUM_PROCESSES", "1"))
+            if process_id is None:
+                process_id = int(os.environ.get("SRML_TPU_PROCESS_ID", "0"))
+
     if coordinator_address is None and allgather_fn is not None:
         import socket
 
@@ -73,6 +84,14 @@ def init_process_group(
         coordinator_address,
     )
     _initialized = True
+
+
+def init_from_env() -> bool:
+    """Bootstrap from the SRML_TPU_COORDINATOR / SRML_TPU_NUM_PROCESSES /
+    SRML_TPU_PROCESS_ID environment (the control-plane-free launcher path).
+    Returns True when a multi-process group was (or already is) up."""
+    init_process_group()
+    return jax.process_count() > 1
 
 
 def reset_process_group() -> None:

@@ -61,9 +61,17 @@ def replicated(mesh: Mesh) -> NamedSharding:
 
 
 def shard_array(x: np.ndarray, mesh: Mesh) -> jax.Array:
-    """Place a host array on the mesh with rows sharded across the data axis."""
-    return jax.device_put(x, row_sharding(mesh, x.ndim))
+    """Place a host array on the mesh with rows sharded across the data axis.
+
+    Back-compat shim: placement is owned by the Partitioner
+    (parallel/partitioner.py) — this delegates so a mesh threaded through an
+    op still resolves to Partitioner-owned shardings."""
+    from .partitioner import shard_rows
+
+    return shard_rows(x, mesh)
 
 
 def replicate_array(x: np.ndarray, mesh: Mesh) -> jax.Array:
-    return jax.device_put(x, replicated(mesh))
+    from .partitioner import replicate_rows
+
+    return replicate_rows(x, mesh)

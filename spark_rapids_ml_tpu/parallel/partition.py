@@ -44,13 +44,28 @@ class PartitionDescriptor:
         padded_m: int = -1,
     ) -> "PartitionDescriptor":
         parts = [(r, int(sz)) for r, sz in enumerate(partition_rows)]
+        m = int(sum(partition_rows))
+        n = int(total_cols)
+        if padded_m < 0:
+            # Callers that skip pad_rows (pre-padded global arrays, ragged
+            # barrier partitions) used to leak the -1 sentinel into fit
+            # arithmetic. Compute the real padded height: every rank pads its
+            # rows to the ragged MAX rounded up to the sublane tile (8), so
+            # the global padded height is ranks * that — identical to
+            # pad_rows' result for even splits.
+            max_rank = max((int(sz) for sz in partition_rows), default=0)
+            per_rank = ((max_rank + 7) // 8) * 8
+            padded_m = len(parts) * per_rank
+        if nnz < 0:
+            # dense inputs: every real element is a stored element
+            nnz = m * n
         return cls(
             parts_rank_size=parts,
-            m=int(sum(partition_rows)),
-            n=int(total_cols),
+            m=m,
+            n=n,
             rank=rank,
-            nnz=nnz,
-            padded_m=padded_m,
+            nnz=int(nnz),
+            padded_m=int(padded_m),
         )
 
 

@@ -160,12 +160,29 @@ class ReplicaFleet:
     stream) — the fleet never touches model internals itself."""
 
     def __init__(self, name: str, n_cols: int, n_replicas: int,
-                 spawn: Callable[[int], ReplicaHandle],
+                 spawn: Callable[..., ReplicaHandle],
                  retire: Callable[[int], None]):
         self.name = name
         self.n_cols = int(n_cols)
         self._spawn = spawn
         self._retire = retire
+        # disjoint device groups drawn from the active Partitioner's mesh —
+        # NOT the raw local-device list — so a pod-sliced mesh hands each
+        # replica its slice of this host (parallel/partitioner.py)
+        from ..parallel.partitioner import active_partitioner
+
+        self.device_groups = active_partitioner().replica_device_groups(
+            max(1, int(n_replicas))
+        )
+        # spawn callbacks predating device groups take only the index
+        import inspect
+
+        try:
+            self._spawn_takes_devices = (
+                len(inspect.signature(spawn).parameters) >= 2
+            )
+        except (TypeError, ValueError):  # pragma: no cover — builtins
+            self._spawn_takes_devices = False
         self._lock = threading.RLock()
         self._stop = False
         self._seq = 0
@@ -190,7 +207,10 @@ class ReplicaFleet:
         """Build (or rebuild) one replica from the registry's pinned weights:
         spawn the entry (upload + AOT pre-warm), wrap its execute with the
         chaos/liveness guard, start a fresh dispatcher."""
-        handle = self._spawn(rep.index)
+        if self._spawn_takes_devices:
+            handle = self._spawn(rep.index, self.device_groups[rep.index])
+        else:
+            handle = self._spawn(rep.index)
         rep.batcher = MicroBatcher(
             self.name, self.n_cols,
             execute=self._wrap_execute(rep, handle.execute),
@@ -752,6 +772,7 @@ class ReplicaFleet:
                 "consec_failures": rep.consec_failures,
                 "restarts": rep.restarts,
                 "batches": rep.batches,
+                "devices": [str(d) for d in self.device_groups[rep.index]],
             })
         return out
 

@@ -58,17 +58,38 @@ from .fleet import ReplicaFleet, ReplicaHandle, resolve_replicas
 _logger = get_logger("serving.registry")
 
 
+def _upload_attrs(entry: "_ServedModel") -> Tuple[Any, ...]:
+    """Host->device weight upload for one entry, honoring its pinned device
+    group (TPU fleets) or the default device (everything else)."""
+    import jax
+    import jax.numpy as jnp
+
+    if entry.devices and entry.devices[0].platform == "tpu":
+        dev = entry.devices[0]
+        return tuple(
+            jax.device_put(entry.host_attrs[n], dev) for n in entry.attr_names
+        )
+    return tuple(jnp.asarray(entry.host_attrs[n]) for n in entry.attr_names)
+
+
 class _ServedModel:
     """One registered model: the live model object, host copies of its
     device-consumed attributes, the bucket table, and its micro-batcher."""
 
     def __init__(self, name: str, model: Any, attr_names: Tuple[str, ...],
-                 n_cols: int, buckets: Tuple[int, ...]):
+                 n_cols: int, buckets: Tuple[int, ...],
+                 devices: Optional[Tuple[Any, ...]] = None):
         self.name = name
         self.model = model
         self.attr_names = attr_names
         self.n_cols = int(n_cols)
         self.buckets = buckets
+        # partitioner-drawn device group this entry's weight stream pins to
+        # (fleet replicas; None = default device). Pinning engages on TPU
+        # only: per-device executables are the price of real failure domains
+        # there, while the CPU/emulated fleet keeps the shared default device
+        # so replica pre-warms stay zero-compile (the §7c CI assertion)
+        self.devices = devices
         self.cache_key = ("serving_model", name)
         # host originals: the reload source after eviction, and what the
         # model's attribute dict holds between batches
@@ -204,9 +225,8 @@ class ModelRegistry:
             # respawn — add zero compiles.
             entry.fleet = ReplicaFleet(
                 name, n_cols, n_replicas,
-                spawn=lambda i, _e=entry, _w=do_warm: self._spawn_replica(
-                    _e, i, _w
-                ),
+                spawn=lambda i, devices=None, _e=entry, _w=do_warm:
+                    self._spawn_replica(_e, i, _w, devices),
                 retire=lambda i, _e=entry: self._drop_replica(_e, i),
             )
         else:
@@ -253,7 +273,8 @@ class ModelRegistry:
     # ---------------------------------------------------------- fleet replicas
 
     def _spawn_replica(self, parent: _ServedModel, index: int,
-                       do_warm: bool) -> ReplicaHandle:
+                       do_warm: bool,
+                       devices: Optional[Tuple[Any, ...]] = None) -> ReplicaHandle:
         """Fleet spawn callback: build replica `index` of a served model from
         the parent's CURRENT pinned weights — shallow model clone with its own
         attribute dict (install/restore never crosses replicas), its own HBM
@@ -268,7 +289,7 @@ class ModelRegistry:
         )
         rentry = _ServedModel(
             f"{parent.name}#r{index}", clone, attr_names,
-            parent.n_cols, parent.buckets,
+            parent.n_cols, parent.buckets, devices=devices,
         )
         with self._cache_lock:
             self._ensure_resident(rentry)
@@ -297,8 +318,6 @@ class ModelRegistry:
         the attribute dict, re-derive the device attr set, and swap the
         replica's cached device tuple in place (replace() keeps in-flight
         pins, exactly like the parent refresh path)."""
-        import jax.numpy as jnp
-
         with rentry.exec_lock:
             rentry.model._model_attributes = dict(
                 parent.model._model_attributes
@@ -317,10 +336,7 @@ class ModelRegistry:
                 for v in rentry.host_attrs.values()
             ))
             with self._cache_lock:
-                tup = tuple(
-                    jnp.asarray(rentry.host_attrs[n])
-                    for n in rentry.attr_names
-                )
+                tup = _upload_attrs(rentry)
                 rentry.uploads += 1
                 rentry.was_cached = self._cache.replace(
                     rentry.cache_key, 0, tup
@@ -345,11 +361,7 @@ class ModelRegistry:
         tup = self._cache.get(entry.cache_key, 0)
         if tup is not None:
             return tup
-        import jax.numpy as jnp
-
-        tup = tuple(
-            jnp.asarray(entry.host_attrs[n]) for n in entry.attr_names
-        )
+        tup = _upload_attrs(entry)
         entry.uploads += 1
         if entry.was_cached:
             # it WAS resident and is gone: a genuine eviction-driven reload

@@ -123,7 +123,7 @@ def _barrier_train_udf(estimator_payload: bytes, run_id: str = None,
 
         from ..observability import span as _obs_span, worker_scope
         from ..parallel.bootstrap import init_process_group
-        from ..parallel.mesh import get_mesh
+        from ..parallel.partitioner import active_partitioner
 
         est = pickle.loads(estimator_payload)
         ctx = BarrierTaskContext.get()
@@ -133,8 +133,8 @@ def _barrier_train_udf(estimator_payload: bytes, run_id: str = None,
         with worker_scope(rank=rank, run_id=run_id,
                           traceparent=traceparent) as wscope:
             attrs = _barrier_task_body(
-                est, ctx, rank, n_tasks, pdf_iter, init_process_group, get_mesh,
-                _obs_span,
+                est, ctx, rank, n_tasks, pdf_iter, init_process_group,
+                active_partitioner, _obs_span,
             )
         # every rank yields exactly one row: rank 0 the model payload, everyone
         # their metrics snapshot. A None in the binary `model` column is a null
@@ -169,7 +169,7 @@ def _features_nbytes(features: Any) -> Any:
 
 
 def _barrier_task_body(est, ctx, rank, n_tasks, pdf_iter, init_process_group,
-                       get_mesh, _obs_span):
+                       active_partitioner, _obs_span):
     """One barrier task's work, returning the fit-attribute dict (meaningful on
     rank 0). Split from the generator so the task's worker_scope closes — with a
     complete metrics snapshot — before any output row is yielded."""
@@ -286,28 +286,24 @@ def _barrier_task_body(est, ctx, rank, n_tasks, pdf_iter, init_process_group,
         reset_process_group()  # drop any partial link before re-probing
         policy.sleep(failures, "barrier_init")
 
-    # global mesh over the pod; every host pads its rows to the common local
-    # size (XLA needs equal shards), real rows marked by the weight vector
-    import jax
-
-    mesh = get_mesh()
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    # global mesh over the pod, owned by the active Partitioner; every host
+    # pads its rows to the common local size (XLA needs equal shards), real
+    # rows marked by the weight vector. shard_inputs stages ONLY this
+    # process's local rows (make_array_from_process_local_data) — no host
+    # ever gathers a global array.
+    part = active_partitioner()
+    mesh = part.mesh
 
     max_rows = max(i.n_rows for i in infos)
-    local_devices = jax.local_device_count()
-    pad_to = -(-max_rows // (8 * local_devices)) * (8 * local_devices)
+    pad_to = part.local_pad_rows(max_rows)
     w_local = np.zeros((pad_to,), np.float32)
     w_local[: fd.n_rows] = 1.0 if fd.weight is None else fd.weight
     total_rows = sum(i.n_rows for i in infos)
 
-    sharding2 = NamedSharding(mesh, P("data", None))
-    sharding1 = NamedSharding(mesh, P("data"))
-    w_global = jax.make_array_from_process_local_data(sharding1, w_local)
-    label_global = None
+    label_local = None
     if fd.label is not None:
-        y_local = np.zeros((pad_to,), np.float32)
-        y_local[: fd.n_rows] = fd.label
-        label_global = jax.make_array_from_process_local_data(sharding1, y_local)
+        label_local = np.zeros((pad_to,), np.float32)
+        label_local[: fd.n_rows] = fd.label
 
     if sparse_fit:
         # pad the local ELL width to the GLOBAL max so every host contributes
@@ -317,8 +313,9 @@ def _barrier_task_body(est, ctx, rank, n_tasks, pdf_iter, init_process_group,
         i_local = np.zeros((pad_to, r_global), ell_idx.dtype)
         v_local[: fd.n_rows, : ell_vals.shape[1]] = ell_vals
         i_local[: fd.n_rows, : ell_idx.shape[1]] = ell_idx
-        values_global = jax.make_array_from_process_local_data(sharding2, v_local)
-        indices_global = jax.make_array_from_process_local_data(sharding2, i_local)
+        w_global, label_global, values_global, indices_global = part.shard_inputs(
+            w_local, label_local, v_local, i_local
+        )
         fit_inputs = est._build_sparse_fit_inputs_from_global(
             values_global, indices_global, w_global, label_global, total_rows,
             fd.n_cols, mesh,
@@ -329,7 +326,9 @@ def _barrier_task_body(est, ctx, rank, n_tasks, pdf_iter, init_process_group,
     else:
         X_local = np.zeros((pad_to, fd.n_cols), np.float32)
         X_local[: fd.n_rows] = np.asarray(fd.features, dtype=np.float32)
-        X_global = jax.make_array_from_process_local_data(sharding2, X_local)
+        w_global, label_global, X_global = part.shard_inputs(
+            w_local, label_local, X_local
+        )
         fit_inputs = est._build_fit_inputs_from_global(
             X_global, w_global, label_global, total_rows, mesh,
             rank_rows=[i.n_rows for i in infos],
